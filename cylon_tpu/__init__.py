@@ -1,0 +1,28 @@
+"""cylon_tpu — a TPU-native distributed dataframe engine.
+
+A ground-up rebuild of the capabilities of Cylon (distributed relational
+operators over columnar tables) designed for TPU: columns live in HBM as
+device arrays, relational kernels are XLA/Pallas programs, and the
+row-shuffle layer rides ICI collectives (`lax.all_to_all` under `shard_map`
+over a `jax.sharding.Mesh`) instead of MPI point-to-point messaging.
+
+Layer map (tpu-native mirror of SURVEY.md §1):
+
+    L4  api/          user-facing ops: join/union/…, distributed variants
+    L3  ops/          XLA kernels: hash, sort, gather, join, set ops, groupby
+    L2  parallel/     shuffle = two-phase static-shape all_to_all; dist tables
+    L1  (XLA)         collectives over ICI/DCN — no user-space progress engine
+    L0  context.py    CylonContext over a jax Mesh; native/ host runtime
+"""
+
+from .context import CylonContext
+from .dtypes import DataType, Layout, Type
+from .status import Code, CylonError, Status
+from .table import Column, Table
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CylonContext", "Table", "Column", "Status", "Code", "CylonError",
+    "DataType", "Type", "Layout", "__version__",
+]

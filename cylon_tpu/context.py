@@ -1,0 +1,154 @@
+"""Runtime context: device mesh, rank/world, config map, barrier.
+
+Mirrors the reference's ``CylonContext`` (reference:
+cpp/src/cylon/ctx/cylon_context.hpp:29-138, ctx/cylon_context.cpp:21-101):
+``Init()`` = local single-device, ``InitDistributed(config)`` = distributed.
+Where the reference wraps an MPI communicator, we wrap a 1-D
+``jax.sharding.Mesh``; each mesh device plays the role of an MPI rank.
+Collectives ride ICI/DCN via XLA (`shard_map` + `lax.all_to_all`/`psum`),
+so there is no Channel/AllToAll progress engine and no ``edge_id`` tag
+mechanism (XLA program order serializes collectives) — see SURVEY.md §2.4.
+
+The ``GetNextSequence`` edge-id counter survives only for API parity.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXIS = "p"  # the row-partition axis: the engine's one parallelism axis
+
+
+class CylonContext:
+    """Entry point to the runtime.
+
+    ``CylonContext()`` / ``CylonContext('local')``  -> single device
+    ``CylonContext('tpu')`` / ``CylonContext('mpi')`` -> all visible devices
+    ``CylonContext({'backend': 'tpu', 'devices': [...]})`` -> explicit subset
+
+    ('mpi' is accepted for pycylon source compatibility; it means
+    "distributed over whatever the platform gives us", which here is the
+    TPU/CPU device mesh rather than an MPI world.)
+    """
+
+    def __init__(self, config: Any = None, devices: Optional[Sequence[jax.Device]] = None):
+        if isinstance(config, dict):
+            backend = config.get("backend", "tpu")
+            devices = config.get("devices", devices)
+        else:
+            backend = config
+        self._config: Dict[str, str] = {}
+        self._sequence = itertools.count()
+        if backend in (None, "local"):
+            devs = [jax.devices()[0]] if devices is None else list(devices)[:1]
+            self._distributed = False
+        elif backend in ("tpu", "mpi", "dist", "cpu"):
+            devs = list(jax.devices()) if devices is None else list(devices)
+            self._distributed = True
+        else:
+            raise ValueError(f"unknown backend config {config!r}")
+        self._devices = devs
+        self._mesh = Mesh(np.array(devs), (MESH_AXIS,))
+        self._finalized = False
+
+    # -- reference API parity (ctx/cylon_context.hpp) -----------------------
+
+    @staticmethod
+    def Init() -> "CylonContext":
+        return CylonContext(None)
+
+    @staticmethod
+    def InitDistributed(config: Any = "tpu") -> "CylonContext":
+        return CylonContext(config if config is not None else "tpu")
+
+    def get_rank(self) -> int:
+        """Host process index (0 in single-controller SPMD).
+
+        Per-device "ranks" live inside shard_map as lax.axis_index; at the
+        host level, this single process drives all local devices.
+        reference: ctx/cylon_context.cpp (GetRank)
+        """
+        return jax.process_index()
+
+    def get_world_size(self) -> int:
+        """Number of workers == number of mesh devices.
+
+        reference: ctx/cylon_context.cpp (GetWorldSize); one TPU device
+        plays the role of one MPI rank.
+        """
+        return len(self._devices)
+
+    def get_neighbours(self, include_self: bool = False) -> List[int]:
+        """reference: ctx/cylon_context.cpp (GetNeighbours)."""
+        w = self.get_world_size()
+        r = self.get_rank()
+        return [i for i in range(w) if include_self or i != r]
+
+    def add_config(self, key: str, value: str) -> None:
+        self._config[key] = value
+
+    def get_config(self, key: str, default: str = "") -> str:
+        return self._config.get(key, default)
+
+    def get_next_sequence(self) -> int:
+        """Monotone op id (reference edge/tag ids, ctx/cylon_context.cpp:99-101).
+
+        Unused for communication — XLA orders collectives — but kept for
+        tracing/span labels and API parity.
+        """
+        return next(self._sequence)
+
+    def barrier(self) -> None:
+        """Synchronize: block host until all devices drained a tiny psum.
+
+        reference: net/mpi/mpi_communicator.cpp (Barrier)
+        """
+        from jax import shard_map
+        import jax.numpy as jnp
+
+        if not self._distributed or len(self._devices) == 1:
+            jax.effects_barrier()
+            return
+        ones = jax.device_put(
+            jnp.ones((len(self._devices),), jnp.int32),
+            NamedSharding(self._mesh, P(MESH_AXIS)),
+        )
+        out = shard_map(
+            lambda x: jax.lax.psum(x, MESH_AXIS),
+            mesh=self._mesh, in_specs=P(MESH_AXIS), out_specs=P(),
+        )(ones)
+        jax.block_until_ready(out)
+
+    def finalize(self) -> None:
+        self._finalized = True
+
+    def is_distributed(self) -> bool:
+        return self._distributed
+
+    # -- mesh accessors ------------------------------------------------------
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def devices(self) -> List[jax.Device]:
+        return list(self._devices)
+
+    @property
+    def axis(self) -> str:
+        return MESH_AXIS
+
+    def sharding(self, spec: Optional[P] = None) -> NamedSharding:
+        return NamedSharding(self._mesh, spec if spec is not None else P(MESH_AXIS))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self._mesh, P())
+
+    def __repr__(self) -> str:
+        kind = "distributed" if self._distributed else "local"
+        return f"CylonContext({kind}, world={self.get_world_size()}, platform={self._devices[0].platform})"

@@ -1,0 +1,9 @@
+"""Native (C++) host runtime with pure-Python fallbacks.
+
+The reference's host runtime is C++ (memory pool, kernels, CSV); our device
+compute path is XLA, but the host data-loader hot path (string dictionary
+encoding, murmur3 hashing of raw bytes, staging buffers) is implemented in
+C++ (`_cylon_native` extension, see cylon_tpu/native/src/) with numpy
+fallbacks so the package works before the extension is built.
+"""
+from . import runtime  # noqa: F401

@@ -1,0 +1,150 @@
+"""Host-side native runtime dispatch: C++ extension if built, numpy otherwise.
+
+reference parity targets:
+ * murmur3_32 — reference util/murmur3.cpp (MurmurHash3_x86_32), used by the
+   partition kernels (arrow/arrow_partition_kernels.hpp:28-156);
+ * dictionary_encode — host leg of the string strategy (SURVEY.md §7 "Strings
+   on TPU"): sorted unique + int32 codes;
+ * staging arena — reference ctx/memory_pool.hpp:25-66 (MemoryPool), used for
+   pinned host staging of H2D batches.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:  # built by `python setup.py build_ext --inplace` (see repo setup.py)
+    from cylon_tpu.native import _cylon_native as _ext  # type: ignore
+except ImportError:  # pragma: no cover - exercised when extension missing
+    _ext = None
+
+
+def have_native() -> bool:
+    return _ext is not None
+
+
+# ---------------------------------------------------------------------------
+# dictionary encode
+# ---------------------------------------------------------------------------
+
+def dictionary_encode(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """values (1-D object/str/bytes array) -> (int32 codes, sorted dictionary).
+
+    Sorted dictionary ⇒ codes preserve lexical order.
+    """
+    if len(values) == 0:
+        return np.empty((0,), np.int32), np.empty((0,), object)
+    if _ext is not None and values.dtype == object:
+        try:
+            codes, dictionary = _ext.dictionary_encode(values)
+            return codes, dictionary
+        except TypeError:
+            pass
+    dictionary, codes = np.unique(values, return_inverse=True)
+    return codes.astype(np.int32), dictionary
+
+
+# ---------------------------------------------------------------------------
+# murmur3 (host reference implementation; device version is ops/hash.py)
+# ---------------------------------------------------------------------------
+
+def _rotl32(x: np.ndarray, r: int) -> np.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _fmix32(h: np.ndarray) -> np.ndarray:
+    h ^= h >> np.uint32(16)
+    h *= np.uint32(0x85EBCA6B)
+    h ^= h >> np.uint32(13)
+    h *= np.uint32(0xC2B2AE35)
+    h ^= h >> np.uint32(16)
+    return h
+
+
+def murmur3_32_u32(keys: np.ndarray, seed: int = 0) -> np.ndarray:
+    """MurmurHash3_x86_32 of each 4-byte little-endian word, vectorized.
+
+    Matches reference util/murmur3.cpp for len==4 inputs — the case the
+    partition kernels use for 32-bit keys.
+    """
+    if _ext is not None:
+        return _ext.murmur3_32_u32(np.ascontiguousarray(keys, np.uint32),
+                                   np.uint32(seed))
+    k = np.asarray(keys, np.uint32).copy()
+    with np.errstate(over="ignore"):
+        c1, c2 = np.uint32(0xCC9E2D51), np.uint32(0x1B873593)
+        k *= c1
+        k = _rotl32(k, 15)
+        k *= c2
+        h = np.full_like(k, np.uint32(seed))
+        h ^= k
+        h = _rotl32(h, 13)
+        h = h * np.uint32(5) + np.uint32(0xE6546B64)
+        h ^= np.uint32(4)  # length tail
+        return _fmix32(h)
+
+
+def murmur3_32_u64(keys: np.ndarray, seed: int = 0) -> np.ndarray:
+    """MurmurHash3_x86_32 of each 8-byte little-endian word (two blocks)."""
+    if _ext is not None:
+        return _ext.murmur3_32_u64(np.ascontiguousarray(keys, np.uint64),
+                                   np.uint32(seed))
+    kk = np.asarray(keys, np.uint64)
+    lo = (kk & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (kk >> np.uint64(32)).astype(np.uint32)
+    with np.errstate(over="ignore"):
+        c1, c2 = np.uint32(0xCC9E2D51), np.uint32(0x1B873593)
+        h = np.full(kk.shape, np.uint32(seed))
+        for k in (lo, hi):
+            k = k * c1
+            k = _rotl32(k, 15)
+            k *= c2
+            h ^= k
+            h = _rotl32(h, 13)
+            h = h * np.uint32(5) + np.uint32(0xE6546B64)
+        h ^= np.uint32(8)
+        return _fmix32(h)
+
+
+# ---------------------------------------------------------------------------
+# staging arena (host pinned buffers for H2D batches)
+# ---------------------------------------------------------------------------
+
+class StagingArena:
+    """Bump-pointer host arena for assembling H2D transfer batches.
+
+    reference: ctx/memory_pool.hpp:25-66 — pluggable allocator; native
+    implementation lives in the C++ extension, fallback is a numpy arena.
+    """
+
+    def __init__(self, capacity_bytes: int = 64 << 20):
+        if _ext is not None:
+            self._impl = _ext.StagingArena(capacity_bytes)
+            self._buf = None
+        else:
+            self._impl = None
+            self._buf = np.empty((capacity_bytes,), np.uint8)
+            self._off = 0
+
+    def allocate(self, nbytes: int) -> memoryview:
+        if self._impl is not None:
+            return self._impl.allocate(nbytes)
+        aligned = (nbytes + 63) & ~63
+        if self._off + aligned > self._buf.size:
+            raise MemoryError("staging arena exhausted")
+        view = memoryview(self._buf[self._off:self._off + nbytes])
+        self._off += aligned
+        return view
+
+    def reset(self) -> None:
+        if self._impl is not None:
+            self._impl.reset()
+        else:
+            self._off = 0
+
+    @property
+    def bytes_in_use(self) -> int:
+        if self._impl is not None:
+            return self._impl.bytes_in_use()
+        return self._off

@@ -1,0 +1,73 @@
+"""Status / error-code model.
+
+Mirrors the reference's ``Status`` (code + message) error propagation
+(reference: cpp/src/cylon/status.hpp:21-63, cpp/src/cylon/code.cpp), which in
+turn mirrors Arrow's status codes.  Unlike the reference we also raise typed
+Python exceptions at the binding surface — Python callers get exceptions,
+engine-internal code can use Status returns where convenient.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Code(enum.IntEnum):
+    """Error codes (reference: cpp/src/cylon/code.cpp)."""
+
+    OK = 0
+    OutOfMemory = 1
+    KeyError = 2
+    TypeError = 3
+    Invalid = 4
+    IOError = 5
+    CapacityError = 6
+    IndexError = 7
+    UnknownError = 9
+    NotImplemented = 10
+    SerializationError = 11
+    RError = 13
+    CodeGenError = 40
+    ExpressionValidationError = 41
+    ExecutionError = 42
+    AlreadyExists = 45
+
+
+@dataclass(frozen=True)
+class Status:
+    """Outcome of an engine operation: code + human message.
+
+    reference: cpp/src/cylon/status.hpp:21-63
+    """
+
+    code: Code = Code.OK
+    msg: str = ""
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(Code.OK, "")
+
+    @staticmethod
+    def error(code: Code, msg: str) -> "Status":
+        return Status(code, msg)
+
+    def is_ok(self) -> bool:
+        return self.code == Code.OK
+
+    def get_code(self) -> int:
+        return int(self.code)
+
+    def get_msg(self) -> str:
+        return self.msg
+
+    def raise_if_error(self) -> None:
+        if not self.is_ok():
+            raise CylonError(self)
+
+
+class CylonError(RuntimeError):
+    """Exception carrying a Status, raised at the Python API boundary."""
+
+    def __init__(self, status: Status):
+        super().__init__(f"[{status.code.name}] {status.msg}")
+        self.status = status

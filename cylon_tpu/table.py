@@ -1,0 +1,319 @@
+"""Device-resident columnar Table.
+
+Design (SURVEY.md §7): the reference keeps ``arrow::Table`` in host RAM behind
+a global uuid→table registry (reference: cpp/src/cylon/table_api.cpp:45-73,
+table.hpp:39-278).  Here a Table is a plain Python object holding **device
+arrays**: per column a fixed-width data array + optional validity mask; no
+registry, no mutex (the registry existed only to serve id-based FFI — the
+pycylon compat layer keeps ids at that boundary only).
+
+Strings/binary are **dictionary-encoded at ingest** (host side): the device
+stores int32 codes, the host stores the dictionary.  The dictionary is sorted,
+so code order == lexical order — sorts and comparisons work directly on codes.
+Cross-table ops on string columns first *unify* dictionaries (sorted union +
+code remap) so equal strings have equal codes in both tables.
+
+Null semantics follow the reference: hash of null is 0 and a −1 gather index
+appends null (reference: arrow/arrow_partition_kernels.hpp:55-57,93-95,
+util/copy_arrray.cpp:38-43).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .context import CylonContext
+from .dtypes import (DataType, Type, device_dtype, from_arrow_type,
+                     is_dictionary_encoded, to_arrow_type)
+from .status import Code, CylonError, Status
+
+
+@dataclass
+class Column:
+    """One column: logical type + device data (+ validity, + host dictionary).
+
+    reference: cpp/src/cylon/column.hpp:163-193 — but data lives in HBM.
+    """
+
+    name: str
+    dtype: DataType
+    data: jax.Array                      # [n] device array (codes for strings)
+    validity: Optional[jax.Array] = None  # [n] bool device array; None = all valid
+    dictionary: Optional[np.ndarray] = None  # host payload for STRING/BINARY
+    arrow_type: Any = None               # original pyarrow type for round-trip
+
+    def __post_init__(self):
+        if is_dictionary_encoded(self.dtype.type) and self.dictionary is None:
+            self.dictionary = np.empty((0,), dtype=object)
+
+    @property
+    def length(self) -> int:
+        return int(self.data.shape[0])
+
+    def has_nulls(self) -> bool:
+        return self.validity is not None
+
+    def with_data(self, data, validity="__same__") -> "Column":
+        v = self.validity if validity == "__same__" else validity
+        return replace(self, data=data, validity=v)
+
+
+def _combine(chunked):
+    import pyarrow as pa
+
+    if isinstance(chunked, pa.ChunkedArray):
+        return chunked.combine_chunks()
+    return chunked
+
+
+def _typed_numpy(arr, npd: np.dtype) -> np.ndarray:
+    """Arrow array -> numpy of exactly ``npd`` without lossy intermediates.
+
+    Temporal arrays come back as datetime64/timedelta64; reinterpret the
+    underlying int64 rather than casting.  Everything else is a typed copy.
+    """
+    npv = arr.to_numpy(zero_copy_only=False)
+    if npv.dtype.kind in "mM":
+        npv = npv.view(np.int64)
+    return np.ascontiguousarray(npv).astype(npd, copy=False)
+
+
+def _encode_dictionary(arr) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Host-side sorted-dictionary encode of a string/binary arrow array.
+
+    Returns (codes int32, dictionary, validity-or-None).  Sorted dictionary ⇒
+    code order == lexical order, so device-side sort/compare on codes is
+    order-correct.  Uses the native C++ encoder when built (cylon_tpu.native),
+    falling back to numpy.
+    """
+    values = arr.to_numpy(zero_copy_only=False)  # object ndarray, None for null
+    mask = np.array([v is None for v in values], dtype=bool)
+    valid_values = values[~mask]
+    from .native import runtime as _native
+    codes_valid, dictionary = _native.dictionary_encode(valid_values)
+    codes = np.zeros(len(values), dtype=np.int32)
+    codes[~mask] = codes_valid
+    validity = None if not mask.any() else ~mask
+    return codes, dictionary, validity
+
+
+class Table:
+    """Immutable columnar table on device.
+
+    reference: cpp/src/cylon/table.hpp:39-278 (handle façade) — here the
+    object *is* the table; ops produce new Tables.
+    """
+
+    def __init__(self, ctx: CylonContext, columns: List[Column]):
+        if columns:
+            n = columns[0].length
+            for c in columns:
+                if c.length != n:
+                    raise CylonError(Status(Code.Invalid,
+                        f"column {c.name!r} length {c.length} != {n}"))
+        self.ctx = ctx
+        self.columns: List[Column] = columns
+
+    # -- shape ---------------------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return self.columns[0].length if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def column(self, i: Union[int, str]) -> Column:
+        if isinstance(i, str):
+            for c in self.columns:
+                if c.name == i:
+                    return c
+            raise CylonError(Status(Code.KeyError, f"no column {i!r}"))
+        return self.columns[i]
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def from_arrow(ctx: CylonContext, atable) -> "Table":
+        """Ingest a pyarrow Table (host→device transfer happens here).
+
+        reference: table.cpp (FromArrowTable) + type validation
+        arrow/arrow_types.cpp:57-114.
+        """
+        cols: List[Column] = []
+        for fld, col in zip(atable.schema, atable.columns):
+            t = from_arrow_type(fld.type)
+            arr = _combine(col)
+            if is_dictionary_encoded(t):
+                codes, dictionary, validity = _encode_dictionary(arr)
+                data = jnp.asarray(codes)
+                val = jnp.asarray(validity) if validity is not None else None
+                cols.append(Column(fld.name, DataType(t), data, val,
+                                   dictionary=dictionary, arrow_type=fld.type))
+            else:
+                npd = device_dtype(t)
+                if arr.null_count:
+                    import pyarrow.compute as pc
+
+                    mask = np.asarray(
+                        arr.is_valid().to_numpy(zero_copy_only=False), dtype=bool)
+                    # lossless: fill nulls inside arrow (typed), never via float64
+                    fill = False if t == Type.BOOL else 0
+                    import pyarrow as pa
+                    filled_arr = pc.fill_null(arr, pa.scalar(fill, type=arr.type))
+                    npv = _typed_numpy(filled_arr, npd)
+                    data = jnp.asarray(npv)
+                    val = jnp.asarray(mask)
+                else:
+                    npv = _typed_numpy(arr, npd)
+                    data, val = jnp.asarray(npv), None
+                cols.append(Column(fld.name, DataType(t), data, val,
+                                   arrow_type=fld.type))
+        return Table(ctx, cols)
+
+    @staticmethod
+    def from_pandas(ctx: CylonContext, df) -> "Table":
+        import pyarrow as pa
+
+        return Table.from_arrow(ctx, pa.Table.from_pandas(df, preserve_index=False))
+
+    @staticmethod
+    def from_columns(ctx: CylonContext, data: Dict[str, Any]) -> "Table":
+        """Build from a dict of name -> numpy/jnp array (numeric fast path)."""
+        import pyarrow as pa
+
+        cols: List[Column] = []
+        for name, arr in data.items():
+            npa = np.asarray(arr)
+            if npa.dtype == object or npa.dtype.kind in ("U", "S"):
+                return Table.from_arrow(ctx, pa.table(
+                    {k: np.asarray(v) for k, v in data.items()}))
+            t = _TYPE_OF_NUMPY[np.dtype(npa.dtype).name]
+            npa = npa.astype(device_dtype(t), copy=False)
+            cols.append(Column(name, DataType(t), jnp.asarray(npa)))
+        return Table(ctx, cols)
+
+    # -- export --------------------------------------------------------------
+
+    def to_arrow(self):
+        """Device→host; decode dictionaries; reattach nulls."""
+        import pyarrow as pa
+
+        arrays, names = [], []
+        for c in self.columns:
+            host = np.asarray(jax.device_get(c.data))
+            mask = (None if c.validity is None
+                    else ~np.asarray(jax.device_get(c.validity), dtype=bool))
+            if is_dictionary_encoded(c.dtype.type):
+                vals = (c.dictionary[np.clip(host, 0, max(len(c.dictionary) - 1, 0))]
+                        if len(c.dictionary)
+                        else np.full(len(host), None, dtype=object))
+                arrays.append(pa.array(vals, type=to_arrow_type(c.dtype.type,
+                                                                orig=c.arrow_type),
+                                       mask=mask))
+            elif c.dtype.type == Type.BOOL:
+                arrays.append(pa.array(host.astype(bool), type=pa.bool_(), mask=mask))
+            else:
+                at = to_arrow_type(c.dtype.type, orig=c.arrow_type)
+                arrays.append(pa.array(host, type=at, mask=mask))
+            names.append(c.name)
+        return pa.table(arrays, names=names)
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    # -- schema --------------------------------------------------------------
+
+    def schema_types(self) -> List[Type]:
+        return [c.dtype.type for c in self.columns]
+
+    def verify_same_schema(self, other: "Table") -> None:
+        """Column-count + per-column logical type equality.
+
+        reference: table_api.cpp:566 (VerifyTableSchema)
+        """
+        if self.num_columns != other.num_columns:
+            raise CylonError(Status(Code.Invalid,
+                f"column count mismatch {self.num_columns} vs {other.num_columns}"))
+        for a, b in zip(self.columns, other.columns):
+            if a.dtype.type != b.dtype.type:
+                raise CylonError(Status(Code.TypeError,
+                    f"type mismatch {a.name}:{a.dtype.type.name} vs "
+                    f"{b.name}:{b.dtype.type.name}"))
+
+    # -- convenience ---------------------------------------------------------
+
+    def project(self, indices: Sequence[Union[int, str]]) -> "Table":
+        """Zero-copy column subset (reference: table_api.cpp:1007-1026)."""
+        return Table(self.ctx, [self.column(i) for i in indices])
+
+    def rename(self, names: Sequence[str]) -> "Table":
+        return Table(self.ctx, [replace(c, name=n)
+                                for c, n in zip(self.columns, names)])
+
+    def show(self, row1: int = 0, row2: Optional[int] = None,
+             col1: int = 0, col2: Optional[int] = None) -> None:
+        """Print a window of the table (reference: table_api.cpp Print*)."""
+        df = self.to_pandas()
+        row2 = df.shape[0] if row2 is None else row2
+        col2 = df.shape[1] if col2 is None else col2
+        print(df.iloc[row1:row2, col1:col2].to_string(index=False))
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{c.name}:{c.dtype.type.name}" for c in self.columns)
+        return f"Table[{self.num_rows} x {self.num_columns}]({cols})"
+
+
+_TYPE_OF_NUMPY = {
+    "bool": Type.BOOL,
+    "uint8": Type.UINT8, "int8": Type.INT8,
+    "uint16": Type.UINT16, "int16": Type.INT16,
+    "uint32": Type.UINT32, "int32": Type.INT32,
+    "uint64": Type.UINT64, "int64": Type.INT64,
+    "float16": Type.HALF_FLOAT, "float32": Type.FLOAT, "float64": Type.DOUBLE,
+}
+
+
+# ---------------------------------------------------------------------------
+# dictionary unification (cross-table string ops)
+# ---------------------------------------------------------------------------
+
+def unify_dictionaries(a: Column, b: Column) -> Tuple[Column, Column]:
+    """Re-encode two dictionary columns onto one shared sorted dictionary.
+
+    Equal strings get equal codes in both columns, and code order stays
+    lexical — after this, joins/set-ops/sorts treat the column as plain int32.
+    """
+    if not (is_dictionary_encoded(a.dtype.type) and is_dictionary_encoded(b.dtype.type)):
+        return a, b
+    if a.dictionary is b.dictionary or (
+            len(a.dictionary) == len(b.dictionary)
+            and bool(np.all(a.dictionary == b.dictionary))):
+        return a, b
+    merged = np.unique(np.concatenate([a.dictionary, b.dictionary]))
+    map_a = jnp.asarray(np.searchsorted(merged, a.dictionary).astype(np.int32))
+    map_b = jnp.asarray(np.searchsorted(merged, b.dictionary).astype(np.int32))
+    new_a = replace(a, data=(map_a[a.data] if len(a.dictionary) else a.data),
+                    dictionary=merged)
+    new_b = replace(b, data=(map_b[b.data] if len(b.dictionary) else b.data),
+                    dictionary=merged)
+    return new_a, new_b
+
+
+def unify_tables(left: Table, right: Table,
+                 left_cols: Sequence[int], right_cols: Sequence[int]
+                 ) -> Tuple[Table, Table]:
+    """Unify dictionaries for the given column pairs across two tables."""
+    lcols, rcols = list(left.columns), list(right.columns)
+    for li, ri in zip(left_cols, right_cols):
+        lcols[li], rcols[ri] = unify_dictionaries(lcols[li], rcols[ri])
+    return Table(left.ctx, lcols), Table(right.ctx, rcols)

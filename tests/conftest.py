@@ -1,0 +1,54 @@
+"""Test harness: force an 8-device CPU platform so distributed paths run
+without TPU hardware — the mpirun-np-8 equivalent (SURVEY.md §4).
+
+The environment may pin a TPU platform plugin (e.g. axon) that overrides
+JAX_PLATFORMS, so we select CPU devices explicitly via jax.devices('cpu')
+and set the default device to cpu:0 for deterministic, hardware-free tests.
+"""
+import os
+import re
+import sys
+
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# Restrict to the CPU platform BEFORE any backend init: the environment's TPU
+# tunnel plugin (axon) otherwise gets initialized too and can hang the run.
+jax.config.update("jax_platforms", "cpu")
+# env JAX_ENABLE_X64 is read at first jax import, which the environment's
+# sitecustomize performs before conftest runs — set it via the config API.
+jax.config.update("jax_enable_x64", True)
+
+CPU_DEVICES = jax.devices("cpu")
+jax.config.update("jax_default_device", CPU_DEVICES[0])
+
+
+@pytest.fixture(scope="session")
+def ctx():
+    """Local (single-device) context."""
+    from cylon_tpu import CylonContext
+
+    return CylonContext({"backend": "local", "devices": CPU_DEVICES[:1]})
+
+
+@pytest.fixture(scope="session")
+def dctx():
+    """Distributed context over the 8 virtual CPU devices."""
+    from cylon_tpu import CylonContext
+
+    c = CylonContext({"backend": "tpu", "devices": CPU_DEVICES})
+    assert c.get_world_size() == 8
+    return c
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,121 @@
+"""Data-model tests: arrow/pandas round trip, strings, nulls, schema checks.
+
+Mirrors reference python/test/test_table.py (CSV round trip, arrow interop)
+but as a real pytest suite with oracle checks.
+"""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from cylon_tpu import CylonContext, CylonError, Table, Type
+
+
+def test_from_to_arrow_numeric_roundtrip(ctx):
+    at = pa.table({
+        "a": pa.array([1, 2, 3, 4], type=pa.int64()),
+        "b": pa.array([1.5, 2.5, -3.0, 0.0], type=pa.float64()),
+        "c": pa.array([10, 20, 30, 40], type=pa.int32()),
+        "d": pa.array([True, False, True, False], type=pa.bool_()),
+    })
+    tb = Table.from_arrow(ctx, at)
+    assert tb.num_rows == 4 and tb.num_columns == 4
+    assert tb.schema_types() == [Type.INT64, Type.DOUBLE, Type.INT32, Type.BOOL]
+    out = tb.to_arrow()
+    assert out.equals(at)
+
+
+def test_string_dictionary_roundtrip(ctx):
+    at = pa.table({"s": ["pear", "apple", "pear", "zoo", "apple"]})
+    tb = Table.from_arrow(ctx, at)
+    col = tb.column("s")
+    assert col.dtype.type == Type.STRING
+    # sorted dictionary => codes preserve lexical order
+    assert list(col.dictionary) == ["apple", "pear", "zoo"]
+    codes = np.asarray(col.data)
+    assert codes.tolist() == [1, 0, 1, 2, 0]
+    assert tb.to_arrow().equals(at)
+
+
+def test_nulls_roundtrip(ctx):
+    at = pa.table({
+        "x": pa.array([1.0, None, 3.0], type=pa.float64()),
+        "s": pa.array(["a", None, "c"]),
+    })
+    tb = Table.from_arrow(ctx, at)
+    assert tb.column("x").has_nulls() and tb.column("s").has_nulls()
+    out = tb.to_arrow()
+    assert out.equals(at)
+
+
+def test_from_pandas_and_columns(ctx):
+    df = pd.DataFrame({"k": np.arange(5, dtype=np.int64),
+                       "v": np.linspace(0, 1, 5)})
+    tb = Table.from_pandas(ctx, df)
+    pd.testing.assert_frame_equal(tb.to_pandas(), df)
+
+    tb2 = Table.from_columns(ctx, {"k": np.arange(3, dtype=np.int32)})
+    assert tb2.schema_types() == [Type.INT32]
+
+
+def test_project_and_rename(ctx):
+    tb = Table.from_columns(ctx, {"a": np.arange(3), "b": np.arange(3.0)})
+    p = tb.project(["b"])
+    assert p.column_names == ["b"] and p.num_columns == 1
+    r = tb.rename(["x", "y"])
+    assert r.column_names == ["x", "y"]
+
+
+def test_schema_verify(ctx):
+    t1 = Table.from_columns(ctx, {"a": np.arange(3, dtype=np.int64)})
+    t2 = Table.from_columns(ctx, {"z": np.arange(4, dtype=np.int64)})
+    t1.verify_same_schema(t2)  # names may differ, types must match
+    t3 = Table.from_columns(ctx, {"a": np.arange(3.0)})
+    with pytest.raises(CylonError):
+        t1.verify_same_schema(t3)
+
+
+def test_dictionary_unification(ctx):
+    from cylon_tpu.table import unify_tables
+    t1 = Table.from_arrow(ctx, pa.table({"s": ["b", "a", "c"]}))
+    t2 = Table.from_arrow(ctx, pa.table({"s": ["d", "b", "b"]}))
+    u1, u2 = unify_tables(t1, t2, [0], [0])
+    d = list(u1.column(0).dictionary)
+    assert d == ["a", "b", "c", "d"]
+    assert list(u2.column(0).dictionary) == d
+    assert np.asarray(u1.column(0).data).tolist() == [1, 0, 2]
+    assert np.asarray(u2.column(0).data).tolist() == [3, 1, 1]
+    assert u1.to_arrow().column(0).to_pylist() == ["b", "a", "c"]
+
+
+def test_context_basics(ctx, dctx):
+    assert not ctx.is_distributed() and ctx.get_world_size() == 1
+    assert dctx.is_distributed() and dctx.get_world_size() == 8
+    assert dctx.get_neighbours() == list(range(1, 8)) or len(dctx.get_neighbours()) == 7
+    dctx.barrier()
+    s0 = dctx.get_next_sequence()
+    assert dctx.get_next_sequence() == s0 + 1
+
+
+def test_large_int64_with_nulls_lossless(ctx):
+    big = 2**60 + 1
+    at = pa.table({"x": pa.array([big, None, -big], type=pa.int64())})
+    tb = Table.from_arrow(ctx, at)
+    out = tb.to_arrow()
+    assert out.column("x").to_pylist() == [big, None, -big]
+
+
+def test_all_null_string_column(ctx):
+    at = pa.table({"s": pa.array([None, None], type=pa.string())})
+    tb = Table.from_arrow(ctx, at)
+    assert tb.to_arrow().equals(at)
+
+
+def test_binary_and_timestamp_roundtrip(ctx):
+    at = pa.table({
+        "b": pa.array([b"xx", b"a", None], type=pa.binary()),
+        "t": pa.array([1, None, 3], type=pa.timestamp("us")),
+        "bo": pa.array([True, None, False], type=pa.bool_()),
+    })
+    tb = Table.from_arrow(ctx, at)
+    assert tb.to_arrow().equals(at)
