@@ -1,0 +1,265 @@
+"""Local (single-device) table operations — the L4 op surface.
+
+TPU-native mirror of the reference's local table API (reference:
+cpp/src/cylon/table_api.cpp — Join/Union/Subtract/Intersect/Sort/Merge/
+Select/Project) on top of the jittable kernels in ops/.  Data-dependent
+output sizes are handled by count-then-materialize with power-of-two
+capacity bucketing (ops/compact.next_bucket) so recompilation is bounded.
+
+Two intentional divergences from the reference, recorded in SURVEY.md §7:
+ * Sort actually applies its indices (reference bug: table_api.cpp:446
+   gathers with nullptr indices, output unsorted);
+ * comparators are dtype-generic (reference bug: INT32 routed to the Int16
+   comparator, arrow/arrow_comparator.cpp:67).
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import JoinAlgorithm, JoinConfig, JoinType
+from .dtypes import Type, is_dictionary_encoded
+from .ops import compact as ops_compact
+from .ops import gather as ops_gather
+from .ops import groupby as ops_groupby
+from .ops import join as ops_join
+from .ops import setops as ops_setops
+from .ops import sort as ops_sort
+from .status import Code, CylonError, Status
+from .table import Column, Table, unify_dictionaries, unify_tables
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _null_sentinel(dtype) -> jnp.ndarray:
+    """Value substituted for null keys so null == null in joins/sorts.
+
+    Collides with genuine max-value keys; documented divergence (the
+    reference joins on raw slot bytes under nulls, which is garbage).
+    """
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.finfo(dtype).max, dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)
+
+
+def _key_array(col: Column) -> jax.Array:
+    if col.validity is None:
+        return col.data
+    return jnp.where(col.validity, col.data, _null_sentinel(col.data.dtype))
+
+
+def _gather_columns(tb: Table, indices: jax.Array, fill_null: bool,
+                    prefix: str = "") -> List[Column]:
+    out = []
+    for c in tb.columns:
+        data, validity = ops_gather.take(c.data, c.validity, indices,
+                                         fill_null=fill_null)
+        out.append(Column(prefix + c.name, c.dtype, data, validity,
+                          dictionary=c.dictionary, arrow_type=c.arrow_type))
+    return out
+
+
+def _slice_columns(cols: List[Column], count: int) -> List[Column]:
+    return [replace(c, data=c.data[:count],
+                    validity=None if c.validity is None else c.validity[:count])
+            for c in cols]
+
+
+def _concat_columns(a: Column, b: Column, name: Optional[str] = None) -> Column:
+    ca, cb = unify_dictionaries(a, b)
+    data = jnp.concatenate([ca.data, cb.data])
+    if ca.validity is None and cb.validity is None:
+        validity = None
+    else:
+        va = ca.validity if ca.validity is not None else jnp.ones(ca.length, bool)
+        vb = cb.validity if cb.validity is not None else jnp.ones(cb.length, bool)
+        validity = jnp.concatenate([va, vb])
+    return Column(name or ca.name, ca.dtype, data, validity,
+                  dictionary=ca.dictionary, arrow_type=ca.arrow_type)
+
+
+# ---------------------------------------------------------------------------
+# join (reference: table_api.cpp JoinTables -> join/join.cpp)
+# ---------------------------------------------------------------------------
+
+def join(left: Table, right: Table, config: JoinConfig) -> Table:
+    """Local equi-join; output columns renamed ``lt-…`` / ``rt-…``
+    (reference: join/join_utils.cpp:23-95 build_final_table)."""
+    lcol = left.column(config.left_column_idx)
+    rcol = right.column(config.right_column_idx)
+    if lcol.dtype.type != rcol.dtype.type:
+        raise CylonError(Status(Code.TypeError,
+            f"join key type mismatch {lcol.dtype.type.name} vs {rcol.dtype.type.name}"))
+    if is_dictionary_encoded(lcol.dtype.type):
+        left, right = unify_tables(left, right, [config.left_column_idx],
+                                   [config.right_column_idx])
+        lcol = left.column(config.left_column_idx)
+        rcol = right.column(config.right_column_idx)
+    how = config.join_type.value
+    lk, rk = _key_array(lcol), _key_array(rcol)
+    total = int(ops_join.join_count(lk, rk, how))
+    cap = ops_compact.next_bucket(total)
+    li, ri, cnt = ops_join.join_indices(lk, rk, how, cap)
+    fill_left = how in ("right", "full_outer")
+    fill_right = how in ("left", "full_outer")
+    cols = (_gather_columns(left, li, fill_left, prefix="lt-")
+            + _gather_columns(right, ri, fill_right, prefix="rt-"))
+    return Table(left.ctx, _slice_columns(cols, total))
+
+
+# ---------------------------------------------------------------------------
+# set ops (reference: table_api.cpp:530-902)
+# ---------------------------------------------------------------------------
+
+def _set_op(a: Table, b: Table, op: str) -> Table:
+    a.verify_same_schema(b)
+    n_a, n_b = a.num_rows, b.num_rows
+    if n_a + n_b == 0:
+        return a
+    if n_a == 0:
+        if op == ops_setops.UNION:
+            return unique(b).rename(a.column_names)
+        return a  # intersect/subtract of empty A is empty
+    if n_b == 0 and op != ops_setops.UNION:
+        if op == ops_setops.INTERSECT:
+            return Table(a.ctx, _slice_columns(list(a.columns), 0))
+        return unique(a)  # subtract: distinct rows of A
+
+    concat = [_concat_columns(ca, cb)
+              for ca, cb in zip(a.columns, b.columns)]
+    cols = tuple(c.data for c in concat)
+    vals = tuple(c.validity for c in concat)
+    idx, count = ops_setops.set_op_indices(cols, vals, n_a, op)
+    total = int(count)
+    holder = Table(a.ctx, concat)
+    out = _gather_columns(holder, idx, fill_null=False)
+    return Table(a.ctx, _slice_columns(out, total))
+
+
+def union(a: Table, b: Table) -> Table:
+    return _set_op(a, b, ops_setops.UNION)
+
+
+def intersect(a: Table, b: Table) -> Table:
+    return _set_op(a, b, ops_setops.INTERSECT)
+
+
+def subtract(a: Table, b: Table) -> Table:
+    return _set_op(a, b, ops_setops.SUBTRACT)
+
+
+def unique(t: Table) -> Table:
+    """Distinct rows of one table (union with an empty right side)."""
+    if t.num_rows == 0:
+        return t
+    cols = tuple(c.data for c in t.columns)
+    vals = tuple(c.validity for c in t.columns)
+    idx, count = ops_setops.set_op_indices(cols, vals, t.num_rows,
+                                           ops_setops.UNION)
+    out = _gather_columns(t, idx, fill_null=False)
+    return Table(t.ctx, _slice_columns(out, int(count)))
+
+
+# ---------------------------------------------------------------------------
+# sort / select / merge (reference: table_api.cpp:404-459, 977-1005)
+# ---------------------------------------------------------------------------
+
+def sort(t: Table, sort_column: Union[int, str], ascending: bool = True) -> Table:
+    """Order by one column, nulls last.  (Applies its indices — the
+    reference's local Sort forgets to, table_api.cpp:446.)"""
+    col = t.column(sort_column)
+    order = ops_sort.sort_indices(col.data, col.validity, ascending)
+    return Table(t.ctx, _gather_columns(t, order, fill_null=False))
+
+
+def sort_multi(t: Table, sort_columns: Sequence[Union[int, str]],
+               ascending: bool = True) -> Table:
+    cols = [t.column(c) for c in sort_columns]
+    order = ops_sort.lexsort_indices([c.data for c in cols],
+                                     [c.validity for c in cols], ascending)
+    return Table(t.ctx, _gather_columns(t, order, fill_null=False))
+
+
+def select(t: Table, predicate: Callable[[Dict[str, jax.Array]], jax.Array]) -> Table:
+    """Vectorized row filter: ``predicate`` maps {name: data array} -> bool
+    mask.  (The reference's per-row lambda, table_api.cpp:977-1005, survives
+    only in the pycylon compat shim as a host path.)"""
+    env = {c.name: c.data for c in t.columns}
+    mask = predicate(env)
+    if mask.shape != (t.num_rows,):
+        raise CylonError(Status(Code.Invalid,
+            f"predicate mask shape {mask.shape} != ({t.num_rows},)"))
+    idx, count = ops_compact.mask_to_indices(mask, t.num_rows)
+    out = _gather_columns(t, idx, fill_null=False)
+    return Table(t.ctx, _slice_columns(out, int(count)))
+
+
+def merge(tables: Sequence[Table]) -> Table:
+    """Concatenate tables with identical schemas (reference Merge,
+    table_api.cpp:404-423)."""
+    if not tables:
+        raise CylonError(Status(Code.Invalid, "merge of zero tables"))
+    head = tables[0]
+    for other in tables[1:]:
+        head.verify_same_schema(other)
+    cols = list(tables[0].columns)
+    for other in tables[1:]:
+        cols = [_concat_columns(ca, cb) for ca, cb in zip(cols, other.columns)]
+    return Table(head.ctx, cols)
+
+
+# ---------------------------------------------------------------------------
+# groupby-aggregate (new capability — BASELINE.json config 3)
+# ---------------------------------------------------------------------------
+
+def groupby(t: Table, key_columns: Sequence[Union[int, str]],
+            aggregations: Sequence[Tuple[Union[int, str], str]]) -> Table:
+    """Group by key columns and aggregate: aggregations = [(col, op), ...]
+    with op ∈ {sum, count, mean, min, max}.  Output columns: the key columns
+    then ``{op}_{col}`` per aggregation (pandas naming)."""
+    if t.num_rows == 0:
+        kcols = [t.column(c) for c in key_columns]
+        acols = []
+        for c, op in aggregations:
+            base = t.column(c)
+            acols.append(Column(f"{op}_{base.name}", base.dtype, base.data[:0]))
+        return Table(t.ctx, [replace(k, data=k.data[:0], validity=None)
+                             for k in kcols] + acols)
+    kcols = [t.column(c) for c in key_columns]
+    vcols = [t.column(c) for c, _ in aggregations]
+    aggs = tuple(op for _, op in aggregations)
+    for op in aggs:
+        if op not in ops_groupby.AGG_OPS:
+            raise CylonError(Status(Code.Invalid, f"unknown aggregation {op!r}"))
+    key_idx, outs, out_valids, count = ops_groupby.groupby_aggregate(
+        tuple(c.data for c in kcols), tuple(c.validity for c in kcols),
+        tuple(c.data for c in vcols), tuple(c.validity for c in vcols), aggs)
+    total = int(count)
+    holder = Table(t.ctx, kcols)
+    out_cols = _slice_columns(_gather_columns(holder, key_idx, fill_null=False),
+                              total)
+    from .dtypes import DataType
+    for (cref, op), arr, validity in zip(aggregations, outs, out_valids):
+        base = t.column(cref)
+        name = f"{op}_{base.name}"
+        arr = arr[:total]
+        validity = None if validity is None else validity[:total]
+        t_out = _agg_output_type(base.dtype.type, op)
+        out_cols.append(Column(name, DataType(t_out), arr, validity))
+    return Table(t.ctx, out_cols)
+
+
+def _agg_output_type(in_type: Type, op: str) -> Type:
+    if op == "count":
+        return Type.INT64
+    if op == "mean":
+        return Type.DOUBLE
+    if op == "sum" and in_type not in (Type.FLOAT, Type.DOUBLE, Type.HALF_FLOAT):
+        return Type.INT64
+    return in_type

@@ -1,0 +1,59 @@
+"""Operation config objects.
+
+JoinConfig mirrors the reference's join type × algorithm × key columns
+builder (reference: cpp/src/cylon/join/join_config.hpp:22-89).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    FULL_OUTER = "full_outer"
+
+
+class JoinAlgorithm(enum.Enum):
+    SORT = "sort"
+    HASH = "hash"
+
+
+@dataclass(frozen=True)
+class JoinConfig:
+    """join type × algorithm × key column index per side.
+
+    Both algorithms execute on the same sort-based kernel (ops/join.py);
+    the algorithm choice is honored at the distributed layer (hash ⇒
+    hash-partition shuffle; sort ⇒ sample-sort shuffle) and kept for
+    pycylon source compatibility.
+    reference: join/join_config.hpp:29-89
+    """
+
+    join_type: JoinType = JoinType.INNER
+    algorithm: JoinAlgorithm = JoinAlgorithm.SORT
+    left_column_idx: int = 0
+    right_column_idx: int = 0
+
+    @staticmethod
+    def InnerJoin(left_column_idx: int = 0, right_column_idx: int = 0,
+                  algorithm: JoinAlgorithm = JoinAlgorithm.SORT) -> "JoinConfig":
+        return JoinConfig(JoinType.INNER, algorithm, left_column_idx, right_column_idx)
+
+    @staticmethod
+    def LeftJoin(left_column_idx: int = 0, right_column_idx: int = 0,
+                 algorithm: JoinAlgorithm = JoinAlgorithm.SORT) -> "JoinConfig":
+        return JoinConfig(JoinType.LEFT, algorithm, left_column_idx, right_column_idx)
+
+    @staticmethod
+    def RightJoin(left_column_idx: int = 0, right_column_idx: int = 0,
+                  algorithm: JoinAlgorithm = JoinAlgorithm.SORT) -> "JoinConfig":
+        return JoinConfig(JoinType.RIGHT, algorithm, left_column_idx, right_column_idx)
+
+    @staticmethod
+    def FullOuterJoin(left_column_idx: int = 0, right_column_idx: int = 0,
+                      algorithm: JoinAlgorithm = JoinAlgorithm.SORT) -> "JoinConfig":
+        return JoinConfig(JoinType.FULL_OUTER, algorithm, left_column_idx,
+                          right_column_idx)

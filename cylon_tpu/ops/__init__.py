@@ -1,0 +1,10 @@
+"""XLA kernels over column arrays (L3 of the layer map).
+
+Everything here is a pure, jittable function over jnp arrays — the TPU-native
+mirror of the reference's per-type C++ kernel layer (reference:
+cpp/src/cylon/arrow/arrow_kernels.hpp, arrow_partition_kernels.hpp,
+join/join.cpp, util/copy_arrray.cpp).  No per-type dispatch: jnp is
+dtype-generic; strings arrive as int32 dictionary codes.
+"""
+from . import (compact, gather, groupby, hash as hashing, join,  # noqa: F401
+               setops, sort)

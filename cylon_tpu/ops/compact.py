@@ -1,0 +1,40 @@
+"""Mask → index compaction with static output capacity.
+
+The XLA replacement for the reference's dynamic builder loops (BooleanBuilder
+mask + arrow Filter in Select, reference table_api.cpp:977-1005; index-vector
+builds in the set ops): under jit, output shapes are static, so compaction
+produces a fixed-capacity index vector padded with −1 plus a valid count.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def mask_to_indices(mask: jax.Array, capacity: int) -> Tuple[jax.Array, jax.Array]:
+    """Indices of True entries, padded with −1 to ``capacity``; plus count."""
+    idx = jnp.flatnonzero(mask, size=capacity, fill_value=-1)
+    return idx.astype(jnp.int32), jnp.sum(mask).astype(jnp.int32)
+
+
+def pad_to(x: jax.Array, capacity: int, fill=0) -> jax.Array:
+    """Right-pad axis 0 to ``capacity`` with ``fill`` (static shape)."""
+    n = x.shape[0]
+    if n == capacity:
+        return x
+    if n > capacity:
+        raise ValueError(f"cannot pad length {n} into capacity {capacity}")
+    pad_width = [(0, capacity - n)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad_width, constant_values=fill)
+
+
+def next_bucket(n: int, minimum: int = 1024) -> int:
+    """Round a dynamic size up to a power-of-two bucket.
+
+    Bounds re-JIT count when materializing data-dependent shapes
+    (SURVEY.md §7 hard part 1: capacity buffers + size-class bucketing).
+    """
+    cap = max(int(n), minimum)
+    return 1 << (cap - 1).bit_length()

@@ -1,0 +1,43 @@
+"""Gather-by-indices with −1 → null semantics.
+
+TPU-native mirror of the reference's copy-by-indices kernels (reference:
+cpp/src/cylon/util/copy_arrray.cpp:24-267): building output columns from an
+index vector where index −1 appends a null (the outer-join fill path,
+copy_arrray.cpp:38-43).  One vectorized take instead of per-type builder
+loops.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def take(data: jax.Array, validity: Optional[jax.Array], indices: jax.Array,
+         fill_null: bool = False) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Gather rows; if ``fill_null``, index −1 produces a null row.
+
+    Returns (data, validity) for the gathered column.  ``fill_null=False``
+    (no −1 possible, e.g. inner join) keeps validity None when input had none.
+    """
+    n = data.shape[0]
+    if n == 0:
+        # degenerate gather: all outputs null (only valid when fill_null)
+        out = jnp.zeros(indices.shape[:1] + data.shape[1:], data.dtype)
+        return out, jnp.zeros(indices.shape[:1], bool) if fill_null else None
+    safe = jnp.clip(indices, 0, n - 1)
+    out = jnp.take(data, safe, axis=0)
+    if not fill_null:
+        if validity is None:
+            return out, None
+        return out, jnp.take(validity, safe, axis=0)
+    valid = indices >= 0
+    if validity is not None:
+        valid = valid & jnp.take(validity, safe, axis=0)
+    out = jnp.where(_bcast(valid, out), out, jnp.zeros((), out.dtype))
+    return out, valid
+
+
+def _bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
+    return mask.reshape(mask.shape + (1,) * (like.ndim - 1))

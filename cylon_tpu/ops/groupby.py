@@ -1,0 +1,107 @@
+"""Groupby-aggregate: sort by keys → segment boundaries → segment reductions.
+
+The reference has NO groupby (verified absent in cpp/src — SURVEY.md §2.2);
+BASELINE.json config 3 requires "Distributed groupby-aggregate (sum/mean/
+count) with hash repartition", so this is built fresh the TPU way: lexsort
+keys, adjacent-compare for group starts, then `jax.ops.segment_*` reductions
+(which XLA lowers to efficient sorted-segment scans).  The distributed
+variant (parallel/) shuffles on key hash first, then runs this locally —
+the same shuffle + local-op pattern the reference uses for join/set-ops.
+
+Output capacity is the input row count (≤ one group per row), so a single
+jitted pass suffices; rows [0, count) are valid.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+SUM, COUNT, MEAN, MIN, MAX = "sum", "count", "mean", "min", "max"
+AGG_OPS = (SUM, COUNT, MEAN, MIN, MAX)
+
+
+def _group_structure(key_cols: Sequence[jax.Array],
+                     key_validities: Sequence[Optional[jax.Array]]):
+    keys = []
+    for c, v in zip(key_cols, key_validities):
+        keys.append(c)
+        if v is not None:
+            keys.append(~v)
+    order = jnp.lexsort(tuple(reversed(keys)))
+    n = key_cols[0].shape[0]
+    is_first = jnp.zeros(n, bool).at[0].set(True)
+    for c, v in zip(key_cols, key_validities):
+        cs = jnp.take(c, order)
+        is_first |= jnp.concatenate([jnp.ones((1,), bool), cs[1:] != cs[:-1]])
+        if v is not None:
+            vs = jnp.take(v, order)
+            is_first |= jnp.concatenate([jnp.ones((1,), bool), vs[1:] != vs[:-1]])
+    group_id = jnp.cumsum(is_first) - 1
+    return order, is_first, group_id
+
+
+@functools.partial(jax.jit, static_argnames=("aggs",))
+def groupby_aggregate(key_cols: Sequence[jax.Array],
+                      key_validities: Sequence[Optional[jax.Array]],
+                      value_cols: Sequence[jax.Array],
+                      value_validities: Sequence[Optional[jax.Array]],
+                      aggs: Tuple[str, ...]):
+    """Aggregate ``value_cols[i]`` with ``aggs[i]`` per distinct key row.
+
+    Returns (key_row_indices[n] padded −1, agg_arrays (one per value col,
+    each [n]), agg_validities, count).  Null handling is pandas-style: null
+    values are skipped; a group with no valid values yields null (for
+    min/max/mean) or 0 (sum/count).
+    """
+    n = key_cols[0].shape[0]
+    order, is_first, group_id = _group_structure(key_cols, key_validities)
+    num_groups = jnp.sum(is_first).astype(jnp.int32)
+    key_pos = jnp.flatnonzero(is_first, size=n, fill_value=-1)
+    key_idx = jnp.where(key_pos >= 0,
+                        jnp.take(order, jnp.clip(key_pos, 0, n - 1)).astype(jnp.int32),
+                        jnp.int32(-1))
+
+    outs, out_valids = [], []
+    for col, validity, agg in zip(value_cols, value_validities, aggs):
+        vs = jnp.take(col, order)
+        valid = (jnp.ones(n, bool) if validity is None
+                 else jnp.take(validity, order))
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int64 if
+                                               jax.config.jax_enable_x64
+                                               else jnp.int32),
+                                  group_id, num_segments=n)
+        if agg == COUNT:
+            outs.append(cnt)
+            out_valids.append(None)
+            continue
+        if agg in (SUM, MEAN):
+            acc_dt = (col.dtype if jnp.issubdtype(col.dtype, jnp.floating)
+                      else (jnp.int64 if jax.config.jax_enable_x64 else jnp.int32))
+            z = jnp.where(valid, vs, jnp.zeros((), col.dtype)).astype(acc_dt)
+            s = jax.ops.segment_sum(z, group_id, num_segments=n)
+            if agg == SUM:
+                outs.append(s)
+                out_valids.append(None)
+            else:
+                denom = jnp.maximum(cnt, 1).astype(jnp.float64 if
+                                                   jax.config.jax_enable_x64
+                                                   else jnp.float32)
+                outs.append(s.astype(denom.dtype) / denom)
+                out_valids.append(cnt > 0)
+            continue
+        if agg in (MIN, MAX):
+            if jnp.issubdtype(col.dtype, jnp.floating):
+                sentinel = jnp.array(jnp.inf if agg == MIN else -jnp.inf, col.dtype)
+            else:
+                info = jnp.iinfo(col.dtype)
+                sentinel = jnp.array(info.max if agg == MIN else info.min, col.dtype)
+            z = jnp.where(valid, vs, sentinel)
+            seg = jax.ops.segment_min if agg == MIN else jax.ops.segment_max
+            outs.append(seg(z, group_id, num_segments=n))
+            out_valids.append(cnt > 0)
+            continue
+        raise ValueError(f"unknown aggregation {agg!r}")
+    return key_idx, tuple(outs), tuple(out_valids), num_groups

@@ -1,0 +1,99 @@
+"""Set operations (union / intersect / subtract) over whole rows.
+
+TPU-native replacement for the reference's hash-set-of-rows approach
+(reference: cpp/src/cylon/table_api.cpp:530-902 — ``unordered_set`` keyed by
+(table#, row#) with per-row virtual hash + compare calls).  Pointer-chasing
+hash sets don't vectorize; the TPU-shaped equivalent is:
+
+  lexsort all rows of concat(A, B) (origin flag as the final tie-break key)
+  → adjacent-compare for distinct-row boundaries → per-group presence bits
+  via segment_max → compact surviving representative rows.
+
+All outputs are bounded by the input sizes, so unlike joins these need no
+two-phase counting: results come back as (indices-into-concat, count) at a
+static capacity.
+
+Set semantics match the reference: results are deduplicated; a surviving row
+is emitted once even if it appears many times (table_api.cpp Union dedups
+across *and* within tables).  Null == null for row equality (validity takes
+part in the sort keys).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+UNION, INTERSECT, SUBTRACT = "union", "intersect", "subtract"
+
+
+def _row_order_and_groups(cols: Sequence[jax.Array],
+                          validities: Sequence[Optional[jax.Array]],
+                          origin: jax.Array):
+    """Sort rows lexicographically (origin last), mark distinct-row starts."""
+    # jnp.lexsort sorts by the LAST key first; origin goes FIRST in the
+    # sequence so it's the least-significant tie-break — identical rows from
+    # A and B land adjacent, with the A copies leading their group.
+    keys = [origin]
+    for c, v in zip(cols, validities):
+        keys.append(c)
+        if v is not None:
+            keys.append(~v)
+    order = jnp.lexsort(tuple(keys))
+    is_first = jnp.zeros(origin.shape[0], bool).at[0].set(True)
+    for c, v in zip(cols, validities):
+        cs = jnp.take(c, order)
+        diff = jnp.concatenate([jnp.ones((1,), bool), cs[1:] != cs[:-1]])
+        is_first = is_first | diff
+        if v is not None:
+            vs = jnp.take(v, order)
+            vdiff = jnp.concatenate([jnp.ones((1,), bool), vs[1:] != vs[:-1]])
+            is_first = is_first | vdiff
+    return order, is_first
+
+
+@functools.partial(jax.jit, static_argnames=("op", "n_a"))
+def set_op_indices(cols: Sequence[jax.Array],
+                   validities: Sequence[Optional[jax.Array]],
+                   n_a: int, op: str) -> Tuple[jax.Array, jax.Array]:
+    """Run a set op over concatenated row columns.
+
+    ``cols[i]`` holds table A's rows [0, n_a) followed by table B's rows.
+    Returns (indices into the concatenated rows padded with −1, count).
+    Capacity: n_a + n_b for union, n_a for intersect/subtract.
+    """
+    n = cols[0].shape[0]
+    n_b = n - n_a
+    origin = (jnp.arange(n) >= n_a)  # False=A, True=B
+    order, is_first = _row_order_and_groups(cols, validities, origin)
+    group_id = jnp.cumsum(is_first) - 1  # [n] ints, < n
+
+    og = jnp.take(origin, order)
+    from_a = (~og).astype(jnp.int32)
+    from_b = og.astype(jnp.int32)
+    has_a = jax.ops.segment_max(from_a, group_id, num_segments=n) > 0
+    has_b = jax.ops.segment_max(from_b, group_id, num_segments=n) > 0
+
+    # group representative = its first sorted row; origin is the last sort
+    # key, so when a group spans both tables the representative is from A.
+    if op == UNION:
+        keep_group = has_a | has_b  # every group (trivially true for real groups)
+        capacity = n
+    elif op == INTERSECT:
+        keep_group = has_a & has_b
+        capacity = n_a
+    elif op == SUBTRACT:
+        keep_group = has_a & ~has_b
+        capacity = n_a
+    else:
+        raise ValueError(f"unknown set op {op!r}")
+
+    keep_row = is_first & jnp.take(keep_group, group_id)
+    pos = jnp.flatnonzero(keep_row, size=capacity, fill_value=-1)
+    count = jnp.sum(keep_row).astype(jnp.int32)
+    idx = jnp.where(pos >= 0,
+                    jnp.take(order, jnp.clip(pos, 0, n - 1)).astype(jnp.int32),
+                    jnp.int32(-1))
+    return idx, count

@@ -1,0 +1,61 @@
+"""Sort-indices kernels (argsort / lexsort).
+
+TPU-native mirror of the reference sort kernels (reference:
+cpp/src/cylon/arrow/arrow_kernels.hpp:125-193, util/sort_indices.cpp) —
+``std::sort`` over raw values becomes XLA's sort, which tiles onto the VPU.
+All sorts here are stable, matching arrow's SortToIndices.
+
+Null ordering: the reference sorts raw slot values (validity ignored).  We
+sort nulls LAST (pandas ``na_position='last'``) by prepending an is-null key —
+an intentional, documented divergence that makes the op actually correct
+(the reference's local Sort is also bugged: it never applies the computed
+indices, table_api.cpp:446 — we obviously don't replicate that).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_indices(col: jax.Array, validity: Optional[jax.Array] = None,
+                 ascending: bool = True) -> jax.Array:
+    """Stable argsort of one column -> int32/int64 index array."""
+    key = col if ascending else _invert(col)
+    if validity is None:
+        return jnp.argsort(key, stable=True)
+    # nulls last regardless of direction
+    isnull = ~validity
+    return jnp.lexsort((key, isnull))
+
+
+def lexsort_indices(cols: Sequence[jax.Array],
+                    validities: Optional[Sequence[Optional[jax.Array]]] = None,
+                    ascending: bool = True) -> jax.Array:
+    """Stable lexicographic argsort; cols[0] is the primary key."""
+    keys = []
+    for i, c in enumerate(cols):
+        k = c if ascending else _invert(c)
+        v = validities[i] if validities is not None else None
+        if v is not None:
+            keys.append((~v, k))
+        else:
+            keys.append((None, k))
+    # jnp.lexsort: LAST key is primary -> reverse; null-key precedes its value
+    flat = []
+    for isnull, k in reversed(keys):
+        flat.append(k)
+        if isnull is not None:
+            flat.append(isnull)
+    return jnp.lexsort(tuple(flat))
+
+
+def _invert(col: jax.Array) -> jax.Array:
+    """Order-reversing transform for descending sort."""
+    if jnp.issubdtype(col.dtype, jnp.floating):
+        return -col
+    if jnp.issubdtype(col.dtype, jnp.unsignedinteger):
+        return jnp.iinfo(col.dtype).max - col
+    return -col  # signed ints: min value maps to min+... acceptable (two's
+    # complement -min == min wraps to itself, a single-value edge we accept)

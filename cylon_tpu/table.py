@@ -74,12 +74,53 @@ def _typed_numpy(arr, npd: np.dtype) -> np.ndarray:
     """Arrow array -> numpy of exactly ``npd`` without lossy intermediates.
 
     Temporal arrays come back as datetime64/timedelta64; reinterpret the
-    underlying int64 rather than casting.  Everything else is a typed copy.
+    underlying int64 rather than casting.  time32/time64 come back as object
+    arrays of datetime.time — cast those to their integer storage inside
+    arrow first.  Everything else is a typed copy.
     """
+    import pyarrow as pa
+
     npv = arr.to_numpy(zero_copy_only=False)
     if npv.dtype.kind in "mM":
         npv = npv.view(np.int64)
+    elif npv.dtype.kind == "O":  # e.g. time32/time64 -> datetime.time objects
+        target = pa.int64() if npd.itemsize == 8 else pa.int32()
+        npv = arr.cast(target).to_numpy(zero_copy_only=False)
     return np.ascontiguousarray(npv).astype(npd, copy=False)
+
+
+def _device_put(npv: np.ndarray, t: Type, col_name: str):
+    """jnp.asarray with explicit handling of x64-disabled narrowing.
+
+    Under JAX's default config 64-bit arrays silently narrow to 32-bit.
+    Silent corruption is unacceptable: ints are range-checked (narrow +
+    logical-type downgrade when lossless, error otherwise); floats narrow
+    with a warning (precision loss is the expected trade on TPU).
+    Returns (device_array, effective_logical_type).
+    """
+    import warnings
+
+    if npv.dtype.itemsize == 8 and not jax.config.jax_enable_x64:
+        if npv.dtype.kind in "iu":
+            lo = int(npv.min()) if npv.size else 0
+            hi = int(npv.max()) if npv.size else 0
+            narrow = np.int32 if npv.dtype.kind == "i" else np.uint32
+            info = np.iinfo(narrow)
+            if lo < info.min or hi > info.max:
+                raise CylonError(Status(Code.ExecutionError,
+                    f"column {col_name!r}: 64-bit values do not fit in 32 bits "
+                    f"and jax_enable_x64 is off — enable x64 or use 32-bit data"))
+            eff = {Type.INT64: Type.INT32, Type.UINT64: Type.UINT32}.get(t, t)
+            warnings.warn(
+                f"column {col_name!r}: narrowing {npv.dtype} to 32-bit "
+                "(jax_enable_x64 is off)", stacklevel=3)
+            return jnp.asarray(npv.astype(narrow)), eff
+        if npv.dtype.kind == "f":
+            warnings.warn(
+                f"column {col_name!r}: narrowing float64 to float32 "
+                "(jax_enable_x64 is off)", stacklevel=3)
+            return jnp.asarray(npv.astype(np.float32)), Type.FLOAT if t == Type.DOUBLE else t
+    return jnp.asarray(npv), t
 
 
 def _encode_dictionary(arr) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
@@ -91,7 +132,7 @@ def _encode_dictionary(arr) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray
     falling back to numpy.
     """
     values = arr.to_numpy(zero_copy_only=False)  # object ndarray, None for null
-    mask = np.array([v is None for v in values], dtype=bool)
+    mask = ~np.asarray(arr.is_valid().to_numpy(zero_copy_only=False), dtype=bool)
     valid_values = values[~mask]
     from .native import runtime as _native
     codes_valid, dictionary = _native.dictionary_encode(valid_values)
@@ -171,11 +212,11 @@ class Table:
                     import pyarrow as pa
                     filled_arr = pc.fill_null(arr, pa.scalar(fill, type=arr.type))
                     npv = _typed_numpy(filled_arr, npd)
-                    data = jnp.asarray(npv)
+                    data, t = _device_put(npv, t, fld.name)
                     val = jnp.asarray(mask)
                 else:
                     npv = _typed_numpy(arr, npd)
-                    data, val = jnp.asarray(npv), None
+                    (data, t), val = _device_put(npv, t, fld.name), None
                 cols.append(Column(fld.name, DataType(t), data, val,
                                    arrow_type=fld.type))
         return Table(ctx, cols)
@@ -197,9 +238,15 @@ class Table:
             if npa.dtype == object or npa.dtype.kind in ("U", "S"):
                 return Table.from_arrow(ctx, pa.table(
                     {k: np.asarray(v) for k, v in data.items()}))
-            t = _TYPE_OF_NUMPY[np.dtype(npa.dtype).name]
+            try:
+                t = _TYPE_OF_NUMPY[np.dtype(npa.dtype).name]
+            except KeyError:
+                raise CylonError(Status(Code.NotImplemented,
+                    f"column {name!r}: unsupported numpy dtype {npa.dtype!r} "
+                    "(use from_arrow for temporal/other types)")) from None
             npa = npa.astype(device_dtype(t), copy=False)
-            cols.append(Column(name, DataType(t), jnp.asarray(npa)))
+            data, t = _device_put(npa, t, name)
+            cols.append(Column(name, DataType(t), data))
         return Table(ctx, cols)
 
     # -- export --------------------------------------------------------------

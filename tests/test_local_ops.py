@@ -1,0 +1,212 @@
+"""Local operator tests against a pandas oracle (SURVEY.md §4: property tests
+of each kernel vs an independent oracle — the reference verified with itself).
+"""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from cylon_tpu import CylonContext, Table
+from cylon_tpu import compute
+from cylon_tpu.config import JoinConfig, JoinType
+
+
+def norm(df: pd.DataFrame) -> pd.DataFrame:
+    """Order-insensitive normal form for comparing result sets."""
+    out = df.copy()
+    for c in out.columns:
+        if pd.api.types.is_numeric_dtype(out[c].dtype):
+            out[c] = out[c].astype(np.float64)
+        else:
+            out[c] = out[c].astype(object).where(out[c].notna(), "<NA>").astype(str)
+    out = out.sort_values(list(out.columns)).reset_index(drop=True)
+    return out
+
+
+def assert_same_rows(ours: pd.DataFrame, oracle: pd.DataFrame):
+    a, b = norm(ours), norm(oracle)
+    assert list(a.columns) == list(b.columns)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False, atol=1e-9)
+
+
+HOW_PANDAS = {"inner": "inner", "left": "left", "right": "right",
+              "full_outer": "outer"}
+
+
+def oracle_join(ldf, rdf, lkey, rkey, how):
+    return pd.merge(ldf.add_prefix("lt-"), rdf.add_prefix("rt-"),
+                    left_on="lt-" + lkey, right_on="rt-" + rkey,
+                    how=HOW_PANDAS[how])
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full_outer"])
+def test_join_types_int_keys(ctx, rng, how):
+    ldf = pd.DataFrame({"k": rng.integers(0, 20, 50), "a": rng.normal(size=50)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 20, 40), "b": rng.integers(0, 100, 40)})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    cfg = JoinConfig(JoinType(how), left_column_idx=0, right_column_idx=0)
+    ours = compute.join(lt, rt, cfg).to_pandas()
+    assert_same_rows(ours, oracle_join(ldf, rdf, "k", "k", how))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full_outer"])
+def test_join_string_keys(ctx, how):
+    ldf = pd.DataFrame({"k": ["a", "b", "c", "a", "x"], "v": [1, 2, 3, 4, 5]})
+    rdf = pd.DataFrame({"k": ["b", "a", "z", "b"], "w": [10., 20., 30., 40.]})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    cfg = JoinConfig(JoinType(how), left_column_idx=0, right_column_idx=0)
+    ours = compute.join(lt, rt, cfg).to_pandas()
+    assert_same_rows(ours, oracle_join(ldf, rdf, "k", "k", how))
+
+
+def test_join_duplicate_key_explosion(ctx):
+    # key-dup ratio like the reference's scaling harness (0.99 dup ratio)
+    ldf = pd.DataFrame({"k": [7] * 30 + [1, 2], "a": range(32)})
+    rdf = pd.DataFrame({"k": [7] * 25 + [2, 3], "b": range(27)})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    ours = compute.join(lt, rt, JoinConfig.InnerJoin(0, 0)).to_pandas()
+    oracle = oracle_join(ldf, rdf, "k", "k", "inner")
+    assert len(ours) == 30 * 25 + 1
+    assert_same_rows(ours, oracle)
+
+
+def test_join_empty_sides(ctx):
+    ldf = pd.DataFrame({"k": pd.Series([], dtype=np.int64),
+                        "a": pd.Series([], dtype=np.float64)})
+    rdf = pd.DataFrame({"k": [1, 2], "b": [1.0, 2.0]})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    assert compute.join(lt, rt, JoinConfig.InnerJoin()).num_rows == 0
+    fo = compute.join(lt, rt, JoinConfig.FullOuterJoin()).to_pandas()
+    assert_same_rows(fo, oracle_join(ldf, rdf, "k", "k", "full_outer"))
+    lj = compute.join(rt, lt, JoinConfig.LeftJoin()).to_pandas()
+    assert_same_rows(lj, oracle_join(rdf, ldf, "k", "k", "left"))
+
+
+def _setop_tables(ctx):
+    adf = pd.DataFrame({"x": [1, 2, 2, 3, 4], "y": ["p", "q", "q", "r", "s"]})
+    bdf = pd.DataFrame({"x": [2, 4, 5], "y": ["q", "s", "t"]})
+    return (Table.from_pandas(ctx, adf), Table.from_pandas(ctx, bdf), adf, bdf)
+
+
+def test_union(ctx):
+    ta, tb, adf, bdf = _setop_tables(ctx)
+    ours = compute.union(ta, tb).to_pandas()
+    oracle = pd.concat([adf, bdf]).drop_duplicates()
+    assert_same_rows(ours, oracle)
+
+
+def test_intersect(ctx):
+    ta, tb, adf, bdf = _setop_tables(ctx)
+    ours = compute.intersect(ta, tb).to_pandas()
+    oracle = pd.merge(adf.drop_duplicates(), bdf.drop_duplicates(),
+                      how="inner", left_on=["x", "y"], right_on=["x", "y"])
+    assert_same_rows(ours, oracle)
+
+
+def test_subtract(ctx):
+    ta, tb, adf, bdf = _setop_tables(ctx)
+    ours = compute.subtract(ta, tb).to_pandas()
+    m = adf.drop_duplicates().merge(bdf.drop_duplicates(), how="left",
+                                    indicator=True, on=["x", "y"])
+    oracle = m[m["_merge"] == "left_only"].drop(columns="_merge")
+    assert_same_rows(ours, oracle)
+
+
+def test_setops_empty(ctx):
+    ta, _, adf, _ = _setop_tables(ctx)
+    empty = Table.from_pandas(ctx, adf.iloc[:0])
+    assert compute.union(ta, empty).num_rows == len(adf.drop_duplicates())
+    assert compute.intersect(ta, empty).num_rows == 0
+    assert compute.subtract(ta, empty).num_rows == len(adf.drop_duplicates())
+    assert compute.union(empty, ta).num_rows == len(adf.drop_duplicates())
+    assert compute.subtract(empty, ta).num_rows == 0
+
+
+def test_unique(ctx, rng):
+    df = pd.DataFrame({"a": rng.integers(0, 5, 40), "b": rng.integers(0, 3, 40)})
+    t = Table.from_pandas(ctx, df)
+    assert_same_rows(compute.unique(t).to_pandas(), df.drop_duplicates())
+
+
+def test_sort(ctx, rng):
+    df = pd.DataFrame({"k": rng.integers(0, 100, 30),
+                       "v": rng.normal(size=30)})
+    t = Table.from_pandas(ctx, df)
+    ours = compute.sort(t, "k").to_pandas()
+    oracle = df.sort_values("k", kind="stable").reset_index(drop=True)
+    pd.testing.assert_frame_equal(ours, oracle, check_dtype=False)
+    ours_d = compute.sort(t, "k", ascending=False).to_pandas()
+    oracle_d = df.sort_values("k", ascending=False,
+                              kind="stable").reset_index(drop=True)
+    np.testing.assert_array_equal(ours_d["k"].values, oracle_d["k"].values)
+
+
+def test_sort_nulls_last(ctx):
+    df = pd.DataFrame({"k": [3.0, None, 1.0, None, 2.0], "v": [1, 2, 3, 4, 5]})
+    t = Table.from_pandas(ctx, df)
+    ours = compute.sort(t, "k").to_pandas()
+    assert ours["k"].tolist()[:3] == [1.0, 2.0, 3.0]
+    assert ours["k"].isna().tolist() == [False, False, False, True, True]
+
+
+def test_sort_multi(ctx, rng):
+    df = pd.DataFrame({"a": rng.integers(0, 4, 30), "b": rng.integers(0, 4, 30),
+                       "v": np.arange(30)})
+    t = Table.from_pandas(ctx, df)
+    ours = compute.sort_multi(t, ["a", "b"]).to_pandas()
+    oracle = df.sort_values(["a", "b"], kind="stable").reset_index(drop=True)
+    pd.testing.assert_frame_equal(ours, oracle, check_dtype=False)
+
+
+def test_select(ctx, rng):
+    df = pd.DataFrame({"x": rng.integers(0, 100, 50), "y": rng.normal(size=50)})
+    t = Table.from_pandas(ctx, df)
+    ours = compute.select(t, lambda c: (c["x"] > 50) & (c["y"] < 0)).to_pandas()
+    oracle = df[(df.x > 50) & (df.y < 0)].reset_index(drop=True)
+    pd.testing.assert_frame_equal(ours, oracle, check_dtype=False)
+
+
+def test_merge_concat(ctx):
+    a = pd.DataFrame({"x": [1, 2], "s": ["a", "b"]})
+    b = pd.DataFrame({"x": [3], "s": ["z"]})
+    t = compute.merge([Table.from_pandas(ctx, a), Table.from_pandas(ctx, b)])
+    pd.testing.assert_frame_equal(t.to_pandas(),
+                                  pd.concat([a, b]).reset_index(drop=True))
+
+
+def test_groupby_aggregate(ctx, rng):
+    df = pd.DataFrame({"g": rng.integers(0, 6, 60),
+                       "h": rng.integers(0, 2, 60),
+                       "v": rng.normal(size=60),
+                       "w": rng.integers(0, 10, 60)})
+    t = Table.from_pandas(ctx, df)
+    ours = compute.groupby(t, ["g", "h"],
+                           [("v", "sum"), ("v", "mean"), ("w", "max"),
+                            ("w", "min"), ("v", "count")]).to_pandas()
+    oracle = df.groupby(["g", "h"], as_index=False).agg(
+        **{"sum_v": ("v", "sum"), "mean_v": ("v", "mean"),
+           "max_w": ("w", "max"), "min_w": ("w", "min"),
+           "count_v": ("v", "count")})
+    assert_same_rows(ours, oracle)
+
+
+def test_groupby_with_null_values(ctx):
+    df = pd.DataFrame({"g": [1, 1, 2, 2, 2],
+                       "v": [1.0, None, 3.0, None, 5.0]})
+    t = Table.from_pandas(ctx, df)
+    ours = compute.groupby(t, ["g"], [("v", "sum"), ("v", "count"),
+                                      ("v", "mean")]).to_pandas()
+    oracle = df.groupby("g", as_index=False).agg(
+        **{"sum_v": ("v", "sum"), "count_v": ("v", "count"),
+           "mean_v": ("v", "mean")})
+    assert_same_rows(ours, oracle)
+
+
+def test_join_hash_algorithm_same_result(ctx, rng):
+    from cylon_tpu.config import JoinAlgorithm
+    ldf = pd.DataFrame({"k": rng.integers(0, 10, 30), "a": range(30)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 10, 30), "b": range(30)})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    s = compute.join(lt, rt, JoinConfig.InnerJoin(0, 0, JoinAlgorithm.SORT))
+    h = compute.join(lt, rt, JoinConfig.InnerJoin(0, 0, JoinAlgorithm.HASH))
+    assert_same_rows(s.to_pandas(), h.to_pandas())
