@@ -24,13 +24,17 @@ AGG_OPS = (SUM, COUNT, MEAN, MIN, MAX)
 
 
 def _group_structure(key_cols: Sequence[jax.Array],
-                     key_validities: Sequence[Optional[jax.Array]]):
+                     key_validities: Sequence[Optional[jax.Array]],
+                     valid: Optional[jax.Array] = None):
     keys = []
     for c, v in zip(key_cols, key_validities):
         keys.append(c)
         if v is not None:
             keys.append(~v)
-    order = jnp.lexsort(tuple(reversed(keys)))
+    seq = list(reversed(keys))
+    if valid is not None:
+        seq.append(~valid)  # most significant: padding rows sort last
+    order = jnp.lexsort(tuple(seq))
     n = key_cols[0].shape[0]
     is_first = jnp.zeros(n, bool).at[0].set(True)
     for c, v in zip(key_cols, key_validities):
@@ -39,6 +43,9 @@ def _group_structure(key_cols: Sequence[jax.Array],
         if v is not None:
             vs = jnp.take(v, order)
             is_first |= jnp.concatenate([jnp.ones((1,), bool), vs[1:] != vs[:-1]])
+    if valid is not None:
+        vs = jnp.take(valid, order)
+        is_first |= jnp.concatenate([jnp.ones((1,), bool), vs[1:] != vs[:-1]])
     group_id = jnp.cumsum(is_first) - 1
     return order, is_first, group_id
 
@@ -48,8 +55,13 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
                       key_validities: Sequence[Optional[jax.Array]],
                       value_cols: Sequence[jax.Array],
                       value_validities: Sequence[Optional[jax.Array]],
-                      aggs: Tuple[str, ...]):
+                      aggs: Tuple[str, ...],
+                      row_valid: Optional[jax.Array] = None):
     """Aggregate ``value_cols[i]`` with ``aggs[i]`` per distinct key row.
+
+    ``row_valid`` marks real rows in padded blocks (None = all real);
+    padding rows sort last, form their own (dropped) groups, and group ids
+    [0, count) are exactly the real groups.
 
     Returns (key_row_indices[n] padded −1, agg_arrays (one per value col,
     each [n]), agg_validities, count).  Null handling is pandas-style: null
@@ -57,9 +69,13 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
     min/max/mean) or 0 (sum/count).
     """
     n = key_cols[0].shape[0]
-    order, is_first, group_id = _group_structure(key_cols, key_validities)
-    num_groups = jnp.sum(is_first).astype(jnp.int32)
-    key_pos = jnp.flatnonzero(is_first, size=n, fill_value=-1)
+    order, is_first, group_id = _group_structure(key_cols, key_validities,
+                                                 row_valid)
+    rv = (jnp.ones(n, bool) if row_valid is None
+          else jnp.take(row_valid, order))
+    keep_first = is_first & rv  # padding groups start with an invalid row
+    num_groups = jnp.sum(keep_first).astype(jnp.int32)
+    key_pos = jnp.flatnonzero(keep_first, size=n, fill_value=-1)
     key_idx = jnp.where(key_pos >= 0,
                         jnp.take(order, jnp.clip(key_pos, 0, n - 1)).astype(jnp.int32),
                         jnp.int32(-1))
@@ -67,8 +83,8 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
     outs, out_valids = [], []
     for col, validity, agg in zip(value_cols, value_validities, aggs):
         vs = jnp.take(col, order)
-        valid = (jnp.ones(n, bool) if validity is None
-                 else jnp.take(validity, order))
+        valid = (rv if validity is None
+                 else rv & jnp.take(validity, order))
         cnt = jax.ops.segment_sum(valid.astype(jnp.int64 if
                                                jax.config.jax_enable_x64
                                                else jnp.int32),

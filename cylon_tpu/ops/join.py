@@ -20,11 +20,20 @@ Join outputs are data-dependent, so the kernel is two-phase under jit
 
 Keys are single pre-combined arrays; the table layer encodes null keys and
 unifies string dictionaries before calling in.
+
+**Padded blocks (the distributed path).**  Shuffle outputs are static-capacity
+blocks whose rows [0, count) are valid (SPMD shapes must be uniform across
+shards).  Both phases therefore take optional traced ``l_count``/``r_count``:
+padding rows are masked to the max-value sentinel, which sorts them to the
+tail (valid rows occupy sorted positions [0, count) because padding always
+lives at original indices ≥ count), and match ranges are clamped to the valid
+prefix.  ``None`` (the local path) means "all rows valid" and compiles to the
+unmasked program.
 """
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,61 +41,98 @@ import jax.numpy as jnp
 INNER, LEFT, RIGHT, FULL_OUTER = "inner", "left", "right", "full_outer"
 
 
-def _match_ranges(l_key: jax.Array, r_key: jax.Array):
-    """Sort both sides; per left row, the [lo, hi) run of equal keys in right."""
+def _pad_sentinel(dtype):
+    """Key substituted for padding rows; sorts last.  Shares the max-value
+    slot with the null sentinel (compute._null_sentinel) — the clamp to the
+    valid prefix is what keeps padding from matching genuine max/null keys."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.finfo(dtype).max, dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)
+
+
+def _masked(key: jax.Array, count) -> jax.Array:
+    if count is None:
+        return key
+    n = key.shape[0]
+    return jnp.where(jnp.arange(n) < count, key, _pad_sentinel(key.dtype))
+
+
+def _match_ranges(l_key: jax.Array, r_key: jax.Array, l_count, r_count):
+    """Sort both sides; per sorted-left row, the [lo, hi) run of equal keys in
+    sorted right, clamped to right's valid prefix; cnt zeroed for padding."""
+    l_key = _masked(l_key, l_count)
+    r_key = _masked(r_key, r_count)
     ls = jnp.argsort(l_key, stable=True)
     rs = jnp.argsort(r_key, stable=True)
     lk = jnp.take(l_key, ls)
     rk = jnp.take(r_key, rs)
     lo = jnp.searchsorted(rk, lk, side="left")
     hi = jnp.searchsorted(rk, lk, side="right")
-    return ls, rs, lk, rk, lo, hi
+    if r_count is not None:
+        hi = jnp.minimum(hi, r_count)
+    cnt = jnp.maximum(hi - lo, 0)
+    if l_count is not None:
+        valid_l = ls < l_count
+        cnt = jnp.where(valid_l, cnt, 0)
+    else:
+        valid_l = jnp.ones(ls.shape, bool)
+    return ls, rs, lk, rk, lo, cnt, valid_l
 
 
-def _right_matched(lk: jax.Array, rk: jax.Array) -> jax.Array:
-    """Per sorted-right row: does its key occur on the left?"""
+def _right_matched(lk: jax.Array, rk: jax.Array, l_count) -> jax.Array:
+    """Per sorted-right position: does its key occur among valid left rows?"""
     lo = jnp.searchsorted(lk, rk, side="left")
     hi = jnp.searchsorted(lk, rk, side="right")
+    if l_count is not None:
+        hi = jnp.minimum(hi, l_count)
     return hi > lo
 
 
 @functools.partial(jax.jit, static_argnames=("how",))
-def join_count(l_key: jax.Array, r_key: jax.Array, how: str = INNER) -> jax.Array:
+def join_count(l_key: jax.Array, r_key: jax.Array, how: str = INNER,
+               l_count=None, r_count=None) -> jax.Array:
     """Phase 1: exact number of output rows for this join."""
     if how == RIGHT:
-        return join_count(r_key, l_key, LEFT)
-    _, _, lk, rk, lo, hi = _match_ranges(l_key, r_key)
-    cnt = (hi - lo).astype(jnp.int64) if jax.config.jax_enable_x64 \
-        else (hi - lo).astype(jnp.int32)
+        return join_count(r_key, l_key, LEFT, r_count, l_count)
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    n_l, n_r = l_key.shape[0], r_key.shape[0]
+    if n_l == 0 or n_r == 0:
+        _, _, total = _degenerate(l_key, r_key, how, 1, idt, l_count, r_count)
+        return total
+    _, _, lk, rk, _, cnt, valid_l = _match_ranges(l_key, r_key, l_count, r_count)
+    cnt = cnt.astype(idt)
     total = jnp.sum(cnt)
     if how == INNER:
         return total
-    left_total = total + jnp.sum(cnt == 0)
+    left_total = total + jnp.sum(valid_l & (cnt == 0))
     if how == LEFT:
         return left_total
     if how == FULL_OUTER:
-        return left_total + jnp.sum(~_right_matched(lk, rk))
+        valid_r = (jnp.ones(rk.shape, bool) if r_count is None
+                   else jnp.arange(n_r) < r_count)
+        return left_total + jnp.sum(valid_r & ~_right_matched(lk, rk, l_count))
     raise ValueError(f"unknown join type {how!r}")
 
 
 @functools.partial(jax.jit, static_argnames=("how", "capacity"))
-def join_indices(l_key: jax.Array, r_key: jax.Array, how: str, capacity: int
+def join_indices(l_key: jax.Array, r_key: jax.Array, how: str, capacity: int,
+                 l_count=None, r_count=None
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Phase 2: (left_idx[cap], right_idx[cap], count). −1 ⇒ null row.
 
     Rows [0, count) are valid; the rest is padding (−1, −1).
     """
     if how == RIGHT:
-        ri, li, n = join_indices(r_key, l_key, LEFT, capacity)
+        ri, li, n = join_indices(r_key, l_key, LEFT, capacity, r_count, l_count)
         return li, ri, n
     n_l, n_r = l_key.shape[0], r_key.shape[0]
     idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     if n_l == 0 or n_r == 0:
-        return _degenerate(l_key, r_key, how, capacity, idt)
+        return _degenerate(l_key, r_key, how, capacity, idt, l_count, r_count)
 
-    ls, rs, lk, rk, lo, hi = _match_ranges(l_key, r_key)
-    cnt = (hi - lo).astype(idt)
-    emit = cnt if how == INNER else jnp.maximum(cnt, 1)
+    ls, rs, lk, rk, lo, cnt, valid_l = _match_ranges(l_key, r_key, l_count, r_count)
+    cnt = cnt.astype(idt)
+    emit = cnt if how == INNER else jnp.where(valid_l, jnp.maximum(cnt, 1), 0)
     offs_incl = jnp.cumsum(emit)
     total_lpart = offs_incl[-1]
 
@@ -103,7 +149,9 @@ def join_indices(l_key: jax.Array, r_key: jax.Array, how: str, capacity: int
                           jnp.int32(-1))
 
     if how == FULL_OUTER:
-        unmatched_r = ~_right_matched(lk, rk)
+        valid_r = (jnp.ones(rk.shape, bool) if r_count is None
+                   else jnp.arange(n_r) < r_count)
+        unmatched_r = valid_r & ~_right_matched(lk, rk, l_count)
         n_um = jnp.sum(unmatched_r.astype(idt))
         um_pos = jnp.flatnonzero(unmatched_r, size=n_r, fill_value=0)
         k = jnp.clip(j - total_lpart, 0, max(n_r - 1, 0))
@@ -121,21 +169,23 @@ def join_indices(l_key: jax.Array, r_key: jax.Array, how: str, capacity: int
     return left_idx, right_idx, total.astype(jnp.int32)
 
 
-def _degenerate(l_key, r_key, how, capacity, idt):
-    """One side empty: inner ⇒ ∅; outer ⇒ null-filled survivors."""
+def _degenerate(l_key, r_key, how, capacity, idt, l_count=None, r_count=None):
+    """One side statically empty: inner ⇒ ∅; outer ⇒ null-filled survivors."""
     n_l, n_r = l_key.shape[0], r_key.shape[0]
+    lc = jnp.asarray(n_l if l_count is None else l_count, idt)
+    rc = jnp.asarray(n_r if r_count is None else r_count, idt)
     j = jnp.arange(capacity, dtype=idt)
     neg = jnp.full((capacity,), -1, jnp.int32)
     if how == INNER or (how == LEFT and n_l == 0):
         return neg, neg, jnp.int32(0)
-    if how == LEFT:  # n_r == 0: every left row survives null-filled
-        li = jnp.where(j < n_l, j, -1).astype(jnp.int32)
-        return li, neg, jnp.int32(n_l)
+    if how == LEFT:  # n_r == 0: every valid left row survives null-filled
+        li = jnp.where(j < lc, j, -1).astype(jnp.int32)
+        return li, neg, lc.astype(jnp.int32)
     # FULL_OUTER with an empty side: survivors of the non-empty side
     if n_l == 0 and n_r == 0:
         return neg, neg, jnp.int32(0)
     if n_r == 0:
-        li = jnp.where(j < n_l, j, -1).astype(jnp.int32)
-        return li, neg, jnp.int32(n_l)
-    ri = jnp.where(j < n_r, j, -1).astype(jnp.int32)
-    return neg, ri, jnp.int32(n_r)
+        li = jnp.where(j < lc, j, -1).astype(jnp.int32)
+        return li, neg, lc.astype(jnp.int32)
+    ri = jnp.where(j < rc, j, -1).astype(jnp.int32)
+    return neg, ri, rc.astype(jnp.int32)

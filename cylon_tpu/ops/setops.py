@@ -31,8 +31,14 @@ UNION, INTERSECT, SUBTRACT = "union", "intersect", "subtract"
 
 def _row_order_and_groups(cols: Sequence[jax.Array],
                           validities: Sequence[Optional[jax.Array]],
-                          origin: jax.Array):
-    """Sort rows lexicographically (origin last), mark distinct-row starts."""
+                          origin: jax.Array,
+                          valid: Optional[jax.Array] = None):
+    """Sort rows lexicographically (origin last), mark distinct-row starts.
+
+    ``valid`` (padded-block support): invalid rows sort after ALL valid rows
+    (most-significant key) and start their own groups, so padding never
+    shares a group with a real row.
+    """
     # jnp.lexsort sorts by the LAST key first; origin goes FIRST in the
     # sequence so it's the least-significant tie-break — identical rows from
     # A and B land adjacent, with the A copies leading their group.
@@ -41,6 +47,8 @@ def _row_order_and_groups(cols: Sequence[jax.Array],
         keys.append(c)
         if v is not None:
             keys.append(~v)
+    if valid is not None:
+        keys.append(~valid)  # most significant: padding last
     order = jnp.lexsort(tuple(keys))
     is_first = jnp.zeros(origin.shape[0], bool).at[0].set(True)
     for c, v in zip(cols, validities):
@@ -51,35 +59,44 @@ def _row_order_and_groups(cols: Sequence[jax.Array],
             vs = jnp.take(v, order)
             vdiff = jnp.concatenate([jnp.ones((1,), bool), vs[1:] != vs[:-1]])
             is_first = is_first | vdiff
+    if valid is not None:
+        vs = jnp.take(valid, order)
+        is_first = is_first | jnp.concatenate(
+            [jnp.ones((1,), bool), vs[1:] != vs[:-1]])
     return order, is_first
 
 
 @functools.partial(jax.jit, static_argnames=("op", "n_a"))
 def set_op_indices(cols: Sequence[jax.Array],
                    validities: Sequence[Optional[jax.Array]],
-                   n_a: int, op: str) -> Tuple[jax.Array, jax.Array]:
+                   n_a: int, op: str,
+                   valid: Optional[jax.Array] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
     """Run a set op over concatenated row columns.
 
     ``cols[i]`` holds table A's rows [0, n_a) followed by table B's rows.
+    ``valid`` marks real rows in padded blocks (None = all rows real).
     Returns (indices into the concatenated rows padded with −1, count).
     Capacity: n_a + n_b for union, n_a for intersect/subtract.
     """
     n = cols[0].shape[0]
     n_b = n - n_a
     origin = (jnp.arange(n) >= n_a)  # False=A, True=B
-    order, is_first = _row_order_and_groups(cols, validities, origin)
+    order, is_first = _row_order_and_groups(cols, validities, origin, valid)
     group_id = jnp.cumsum(is_first) - 1  # [n] ints, < n
 
     og = jnp.take(origin, order)
-    from_a = (~og).astype(jnp.int32)
-    from_b = og.astype(jnp.int32)
+    vg = (jnp.ones(n, bool) if valid is None else jnp.take(valid, order))
+    from_a = (~og & vg).astype(jnp.int32)
+    from_b = (og & vg).astype(jnp.int32)
     has_a = jax.ops.segment_max(from_a, group_id, num_segments=n) > 0
     has_b = jax.ops.segment_max(from_b, group_id, num_segments=n) > 0
 
     # group representative = its first sorted row; origin is the last sort
     # key, so when a group spans both tables the representative is from A.
+    # Padding-only groups have neither has_a nor has_b and are dropped.
     if op == UNION:
-        keep_group = has_a | has_b  # every group (trivially true for real groups)
+        keep_group = has_a | has_b
         capacity = n
     elif op == INTERSECT:
         keep_group = has_a & has_b

@@ -51,6 +51,18 @@ def lexsort_indices(cols: Sequence[jax.Array],
     return jnp.lexsort(tuple(flat))
 
 
+def sort_indices_masked(col: jax.Array, validity: Optional[jax.Array],
+                        count, ascending: bool = True) -> jax.Array:
+    """Stable argsort of a padded block: rows [0, count) ordered (nulls last),
+    padding rows sorted to the tail.  Used by the distributed sort where
+    shuffle outputs are static-capacity blocks."""
+    n = col.shape[0]
+    ispad = jnp.arange(n) >= count
+    key = col if ascending else _invert(col)
+    isnull = jnp.zeros(n, bool) if validity is None else ~validity
+    return jnp.lexsort((key, isnull, ispad))
+
+
 def _invert(col: jax.Array) -> jax.Array:
     """Order-reversing transform for descending sort."""
     if jnp.issubdtype(col.dtype, jnp.floating):

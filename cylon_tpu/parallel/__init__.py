@@ -1,0 +1,20 @@
+"""Distributed layer (L2): mesh-sharded tables + the shuffle engine.
+
+TPU-native replacement for the reference's entire ``cylon::net`` +
+``ArrowAllToAll`` stack (reference: cpp/src/cylon/net/ops/all_to_all.cpp,
+net/mpi/mpi_channel.cpp, arrow/arrow_all_to_all.cpp) and the distributed
+table ops built on it (reference: cpp/src/cylon/table_api.cpp:214-352,
+904-975).  Rows live in HBM sharded over a ``jax.sharding.Mesh``; the
+rendezvous/AllToAll protocol collapses into a two-phase static-shape
+``lax.all_to_all`` under ``shard_map`` (SURVEY.md §2.4).
+"""
+from .dtable import DColumn, DTable
+from .shuffle import shuffle_leaves
+from .dist_ops import (dist_groupby, dist_intersect, dist_join, dist_sort,
+                       dist_subtract, dist_union, shuffle_table)
+
+__all__ = [
+    "DColumn", "DTable", "shuffle_leaves", "shuffle_table",
+    "dist_join", "dist_union", "dist_intersect", "dist_subtract",
+    "dist_groupby", "dist_sort",
+]

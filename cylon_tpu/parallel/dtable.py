@@ -1,0 +1,250 @@
+"""Distributed Table: columns sharded over the device mesh.
+
+Each column is ONE global ``jax.Array`` of shape ``[P * cap]`` with
+``NamedSharding(mesh, P('p'))`` on axis 0 — shard *i* (one TPU chip = one
+reference MPI rank) holds rows ``[i*cap, i*cap + counts[i])``; the rest of
+its block is padding.  Static per-shard capacity + dynamic valid counts is
+how data-dependent row distribution meets XLA's static-shape SPMD model
+(SURVEY.md §7 hard part 1).
+
+The reference has no separate distributed-table type: an ``arrow::Table``
+per rank *is* the partition (reference: cpp/src/cylon/table.hpp:39-278,
+docs/docs/arch.md:7-25 — every rank runs the same program on its local
+table).  Under single-controller JAX the partitioned state must be a
+first-class object, hence DTable.
+
+String columns carry ONE host dictionary shared by all shards (codes are
+what travels through collectives); ``from_partitions`` re-encodes per-rank
+dictionaries onto a shared one at ingest.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import CylonContext
+from ..dtypes import DataType, is_dictionary_encoded
+from ..ops import compact as ops_compact
+from ..status import Code, CylonError, Status
+from ..table import Column, Table
+
+
+@dataclass
+class DColumn:
+    """One distributed column: global sharded data + optional validity.
+
+    reference: cpp/src/cylon/column.hpp:163-193, except data is a mesh-
+    sharded device array rather than a host Arrow array.
+    """
+
+    name: str
+    dtype: DataType
+    data: jax.Array                        # [P*cap] sharded P('p')
+    validity: Optional[jax.Array] = None   # [P*cap] bool, same sharding
+    dictionary: Optional[np.ndarray] = None
+    arrow_type: Any = None
+
+
+class DTable:
+    """Mesh-partitioned table: padded per-shard blocks + valid counts."""
+
+    def __init__(self, ctx: CylonContext, columns: List[DColumn], cap: int,
+                 counts: jax.Array):
+        self.ctx = ctx
+        self.columns = columns
+        self.cap = int(cap)
+        self.counts = counts               # [P] int32, sharded P('p')
+        self._counts_host: Optional[np.ndarray] = None
+
+    # -- shape ---------------------------------------------------------------
+
+    @property
+    def nparts(self) -> int:
+        return self.ctx.get_world_size()
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def counts_host(self) -> np.ndarray:
+        if self._counts_host is None:
+            self._counts_host = np.asarray(jax.device_get(self.counts))
+        return self._counts_host
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.counts_host().sum())
+
+    def column(self, i: Union[int, str]) -> DColumn:
+        if isinstance(i, str):
+            for c in self.columns:
+                if c.name == i:
+                    return c
+            raise CylonError(Status(Code.KeyError, f"no column {i!r}"))
+        return self.columns[i]
+
+    def column_index(self, i: Union[int, str]) -> int:
+        if isinstance(i, str):
+            for j, c in enumerate(self.columns):
+                if c.name == i:
+                    return j
+            raise CylonError(Status(Code.KeyError, f"no column {i!r}"))
+        return i
+
+    def verify_same_schema(self, other: "DTable") -> None:
+        """reference: table_api.cpp:566 (VerifyTableSchema)."""
+        if self.num_columns != other.num_columns:
+            raise CylonError(Status(Code.Invalid,
+                f"column count mismatch {self.num_columns} vs {other.num_columns}"))
+        for a, b in zip(self.columns, other.columns):
+            if a.dtype.type != b.dtype.type:
+                raise CylonError(Status(Code.TypeError,
+                    f"type mismatch {a.name}:{a.dtype.type.name} vs "
+                    f"{b.name}:{b.dtype.type.name}"))
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def from_table(ctx: CylonContext, table: Table, cap: Optional[int] = None
+                   ) -> "DTable":
+        """Block-distribute a local table's rows over the mesh.
+
+        The single-controller analogue of "mpirun gave every rank a slice"
+        (reference: docs/docs/mpi.md:7-14 — scheduling is whatever mpirun
+        launched).
+        """
+        Pn = ctx.get_world_size()
+        n = table.num_rows
+        base, rem = divmod(n, Pn)
+        sizes = np.array([base + (1 if i < rem else 0) for i in range(Pn)],
+                         np.int32)
+        if cap is None:
+            cap = ops_compact.next_bucket(max(int(sizes.max(initial=0)), 1),
+                                          minimum=8)
+        offs = np.concatenate([[0], np.cumsum(sizes)])
+        cols: List[DColumn] = []
+        for c in table.columns:
+            data = _blocked_put(ctx, np.asarray(jax.device_get(c.data)),
+                                sizes, offs, cap)
+            validity = (None if c.validity is None else
+                        _blocked_put(ctx,
+                                     np.asarray(jax.device_get(c.validity),
+                                                dtype=bool),
+                                     sizes, offs, cap))
+            cols.append(DColumn(c.name, c.dtype, data, validity,
+                                c.dictionary, c.arrow_type))
+        counts = jax.device_put(sizes, ctx.sharding())
+        return DTable(ctx, cols, cap, counts)
+
+    @staticmethod
+    def from_partitions(ctx: CylonContext, parts: Sequence[Table],
+                        cap: Optional[int] = None) -> "DTable":
+        """Build from one local Table per mesh position (the per-rank-CSV
+        ingest path: reference examples/bench/table_join_dist_test.cpp:87-91
+        reads ``csv1_<rank>.csv`` on each rank)."""
+        Pn = ctx.get_world_size()
+        if len(parts) != Pn:
+            raise CylonError(Status(Code.Invalid,
+                f"{len(parts)} partitions for a {Pn}-device mesh"))
+        head = parts[0]
+        for p in parts[1:]:
+            head.verify_same_schema(p)
+        sizes = np.array([p.num_rows for p in parts], np.int32)
+        if cap is None:
+            cap = ops_compact.next_bucket(max(int(sizes.max(initial=0)), 1),
+                                          minimum=8)
+        cols: List[DColumn] = []
+        for j, c0 in enumerate(head.columns):
+            pcols = [p.columns[j] for p in parts]
+            dictionary = None
+            hosts = [np.asarray(jax.device_get(pc.data)) for pc in pcols]
+            if is_dictionary_encoded(c0.dtype.type):
+                dicts = [pc.dictionary for pc in pcols]
+                dictionary = np.unique(np.concatenate(dicts)) if any(
+                    len(d) for d in dicts) else dicts[0]
+                hosts = [np.searchsorted(dictionary, d)[h].astype(np.int32)
+                         if len(d) else h
+                         for h, d in zip(hosts, dicts)]
+            block = np.zeros((Pn * cap,) + hosts[0].shape[1:], hosts[0].dtype)
+            for i in range(Pn):
+                block[i * cap:i * cap + sizes[i]] = hosts[i]
+            data = jax.device_put(block, ctx.sharding())
+            if any(pc.validity is not None for pc in pcols):
+                vb = np.zeros((Pn * cap,), bool)
+                for i, pc in enumerate(pcols):
+                    vb[i * cap:i * cap + sizes[i]] = (
+                        np.ones(sizes[i], bool) if pc.validity is None
+                        else np.asarray(jax.device_get(pc.validity), bool))
+                validity = jax.device_put(vb, ctx.sharding())
+            else:
+                validity = None
+            cols.append(DColumn(c0.name, c0.dtype, data, validity,
+                                dictionary, c0.arrow_type))
+        counts = jax.device_put(sizes, ctx.sharding())
+        return DTable(ctx, cols, cap, counts)
+
+    # -- export --------------------------------------------------------------
+
+    def to_table(self) -> Table:
+        """Gather all shards to one local Table (drops padding)."""
+        cnts = self.counts_host()
+        cols: List[Column] = []
+        for c in self.columns:
+            host = np.asarray(jax.device_get(c.data))
+            parts = [host[i * self.cap:i * self.cap + cnts[i]]
+                     for i in range(self.nparts)]
+            data = jnp.asarray(np.concatenate(parts) if parts
+                               else host[:0])
+            if c.validity is not None:
+                vh = np.asarray(jax.device_get(c.validity), bool)
+                vparts = [vh[i * self.cap:i * self.cap + cnts[i]]
+                          for i in range(self.nparts)]
+                validity = jnp.asarray(np.concatenate(vparts))
+            else:
+                validity = None
+            cols.append(Column(c.name, c.dtype, data, validity,
+                               dictionary=c.dictionary, arrow_type=c.arrow_type))
+        return Table(self.ctx, cols)
+
+    def partition(self, i: int) -> Table:
+        """Shard *i*'s rows as a local Table (a rank's-eye view)."""
+        cnt = int(self.counts_host()[i])
+        cols: List[Column] = []
+        for c in self.columns:
+            host = np.asarray(jax.device_get(c.data))
+            data = jnp.asarray(host[i * self.cap:i * self.cap + cnt])
+            if c.validity is not None:
+                vh = np.asarray(jax.device_get(c.validity), bool)
+                validity = jnp.asarray(vh[i * self.cap:i * self.cap + cnt])
+            else:
+                validity = None
+            cols.append(Column(c.name, c.dtype, data, validity,
+                               dictionary=c.dictionary, arrow_type=c.arrow_type))
+        return Table(self.ctx, cols)
+
+    def rename(self, names: Sequence[str]) -> "DTable":
+        return DTable(self.ctx, [replace(c, name=n)
+                                 for c, n in zip(self.columns, names)],
+                      self.cap, self.counts)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{c.name}:{c.dtype.type.name}" for c in self.columns)
+        return (f"DTable[{self.num_rows} rows over {self.nparts} shards, "
+                f"cap={self.cap}]({cols})")
+
+
+def _blocked_put(ctx: CylonContext, host: np.ndarray, sizes: np.ndarray,
+                 offs: np.ndarray, cap: int) -> jax.Array:
+    Pn = len(sizes)
+    block = np.zeros((Pn * cap,) + host.shape[1:], host.dtype)
+    for i in range(Pn):
+        block[i * cap:i * cap + sizes[i]] = host[offs[i]:offs[i + 1]]
+    return jax.device_put(block, ctx.sharding())

@@ -1,0 +1,124 @@
+"""Two-phase static-shape shuffle: the ICI replacement for cylon::net.
+
+The reference moves rows with a user-space progress engine — per-peer
+rendezvous state machines over ``MPI_Isend/Irecv`` polled by ``MPI_Test``
+(reference: cpp/src/cylon/net/mpi/mpi_channel.cpp:27-243), a queueing
+AllToAll with FIN bookkeeping (net/ops/all_to_all.cpp:26-177), and an Arrow
+buffer walker on top (arrow/arrow_all_to_all.cpp:80-221).  None of that
+machinery exists here: XLA compiles ONE collective per column buffer and the
+ICI network does the rest (SURVEY.md §2.4).
+
+Variable-length sends meet XLA's static shapes with the two-phase plan:
+
+  phase 1 (counts)    per-shard ``bincount`` of target ids → ``[P, P]``
+                      matrix on host (a tiny transfer — the analogue of the
+                      reference's 8-int header messages).
+  phase 2 (exchange)  rows grouped by target via one argsort, padded to a
+                      power-of-two block ``M = bucket(max count)``, one
+                      ``lax.all_to_all`` per column leaf, then receiver-side
+                      compaction to ``bucket(max rows received)``.
+
+Bucketing both shapes to powers of two bounds recompilation
+(SURVEY.md §7 hard part 1).  Peak extra memory is ``P*M`` rows per column —
+the padded send buffer; the FIN protocol, backpressure caps and spin loops
+of the reference (table_api.cpp:260-261) have no equivalent because the
+collective is one program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..ops import compact as ops_compact
+
+
+def _bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
+    return mask.reshape(mask.shape + (1,) * (like.ndim - mask.ndim))
+
+
+@functools.lru_cache(maxsize=None)
+def _counts_fn(mesh, axis: str, nparts: int):
+    """pid [P*cap] → counts [P, P]; counts[s, t] = rows sender s has for t."""
+
+    def kernel(pid_blk):
+        cnt = jnp.bincount(pid_blk, length=nparts + 1)[:nparts]
+        return cnt.astype(jnp.int32)[None, :]
+
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=P(axis), out_specs=P(axis)))
+
+
+@functools.lru_cache(maxsize=None)
+def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
+    """The exchange program: group-by-target, all_to_all, compact.
+
+    Returns a jitted fn ``(pid, leaves_tuple) -> (counts[P], new_leaves)``
+    reused across calls with the same (mesh, block, outcap); differing leaf
+    structures hit jit's own cache.
+    """
+
+    def kernel(pid_blk, leaves):
+        cap = pid_blk.shape[0]
+        order = jnp.argsort(pid_blk, stable=True)     # rows grouped by target
+        cnt = jnp.bincount(pid_blk, length=nparts + 1)[:nparts].astype(jnp.int32)
+        offs = jnp.concatenate([jnp.zeros((1,), cnt.dtype),
+                                jnp.cumsum(cnt)])[:-1]
+        jj = jnp.arange(block, dtype=jnp.int32)[None, :]
+        gather_pos = jnp.clip(offs[:, None] + jj, 0, cap - 1)
+        send_idx = jnp.take(order, gather_pos)        # [P, block]
+        valid_send = jj < cnt[:, None]
+
+        # the 8-int header of mpi_channel.cpp, as one int exchange
+        rcnt = jax.lax.all_to_all(cnt, axis, 0, 0, tiled=True)  # [P]
+        recv_valid = (jnp.arange(block, dtype=jnp.int32)[None, :]
+                      < rcnt[:, None]).reshape(-1)    # [P*block]
+        vidx = jnp.flatnonzero(recv_valid, size=outcap, fill_value=0)
+        newcount = jnp.sum(rcnt).astype(jnp.int32)
+        keep = jnp.arange(outcap, dtype=jnp.int32) < newcount
+
+        outs = []
+        for leaf in leaves:
+            as_bool = leaf.dtype == jnp.bool_
+            x = leaf.astype(jnp.uint8) if as_bool else leaf
+            S = jnp.take(x, send_idx, axis=0)         # [P, block, ...]
+            S = jnp.where(_bcast(valid_send, S), S, jnp.zeros((), S.dtype))
+            R = jax.lax.all_to_all(S, axis, 0, 0, tiled=True)
+            flat = R.reshape((nparts * block,) + R.shape[2:])
+            C = jnp.take(flat, vidx, axis=0)
+            C = jnp.where(_bcast(keep, C), C, jnp.zeros((), C.dtype))
+            outs.append(C.astype(jnp.bool_) if as_bool else C)
+        return newcount[None], tuple(outs)
+
+    f = shard_map(kernel, mesh=mesh,
+                  in_specs=(P(axis), P(axis)),
+                  out_specs=(P(axis), P(axis)))
+    return jax.jit(f)
+
+
+def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
+                   ) -> Tuple[List[jax.Array], jax.Array, int]:
+    """Repartition rows of sharded ``leaves`` by target ids ``pid``.
+
+    ``pid`` is [P*cap] int32 sharded over the mesh: the target shard per
+    row, with padding rows set to P (dropped).  Returns
+    ``(new_leaves [P*outcap], counts [P], outcap)``.
+
+    reference: cpp/src/cylon/table_api.cpp:214-297 (Shuffle) — here the
+    HashPartition+split+AllToAll+concat pipeline is phase1+phase2.
+    """
+    mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
+    counts = np.asarray(jax.device_get(_counts_fn(mesh, axis, Pn)(pid)))
+    block = ops_compact.next_bucket(max(int(counts.max(initial=0)), 1),
+                                    minimum=8)
+    per_recv = counts.sum(axis=0)
+    outcap = ops_compact.next_bucket(max(int(per_recv.max(initial=0)), 1),
+                                     minimum=8)
+    newcounts, outs = _exchange_fn(mesh, axis, Pn, block, outcap)(
+        pid, tuple(leaves))
+    return list(outs), newcounts, outcap
