@@ -26,6 +26,7 @@ from .dtypes import Type, is_dictionary_encoded
 from .ops import compact as ops_compact
 from .ops import gather as ops_gather
 from .ops import groupby as ops_groupby
+from .ops import hashjoin as ops_hashjoin
 from .ops import join as ops_join
 from .ops import setops as ops_setops
 from .ops import sort as ops_sort
@@ -36,23 +37,6 @@ from .table import Column, Table, unify_dictionaries, unify_tables
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
-
-def _null_sentinel(dtype) -> jnp.ndarray:
-    """Value substituted for null keys so null == null in joins/sorts.
-
-    Collides with genuine max-value keys; documented divergence (the
-    reference joins on raw slot bytes under nulls, which is garbage).
-    """
-    if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.array(jnp.finfo(dtype).max, dtype)
-    return jnp.array(jnp.iinfo(dtype).max, dtype)
-
-
-def _key_array(col: Column) -> jax.Array:
-    if col.validity is None:
-        return col.data
-    return jnp.where(col.validity, col.data, _null_sentinel(col.data.dtype))
-
 
 def _gather_columns(tb: Table, indices: jax.Array, fill_null: bool,
                     prefix: str = "") -> List[Column]:
@@ -88,24 +72,50 @@ def _concat_columns(a: Column, b: Column, name: Optional[str] = None) -> Column:
 # join (reference: table_api.cpp JoinTables -> join/join.cpp)
 # ---------------------------------------------------------------------------
 
+def _join_key_ranks(left: Table, right: Table,
+                    left_idx: Sequence[Union[int, str]],
+                    right_idx: Sequence[Union[int, str]]
+                    ) -> Tuple[Table, Table, jax.Array, jax.Array]:
+    """Type-check + dictionary-unify key columns, then dense-rank them."""
+    l_ids = [left.column_names.index(i) if isinstance(i, str) else i
+             for i in left_idx]
+    r_ids = [right.column_names.index(i) if isinstance(i, str) else i
+             for i in right_idx]
+    for li, ri in zip(l_ids, r_ids):
+        lt, rt = left.columns[li].dtype.type, right.columns[ri].dtype.type
+        if lt != rt:
+            raise CylonError(Status(Code.TypeError,
+                f"join key type mismatch {lt.name} vs {rt.name}"))
+    if any(is_dictionary_encoded(left.columns[i].dtype.type) for i in l_ids):
+        left, right = unify_tables(left, right, l_ids, r_ids)
+    lcols = [left.columns[i] for i in l_ids]
+    rcols = [right.columns[i] for i in r_ids]
+    lrank, rrank = ops_join.dense_ranks(
+        tuple(c.data for c in lcols), tuple(c.validity for c in lcols),
+        tuple(c.data for c in rcols), tuple(c.validity for c in rcols))
+    return left, right, lrank, rrank
+
+
 def join(left: Table, right: Table, config: JoinConfig) -> Table:
     """Local equi-join; output columns renamed ``lt-…`` / ``rt-…``
-    (reference: join/join_utils.cpp:23-95 build_final_table)."""
-    lcol = left.column(config.left_column_idx)
-    rcol = right.column(config.right_column_idx)
-    if lcol.dtype.type != rcol.dtype.type:
-        raise CylonError(Status(Code.TypeError,
-            f"join key type mismatch {lcol.dtype.type.name} vs {rcol.dtype.type.name}"))
-    if is_dictionary_encoded(lcol.dtype.type):
-        left, right = unify_tables(left, right, [config.left_column_idx],
-                                   [config.right_column_idx])
-        lcol = left.column(config.left_column_idx)
-        rcol = right.column(config.right_column_idx)
+    (reference: join/join_utils.cpp:23-95 build_final_table).
+
+    ``algorithm='hash'`` runs the bucket-probe hash kernel
+    (ops/hashjoin.py); ``'sort'`` the argsort/searchsorted kernel
+    (ops/join.py) — mirroring the reference's SORT/HASH split
+    (join/join.cpp:247 do_hash_join vs :51 do_sorted_join).
+    """
     how = config.join_type.value
-    lk, rk = _key_array(lcol), _key_array(rcol)
-    total = int(ops_join.join_count(lk, rk, how))
-    cap = ops_compact.next_bucket(total)
-    li, ri, cnt = ops_join.join_indices(lk, rk, how, cap)
+    left, right, lk, rk = _join_key_ranks(
+        left, right, [config.left_column_idx], [config.right_column_idx])
+    if config.algorithm == JoinAlgorithm.HASH:
+        total = int(ops_hashjoin.hash_join_count(lk, rk, how))
+        cap = ops_compact.next_bucket(total)
+        li, ri, cnt = ops_hashjoin.hash_join_indices(lk, rk, how, cap)
+    else:
+        total = int(ops_join.join_count(lk, rk, how))
+        cap = ops_compact.next_bucket(total)
+        li, ri, cnt = ops_join.join_indices(lk, rk, how, cap)
     fill_left = how in ("right", "full_outer")
     fill_right = how in ("left", "full_outer")
     cols = (_gather_columns(left, li, fill_left, prefix="lt-")

@@ -66,13 +66,17 @@ class CylonContext:
         return CylonContext(config if config is not None else "tpu")
 
     def get_rank(self) -> int:
-        """Host process index (0 in single-controller SPMD).
+        """Lowest rank this controller drives.
 
-        Per-device "ranks" live inside shard_map as lax.axis_index; at the
-        host level, this single process drives all local devices.
+        Rank semantics: a *rank* is a mesh position (one device == one
+        reference MPI rank), numbered 0..world_size−1.  Single-controller
+        JAX means one host process drives a contiguous block of ranks —
+        this returns the first of them (0 in single-process runs).  Inside
+        ``shard_map`` the per-rank id is ``lax.axis_index(ctx.axis)``.
         reference: ctx/cylon_context.cpp (GetRank)
         """
-        return jax.process_index()
+        local = self.local_ranks()
+        return local[0] if local else 0
 
     def get_world_size(self) -> int:
         """Number of workers == number of mesh devices.
@@ -82,11 +86,22 @@ class CylonContext:
         """
         return len(self._devices)
 
+    def local_ranks(self) -> List[int]:
+        """Ranks (mesh positions) whose devices this process drives."""
+        pidx = jax.process_index()
+        return [i for i, d in enumerate(self._devices)
+                if getattr(d, "process_index", 0) == pidx]
+
     def get_neighbours(self, include_self: bool = False) -> List[int]:
-        """reference: ctx/cylon_context.cpp (GetNeighbours)."""
-        w = self.get_world_size()
-        r = self.get_rank()
-        return [i for i in range(w) if include_self or i != r]
+        """Ranks driven by *other* controllers (all remote mesh positions).
+
+        With one process driving the whole mesh this is empty — every rank
+        is local; ``include_self`` adds the locally driven ranks.
+        reference: ctx/cylon_context.cpp (GetNeighbours)
+        """
+        local = set(self.local_ranks())
+        return [i for i in range(self.get_world_size())
+                if include_self or i not in local]
 
     def add_config(self, key: str, value: str) -> None:
         self._config[key] = value

@@ -6,5 +6,5 @@ cpp/src/cylon/arrow/arrow_kernels.hpp, arrow_partition_kernels.hpp,
 join/join.cpp, util/copy_arrray.cpp).  No per-type dispatch: jnp is
 dtype-generic; strings arrive as int32 dictionary codes.
 """
-from . import (compact, gather, groupby, hash as hashing, join,  # noqa: F401
-               setops, sort)
+from . import (compact, gather, groupby, hash as hashing, hashjoin,  # noqa: F401
+               join, setops, sort)

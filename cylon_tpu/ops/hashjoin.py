@@ -1,0 +1,150 @@
+"""Hash-join kernel: direct-address build/probe over dense key ranks.
+
+TPU-native mirror of the reference's hash join (reference:
+cpp/src/cylon/arrow/arrow_hash_kernels.hpp:34-234 — build an
+``unordered_multimap<key,row>`` on one side, probe with the other;
+ProbePhase/ProbePhaseNoFill/ProbePhaseOuter variants).  A pointer-chasing
+multimap doesn't vectorize; but after ``ops.join.dense_ranks`` every key is
+already a dense int32 group id, which makes the *perfect-hash* formulation
+available:
+
+  build  bincount of build-side ranks → per-rank counts + exclusive
+         offsets (the multimap's buckets), build rows grouped by rank via
+         one stable counting argsort of small ints;
+  probe  each probe row's rank indexes the count/offset tables directly —
+         O(1) per row, no comparison, no binary search — and matches expand
+         by the same run-length machinery as the sort kernel.
+
+Contrast with ops/join.py (the SORT algorithm): no ordered merge, no
+``searchsorted`` over keys; probe cost is independent of build-side order.
+Both kernels share the two-phase count/materialize protocol and the −1 ⇒
+null-fill convention (reference util/copy_arrray.cpp:38-43), so the table
+layer can swap them per ``JoinConfig.algorithm``.
+
+Padded distributed blocks: ranks of padding rows are INT32_MAX (set by
+``dense_ranks``); they are remapped to a sentinel bucket whose count is
+zeroed, so padding can never match — plus the same ``l_count``/``r_count``
+masking as the sort kernel.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .join import INNER, LEFT, RIGHT, FULL_OUTER, _degenerate
+
+_MAXR = jnp.iinfo(jnp.int32).max
+
+
+def _valid_mask(rank: jax.Array, count) -> jax.Array:
+    if count is None:
+        return rank != _MAXR
+    return jnp.arange(rank.shape[0]) < count
+
+
+def _build_table(r_rank: jax.Array, n_ranks: int, r_count):
+    """Per-rank (count, exclusive offset, grouped row indices) tables."""
+    valid_r = _valid_mask(r_rank, r_count)
+    rr = jnp.where(valid_r, r_rank, n_ranks)  # sentinel bucket for padding
+    cnt = jnp.bincount(rr, length=n_ranks + 1).at[n_ranks].set(0)
+    cnt = cnt.astype(jnp.int32)
+    offs = (jnp.cumsum(cnt) - cnt).astype(jnp.int32)   # exclusive
+    grouped = jnp.argsort(rr, stable=True).astype(jnp.int32)  # pads at tail
+    return valid_r, rr, cnt, offs, grouped
+
+
+def _probe_counts(l_rank: jax.Array, cnt: jax.Array, n_ranks: int, l_count):
+    valid_l = _valid_mask(l_rank, l_count)
+    g = jnp.where(valid_l, l_rank, n_ranks)
+    match_cnt = jnp.take(cnt, jnp.minimum(g, n_ranks))
+    return valid_l, g, match_cnt
+
+
+@functools.partial(jax.jit, static_argnames=("how",))
+def hash_join_count(l_rank: jax.Array, r_rank: jax.Array, how: str = INNER,
+                    l_count=None, r_count=None) -> jax.Array:
+    """Phase 1: exact output row count (direct-address probe)."""
+    if how == RIGHT:
+        return hash_join_count(r_rank, l_rank, LEFT, r_count, l_count)
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    n_l, n_r = l_rank.shape[0], r_rank.shape[0]
+    if n_l == 0 or n_r == 0:
+        _, _, total = _degenerate(l_rank, r_rank, how, 1, idt, l_count, r_count)
+        return total.astype(idt)
+    n_ranks = n_l + n_r
+    valid_r, rr, cnt, _, _ = _build_table(r_rank, n_ranks, r_count)
+    valid_l, g, match_cnt = _probe_counts(l_rank, cnt, n_ranks, l_count)
+    match_cnt = match_cnt.astype(idt)
+    total = jnp.sum(match_cnt)
+    if how == INNER:
+        return total
+    left_total = total + jnp.sum(valid_l & (match_cnt == 0))
+    if how == LEFT:
+        return left_total
+    if how == FULL_OUTER:
+        l_present = jnp.bincount(g, length=n_ranks + 1).at[n_ranks].set(0) > 0
+        unmatched_r = valid_r & ~jnp.take(l_present, jnp.minimum(rr, n_ranks))
+        return left_total + jnp.sum(unmatched_r)
+    raise ValueError(f"unknown join type {how!r}")
+
+
+@functools.partial(jax.jit, static_argnames=("how", "capacity"))
+def hash_join_indices(l_rank: jax.Array, r_rank: jax.Array, how: str,
+                      capacity: int, l_count=None, r_count=None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Phase 2: (left_idx[cap], right_idx[cap], count). −1 ⇒ null row.
+
+    Output order: probe (left) rows in their original order — the hash
+    kernel needs no left sort, unlike ops/join.py which emits in sorted-key
+    order.  Both satisfy the same set-of-pairs contract.
+    """
+    if how == RIGHT:
+        ri, li, n = hash_join_indices(r_rank, l_rank, LEFT, capacity,
+                                      r_count, l_count)
+        return li, ri, n
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    n_l, n_r = l_rank.shape[0], r_rank.shape[0]
+    if n_l == 0 or n_r == 0:
+        return _degenerate(l_rank, r_rank, how, capacity, idt, l_count, r_count)
+    n_ranks = n_l + n_r
+    valid_r, rr, cnt, offs, grouped = _build_table(r_rank, n_ranks, r_count)
+    valid_l, g, match_cnt = _probe_counts(l_rank, cnt, n_ranks, l_count)
+    match_cnt = match_cnt.astype(idt)
+
+    emit = (match_cnt if how == INNER
+            else jnp.where(valid_l, jnp.maximum(match_cnt, 1), 0))
+    offs_incl = jnp.cumsum(emit)
+    total_lpart = offs_incl[-1]
+
+    j = jnp.arange(capacity, dtype=idt)
+    li_pos = jnp.searchsorted(offs_incl, j, side="right")
+    li_pos_c = jnp.clip(li_pos, 0, n_l - 1).astype(jnp.int32)
+    start = offs_incl[li_pos_c] - emit[li_pos_c]
+    within = j - start
+    matched = within < match_cnt[li_pos_c]
+    left_idx = li_pos_c
+    r_pos = jnp.clip(jnp.take(offs, jnp.minimum(jnp.take(g, li_pos_c), n_ranks - 1))
+                     + within, 0, n_r - 1).astype(jnp.int32)
+    right_idx = jnp.where(matched, jnp.take(grouped, r_pos), jnp.int32(-1))
+
+    if how == FULL_OUTER:
+        l_present = jnp.bincount(g, length=n_ranks + 1).at[n_ranks].set(0) > 0
+        unmatched_r = valid_r & ~jnp.take(l_present, jnp.minimum(rr, n_ranks))
+        n_um = jnp.sum(unmatched_r.astype(idt))
+        um_pos = jnp.flatnonzero(unmatched_r, size=n_r, fill_value=0)
+        k = jnp.clip(j - total_lpart, 0, max(n_r - 1, 0))
+        in_rpart = j >= total_lpart
+        r_only = jnp.take(um_pos, k).astype(jnp.int32)
+        left_idx = jnp.where(in_rpart, jnp.int32(-1), left_idx)
+        right_idx = jnp.where(in_rpart, r_only, right_idx)
+        total = total_lpart + n_um
+    else:
+        total = total_lpart if how == LEFT else jnp.sum(match_cnt)
+
+    valid = j < total
+    left_idx = jnp.where(valid, left_idx, jnp.int32(-1))
+    right_idx = jnp.where(valid, right_idx, jnp.int32(-1))
+    return left_idx, right_idx, total.astype(jnp.int32)

@@ -18,8 +18,15 @@ Join outputs are data-dependent, so the kernel is two-phase under jit
                           (outer variants), exactly the reference's −1
                           convention (join.cpp / copy_arrray.cpp:38-43).
 
-Keys are single pre-combined arrays; the table layer encodes null keys and
-unifies string dictionaries before calling in.
+Keys enter through ``dense_ranks``: the composite key columns of BOTH sides
+(with validity as its own comparison key) are lexsorted together and each
+distinct composite key gets a dense int32 group id.  Both join phases then
+operate on plain int32 ranks.  This removes the null↔INT_MAX sentinel
+aliasing hazard (a legitimate max-value key can never collide with null —
+they are different groups), makes padding sentinels collision-free (ranks
+are < n_l+n_r << INT32_MAX), and supports multi-column keys for free.  The
+table layer still unifies string dictionaries before calling in (codes from
+different dictionaries are not comparable).
 
 **Padded blocks (the distributed path).**  Shuffle outputs are static-capacity
 blocks whose rows [0, count) are valid (SPMD shapes must be uniform across
@@ -42,12 +49,71 @@ INNER, LEFT, RIGHT, FULL_OUTER = "inner", "left", "right", "full_outer"
 
 
 def _pad_sentinel(dtype):
-    """Key substituted for padding rows; sorts last.  Shares the max-value
-    slot with the null sentinel (compute._null_sentinel) — the clamp to the
-    valid prefix is what keeps padding from matching genuine max/null keys."""
+    """Rank substituted for padding rows; sorts last.  Dense ranks are
+    bounded by the row count, so the max value is never a real rank."""
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.array(jnp.finfo(dtype).max, dtype)
     return jnp.array(jnp.iinfo(dtype).max, dtype)
+
+
+@jax.jit
+def dense_ranks(l_cols, l_valids, r_cols, r_valids, l_count=None, r_count=None):
+    """Composite join keys → dense int32 ranks comparable across both sides.
+
+    ``l_cols``/``r_cols`` are tuples of aligned key columns (same dtypes);
+    ``*_valids`` are per-column validity masks or None.  Rows are grouped by
+    the tuple (isnull_0, value_0, isnull_1, value_1, …): equal composite
+    keys — with null == null, and null distinct from every real value —
+    share a rank.  Padding rows (index ≥ count, for shuffled static-capacity
+    blocks) get INT32_MAX, which can never equal a real rank.
+
+    reference: the per-type key comparison of join.cpp:128-212 and the
+    probe-key equality of arrow_hash_kernels.hpp:34-234, collapsed into one
+    vectorized rank assignment.
+    """
+    n_l, n_r = l_cols[0].shape[0], r_cols[0].shape[0]
+    n = n_l + n_r
+    if n == 0:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z
+    pad_l = (jnp.zeros(n_l, bool) if l_count is None
+             else jnp.arange(n_l) >= l_count)
+    pad_r = (jnp.zeros(n_r, bool) if r_count is None
+             else jnp.arange(n_r) >= r_count)
+    pad = jnp.concatenate([pad_l, pad_r])
+    comps = []  # (value, isnull) per key column, most-significant first
+    for lc, lv, rc, rv in zip(l_cols, l_valids, r_cols, r_valids):
+        c = jnp.concatenate([lc, rc])
+        if lv is None and rv is None:
+            isnull = None
+        else:
+            nl = jnp.zeros(n_l, bool) if lv is None else ~lv
+            nr = jnp.zeros(n_r, bool) if rv is None else ~rv
+            isnull = jnp.concatenate([nl, nr])
+            # all nulls are ONE group regardless of the slot value under them
+            c = jnp.where(isnull, jnp.zeros((), c.dtype), c)
+        comps.append((c, isnull))
+    # jnp.lexsort: LAST key is primary ⇒ reversed significance order
+    flat = []
+    for c, isnull in reversed(comps):
+        flat.append(c)
+        if isnull is not None:
+            flat.append(isnull)
+    flat.append(pad)
+    order = jnp.lexsort(tuple(flat))
+    is_first = jnp.zeros(n, bool).at[0].set(True)
+    one = jnp.ones((1,), bool)
+    for c, isnull in comps:
+        cs = jnp.take(c, order)
+        is_first = is_first | jnp.concatenate([one, cs[1:] != cs[:-1]])
+        if isnull is not None:
+            ns = jnp.take(isnull, order)
+            is_first = is_first | jnp.concatenate([one, ns[1:] != ns[:-1]])
+    group_id = (jnp.cumsum(is_first) - 1).astype(jnp.int32)
+    rank = jnp.zeros(n, jnp.int32).at[order].set(group_id)
+    l_rank = jnp.where(pad_l, jnp.iinfo(jnp.int32).max, rank[:n_l])
+    r_rank = jnp.where(pad_r, jnp.iinfo(jnp.int32).max, rank[n_l:])
+    return l_rank, r_rank
 
 
 def _masked(key: jax.Array, count) -> jax.Array:
@@ -98,7 +164,7 @@ def join_count(l_key: jax.Array, r_key: jax.Array, how: str = INNER,
     n_l, n_r = l_key.shape[0], r_key.shape[0]
     if n_l == 0 or n_r == 0:
         _, _, total = _degenerate(l_key, r_key, how, 1, idt, l_count, r_count)
-        return total
+        return total.astype(idt)
     _, _, lk, rk, _, cnt, valid_l = _match_ranges(l_key, r_key, l_count, r_count)
     cnt = cnt.astype(idt)
     total = jnp.sum(cnt)

@@ -64,10 +64,14 @@ def sort_indices_masked(col: jax.Array, validity: Optional[jax.Array],
 
 
 def _invert(col: jax.Array) -> jax.Array:
-    """Order-reversing transform for descending sort."""
+    """Total order-reversing transform for descending sort.
+
+    Signed ints use bitwise-not (~x == -x-1), which is a bijection — unlike
+    negation, where two's-complement -INT_MIN wraps back to INT_MIN and the
+    minimum would sort first in descending order too.
+    """
     if jnp.issubdtype(col.dtype, jnp.floating):
         return -col
     if jnp.issubdtype(col.dtype, jnp.unsignedinteger):
         return jnp.iinfo(col.dtype).max - col
-    return -col  # signed ints: min value maps to min+... acceptable (two's
-    # complement -min == min wraps to itself, a single-value edge we accept)
+    return ~col  # signed ints / bool: total, order-reversing

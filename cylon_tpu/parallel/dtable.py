@@ -170,8 +170,11 @@ class DTable:
                 dicts = [pc.dictionary for pc in pcols]
                 dictionary = np.unique(np.concatenate(dicts)) if any(
                     len(d) for d in dicts) else dicts[0]
+                # empty-dict partitions hold only null rows (sorted-encode
+                # invariant); zero their codes so nothing decodes against
+                # the merged dictionary by accident.
                 hosts = [np.searchsorted(dictionary, d)[h].astype(np.int32)
-                         if len(d) else h
+                         if len(d) else np.zeros_like(h, dtype=np.int32)
                          for h, d in zip(hosts, dicts)]
             block = np.zeros((Pn * cap,) + hosts[0].shape[1:], hosts[0].dtype)
             for i in range(Pn):

@@ -1,0 +1,110 @@
+"""Adversarial edge-case tests for the hazards VERDICT r1 flagged:
+
+ * null join keys must NOT collide with legitimate INT_MAX/INT_MIN keys
+   (the old max-value sentinel aliasing) — dense ranks give nulls their own
+   group;
+ * descending sort must be total at INT_MIN (two's-complement -INT_MIN ==
+   INT_MIN would sort it first in descending order too);
+ * context rank semantics must be coherent (local ranks vs neighbours).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import CylonContext, Table, compute
+from cylon_tpu.config import JoinAlgorithm, JoinConfig, JoinType
+
+from test_local_ops import assert_same_rows, oracle_join
+
+I64 = np.iinfo(np.int64)
+I32 = np.iinfo(np.int32)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full_outer"])
+@pytest.mark.parametrize("algorithm", [JoinAlgorithm.SORT, JoinAlgorithm.HASH])
+def test_join_null_vs_intmax_keys(ctx, how, algorithm):
+    """A genuine INT64_MAX key must join only with INT64_MAX, never null."""
+    ldf = pd.DataFrame({"k": pd.array([I64.max, I64.min, None, 5, None],
+                                      dtype="Int64"),
+                        "a": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    rdf = pd.DataFrame({"k": pd.array([I64.max, None, 5, 7], dtype="Int64"),
+                        "b": [10, 20, 30, 40]})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    cfg = JoinConfig(JoinType(how), algorithm, 0, 0)
+    ours = compute.join(lt, rt, cfg).to_pandas()
+    oracle = oracle_join(ldf, rdf, "k", "k", how)
+    assert_same_rows(ours, oracle)
+    if how == "inner":
+        # exactly: max↔max, null↔null ×2, 5↔5 — NOT max↔null
+        assert len(ours) == 4
+
+
+@pytest.mark.parametrize("algorithm", [JoinAlgorithm.SORT, JoinAlgorithm.HASH])
+def test_join_intmax_float_keys(ctx, algorithm):
+    fmax = np.finfo(np.float64).max
+    ldf = pd.DataFrame({"k": [fmax, 1.5, None], "a": [1, 2, 3]})
+    rdf = pd.DataFrame({"k": [fmax, None, 2.5], "b": [9, 8, 7]})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    ours = compute.join(lt, rt,
+                        JoinConfig(JoinType.INNER, algorithm, 0, 0)).to_pandas()
+    oracle = oracle_join(ldf, rdf, "k", "k", "inner")
+    assert_same_rows(ours, oracle)
+    assert len(ours) == 2  # fmax↔fmax, null↔null
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full_outer"])
+def test_join_extreme_int32_keys(ctx, how):
+    ldf = pd.DataFrame({"k": np.array([I32.max, I32.min, 0, I32.max], np.int32),
+                        "a": np.arange(4)})
+    rdf = pd.DataFrame({"k": np.array([I32.max, I32.min, 17], np.int32),
+                        "b": np.arange(3)})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    cfg = JoinConfig(JoinType(how), JoinAlgorithm.SORT, 0, 0)
+    assert_same_rows(compute.join(lt, rt, cfg).to_pandas(),
+                     oracle_join(ldf, rdf, "k", "k", how))
+
+
+def test_descending_sort_int_min(ctx):
+    df = pd.DataFrame({"k": np.array([I64.min, 5, I64.max, -1, I64.min],
+                                     np.int64),
+                       "v": np.arange(5)})
+    t = Table.from_pandas(ctx, df)
+    ours = compute.sort(t, "k", ascending=False).to_pandas()
+    oracle = df.sort_values("k", ascending=False,
+                            kind="stable").reset_index(drop=True)
+    np.testing.assert_array_equal(ours["k"].values, oracle["k"].values)
+    np.testing.assert_array_equal(ours["v"].values, oracle["v"].values)
+
+
+def test_descending_sort_int32_min(ctx):
+    df = pd.DataFrame({"k": np.array([I32.min, 3, I32.max, I32.min + 1],
+                                     np.int32)})
+    t = Table.from_pandas(ctx, df)
+    ours = compute.sort(t, "k", ascending=False).to_pandas()
+    assert ours["k"].tolist() == sorted(df["k"].tolist(), reverse=True)
+
+
+def test_rank_semantics_coherent(dctx):
+    world = dctx.get_world_size()
+    assert world == 8
+    local = dctx.local_ranks()
+    assert local == list(range(8))           # one controller drives all ranks
+    assert dctx.get_rank() == 0
+    assert dctx.get_neighbours() == []       # no remote controllers
+    assert dctx.get_neighbours(include_self=True) == list(range(8))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full_outer"])
+@pytest.mark.parametrize("algorithm", [JoinAlgorithm.SORT, JoinAlgorithm.HASH])
+def test_join_fuzz_with_nulls(ctx, rng, how, algorithm):
+    n_l, n_r = 67, 53
+    lk = rng.integers(-5, 6, n_l).astype(np.float64)
+    rk = rng.integers(-5, 6, n_r).astype(np.float64)
+    lk[rng.random(n_l) < 0.2] = np.nan
+    rk[rng.random(n_r) < 0.2] = np.nan
+    ldf = pd.DataFrame({"k": lk, "a": rng.normal(size=n_l)})
+    rdf = pd.DataFrame({"k": rk, "b": rng.normal(size=n_r)})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    cfg = JoinConfig(JoinType(how), algorithm, 0, 0)
+    assert_same_rows(compute.join(lt, rt, cfg).to_pandas(),
+                     oracle_join(ldf, rdf, "k", "k", how))

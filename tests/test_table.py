@@ -91,7 +91,9 @@ def test_dictionary_unification(ctx):
 def test_context_basics(ctx, dctx):
     assert not ctx.is_distributed() and ctx.get_world_size() == 1
     assert dctx.is_distributed() and dctx.get_world_size() == 8
-    assert dctx.get_neighbours() == [i for i in range(8) if i != dctx.get_rank()]
+    # one controller drives all 8 ranks: no remote neighbours
+    assert dctx.local_ranks() == list(range(8))
+    assert dctx.get_neighbours() == []
     dctx.barrier()
     s0 = dctx.get_next_sequence()
     assert dctx.get_next_sequence() == s0 + 1
