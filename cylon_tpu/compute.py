@@ -105,10 +105,24 @@ def join(left: Table, right: Table, config: JoinConfig) -> Table:
     (ops/join.py) — mirroring the reference's SORT/HASH split
     (join/join.cpp:247 do_hash_join vs :51 do_sorted_join).
     """
-    how = config.join_type.value
-    left, right, lk, rk = _join_key_ranks(
-        left, right, [config.left_column_idx], [config.right_column_idx])
-    if config.algorithm == JoinAlgorithm.HASH:
+    return join_on(left, right, [config.left_column_idx],
+                   [config.right_column_idx], config.join_type.value,
+                   config.algorithm)
+
+
+def join_on(left: Table, right: Table,
+            left_on: Sequence[Union[int, str]],
+            right_on: Sequence[Union[int, str]],
+            how: str = "inner",
+            algorithm: JoinAlgorithm = JoinAlgorithm.SORT) -> Table:
+    """Multi-column equi-join (composite keys via dense_ranks).
+
+    The reference's JoinConfig is single-column (join_config.hpp:29-89);
+    composite keys there require pre-concatenating columns.  Here the
+    dense-rank keying handles any number of key columns directly.
+    """
+    left, right, lk, rk = _join_key_ranks(left, right, left_on, right_on)
+    if algorithm == JoinAlgorithm.HASH:
         total = int(ops_hashjoin.hash_join_count(lk, rk, how))
         cap = ops_compact.next_bucket(total)
         li, ri, cnt = ops_hashjoin.hash_join_indices(lk, rk, how, cap)

@@ -25,10 +25,16 @@ class JoinAlgorithm(enum.Enum):
 class JoinConfig:
     """join type × algorithm × key column index per side.
 
-    Both algorithms execute on the same sort-based kernel (ops/join.py);
-    the algorithm choice is honored at the distributed layer (hash ⇒
-    hash-partition shuffle; sort ⇒ sample-sort shuffle) and kept for
-    pycylon source compatibility.
+    The algorithm selects a genuinely different execution path at both
+    layers, mirroring the reference's SORT/HASH split (join/join.cpp:247
+    do_hash_join vs :51 do_sorted_join):
+
+      SORT  local: argsort+searchsorted merge kernel (ops/join.py);
+            distributed: sampled-splitter range-partition shuffle
+            (sample-sort) — output is additionally globally key-ordered;
+      HASH  local: direct-address build/probe kernel (ops/hashjoin.py);
+            distributed: murmur3 hash-partition shuffle.
+
     reference: join/join_config.hpp:29-89
     """
 

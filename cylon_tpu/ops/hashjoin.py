@@ -34,7 +34,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from .join import INNER, LEFT, RIGHT, FULL_OUTER, _degenerate
+from .join import (INNER, LEFT, RIGHT, FULL_OUTER, _degenerate,
+                   append_right_tail, expand_pairs, mask_past_total)
 
 _MAXR = jnp.iinfo(jnp.int32).max
 
@@ -116,35 +117,24 @@ def hash_join_indices(l_rank: jax.Array, r_rank: jax.Array, how: str,
 
     emit = (match_cnt if how == INNER
             else jnp.where(valid_l, jnp.maximum(match_cnt, 1), 0))
-    offs_incl = jnp.cumsum(emit)
-    total_lpart = offs_incl[-1]
 
-    j = jnp.arange(capacity, dtype=idt)
-    li_pos = jnp.searchsorted(offs_incl, j, side="right")
-    li_pos_c = jnp.clip(li_pos, 0, n_l - 1).astype(jnp.int32)
-    start = offs_incl[li_pos_c] - emit[li_pos_c]
-    within = j - start
-    matched = within < match_cnt[li_pos_c]
-    left_idx = li_pos_c
-    r_pos = jnp.clip(jnp.take(offs, jnp.minimum(jnp.take(g, li_pos_c), n_ranks - 1))
-                     + within, 0, n_r - 1).astype(jnp.int32)
-    right_idx = jnp.where(matched, jnp.take(grouped, r_pos), jnp.int32(-1))
+    def right_at(pos, within):
+        bucket = jnp.minimum(jnp.take(g, pos), n_ranks - 1)
+        r_pos = jnp.clip(jnp.take(offs, bucket) + within, 0, n_r - 1)
+        return jnp.take(grouped, r_pos.astype(jnp.int32))
+
+    j, left_idx, right_idx, total_lpart = expand_pairs(
+        emit, match_cnt, capacity, idt, n_l,
+        left_at=lambda pos: pos.astype(jnp.int32),   # probe in original order
+        right_at=right_at)
 
     if how == FULL_OUTER:
         l_present = jnp.bincount(g, length=n_ranks + 1).at[n_ranks].set(0) > 0
         unmatched_r = valid_r & ~jnp.take(l_present, jnp.minimum(rr, n_ranks))
-        n_um = jnp.sum(unmatched_r.astype(idt))
-        um_pos = jnp.flatnonzero(unmatched_r, size=n_r, fill_value=0)
-        k = jnp.clip(j - total_lpart, 0, max(n_r - 1, 0))
-        in_rpart = j >= total_lpart
-        r_only = jnp.take(um_pos, k).astype(jnp.int32)
-        left_idx = jnp.where(in_rpart, jnp.int32(-1), left_idx)
-        right_idx = jnp.where(in_rpart, r_only, right_idx)
-        total = total_lpart + n_um
+        left_idx, right_idx, total = append_right_tail(
+            j, total_lpart, unmatched_r, n_r, idt, left_idx, right_idx,
+            right_orig=lambda pos: pos.astype(jnp.int32))
     else:
         total = total_lpart if how == LEFT else jnp.sum(match_cnt)
 
-    valid = j < total
-    left_idx = jnp.where(valid, left_idx, jnp.int32(-1))
-    right_idx = jnp.where(valid, right_idx, jnp.int32(-1))
-    return left_idx, right_idx, total.astype(jnp.int32)
+    return mask_past_total(j, total, left_idx, right_idx)

@@ -1,12 +1,11 @@
 """Sort-based equi-join kernel (all four join types).
 
-TPU-native replacement for BOTH reference join algorithms — the dual-cursor
-sort-merge join (reference: cpp/src/cylon/join/join.cpp:26-232) and the
-``unordered_multimap`` hash join (reference: arrow/arrow_hash_kernels.hpp:
-34-234).  Hash tables with contended scatter map poorly onto the VPU;
-argsort + searchsorted + run-length pair expansion is the TPU-shaped
-equivalent (SURVEY.md §7) and serves as the execution engine for both
-``algorithm='sort'`` and ``algorithm='hash'`` configs.
+TPU-native mirror of the reference's dual-cursor sort-merge join
+(reference: cpp/src/cylon/join/join.cpp:26-232): argsort + searchsorted +
+run-length pair expansion is the TPU-shaped equivalent (SURVEY.md §7).
+This is the ``algorithm='sort'`` engine; ``algorithm='hash'`` runs the
+direct-address kernel in ops/hashjoin.py, which shares this module's
+dense-rank keying and pair-expansion machinery.
 
 Join outputs are data-dependent, so the kernel is two-phase under jit
 (SURVEY.md §7 hard part 1):
@@ -180,6 +179,54 @@ def join_count(l_key: jax.Array, r_key: jax.Array, how: str = INNER,
     raise ValueError(f"unknown join type {how!r}")
 
 
+def expand_pairs(emit, match_cnt, capacity: int, idt, n_l: int,
+                 left_at, right_at):
+    """Shared run-length pair expansion (both join kernels' phase 2 core).
+
+    Per left expansion slot ``pos`` (with ``within``-th match of that row):
+    ``left_at(pos)`` / ``right_at(pos, within)`` map back to original row
+    indices.  Returns (j, left_idx, right_idx, total_lpart) where
+    unmatched slots carry right_idx −1 (the outer null-fill convention).
+    """
+    offs_incl = jnp.cumsum(emit)
+    total_lpart = offs_incl[-1]
+    j = jnp.arange(capacity, dtype=idt)
+    li_pos = jnp.searchsorted(offs_incl, j, side="right")
+    li_pos_c = jnp.clip(li_pos, 0, n_l - 1)
+    start = offs_incl[li_pos_c] - emit[li_pos_c]
+    within = j - start
+    matched = within < match_cnt[li_pos_c]
+    left_idx = left_at(li_pos_c)
+    right_idx = jnp.where(matched, right_at(li_pos_c, within), jnp.int32(-1))
+    return j, left_idx, right_idx, total_lpart
+
+
+def append_right_tail(j, total_lpart, unmatched_r, n_r: int, idt,
+                      left_idx, right_idx, right_orig):
+    """FULL_OUTER: append unmatched right rows after the left partition.
+
+    ``unmatched_r`` is a mask in ``right_orig``'s index space; shared by
+    both kernels (sorted-right space for the sort kernel, original order
+    for the hash kernel).
+    """
+    n_um = jnp.sum(unmatched_r.astype(idt))
+    um_pos = jnp.flatnonzero(unmatched_r, size=n_r, fill_value=0)
+    k = jnp.clip(j - total_lpart, 0, max(n_r - 1, 0))
+    in_rpart = j >= total_lpart
+    r_only = right_orig(jnp.take(um_pos, k))
+    left_idx = jnp.where(in_rpart, jnp.int32(-1), left_idx)
+    right_idx = jnp.where(in_rpart, r_only, right_idx)
+    return left_idx, right_idx, total_lpart + n_um
+
+
+def mask_past_total(j, total, left_idx, right_idx):
+    """Final (−1, −1) padding beyond the valid output prefix."""
+    valid = j < total
+    return (jnp.where(valid, left_idx, jnp.int32(-1)),
+            jnp.where(valid, right_idx, jnp.int32(-1)),
+            total.astype(jnp.int32))
+
+
 @functools.partial(jax.jit, static_argnames=("how", "capacity"))
 def join_indices(l_key: jax.Array, r_key: jax.Array, how: str, capacity: int,
                  l_count=None, r_count=None
@@ -199,40 +246,23 @@ def join_indices(l_key: jax.Array, r_key: jax.Array, how: str, capacity: int,
     ls, rs, lk, rk, lo, cnt, valid_l = _match_ranges(l_key, r_key, l_count, r_count)
     cnt = cnt.astype(idt)
     emit = cnt if how == INNER else jnp.where(valid_l, jnp.maximum(cnt, 1), 0)
-    offs_incl = jnp.cumsum(emit)
-    total_lpart = offs_incl[-1]
-
-    j = jnp.arange(capacity, dtype=idt)
-    li_pos = jnp.searchsorted(offs_incl, j, side="right")
-    li_pos_c = jnp.clip(li_pos, 0, n_l - 1)
-    start = offs_incl[li_pos_c] - emit[li_pos_c]
-    within = j - start
-    matched = within < cnt[li_pos_c]
-    left_idx = jnp.take(ls, li_pos_c).astype(jnp.int32)
-    r_sorted_pos = jnp.clip(lo[li_pos_c] + within, 0, n_r - 1)
-    right_idx = jnp.where(matched,
-                          jnp.take(rs, r_sorted_pos).astype(jnp.int32),
-                          jnp.int32(-1))
+    j, left_idx, right_idx, total_lpart = expand_pairs(
+        emit, cnt, capacity, idt, n_l,
+        left_at=lambda pos: jnp.take(ls, pos).astype(jnp.int32),
+        right_at=lambda pos, within: jnp.take(
+            rs, jnp.clip(lo[pos] + within, 0, n_r - 1)).astype(jnp.int32))
 
     if how == FULL_OUTER:
         valid_r = (jnp.ones(rk.shape, bool) if r_count is None
                    else jnp.arange(n_r) < r_count)
         unmatched_r = valid_r & ~_right_matched(lk, rk, l_count)
-        n_um = jnp.sum(unmatched_r.astype(idt))
-        um_pos = jnp.flatnonzero(unmatched_r, size=n_r, fill_value=0)
-        k = jnp.clip(j - total_lpart, 0, max(n_r - 1, 0))
-        in_rpart = j >= total_lpart
-        r_only = jnp.take(rs, jnp.take(um_pos, k)).astype(jnp.int32)
-        left_idx = jnp.where(in_rpart, jnp.int32(-1), left_idx)
-        right_idx = jnp.where(in_rpart, r_only, right_idx)
-        total = total_lpart + n_um
+        left_idx, right_idx, total = append_right_tail(
+            j, total_lpart, unmatched_r, n_r, idt, left_idx, right_idx,
+            right_orig=lambda pos: jnp.take(rs, pos).astype(jnp.int32))
     else:
         total = total_lpart if how == LEFT else jnp.sum(cnt)
 
-    valid = j < total
-    left_idx = jnp.where(valid, left_idx, jnp.int32(-1))
-    right_idx = jnp.where(valid, right_idx, jnp.int32(-1))
-    return left_idx, right_idx, total.astype(jnp.int32)
+    return mask_past_total(j, total, left_idx, right_idx)
 
 
 def _degenerate(l_key, r_key, how, capacity, idt, l_count=None, r_count=None):

@@ -1,0 +1,283 @@
+"""Distributed operator tests on the 8-virtual-CPU-device mesh vs a pandas
+oracle — the mpirun -np 8 equivalent (SURVEY.md §4).  Covers the layers the
+round-1 suite never executed: shuffle_leaves, DTable exchange, and every
+dist_* operator, including empty shards, nulls, and string columns.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import CylonContext, Table
+from cylon_tpu.config import JoinAlgorithm, JoinConfig, JoinType
+from cylon_tpu.parallel import (DTable, dist_groupby, dist_intersect,
+                                dist_join, dist_sort, dist_subtract,
+                                dist_union, shuffle_table)
+
+from test_local_ops import assert_same_rows, oracle_join
+
+
+def dtable_from_pandas(dctx, df, n_empty_shards=0):
+    """Block-distribute a dataframe, optionally leaving trailing shards empty
+    (the skew/empty-shard regime the reference hits with csv1_<rank>.csv)."""
+    t = Table.from_pandas(dctx, df)
+    if n_empty_shards == 0:
+        return DTable.from_table(dctx, t)
+    P = dctx.get_world_size()
+    live = P - n_empty_shards
+    idx = np.array_split(np.arange(len(df)), live)
+    parts = [Table.from_pandas(dctx, df.iloc[i]) for i in idx]
+    parts += [Table.from_pandas(dctx, df.iloc[:0])] * n_empty_shards
+    return DTable.from_partitions(dctx, parts)
+
+
+def _join_dfs(rng, n_l=97, n_r=83, with_nulls=True):
+    lk = rng.integers(0, 25, n_l).astype(np.float64)
+    rk = rng.integers(0, 25, n_r).astype(np.float64)
+    if with_nulls:
+        lk[rng.random(n_l) < 0.1] = np.nan
+        rk[rng.random(n_r) < 0.1] = np.nan
+    ldf = pd.DataFrame({"k": lk, "a": rng.normal(size=n_l)})
+    rdf = pd.DataFrame({"k": rk, "b": rng.normal(size=n_r)})
+    return ldf, rdf
+
+
+# ---------------------------------------------------------------------------
+# shuffle
+# ---------------------------------------------------------------------------
+
+def test_shuffle_preserves_rows_and_colocates(dctx, rng):
+    df = pd.DataFrame({"k": rng.integers(0, 10, 200),
+                       "v": rng.normal(size=200)})
+    dt = dtable_from_pandas(dctx, df)
+    sh = shuffle_table(dt, ["k"])
+    # multiset of rows is preserved
+    assert_same_rows(sh.to_table().to_pandas(), df)
+    # equal keys co-locate: each key appears on exactly one shard
+    owners = {}
+    for i in range(dctx.get_world_size()):
+        part = sh.partition(i).to_pandas()
+        for k in part["k"].unique():
+            assert owners.setdefault(k, i) == i, f"key {k} on two shards"
+
+
+def test_shuffle_empty_and_skewed_shards(dctx, rng):
+    df = pd.DataFrame({"k": np.array([7] * 50 + [1, 2, 3]),
+                       "v": np.arange(53)})
+    dt = dtable_from_pandas(dctx, df, n_empty_shards=5)
+    sh = shuffle_table(dt, ["k"])
+    assert_same_rows(sh.to_table().to_pandas(), df)
+
+
+def test_shuffle_with_strings_and_nulls(dctx, rng):
+    df = pd.DataFrame({"s": ["a", "bb", None, "a", "ccc", None, "bb", "zz"],
+                       "x": [1.0, None, 3.0, 4.0, 5.0, 6.0, None, 8.0]})
+    dt = dtable_from_pandas(dctx, df)
+    sh = shuffle_table(dt, ["s"])
+    assert_same_rows(sh.to_table().to_pandas(), df)
+
+
+# ---------------------------------------------------------------------------
+# distributed join
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full_outer"])
+@pytest.mark.parametrize("algorithm", [JoinAlgorithm.HASH, JoinAlgorithm.SORT])
+def test_dist_join_vs_oracle(dctx, rng, how, algorithm):
+    ldf, rdf = _join_dfs(rng)
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf, n_empty_shards=2)
+    cfg = JoinConfig(JoinType(how), algorithm, 0, 0)
+    ours = dist_join(lt, rt, cfg).to_table().to_pandas()
+    assert_same_rows(ours, oracle_join(ldf, rdf, "k", "k", how))
+
+
+def test_dist_join_matches_local(dctx, ctx, rng):
+    ldf, rdf = _join_dfs(rng, 40, 30, with_nulls=False)
+    from cylon_tpu import compute
+    cfg = JoinConfig.InnerJoin(0, 0)
+    local = compute.join(Table.from_pandas(ctx, ldf),
+                         Table.from_pandas(ctx, rdf), cfg).to_pandas()
+    dist = dist_join(dtable_from_pandas(dctx, ldf),
+                     dtable_from_pandas(dctx, rdf), cfg)
+    assert_same_rows(dist.to_table().to_pandas(), local)
+
+
+def test_dist_join_string_keys(dctx):
+    ldf = pd.DataFrame({"k": ["a", "b", "c", "a", "x", "b", "c", "d"],
+                        "v": np.arange(8)})
+    rdf = pd.DataFrame({"k": ["b", "a", "z", "b", "d"],
+                        "w": np.arange(5, dtype=np.float64)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    ours = dist_join(lt, rt, JoinConfig.InnerJoin(0, 0)).to_table().to_pandas()
+    assert_same_rows(ours, oracle_join(ldf, rdf, "k", "k", "inner"))
+
+
+def test_dist_join_sample_sort_globally_ordered(dctx, rng):
+    """SORT algorithm range-partitions: shard i's keys all ≤ shard i+1's."""
+    ldf, rdf = _join_dfs(rng, 120, 90, with_nulls=False)
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+    out = dist_join(dtable_from_pandas(dctx, ldf),
+                    dtable_from_pandas(dctx, rdf), cfg)
+    assert_same_rows(out.to_table().to_pandas(),
+                     oracle_join(ldf, rdf, "k", "k", "inner"))
+    prev_max = -np.inf
+    for i in range(dctx.get_world_size()):
+        part = out.partition(i).to_pandas()
+        if len(part) == 0:
+            continue
+        assert part["lt-k"].min() >= prev_max
+        prev_max = part["lt-k"].max()
+
+
+def test_dist_join_extreme_keys_and_nulls(dctx):
+    M = np.iinfo(np.int64).max
+    ldf = pd.DataFrame({"k": pd.array([M, None, 5, M, None, 3, 2, 1],
+                                      dtype="Int64"),
+                        "a": np.arange(8, dtype=np.float64)})
+    rdf = pd.DataFrame({"k": pd.array([M, None, 2], dtype="Int64"),
+                        "b": [10., 20., 30.]})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    for alg in (JoinAlgorithm.HASH, JoinAlgorithm.SORT):
+        ours = dist_join(lt, rt, JoinConfig(JoinType.INNER, alg, 0, 0))
+        assert_same_rows(ours.to_table().to_pandas(),
+                         oracle_join(ldf, rdf, "k", "k", "inner"))
+
+
+# ---------------------------------------------------------------------------
+# distributed set ops
+# ---------------------------------------------------------------------------
+
+def _setop_dfs(rng):
+    adf = pd.DataFrame({"x": rng.integers(0, 12, 60),
+                        "y": rng.integers(0, 3, 60)})
+    bdf = pd.DataFrame({"x": rng.integers(0, 12, 45),
+                        "y": rng.integers(0, 3, 45)})
+    return adf, bdf
+
+
+def test_dist_union(dctx, rng):
+    adf, bdf = _setop_dfs(rng)
+    res = dist_union(dtable_from_pandas(dctx, adf),
+                     dtable_from_pandas(dctx, bdf))
+    oracle = pd.concat([adf, bdf]).drop_duplicates()
+    assert_same_rows(res.to_table().to_pandas(), oracle)
+
+
+def test_dist_intersect(dctx, rng):
+    adf, bdf = _setop_dfs(rng)
+    res = dist_intersect(dtable_from_pandas(dctx, adf),
+                         dtable_from_pandas(dctx, bdf, n_empty_shards=3))
+    oracle = pd.merge(adf.drop_duplicates(), bdf.drop_duplicates(),
+                      how="inner", on=["x", "y"])
+    assert_same_rows(res.to_table().to_pandas(), oracle)
+
+
+def test_dist_subtract(dctx, rng):
+    adf, bdf = _setop_dfs(rng)
+    res = dist_subtract(dtable_from_pandas(dctx, adf),
+                        dtable_from_pandas(dctx, bdf))
+    m = adf.drop_duplicates().merge(bdf.drop_duplicates(), how="left",
+                                    indicator=True, on=["x", "y"])
+    oracle = m[m["_merge"] == "left_only"].drop(columns="_merge")
+    assert_same_rows(res.to_table().to_pandas(), oracle)
+
+
+def test_dist_setops_with_strings(dctx):
+    adf = pd.DataFrame({"s": ["a", "b", "c", "a", "d", "e", "f", "b"]})
+    bdf = pd.DataFrame({"s": ["b", "x", "d", "b"]})
+    ta, tb = dtable_from_pandas(dctx, adf), dtable_from_pandas(dctx, bdf)
+    assert_same_rows(dist_intersect(ta, tb).to_table().to_pandas(),
+                     pd.DataFrame({"s": ["b", "d"]}))
+    assert_same_rows(dist_union(ta, tb).to_table().to_pandas(),
+                     pd.concat([adf, bdf]).drop_duplicates())
+
+
+# ---------------------------------------------------------------------------
+# distributed groupby
+# ---------------------------------------------------------------------------
+
+def test_dist_groupby_vs_oracle(dctx, rng):
+    df = pd.DataFrame({"g": rng.integers(0, 9, 150),
+                       "h": rng.integers(0, 2, 150),
+                       "v": rng.normal(size=150),
+                       "w": rng.integers(0, 50, 150)})
+    dt = dtable_from_pandas(dctx, df)
+    res = dist_groupby(dt, ["g", "h"],
+                       [("v", "sum"), ("v", "mean"), ("w", "max"),
+                        ("w", "min"), ("v", "count")])
+    oracle = df.groupby(["g", "h"], as_index=False).agg(
+        **{"sum_v": ("v", "sum"), "mean_v": ("v", "mean"),
+           "max_w": ("w", "max"), "min_w": ("w", "min"),
+           "count_v": ("v", "count")})
+    assert_same_rows(res.to_table().to_pandas(), oracle)
+
+
+def test_dist_groupby_null_values(dctx):
+    df = pd.DataFrame({"g": [1, 1, 2, 2, 2, 3, 3, 1],
+                       "v": [1.0, None, 3.0, None, 5.0, 6.0, 7.0, 8.0]})
+    res = dist_groupby(dtable_from_pandas(dctx, df), ["g"],
+                       [("v", "sum"), ("v", "count"), ("v", "mean")])
+    oracle = df.groupby("g", as_index=False).agg(
+        **{"sum_v": ("v", "sum"), "count_v": ("v", "count"),
+           "mean_v": ("v", "mean")})
+    assert_same_rows(res.to_table().to_pandas(), oracle)
+
+
+# ---------------------------------------------------------------------------
+# distributed sample-sort
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("ascending", [True, False])
+def test_dist_sort_global_order(dctx, rng, ascending):
+    df = pd.DataFrame({"k": rng.integers(-1000, 1000, 300),
+                       "v": rng.normal(size=300)})
+    dt = dtable_from_pandas(dctx, df)
+    res = dist_sort(dt, "k", ascending=ascending)
+    got = res.to_table().to_pandas()   # concatenates shards in mesh order
+    oracle = df.sort_values("k", ascending=ascending, kind="stable")
+    np.testing.assert_array_equal(got["k"].values, oracle["k"].values)
+    # row payloads stay attached to their keys
+    assert_same_rows(got, df)
+
+
+def test_dist_sort_with_nulls_last(dctx):
+    df = pd.DataFrame({"k": [5.0, None, -3.0, 12.0, None, 0.0, 7.0, -8.0],
+                       "v": np.arange(8)})
+    res = dist_sort(dtable_from_pandas(dctx, df), "k")
+    got = res.to_table().to_pandas()
+    assert got["k"].tolist()[:6] == [-8.0, -3.0, 0.0, 5.0, 7.0, 12.0]
+    assert got["k"].isna().tolist()[-2:] == [True, True]
+
+
+def test_dist_sort_skewed_duplicates(dctx, rng):
+    df = pd.DataFrame({"k": np.array([42] * 150 + [1, 99]),
+                       "v": np.arange(152)})
+    res = dist_sort(dtable_from_pandas(dctx, df), "k")
+    got = res.to_table().to_pandas()
+    assert got["k"].tolist() == sorted(df["k"].tolist())
+
+
+# ---------------------------------------------------------------------------
+# degenerate worlds
+# ---------------------------------------------------------------------------
+
+def test_dist_ops_single_device_mesh(ctx, rng):
+    """World size 1: the whole pipeline must degrade to the local path."""
+    ldf, rdf = _join_dfs(rng, 30, 20, with_nulls=False)
+    lt = DTable.from_table(ctx, Table.from_pandas(ctx, ldf))
+    rt = DTable.from_table(ctx, Table.from_pandas(ctx, rdf))
+    ours = dist_join(lt, rt, JoinConfig.InnerJoin(0, 0)).to_table().to_pandas()
+    assert_same_rows(ours, oracle_join(ldf, rdf, "k", "k", "inner"))
+
+
+def test_dist_join_empty_table(dctx):
+    ldf = pd.DataFrame({"k": pd.Series([], dtype=np.int64),
+                        "a": pd.Series([], dtype=np.float64)})
+    rdf = pd.DataFrame({"k": np.array([1, 2, 3], dtype=np.int64),
+                        "b": [1.0, 2.0, 3.0]})
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    assert dist_join(lt, rt, JoinConfig.InnerJoin(0, 0)).num_rows == 0
+    fo = dist_join(lt, rt, JoinConfig.FullOuterJoin(0, 0))
+    assert_same_rows(fo.to_table().to_pandas(),
+                     oracle_join(ldf, rdf, "k", "k", "full_outer"))

@@ -210,3 +210,38 @@ def test_join_hash_algorithm_same_result(ctx, rng):
     s = compute.join(lt, rt, JoinConfig.InnerJoin(0, 0, JoinAlgorithm.SORT))
     h = compute.join(lt, rt, JoinConfig.InnerJoin(0, 0, JoinAlgorithm.HASH))
     assert_same_rows(s.to_pandas(), h.to_pandas())
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full_outer"])
+@pytest.mark.parametrize("algorithm", ["sort", "hash"])
+def test_join_on_multi_column_keys(ctx, rng, how, algorithm):
+    from cylon_tpu.config import JoinAlgorithm
+    ldf = pd.DataFrame({"k1": rng.integers(0, 5, 60),
+                        "k2": rng.integers(0, 4, 60),
+                        "a": rng.normal(size=60)})
+    rdf = pd.DataFrame({"k1": rng.integers(0, 5, 45),
+                        "k2": rng.integers(0, 4, 45),
+                        "b": rng.normal(size=45)})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    ours = compute.join_on(lt, rt, ["k1", "k2"], ["k1", "k2"], how,
+                           JoinAlgorithm(algorithm)).to_pandas()
+    oracle = pd.merge(ldf.add_prefix("lt-"), rdf.add_prefix("rt-"),
+                      left_on=["lt-k1", "lt-k2"],
+                      right_on=["rt-k1", "rt-k2"], how=HOW_PANDAS[how])
+    assert_same_rows(ours, oracle)
+
+
+def test_join_on_multi_column_with_nulls_and_strings(ctx):
+    ldf = pd.DataFrame({"k1": ["a", "b", None, "a", "b"],
+                        "k2": pd.array([1, None, 3, 1, None], dtype="Int64"),
+                        "v": np.arange(5, dtype=np.float64)})
+    rdf = pd.DataFrame({"k1": ["b", "a", None, "z"],
+                        "k2": pd.array([None, 1, 3, 9], dtype="Int64"),
+                        "w": np.arange(4, dtype=np.float64)})
+    lt, rt = Table.from_pandas(ctx, ldf), Table.from_pandas(ctx, rdf)
+    ours = compute.join_on(lt, rt, ["k1", "k2"], ["k1", "k2"],
+                           "inner").to_pandas()
+    oracle = pd.merge(ldf.add_prefix("lt-"), rdf.add_prefix("rt-"),
+                      left_on=["lt-k1", "lt-k2"],
+                      right_on=["rt-k1", "rt-k2"], how="inner")
+    assert_same_rows(ours, oracle)
