@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""cylon_tpu benchmark: distributed shuffle hash join throughput.
+
+Workload mirrors the reference's scaling protocol (reference:
+cpp/src/experiments/run_dist_scaling.py:62-66 and generate_files.py:30,49 —
+4 columns, int keys uniform in [0, 0.99 * rows), i.e. ~1% duplicate keys;
+timing shape mirrors examples/bench/table_join_dist_test.cpp:28-63: j_t =
+DistributedJoin wall-clock, w_t = barrier).
+
+Prints ONE JSON line:
+  {"metric": "dist_join_rows_per_sec", "value": N, "unit": "rows/s",
+   "vs_baseline": N, ...}
+
+vs_baseline is measured in-process against a single-core pandas hash join
+(`pd.merge`) on the identical data — the in-image stand-in for single-worker
+Cylon-MPI-on-CPU (the reference's own comparison anchor, see
+python/test/test_table.py:108-109 comments).  The published Cylon cluster
+curve (BASELINE.md) has no in-repo row count, so ratios must be measured,
+not assumed.
+
+Env knobs: CYLON_BENCH_ROWS (rows per device per side),
+CYLON_BENCH_REPS (timed repetitions, default 3).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    from cylon_tpu import CylonContext, JoinAlgorithm, JoinConfig, Table
+    from cylon_tpu.parallel import DTable, dist_join, shuffle_table
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    world = len(devs)
+    rows = int(os.environ.get("CYLON_BENCH_ROWS", "0"))
+    if rows == 0:
+        rows = 4_000_000 if platform == "tpu" else 500_000
+    reps = int(os.environ.get("CYLON_BENCH_REPS", "3"))
+    total = rows * world
+
+    ctx = CylonContext({"backend": "tpu", "devices": devs})
+    rng = np.random.default_rng(3)
+    krange = max(int(total * 0.99), 1)
+
+    def make(n: int):
+        return {
+            "k": rng.integers(0, krange, n).astype(np.int32),
+            "v0": rng.random(n, dtype=np.float32),
+            "v1": rng.random(n, dtype=np.float32),
+            "v2": rng.random(n, dtype=np.float32),
+        }
+
+    ldata, rdata = make(total), make(total)
+    left = DTable.from_table(ctx, Table.from_columns(ctx, ldata))
+    right = DTable.from_table(ctx, Table.from_columns(ctx, rdata))
+    cfg = JoinConfig.InnerJoin(0, 0, algorithm=JoinAlgorithm.HASH)
+
+    def run_join():
+        t0 = time.perf_counter()
+        out = dist_join(left, right, cfg)
+        jax.block_until_ready([c.data for c in out.columns])
+        t1 = time.perf_counter()
+        ctx.barrier()
+        t2 = time.perf_counter()
+        return t1 - t0, t2 - t1, out
+
+    _, _, warm = run_join()  # compile + first caches
+    out_rows = warm.num_rows
+    del warm
+    j_ts, w_ts = [], []
+    for _ in range(reps):
+        j, w, out = run_join()
+        j_ts.append(j)
+        w_ts.append(w)
+        del out
+    j_t = min(j_ts)
+
+    # phase breakdown: shuffle alone on the left table (same size both sides)
+    def run_shuffle():
+        t0 = time.perf_counter()
+        sh = shuffle_table(left, [0])
+        jax.block_until_ready([c.data for c in sh.columns])
+        return time.perf_counter() - t0
+    run_shuffle()
+    s_t = min(run_shuffle() for _ in range(reps))
+
+    # baseline: single-core pandas hash join on identical data
+    ldf, rdf = pd.DataFrame(ldata), pd.DataFrame(rdata)
+    t0 = time.perf_counter()
+    base_out = ldf.merge(rdf, on="k", how="inner")
+    p_t = time.perf_counter() - t0
+    base_rows = len(base_out)
+    del base_out
+
+    value = (2 * total) / j_t
+    base_rps = (2 * total) / p_t
+    print(json.dumps({
+        "metric": "dist_join_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(value / base_rps, 3),
+        "detail": {
+            "platform": platform, "world": world,
+            "rows_per_side": total, "out_rows": int(out_rows),
+            "baseline_out_rows": int(base_rows),
+            "j_t_ms": round(j_t * 1e3, 2),
+            "w_t_ms": round(min(w_ts) * 1e3, 2),
+            "shuffle_ms": round(s_t * 1e3, 2),
+            "shuffle_rows_per_sec_per_chip": round(rows / s_t, 1),
+            "pandas_join_ms": round(p_t * 1e3, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

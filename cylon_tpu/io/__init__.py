@@ -1,0 +1,12 @@
+"""I/O layer: CSV ingest/egress via pyarrow on host, then H2D transfer.
+
+The reference memory-maps files into ``arrow::csv::TableReader``
+(reference: cpp/src/cylon/io/arrow_io.cpp:25-50); pyarrow's reader is the
+same C++ under the hood, so reimplementing parsing would be pure loss
+(SURVEY.md §7).  Device residency happens at ``Table.from_arrow``.
+"""
+from .csv import (CSVReadOptions, CSVWriteOptions, read_csv, read_csv_many,
+                  write_csv)
+
+__all__ = ["CSVReadOptions", "CSVWriteOptions", "read_csv", "read_csv_many",
+           "write_csv"]

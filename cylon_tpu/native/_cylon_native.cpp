@@ -1,0 +1,288 @@
+// cylon_tpu native host runtime: the C++ leg of the framework.
+//
+// TPU compute runs through XLA; this extension covers the host-side hot
+// paths the reference implements in C++ (reference: cpp/src/cylon/util/
+// murmur3.cpp hashing, ctx/memory_pool.hpp:25-66 allocator, and the host
+// half of the string strategy — SURVEY.md §7 "Strings on TPU").
+//
+// Built by setup.py (setuptools C extension, CPython C API + numpy — no
+// pybind11 in this environment).  cylon_tpu/native/runtime.py dispatches
+// here when present and falls back to numpy otherwise.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// MurmurHash3_x86_32 (Austin Appleby's public-domain algorithm, rewritten)
+// ---------------------------------------------------------------------------
+
+inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6BU;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35U;
+  h ^= h >> 16;
+  return h;
+}
+
+uint32_t murmur3_32(const void* key, size_t len, uint32_t seed) {
+  const uint8_t* data = static_cast<const uint8_t*>(key);
+  const size_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xCC9E2D51U;
+  const uint32_t c2 = 0x1B873593U;
+
+  for (size_t i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, data + i * 4, 4);  // little-endian load
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xE6546B64U;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k1 ^= static_cast<uint32_t>(tail[1]) << 8; [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+
+  h1 ^= static_cast<uint32_t>(len);
+  return fmix32(h1);
+}
+
+// ---------------------------------------------------------------------------
+// vectorized entry points
+// ---------------------------------------------------------------------------
+
+PyObject* py_murmur3_32_u32(PyObject*, PyObject* args) {
+  PyObject* in_obj;
+  unsigned int seed = 0;
+  if (!PyArg_ParseTuple(args, "O|I", &in_obj, &seed)) return nullptr;
+  PyArrayObject* in = reinterpret_cast<PyArrayObject*>(PyArray_FROM_OTF(
+      in_obj, NPY_UINT32, NPY_ARRAY_IN_ARRAY));
+  if (!in) return nullptr;
+  npy_intp n = PyArray_SIZE(in);
+  PyArrayObject* out = reinterpret_cast<PyArrayObject*>(
+      PyArray_SimpleNew(1, &n, NPY_UINT32));
+  if (!out) { Py_DECREF(in); return nullptr; }
+  const uint32_t* src = static_cast<const uint32_t*>(PyArray_DATA(in));
+  uint32_t* dst = static_cast<uint32_t*>(PyArray_DATA(out));
+  Py_BEGIN_ALLOW_THREADS
+  for (npy_intp i = 0; i < n; i++) dst[i] = murmur3_32(&src[i], 4, seed);
+  Py_END_ALLOW_THREADS
+  Py_DECREF(in);
+  return reinterpret_cast<PyObject*>(out);
+}
+
+PyObject* py_murmur3_32_u64(PyObject*, PyObject* args) {
+  PyObject* in_obj;
+  unsigned int seed = 0;
+  if (!PyArg_ParseTuple(args, "O|I", &in_obj, &seed)) return nullptr;
+  PyArrayObject* in = reinterpret_cast<PyArrayObject*>(PyArray_FROM_OTF(
+      in_obj, NPY_UINT64, NPY_ARRAY_IN_ARRAY));
+  if (!in) return nullptr;
+  npy_intp n = PyArray_SIZE(in);
+  PyArrayObject* out = reinterpret_cast<PyArrayObject*>(
+      PyArray_SimpleNew(1, &n, NPY_UINT32));
+  if (!out) { Py_DECREF(in); return nullptr; }
+  const uint64_t* src = static_cast<const uint64_t*>(PyArray_DATA(in));
+  uint32_t* dst = static_cast<uint32_t*>(PyArray_DATA(out));
+  Py_BEGIN_ALLOW_THREADS
+  for (npy_intp i = 0; i < n; i++) dst[i] = murmur3_32(&src[i], 8, seed);
+  Py_END_ALLOW_THREADS
+  Py_DECREF(in);
+  return reinterpret_cast<PyObject*>(out);
+}
+
+PyObject* py_murmur3_32_bytes(PyObject*, PyObject* args) {
+  const char* buf;
+  Py_ssize_t len;
+  unsigned int seed = 0;
+  if (!PyArg_ParseTuple(args, "y#|I", &buf, &len, &seed)) return nullptr;
+  return PyLong_FromUnsignedLong(
+      murmur3_32(buf, static_cast<size_t>(len), seed));
+}
+
+// ---------------------------------------------------------------------------
+// dictionary encode: object array of str -> (int32 codes, sorted uniques)
+// ---------------------------------------------------------------------------
+
+PyObject* py_dictionary_encode(PyObject*, PyObject* args) {
+  PyObject* in_obj;
+  if (!PyArg_ParseTuple(args, "O", &in_obj)) return nullptr;
+  PyArrayObject* in = reinterpret_cast<PyArrayObject*>(PyArray_FROM_OTF(
+      in_obj, NPY_OBJECT, NPY_ARRAY_IN_ARRAY));
+  if (!in) return nullptr;
+  npy_intp n = PyArray_SIZE(in);
+  PyObject** items = static_cast<PyObject**>(PyArray_DATA(in));
+
+  std::vector<std::pair<std::string, npy_intp>> keyed;
+  keyed.reserve(n);
+  for (npy_intp i = 0; i < n; i++) {
+    Py_ssize_t sl;
+    const char* s = PyUnicode_AsUTF8AndSize(items[i], &sl);
+    if (!s) { Py_DECREF(in); return nullptr; }
+    keyed.emplace_back(std::string(s, sl), i);
+  }
+  std::sort(keyed.begin(), keyed.end());
+
+  npy_intp n_out = n;
+  PyArrayObject* codes = reinterpret_cast<PyArrayObject*>(
+      PyArray_SimpleNew(1, &n_out, NPY_INT32));
+  if (!codes) { Py_DECREF(in); return nullptr; }
+  int32_t* code_data = static_cast<int32_t*>(PyArray_DATA(codes));
+
+  std::vector<npy_intp> uniq_first;  // index into keyed of each unique run
+  int32_t next = -1;
+  for (npy_intp i = 0; i < n; i++) {
+    if (i == 0 || keyed[i].first != keyed[i - 1].first) {
+      next++;
+      uniq_first.push_back(i);
+    }
+    code_data[keyed[i].second] = next;
+  }
+
+  npy_intp n_uniq = static_cast<npy_intp>(uniq_first.size());
+  PyArrayObject* dict = reinterpret_cast<PyArrayObject*>(
+      PyArray_SimpleNew(1, &n_uniq, NPY_OBJECT));
+  if (!dict) { Py_DECREF(in); Py_DECREF(codes); return nullptr; }
+  PyObject** dict_data = static_cast<PyObject**>(PyArray_DATA(dict));
+  for (npy_intp u = 0; u < n_uniq; u++) {
+    PyObject* orig = items[keyed[uniq_first[u]].second];
+    Py_INCREF(orig);
+    dict_data[u] = orig;
+  }
+
+  Py_DECREF(in);
+  return Py_BuildValue("(NN)", codes, dict);
+}
+
+// ---------------------------------------------------------------------------
+// StagingArena: 64-byte-aligned bump allocator for H2D staging
+// (reference: ctx/memory_pool.hpp:25-66)
+// ---------------------------------------------------------------------------
+
+struct ArenaObject {
+  PyObject_HEAD
+  uint8_t* base;
+  size_t capacity;
+  size_t offset;
+};
+
+int arena_init(ArenaObject* self, PyObject* args, PyObject*) {
+  Py_ssize_t cap = 64 << 20;
+  if (!PyArg_ParseTuple(args, "|n", &cap)) return -1;
+  self->base = static_cast<uint8_t*>(::operator new(cap, std::align_val_t(64)));
+  self->capacity = static_cast<size_t>(cap);
+  self->offset = 0;
+  return 0;
+}
+
+void arena_dealloc(ArenaObject* self) {
+  if (self->base) ::operator delete(self->base, std::align_val_t(64));
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* arena_allocate(ArenaObject* self, PyObject* args) {
+  Py_ssize_t nbytes;
+  if (!PyArg_ParseTuple(args, "n", &nbytes)) return nullptr;
+  size_t aligned = (static_cast<size_t>(nbytes) + 63) & ~size_t(63);
+  if (self->offset + aligned > self->capacity) {
+    PyErr_SetString(PyExc_MemoryError, "staging arena exhausted");
+    return nullptr;
+  }
+  uint8_t* p = self->base + self->offset;
+  self->offset += aligned;
+  return PyMemoryView_FromMemory(reinterpret_cast<char*>(p), nbytes,
+                                 PyBUF_WRITE);
+}
+
+PyObject* arena_reset(ArenaObject* self, PyObject*) {
+  self->offset = 0;
+  Py_RETURN_NONE;
+}
+
+PyObject* arena_bytes_in_use(ArenaObject* self, PyObject*) {
+  return PyLong_FromSize_t(self->offset);
+}
+
+PyMethodDef arena_methods[] = {
+    {"allocate", reinterpret_cast<PyCFunction>(arena_allocate), METH_VARARGS,
+     "allocate(nbytes) -> writable memoryview (64-byte aligned)"},
+    {"reset", reinterpret_cast<PyCFunction>(arena_reset), METH_NOARGS,
+     "release all allocations"},
+    {"bytes_in_use", reinterpret_cast<PyCFunction>(arena_bytes_in_use),
+     METH_NOARGS, "bytes currently allocated"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject ArenaType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "cylon_tpu.native._cylon_native.StagingArena",  // tp_name
+    sizeof(ArenaObject),
+};
+
+// ---------------------------------------------------------------------------
+// module
+// ---------------------------------------------------------------------------
+
+PyMethodDef module_methods[] = {
+    {"murmur3_32_u32", py_murmur3_32_u32, METH_VARARGS,
+     "murmur3_32_u32(uint32 array, seed=0) -> uint32 array"},
+    {"murmur3_32_u64", py_murmur3_32_u64, METH_VARARGS,
+     "murmur3_32_u64(uint64 array, seed=0) -> uint32 array"},
+    {"murmur3_32_bytes", py_murmur3_32_bytes, METH_VARARGS,
+     "murmur3_32_bytes(bytes, seed=0) -> int"},
+    {"dictionary_encode", py_dictionary_encode, METH_VARARGS,
+     "dictionary_encode(object str array) -> (int32 codes, sorted uniques)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module_def = {
+    PyModuleDef_HEAD_INIT, "_cylon_native",
+    "cylon_tpu native host runtime", -1, module_methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__cylon_native(void) {
+  import_array();
+  ArenaType.tp_flags = Py_TPFLAGS_DEFAULT;
+  ArenaType.tp_new = PyType_GenericNew;
+  ArenaType.tp_init = reinterpret_cast<initproc>(arena_init);
+  ArenaType.tp_dealloc = reinterpret_cast<destructor>(arena_dealloc);
+  ArenaType.tp_methods = arena_methods;
+  ArenaType.tp_doc = "64-byte-aligned bump allocator for H2D staging";
+  if (PyType_Ready(&ArenaType) < 0) return nullptr;
+  PyObject* m = PyModule_Create(&module_def);
+  if (!m) return nullptr;
+  Py_INCREF(&ArenaType);
+  PyModule_AddObject(m, "StagingArena",
+                     reinterpret_cast<PyObject*>(&ArenaType));
+  return m;
+}

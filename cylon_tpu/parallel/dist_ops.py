@@ -22,7 +22,7 @@ splitters.
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +31,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..config import JoinAlgorithm, JoinConfig
-from ..dtypes import DataType, Type, is_dictionary_encoded
+from ..dtypes import DataType, is_dictionary_encoded
 from ..ops import compact as ops_compact
 from ..ops import gather as ops_gather
 from ..ops import groupby as ops_groupby
@@ -41,7 +41,6 @@ from ..ops import join as ops_join
 from ..ops import setops as ops_setops
 from ..ops import sort as ops_sort
 from ..status import Code, CylonError, Status
-from ..table import unify_dictionaries
 from .dtable import DColumn, DTable
 from .shuffle import shuffle_leaves
 

@@ -1,0 +1,24 @@
+"""pycylon — source-compatible Python API over the cylon_tpu backend.
+
+Drop-in surface of the reference's Cython binding (reference:
+python/pycylon/__init__.py, docs/docs/python.md:12-58): the same modules,
+classes and call signatures, but every operator dispatches to the TPU-native
+cylon_tpu engine instead of the C++/MPI core.  ``CylonContext('mpi')`` is
+accepted and means "distributed over the device mesh".
+
+The id-addressed table registry the reference uses for FFI
+(cpp/src/cylon/table_api.cpp:45-73) survives here only at this boundary:
+compat Tables carry a uuid and a module registry resolves uuid → backing
+device table, exactly the role registry ids play in table_cython.cpp.
+"""
+from .ctx.context import CylonContext
+from .common.join_config import JoinAlgorithm, JoinConfig, JoinType, \
+    PJoinAlgorithm, PJoinType
+from .common.status import Status
+from .common.code import Code
+from .data.table import Table, csv_reader
+
+__all__ = [
+    "CylonContext", "Table", "csv_reader", "Status", "Code",
+    "JoinConfig", "JoinType", "JoinAlgorithm", "PJoinType", "PJoinAlgorithm",
+]

@@ -1,0 +1,4 @@
+"""pycylon.common.code — reference: python/pycylon/common/code.pyx:23-40."""
+from cylon_tpu.status import Code
+
+__all__ = ["Code"]

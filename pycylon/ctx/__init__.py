@@ -1,0 +1,3 @@
+from .context import CylonContext
+
+__all__ = ["CylonContext"]

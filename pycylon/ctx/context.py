@@ -1,0 +1,23 @@
+"""pycylon.ctx.context — reference: python/pycylon/ctx/context.pyx:24-75.
+
+``CylonContext('mpi')`` in reference scripts meant "join the MPI world";
+here it means "distribute over the visible device mesh" (TPU chips on
+hardware, virtual CPU devices under
+``--xla_force_host_platform_device_count``).  ``CylonContext()`` /
+``CylonContext(None)`` is the single-device local mode.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from cylon_tpu.context import CylonContext as _Ctx
+
+
+class CylonContext(_Ctx):
+    def __init__(self, config: Optional[Any] = None, **kw):
+        super().__init__(config, **kw)
+        self._config_str = config if isinstance(config, str) else None
+
+    def get_config(self):
+        """reference returns the config string the context was built with."""
+        return self._config_str

@@ -1,0 +1,3 @@
+from .csv_read_config import CSVReadOptions
+
+__all__ = ["CSVReadOptions"]

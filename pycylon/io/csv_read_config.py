@@ -1,0 +1,6 @@
+"""pycylon.io.csv_read_config — reference:
+python/pycylon/io/csv_read_config.pyx (mirror of io/csv_read_config.hpp).
+"""
+from cylon_tpu.io import CSVReadOptions
+
+__all__ = ["CSVReadOptions"]

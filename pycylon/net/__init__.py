@@ -1,0 +1,12 @@
+"""pycylon.net — compat surface over the XLA collective layer.
+
+reference: python/pycylon/net/ (Cython wrappers over cylon::net AllToAll /
+TxRequest / dist).  The progress-engine machinery has no equivalent here —
+``Communication.finish()`` compiles ONE ``lax.all_to_all`` over the device
+mesh and XLA/ICI does the rest.
+"""
+from . import dist
+from .comms import Communication
+from .txrequest import TxRequest
+
+__all__ = ["dist", "Communication", "TxRequest"]

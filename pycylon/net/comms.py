@@ -1,0 +1,136 @@
+"""pycylon.net.comms — raw AllToAll over the mesh collective.
+
+reference: python/pycylon/net/comms.pyx (Communication → CAll_to_all_wrap →
+cylon::AllToAll insert/wait/finish over MPI point-to-point).  Here the
+byte exchange is ONE `lax.all_to_all` over the context mesh: inserted
+buffers are byte-serialized, padded to the per-pair max, exchanged, and
+unpadded on receive — the same two-phase plan as the engine's shuffle
+(cylon_tpu/parallel/shuffle.py), exposed at the raw-buffer level for
+API parity.  ``wait`` is a no-op: XLA dispatch is already asynchronous.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dist import get_ctx
+from .txrequest import TxRequest
+
+
+class Communication:
+    def __init__(self, worker_id: int, sources: Sequence[int],
+                 targets: Sequence[int], edge_id: int, ctx=None):
+        self.ctx = ctx or get_ctx()
+        self.worker_id = int(worker_id)
+        self.sources = list(sources)
+        self.targets = list(targets)
+        self.edge_id = int(edge_id)
+        self._pending: List[TxRequest] = []
+        self._received: Dict[int, List[Tuple[int, np.ndarray, Optional[np.ndarray]]]] = {}
+        self._done = False
+
+    def insert(self, buffer: np.ndarray, length: int, target: int,
+               header: Optional[np.ndarray] = None,
+               header_length: int = -1) -> bool:
+        if self._done:
+            return False
+        if target not in self.targets:
+            return False
+        self._pending.append(TxRequest(target, buffer[:length], length,
+                                       header, header_length))
+        return True
+
+    def wait(self) -> None:
+        """XLA dispatch is async; nothing to progress (the reference's
+        MPI_Test polling loops have no equivalent)."""
+
+    def finish(self) -> None:
+        """Run the exchange: one padded uint8 all_to_all over the mesh."""
+        if self._done:
+            return
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        Pn = self.ctx.get_world_size()
+        mesh, axis = self.ctx.mesh, self.ctx.axis
+
+        # serialize sends per (source=worker_id shard, target)
+        per_target: Dict[int, List[TxRequest]] = {t: [] for t in range(Pn)}
+        for req in self._pending:
+            per_target[req.target].append(req)
+        blobs = {t: _pack(reqs) for t, reqs in per_target.items()}
+        block = max(max((len(b) for b in blobs.values()), default=1), 1)
+
+        send = np.zeros((Pn, Pn, block), np.uint8)   # [source, target, block]
+        lens = np.zeros((Pn, Pn), np.int32)
+        for t, b in blobs.items():
+            send[self.worker_id, t, :len(b)] = np.frombuffer(b, np.uint8)
+            lens[self.worker_id, t] = len(b)
+
+        spec = P(axis)
+        sh = NamedSharding(mesh, spec)
+        send_d = jax.device_put(send.reshape(Pn * Pn, block), sh)
+        lens_d = jax.device_put(lens.reshape(Pn * Pn), sh)
+
+        def kernel(s, l):
+            s = s.reshape((Pn, block))
+            l = l.reshape((Pn,))
+            r = jax.lax.all_to_all(s, axis, 0, 0, tiled=True)
+            rl = jax.lax.all_to_all(l, axis, 0, 0, tiled=True)
+            return r.reshape((Pn * block,)), rl
+
+        recv, rlens = jax.jit(shard_map(kernel, mesh=mesh,
+                                        in_specs=(spec, spec),
+                                        out_specs=(spec, spec)))(send_d, lens_d)
+        recv = np.asarray(jax.device_get(recv)).reshape(Pn, Pn, block)
+        rlens = np.asarray(jax.device_get(rlens)).reshape(Pn, Pn)
+        for tgt in range(Pn):
+            inbox = []
+            for src in range(Pn):
+                n = int(rlens[tgt, src])
+                if n:
+                    inbox.extend((src, buf, hdr) for buf, hdr in
+                                 _unpack(recv[tgt, src, :n].tobytes()))
+            self._received[tgt] = inbox
+        self._done = True
+
+    def received(self, rank: Optional[int] = None):
+        """Buffers received by ``rank`` (default: this worker) as a list of
+        (source, buffer ndarray, header ndarray|None)."""
+        return self._received.get(
+            self.worker_id if rank is None else rank, [])
+
+
+def _pack(reqs: List[TxRequest]) -> bytes:
+    out = bytearray()
+    for r in reqs:
+        buf = np.ascontiguousarray(r.buf)
+        hdr = (np.empty(0, np.int32) if r.header is None
+               else np.asarray(r.header, np.int32))
+        meta = np.array([len(buf.tobytes()), len(hdr)], np.int64).tobytes()
+        dt = str(buf.dtype).encode()
+        out += meta + np.array([len(dt)], np.int64).tobytes() + dt
+        out += hdr.tobytes() + buf.tobytes()
+    return bytes(out)
+
+
+def _unpack(blob: bytes):
+    out = []
+    off = 0
+    while off < len(blob):
+        blen, hlen = np.frombuffer(blob, np.int64, 2, off)
+        off += 16
+        (dlen,) = np.frombuffer(blob, np.int64, 1, off)
+        off += 8
+        dt = np.dtype(blob[off:off + dlen].decode())
+        off += int(dlen)
+        hdr = (np.frombuffer(blob, np.int32, int(hlen), off)
+               if hlen else None)
+        off += int(hlen) * 4
+        buf = np.frombuffer(blob[off:off + int(blen)], dt).copy()
+        off += int(blen)
+        out.append((buf, hdr))
+    return out

@@ -1,0 +1,3 @@
+from .benchutils import benchmark_with_repitions
+
+__all__ = ["benchmark_with_repitions"]

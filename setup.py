@@ -1,0 +1,25 @@
+"""Build the native host runtime extension:
+
+    python setup.py build_ext --inplace
+
+cylon_tpu/native/runtime.py auto-detects the built module and falls back to
+numpy when absent, so the package works either way.
+"""
+import numpy as np
+from setuptools import Extension, setup
+
+setup(
+    name="cylon_tpu",
+    version="0.1.0",
+    packages=["cylon_tpu", "cylon_tpu.ops", "cylon_tpu.parallel",
+              "cylon_tpu.native", "cylon_tpu.io", "pycylon"],
+    ext_modules=[
+        Extension(
+            "cylon_tpu.native._cylon_native",
+            sources=["cylon_tpu/native/_cylon_native.cpp"],
+            include_dirs=[np.get_include()],
+            extra_compile_args=["-O3", "-std=c++17", "-Wall"],
+            language="c++",
+        )
+    ],
+)

@@ -1,0 +1,175 @@
+"""Murmur3 parity: device (ops/hash.py) == host (native/runtime.py) ==
+reference algorithm (util/murmur3.cpp, MurmurHash3_x86_32).
+
+The reference hashes each value's raw little-endian bytes with
+MurmurHash3_x86_32, width = bit_width/8 (reference:
+arrow/arrow_partition_kernels.hpp:93-105), nulls → 0 (:55-57,93-95).  The
+oracle below is a byte-accurate pure-Python MurmurHash3_x86_32 written from
+the published algorithm.  Parity holds exactly for 4- and 8-byte types (the
+partition-key types); sub-4-byte ints are widened to 4 bytes on device — an
+intentional divergence (placement is still internally consistent, which is
+what shuffle correctness needs).
+
+Also: partition placement must be identical between the single-device and
+mesh paths — shuffle invariance.
+"""
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cylon_tpu.native import runtime as native
+from cylon_tpu.ops import hash as ops_hash
+
+
+def murmur3_x86_32_oracle(data: bytes, seed: int = 0) -> int:
+    """Byte-accurate MurmurHash3_x86_32 (published algorithm)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        (k,) = struct.unpack_from("<I", data, i * 4)
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    tail = data[nblocks * 4:]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def test_oracle_known_vectors():
+    """Published MurmurHash3_x86_32 test vectors (sanity of the oracle)."""
+    assert murmur3_x86_32_oracle(b"", 0) == 0
+    assert murmur3_x86_32_oracle(b"", 1) == 0x514E28B7
+    assert murmur3_x86_32_oracle(b"hello", 0) == 0x248BFA47
+    assert murmur3_x86_32_oracle(b"Hello, world!", 0x9747B28C) == 0x24884CBA
+
+
+@pytest.mark.parametrize("dtype,fmt", [
+    (np.int32, "<i"), (np.uint32, "<I"), (np.float32, "<f"),
+    (np.int64, "<q"), (np.uint64, "<Q"), (np.float64, "<d"),
+])
+def test_device_matches_reference_bytes(rng, dtype, fmt):
+    if np.issubdtype(dtype, np.floating):
+        vals = rng.standard_normal(64).astype(dtype)
+    else:
+        info = np.iinfo(dtype)
+        vals = rng.integers(info.min, info.max, 64, dtype=dtype,
+                            endpoint=True)
+        vals[:2] = [info.min, info.max]
+    dev = np.asarray(jax.device_get(ops_hash.murmur3_32(jnp.asarray(vals))))
+    exp = np.array([murmur3_x86_32_oracle(struct.pack(fmt, v)) for v in vals],
+                   np.uint32)
+    np.testing.assert_array_equal(dev, exp)
+
+
+def test_host_matches_reference_bytes(rng):
+    k32 = rng.integers(0, 2**32, 64, dtype=np.uint64).astype(np.uint32)
+    exp32 = np.array([murmur3_x86_32_oracle(struct.pack("<I", v))
+                      for v in k32], np.uint32)
+    np.testing.assert_array_equal(native.murmur3_32_u32(k32), exp32)
+
+    k64 = rng.integers(0, 2**63, 64, dtype=np.uint64)
+    exp64 = np.array([murmur3_x86_32_oracle(struct.pack("<Q", v))
+                      for v in k64], np.uint32)
+    np.testing.assert_array_equal(native.murmur3_32_u64(k64), exp64)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.float64])
+def test_device_matches_host(rng, dtype):
+    if np.issubdtype(dtype, np.floating):
+        vals = rng.standard_normal(256).astype(dtype)
+        host_words = vals.view(np.uint64)
+        host = native.murmur3_32_u64(host_words)
+    elif dtype == np.int64:
+        vals = rng.integers(-2**62, 2**62, 256, dtype=dtype)
+        host = native.murmur3_32_u64(vals.view(np.uint64))
+    else:
+        vals = rng.integers(-2**31, 2**31 - 1, 256, dtype=dtype)
+        host = native.murmur3_32_u32(vals.view(np.uint32))
+    dev = np.asarray(jax.device_get(ops_hash.murmur3_32(jnp.asarray(vals))))
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_null_hashes_to_zero(rng):
+    vals = jnp.asarray(rng.integers(0, 100, 16, dtype=np.int32))
+    validity = jnp.asarray(rng.random(16) > 0.5)
+    h = np.asarray(jax.device_get(ops_hash.column_hash(vals, validity)))
+    v = np.asarray(jax.device_get(validity))
+    assert (h[~v] == 0).all()
+    assert (h[v] != 0).any()
+
+
+class TestShuffleInvariance:
+    """Partition placement must not depend on where rows start."""
+
+    def test_placement_matches_local_hash(self, dctx, rng):
+        from cylon_tpu import Table
+        from cylon_tpu.parallel import DTable, shuffle_table
+
+        n = 300
+        keys = rng.integers(-1000, 1000, n, dtype=np.int32)
+        vals = np.arange(n, dtype=np.int32)
+        dt = DTable.from_table(
+            dctx, Table.from_columns(dctx, {"k": keys, "v": vals}))
+        sh = shuffle_table(dt, ["k"])
+
+        # expected placement from the plain device hash, no mesh involved
+        h = np.asarray(jax.device_get(
+            ops_hash.row_hash((jnp.asarray(keys),), (None,))))
+        expect_pid = h % np.uint32(dctx.get_world_size())
+
+        cnts = sh.counts_host()
+        for p in range(dctx.get_world_size()):
+            part = sh.partition(p)
+            got_v = np.sort(np.asarray(jax.device_get(part.column("v").data)))
+            exp_v = np.sort(vals[expect_pid == p])
+            np.testing.assert_array_equal(got_v, exp_v)
+            assert cnts[p] == exp_v.size
+
+    def test_shuffle_preserves_multiset(self, dctx, rng):
+        from cylon_tpu import Table
+        from cylon_tpu.parallel import DTable, shuffle_table
+
+        n = 257
+        keys = rng.integers(0, 7, n, dtype=np.int32)  # heavy skew
+        dt = DTable.from_table(
+            dctx, Table.from_columns(dctx, {"k": keys}))
+        sh = shuffle_table(dt, ["k"])
+        got = np.sort(np.asarray(jax.device_get(sh.to_table().column("k").data)))
+        np.testing.assert_array_equal(got, np.sort(keys))
+
+    def test_keys_colocate(self, dctx, rng):
+        from cylon_tpu import Table
+        from cylon_tpu.parallel import DTable, shuffle_table
+
+        keys = rng.integers(0, 20, 400, dtype=np.int64)
+        dt = DTable.from_table(dctx, Table.from_columns(dctx, {"k": keys}))
+        sh = shuffle_table(dt, ["k"])
+        seen = {}
+        for p in range(dctx.get_world_size()):
+            for k in np.unique(np.asarray(
+                    jax.device_get(sh.partition(p).column("k").data))):
+                assert seen.setdefault(int(k), p) == p, \
+                    f"key {k} on shards {seen[int(k)]} and {p}"

@@ -1,0 +1,263 @@
+"""CSV I/O and the pycylon source-compat surface.
+
+Models the reference's own python tests (reference: python/test/test_table.py
+CSV round trip + join; test_dist_rl.py distributed ops; test_alltoall.py raw
+AllToAll) — verified against a pandas oracle rather than the engine itself.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture
+def csv_pair(tmp_path, rng):
+    n = 200
+    df1 = pd.DataFrame({
+        "k": rng.integers(0, 50, n),
+        "v": np.round(rng.random(n), 3),
+    })
+    df2 = pd.DataFrame({
+        "k": rng.integers(0, 50, n),
+        "w": np.round(rng.random(n), 3),
+    })
+    p1, p2 = tmp_path / "a.csv", tmp_path / "b.csv"
+    df1.to_csv(p1, index=False)
+    df2.to_csv(p2, index=False)
+    return str(p1), str(p2), df1, df2
+
+
+class TestCSV:
+    def test_read_roundtrip(self, ctx, csv_pair, tmp_path):
+        from cylon_tpu.io import CSVWriteOptions, read_csv, write_csv
+
+        p1, _, df1, _ = csv_pair
+        t = read_csv(ctx, p1)
+        assert t.num_rows == len(df1)
+        assert t.column_names == ["k", "v"]
+        pd.testing.assert_frame_equal(t.to_pandas(), df1, check_dtype=False)
+
+        out = tmp_path / "out.csv"
+        write_csv(t, str(out))
+        pd.testing.assert_frame_equal(pd.read_csv(out), df1, check_dtype=False)
+
+    def test_options(self, ctx, tmp_path):
+        from cylon_tpu.io import CSVReadOptions, read_csv
+
+        p = tmp_path / "t.tsv"
+        p.write_text("x\ty\n1\tNA\n2\t5\n")
+        opts = (CSVReadOptions().WithDelimiter("\t").NullValues(["NA"])
+                .BlockSize(1 << 16))
+        t = read_csv(ctx, str(p), opts)
+        assert t.num_rows == 2
+        assert t.column("y").has_nulls
+
+    def test_include_columns(self, ctx, csv_pair):
+        from cylon_tpu.io import CSVReadOptions, read_csv
+
+        p1, _, _, _ = csv_pair
+        t = read_csv(ctx, p1, CSVReadOptions().IncludeColumns(["v"]))
+        assert t.column_names == ["v"]
+
+    def test_multi_file_concurrent(self, ctx, csv_pair):
+        from cylon_tpu.io import read_csv_many
+
+        p1, p2, df1, df2 = csv_pair
+        ts = read_csv_many(ctx, [p1, p2])
+        assert [t.num_rows for t in ts] == [len(df1), len(df2)]
+
+    def test_missing_file_raises(self, ctx):
+        from cylon_tpu.io import read_csv
+        from cylon_tpu.status import Code, CylonError
+
+        with pytest.raises(CylonError) as e:
+            read_csv(ctx, "/nonexistent/x.csv")
+        assert e.value.status.code == Code.IOError
+
+    def test_write_delimiter(self, ctx, csv_pair, tmp_path):
+        from cylon_tpu.io import CSVWriteOptions, read_csv, write_csv
+
+        p1, _, df1, _ = csv_pair
+        t = read_csv(ctx, p1)
+        out = tmp_path / "semi.csv"
+        write_csv(t, str(out), CSVWriteOptions().WithDelimiter(";"))
+        pd.testing.assert_frame_equal(pd.read_csv(out, sep=";"), df1,
+                                      check_dtype=False)
+
+
+class TestPycylonCompat:
+    """The reference docs' own example flow, module names aside
+    (docs/docs/python.md:12-58)."""
+
+    def test_sequential_flow(self, csv_pair):
+        from pycylon import CylonContext as CC
+        from pycylon.data.table import Table, csv_reader
+
+        ctx = CC(None)
+        p1, p2, df1, df2 = csv_pair
+        tb1 = csv_reader.read(ctx, p1, ",")
+        tb2 = csv_reader.read(ctx, p2, ",")
+        assert tb1.rows == len(df1) and tb1.columns == 2
+
+        tb3 = tb1.join(ctx, table=tb2, join_type="inner", algorithm="hash",
+                       left_col=0, right_col=0)
+        exp = df1.merge(df2, on="k", how="inner")
+        assert tb3.rows == len(exp)
+
+        tb4 = tb1.union(ctx, tb1)
+        assert tb4.rows == len(df1.drop_duplicates())
+
+        assert tb1.subtract(ctx, tb1).rows == 0
+        assert tb1.intersect(ctx, tb1).rows == len(df1.drop_duplicates())
+
+    def test_distributed_flow(self, csv_pair):
+        from pycylon import CylonContext as CC
+        from pycylon.data.table import csv_reader
+        from tests.conftest import CPU_DEVICES
+
+        ctx = CC({"backend": "mpi", "devices": CPU_DEVICES})
+        assert ctx.get_world_size() == 8
+        p1, p2, df1, df2 = csv_pair
+        tb1 = csv_reader.read(ctx, p1, ",")
+        tb2 = csv_reader.read(ctx, p2, ",")
+
+        tb3 = tb1.distributed_join(ctx, table=tb2, join_type="inner",
+                                   algorithm="hash", left_col=0, right_col=0)
+        exp = df1.merge(df2, on="k", how="inner")
+        assert tb3.rows == len(exp)
+        got = (tb3.to_pandas().sort_values(["lt-k", "lt-v", "rt-w"])
+               .reset_index(drop=True))
+        expd = (exp.rename(columns={"k": "lt-k", "v": "lt-v", "w": "rt-w"})
+                .assign(**{"rt-k": lambda d: d["lt-k"]})
+                [["lt-k", "lt-v", "rt-k", "rt-w"]]
+                .sort_values(["lt-k", "lt-v", "rt-w"]).reset_index(drop=True))
+        pd.testing.assert_frame_equal(got, expd, check_dtype=False)
+
+        assert tb1.distributed_union(ctx, tb1).rows == \
+            len(df1.drop_duplicates())
+        assert tb1.distributed_subtract(ctx, tb1).rows == 0
+        s = tb1.distributed_sort(ctx, "k").to_pandas()
+        assert (s["k"].values == np.sort(df1["k"].values)).all()
+
+    def test_arrow_interop(self, csv_pair):
+        import pyarrow as pa
+        from pycylon.data.table import Table
+
+        _, _, df1, _ = csv_pair
+        at = pa.Table.from_pandas(df1)
+        tb = Table.from_arrow(at)
+        back = Table.to_arrow(tb)
+        pd.testing.assert_frame_equal(back.to_pandas(), df1,
+                                      check_dtype=False)
+
+    def test_registry_and_id_ctor(self, csv_pair):
+        from pycylon.data.table import Table
+
+        _, _, df1, _ = csv_pair
+        tb = Table.from_pandas(df1)
+        again = Table(tb.id)
+        assert again.rows == tb.rows
+
+    def test_to_csv_status(self, csv_pair, tmp_path):
+        from pycylon.data.table import Table
+
+        _, _, df1, _ = csv_pair
+        tb = Table.from_pandas(df1)
+        st = tb.to_csv(str(tmp_path / "o.csv"))
+        assert st.is_ok()
+        st2 = tb.to_csv("/nonexistent_dir_xyz/o.csv")
+        assert not st2.is_ok()
+
+    def test_join_config_strings(self):
+        from pycylon.common.join_config import JoinConfig, PJoinType
+        from cylon_tpu.config import JoinType
+
+        jc = JoinConfig("outer", "sort", 1, 2)
+        assert jc.join_type == JoinType.FULL_OUTER
+        assert jc.left_column_idx == 1 and jc.right_column_idx == 2
+        assert PJoinType.OUTER.value == "fullouter"
+        with pytest.raises(ValueError):
+            JoinConfig("cross", "hash", 0, 0)
+
+
+class TestNetCompat:
+    def test_alltoall_bytes(self):
+        """reference: python/test/test_alltoall.py shape."""
+        from pycylon.net import Communication, dist
+        from tests.conftest import CPU_DEVICES
+        from pycylon.ctx.context import CylonContext as CC
+
+        ctx = CC({"backend": "mpi", "devices": CPU_DEVICES})
+        size = ctx.get_world_size()
+        comm = Communication(0, list(range(size)), list(range(size)), 1,
+                             ctx=ctx)
+        hdr = np.array([1, 2, 3, 4], np.int32)
+        payload = np.array([3.14, 2.71], np.double)
+        assert comm.insert(payload, 2, 1, hdr, 4)
+        comm.insert(np.array([7.0]), 1, 0, hdr, 4)
+        comm.wait()
+        comm.finish()
+        inbox1 = comm.received(1)
+        assert len(inbox1) == 1
+        src, buf, h = inbox1[0]
+        assert src == 0
+        np.testing.assert_allclose(buf, payload)
+        np.testing.assert_array_equal(h, hdr)
+        inbox0 = comm.received(0)
+        assert len(inbox0) == 1 and inbox0[0][1][0] == 7.0
+
+    def test_txrequest_header_cap(self):
+        from pycylon.net import TxRequest
+
+        with pytest.raises(ValueError):
+            TxRequest(0, np.arange(3), 3, np.arange(8, dtype=np.int32))
+
+
+class TestDataUtils:
+    def test_minibatcher(self, rng):
+        from pycylon.util.data import MiniBatcher
+
+        data = rng.random((150, 4))
+        batches = MiniBatcher.generate_minibatches(data, 32)
+        assert batches.shape == (5, 32, 4)
+        np.testing.assert_array_equal(batches[0], data[:32])
+        # tail batch reuses head rows to fill
+        np.testing.assert_array_equal(batches[-1][:22], data[128:])
+
+    def test_local_loader(self, tmp_path, rng):
+        from pycylon.util.data import LocalDataLoader
+
+        for i in range(2):
+            pd.DataFrame({"x": rng.integers(0, 9, 10)}).to_csv(
+                tmp_path / f"f{i}.csv", index=False)
+        dl = LocalDataLoader(source_dir=str(tmp_path),
+                             source_files=["f0.csv", "f1.csv"])
+        ds = dl.load()
+        assert len(ds) == 2 and ds[0].num_rows == 10
+
+    def test_distributed_loader(self, dctx, tmp_path, rng):
+        from pycylon.util.data import DistributedDataLoader
+
+        files = []
+        total = 0
+        for i in range(dctx.get_world_size()):
+            n = int(rng.integers(1, 20))
+            total += n
+            pd.DataFrame({"x": rng.integers(0, 9, n)}).to_csv(
+                tmp_path / f"p{i}.csv", index=False)
+            files.append(f"p{i}.csv")
+        dl = DistributedDataLoader(ctx=dctx, source_dir=str(tmp_path),
+                                   source_files=files)
+        (dt,) = dl.load()
+        assert dt.num_rows == total
+
+    def test_benchutils(self):
+        from pycylon.util.benchutils import benchmark_with_repitions
+
+        @benchmark_with_repitions(repititions=3, time_type="ms")
+        def f(x):
+            return x + 1
+
+        ms, ret = f(1)
+        assert ret == 2 and ms >= 0
