@@ -85,6 +85,16 @@ def main() -> None:
         del out
     j_t = min(j_ts)
 
+    # phase decomposition: one traced run (spans sync per phase, so its
+    # total is a little above j_t; the split is what matters)
+    from cylon_tpu import trace
+    trace.enable()
+    trace.reset()
+    _, _, out = run_join()
+    del out
+    phases = {k: round(v, 2) for k, v in trace.phase_totals().items()}
+    trace.disable()
+
     # phase breakdown: shuffle alone on the left table (same size both sides)
     def run_shuffle():
         t0 = time.perf_counter()
@@ -118,6 +128,7 @@ def main() -> None:
             "shuffle_ms": round(s_t * 1e3, 2),
             "shuffle_rows_per_sec_per_chip": round(rows / s_t, 1),
             "pandas_join_ms": round(p_t * 1e3, 2),
+            "phase_ms": phases,
         },
     }))
 

@@ -15,6 +15,7 @@ Layer map (tpu-native mirror of SURVEY.md §1):
     L0  context.py    CylonContext over a jax Mesh; native/ host runtime
 """
 
+from . import trace
 from .config import JoinAlgorithm, JoinConfig, JoinType
 from .context import CylonContext
 from .dtypes import DataType, Layout, Type
@@ -26,5 +27,5 @@ __version__ = "0.1.0"
 __all__ = [
     "CylonContext", "Table", "Column", "Status", "Code", "CylonError",
     "DataType", "Type", "Layout", "JoinConfig", "JoinType", "JoinAlgorithm",
-    "__version__",
+    "trace", "__version__",
 ]

@@ -194,10 +194,48 @@ struct ArenaObject {
   size_t offset;
 };
 
+// A slice of the arena exporting the buffer protocol.  The memoryview
+// returned by allocate() references the slice, the slice references the
+// arena, so the backing memory outlives every view handed out.
+struct ArenaSliceObject {
+  PyObject_HEAD
+  PyObject* arena;  // strong ref
+  uint8_t* ptr;
+  Py_ssize_t nbytes;
+};
+
+void arena_slice_dealloc(ArenaSliceObject* self) {
+  Py_XDECREF(self->arena);
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+int arena_slice_getbuffer(ArenaSliceObject* self, Py_buffer* view, int flags) {
+  return PyBuffer_FillInfo(view, reinterpret_cast<PyObject*>(self), self->ptr,
+                           self->nbytes, /*readonly=*/0, flags);
+}
+
+PyBufferProcs arena_slice_as_buffer = {
+    reinterpret_cast<getbufferproc>(arena_slice_getbuffer), nullptr};
+
+PyTypeObject ArenaSliceType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "cylon_tpu.native._cylon_native.ArenaSlice",  // tp_name
+    sizeof(ArenaSliceObject),
+};
+
 int arena_init(ArenaObject* self, PyObject* args, PyObject*) {
   Py_ssize_t cap = 64 << 20;
   if (!PyArg_ParseTuple(args, "|n", &cap)) return -1;
-  self->base = static_cast<uint8_t*>(::operator new(cap, std::align_val_t(64)));
+  if (cap < 0) {
+    PyErr_SetString(PyExc_ValueError, "capacity must be non-negative");
+    return -1;
+  }
+  self->base = static_cast<uint8_t*>(::operator new(
+      static_cast<size_t>(cap), std::align_val_t(64), std::nothrow));
+  if (self->base == nullptr && cap > 0) {
+    PyErr_SetString(PyExc_MemoryError, "staging arena reservation failed");
+    return -1;
+  }
   self->capacity = static_cast<size_t>(cap);
   self->offset = 0;
   return 0;
@@ -211,15 +249,26 @@ void arena_dealloc(ArenaObject* self) {
 PyObject* arena_allocate(ArenaObject* self, PyObject* args) {
   Py_ssize_t nbytes;
   if (!PyArg_ParseTuple(args, "n", &nbytes)) return nullptr;
+  if (nbytes < 0) {
+    PyErr_SetString(PyExc_ValueError, "nbytes must be non-negative");
+    return nullptr;
+  }
   size_t aligned = (static_cast<size_t>(nbytes) + 63) & ~size_t(63);
-  if (self->offset + aligned > self->capacity) {
+  if (aligned > self->capacity - self->offset) {
     PyErr_SetString(PyExc_MemoryError, "staging arena exhausted");
     return nullptr;
   }
   uint8_t* p = self->base + self->offset;
   self->offset += aligned;
-  return PyMemoryView_FromMemory(reinterpret_cast<char*>(p), nbytes,
-                                 PyBUF_WRITE);
+  ArenaSliceObject* slice = PyObject_New(ArenaSliceObject, &ArenaSliceType);
+  if (slice == nullptr) return nullptr;
+  Py_INCREF(self);
+  slice->arena = reinterpret_cast<PyObject*>(self);
+  slice->ptr = p;
+  slice->nbytes = nbytes;
+  PyObject* mv = PyMemoryView_FromObject(reinterpret_cast<PyObject*>(slice));
+  Py_DECREF(slice);
+  return mv;
 }
 
 PyObject* arena_reset(ArenaObject* self, PyObject*) {
@@ -272,6 +321,11 @@ PyModuleDef module_def = {
 
 PyMODINIT_FUNC PyInit__cylon_native(void) {
   import_array();
+  ArenaSliceType.tp_flags = Py_TPFLAGS_DEFAULT;
+  ArenaSliceType.tp_dealloc = reinterpret_cast<destructor>(arena_slice_dealloc);
+  ArenaSliceType.tp_as_buffer = &arena_slice_as_buffer;
+  ArenaSliceType.tp_doc = "writable view of a StagingArena allocation";
+  if (PyType_Ready(&ArenaSliceType) < 0) return nullptr;
   ArenaType.tp_flags = Py_TPFLAGS_DEFAULT;
   ArenaType.tp_new = PyType_GenericNew;
   ArenaType.tp_init = reinterpret_cast<initproc>(arena_init);

@@ -30,6 +30,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .. import trace
 from ..config import JoinAlgorithm, JoinConfig
 from ..dtypes import DataType, is_dictionary_encoded
 from ..ops import compact as ops_compact
@@ -227,36 +228,43 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig) -> DTable:
             f"join key type mismatch {lt_k.name} vs {rt_k.name}"))
     left, right = _unify_dtable_dicts(left, right, [li_key], [ri_key])
 
-    if config.algorithm == JoinAlgorithm.SORT:
-        splitters = _sample_splitters(
-            [(left, li_key), (right, ri_key)], ascending=True)
-        lpid = _range_pids(left, li_key, splitters, ascending=True)
-        rpid = _range_pids(right, ri_key, splitters, ascending=True)
-        alg = "sort"
-    else:
-        lpid = _hash_pids(left, [li_key])
-        rpid = _hash_pids(right, [ri_key])
-        alg = "hash"
-    lsh = _shuffle_by_pids(left, lpid)
-    rsh = _shuffle_by_pids(right, rpid)
+    with trace.span_sync("join.partition") as sp:
+        if config.algorithm == JoinAlgorithm.SORT:
+            splitters = _sample_splitters(
+                [(left, li_key), (right, ri_key)], ascending=True)
+            lpid = _range_pids(left, li_key, splitters, ascending=True)
+            rpid = _range_pids(right, ri_key, splitters, ascending=True)
+            alg = "sort"
+        else:
+            lpid = _hash_pids(left, [li_key])
+            rpid = _hash_pids(right, [ri_key])
+            alg = "hash"
+        sp.sync((lpid, rpid))
+    with trace.span("join.shuffle"):
+        lsh = _shuffle_by_pids(left, lpid)
+        rsh = _shuffle_by_pids(right, rpid)
 
     how = config.join_type.value
     mesh, axis = ctx.mesh, ctx.axis
     lkc, rkc = lsh.columns[li_key], rsh.columns[ri_key]
-    l_rank, r_rank, cnts = _join_phase1_fn(mesh, axis, how, alg)(
-        lsh.counts, rsh.counts, (lkc.data,), (lkc.validity,),
-        (rkc.data,), (rkc.validity,))
-    per_shard = np.asarray(jax.device_get(cnts))
+    with trace.span("join.count"):
+        l_rank, r_rank, cnts = _join_phase1_fn(mesh, axis, how, alg)(
+            lsh.counts, rsh.counts, (lkc.data,), (lkc.validity,),
+            (rkc.data,), (rkc.validity,))
+        per_shard = np.asarray(jax.device_get(cnts))
     capacity = ops_compact.next_bucket(max(int(per_shard.max(initial=0)), 1),
                                        minimum=8)
+    trace.count("join.out_rows", int(per_shard.sum()))
 
     fill_left = how in ("right", "full_outer")
     fill_right = how in ("left", "full_outer")
     l_leaves = tuple((c.data, c.validity) for c in lsh.columns)
     r_leaves = tuple((c.data, c.validity) for c in rsh.columns)
-    louts, routs, counts = _join_phase2_fn(
-        mesh, axis, how, alg, capacity, fill_left, fill_right)(
-        lsh.counts, rsh.counts, l_rank, r_rank, l_leaves, r_leaves)
+    with trace.span_sync("join.gather") as sp:
+        louts, routs, counts = _join_phase2_fn(
+            mesh, axis, how, alg, capacity, fill_left, fill_right)(
+            lsh.counts, rsh.counts, l_rank, r_rank, l_leaves, r_leaves)
+        sp.sync((louts, routs))
 
     cols = [DColumn("lt-" + c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
             for c, (d, v) in zip(lsh.columns, louts)]
@@ -302,16 +310,19 @@ def _dist_set_op(a: DTable, b: DTable, op: str) -> DTable:
     a.verify_same_schema(b)
     a, b = _unify_dtable_dicts(a, b, range(a.num_columns),
                                range(b.num_columns))
-    ash = _shuffle_by_pids(a, _hash_pids(a, range(a.num_columns)))
-    bsh = _shuffle_by_pids(b, _hash_pids(b, range(b.num_columns)))
+    with trace.span("setop.shuffle"):
+        ash = _shuffle_by_pids(a, _hash_pids(a, range(a.num_columns)))
+        bsh = _shuffle_by_pids(b, _hash_pids(b, range(b.num_columns)))
     has_validity = tuple(
         ca.validity is not None or cb.validity is not None
         for ca, cb in zip(ash.columns, bsh.columns))
     a_leaves = tuple((c.data, c.validity) for c in ash.columns)
     b_leaves = tuple((c.data, c.validity) for c in bsh.columns)
-    outs, counts = _setop_fn(a.ctx.mesh, a.ctx.axis, op, ash.cap, bsh.cap,
-                             has_validity)(
-        ash.counts, bsh.counts, a_leaves, b_leaves)
+    with trace.span_sync("setop.local") as sp:
+        outs, counts = _setop_fn(a.ctx.mesh, a.ctx.axis, op, ash.cap, bsh.cap,
+                                 has_validity)(
+            ash.counts, bsh.counts, a_leaves, b_leaves)
+        sp.sync(outs)
     capacity = ash.cap + bsh.cap if op == ops_setops.UNION else ash.cap
     cols = [DColumn(c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
             for c, (d, v) in zip(ash.columns, outs)]
@@ -366,14 +377,17 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     for op in aggs:
         if op not in ops_groupby.AGG_OPS:
             raise CylonError(Status(Code.Invalid, f"unknown aggregation {op!r}"))
-    sh = _shuffle_by_pids(dt, _hash_pids(dt, key_ids))
+    with trace.span("groupby.shuffle"):
+        sh = _shuffle_by_pids(dt, _hash_pids(dt, key_ids))
     key_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
                        for i in key_ids)
     val_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
                        for i in val_ids)
-    keys_out, outs, out_valids, counts = _groupby_fn(
-        dt.ctx.mesh, dt.ctx.axis, sh.cap, aggs)(
-        sh.counts, key_leaves, val_leaves)
+    with trace.span_sync("groupby.local") as sp:
+        keys_out, outs, out_valids, counts = _groupby_fn(
+            dt.ctx.mesh, dt.ctx.axis, sh.cap, aggs)(
+            sh.counts, key_leaves, val_leaves)
+        sp.sync(outs)
 
     cols = []
     for i, (d, v) in zip(key_ids, keys_out):
@@ -489,12 +503,16 @@ def dist_sort(dt: DTable, sort_column: Union[int, str],
     globally), so concatenating shards in mesh order is the sorted table.
     """
     key_i = dt.column_index(sort_column)
-    splitters = _sample_splitters([(dt, key_i)], ascending)
-    sh = _shuffle_by_pids(dt, _range_pids(dt, key_i, splitters, ascending))
+    with trace.span("sort.sample"):
+        splitters = _sample_splitters([(dt, key_i)], ascending)
+    with trace.span("sort.shuffle"):
+        sh = _shuffle_by_pids(dt, _range_pids(dt, key_i, splitters, ascending))
     kc = sh.columns[key_i]
     leaves = tuple((c.data, c.validity) for c in sh.columns)
-    outs = _local_sort_fn(dt.ctx.mesh, dt.ctx.axis, sh.cap, ascending)(
-        sh.counts, (kc.data, kc.validity), leaves)
+    with trace.span_sync("sort.local") as sp:
+        outs = _local_sort_fn(dt.ctx.mesh, dt.ctx.axis, sh.cap, ascending)(
+            sh.counts, (kc.data, kc.validity), leaves)
+        sp.sync(outs)
     cols = [DColumn(c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
             for c, (d, v) in zip(sh.columns, outs)]
     return DTable(dt.ctx, cols, sh.cap, sh.counts)

@@ -35,6 +35,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .. import trace
 from ..ops import compact as ops_compact
 
 
@@ -113,12 +114,17 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
     HashPartition+split+AllToAll+concat pipeline is phase1+phase2.
     """
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
-    counts = np.asarray(jax.device_get(_counts_fn(mesh, axis, Pn)(pid)))
+    with trace.span("shuffle.counts"):
+        counts = np.asarray(jax.device_get(_counts_fn(mesh, axis, Pn)(pid)))
     block = ops_compact.next_bucket(max(int(counts.max(initial=0)), 1),
                                     minimum=8)
     per_recv = counts.sum(axis=0)
     outcap = ops_compact.next_bucket(max(int(per_recv.max(initial=0)), 1),
                                      minimum=8)
-    newcounts, outs = _exchange_fn(mesh, axis, Pn, block, outcap)(
-        pid, tuple(leaves))
+    trace.count("shuffle.rows_sent",
+                int(counts.sum() - np.trace(counts)))
+    with trace.span_sync("shuffle.exchange") as sp:
+        newcounts, outs = _exchange_fn(mesh, axis, Pn, block, outcap)(
+            pid, tuple(leaves))
+        sp.sync(outs)
     return list(outs), newcounts, outcap

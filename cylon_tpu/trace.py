@@ -1,0 +1,193 @@
+"""Phase timing, counters, and profiling hooks.
+
+The reference has no tracing framework — it logs ad-hoc ``std::chrono``
+spans through glog at op-phase granularity (reference:
+cpp/src/cylon/join/join.cpp:61-102,214-229 combine/sort/join/build-final;
+arrow/arrow_hash_kernels.hpp:114-126,156-173 build/probe;
+table_api.cpp:636-662 set-op progress ticks with eq/hash-call counters) and
+benchmark lines shaped ``"j_t <ms> w_t <ms> lines <n>"``
+(cpp/src/examples/bench/table_join_dist_test.cpp:52-56).
+
+This module is the structured equivalent:
+
+  * ``span(name, sync=arrays)`` — a context manager that records wall-clock
+    per phase.  Timing an async-dispatched XLA program is meaningless, so a
+    span *synchronizes* on the arrays produced inside it — but only while
+    tracing is enabled; disabled spans cost one attribute load and never
+    force a device sync, keeping production dispatch fully async.
+  * counters — the eq/hash-call-count analogue (``count(name, n)``).
+  * ``report()`` / ``bench_line()`` — aggregated phase totals; the bench
+    line keeps the reference's ``j_t``/``w_t`` vocabulary so BENCH output
+    diffs against the reference's logs.
+  * ``profile(path)`` — wraps ``jax.profiler.trace`` for XLA-level traces
+    viewable in TensorBoard/Perfetto.
+
+Enable with ``CYLON_TRACE=1`` in the environment or ``trace.enable()``.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "enable", "disable", "enabled", "span", "count", "reset",
+    "get_spans", "phase_totals", "counters", "report", "bench_line",
+    "profile",
+]
+
+_state = threading.local()
+
+
+def _spans(create: bool = True) -> Optional[List[Tuple[str, int, float]]]:
+    s = getattr(_state, "spans", None)
+    if s is None and create:
+        s = _state.spans = []
+    return s
+
+
+def _counters(create: bool = True) -> Optional[Dict[str, int]]:
+    c = getattr(_state, "counters", None)
+    if c is None and create:
+        c = _state.counters = {}
+    return c
+
+
+_enabled = os.environ.get("CYLON_TRACE", "") not in ("", "0")
+
+
+def enable() -> None:
+    """Turn on span recording (and the per-span device syncs)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def span(name: str, sync=None) -> Iterator[None]:
+    """Record wall-clock of the enclosed block under ``name``.
+
+    ``sync`` is an optional pytree of arrays the block produced; when
+    tracing is enabled the span blocks until they are ready so the time
+    charged to the phase includes the device work it dispatched.  Nested
+    spans record their depth for indented reports.
+    """
+    if not _enabled:
+        yield
+        return
+    depth = getattr(_state, "depth", 0)
+    _state.depth = depth + 1
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if sync is not None:
+            import jax
+            jax.block_until_ready(sync)
+        _spans().append((name, depth, (time.perf_counter() - t0) * 1e3))
+        _state.depth = depth
+
+
+class _SyncSpan:
+    """Imperative span for blocks whose sync target is produced inside.
+
+    >>> with trace.span_sync("exchange") as sp:
+    ...     out = f(x)
+    ...     sp.sync(out)
+    """
+
+    __slots__ = ("_target",)
+
+    def __init__(self) -> None:
+        self._target = None
+
+    def sync(self, target) -> None:
+        self._target = target
+
+
+@contextlib.contextmanager
+def span_sync(name: str) -> Iterator[_SyncSpan]:
+    sp = _SyncSpan()
+    if not _enabled:
+        yield sp
+        return
+    depth = getattr(_state, "depth", 0)
+    _state.depth = depth + 1
+    t0 = time.perf_counter()
+    try:
+        yield sp
+    finally:
+        if sp._target is not None:
+            import jax
+            jax.block_until_ready(sp._target)
+        _spans().append((name, depth, (time.perf_counter() - t0) * 1e3))
+        _state.depth = depth
+
+
+def count(name: str, n: int = 1) -> None:
+    """Bump a named counter (reference: the eq_calls/hash_calls tallies in
+    table_api.cpp:636-662)."""
+    if not _enabled:
+        return
+    c = _counters()
+    c[name] = c.get(name, 0) + int(n)
+
+
+def reset() -> None:
+    _state.spans = []
+    _state.counters = {}
+    _state.depth = 0
+
+
+def get_spans() -> List[Tuple[str, int, float]]:
+    """[(name, depth, ms)] in completion order."""
+    return list(_spans())
+
+
+def counters() -> Dict[str, int]:
+    return dict(_counters())
+
+
+def phase_totals() -> Dict[str, float]:
+    """name → total ms across all recorded spans of that name."""
+    out: Dict[str, float] = {}
+    for name, _, ms in _spans():
+        out[name] = out.get(name, 0.0) + ms
+    return out
+
+
+def report() -> str:
+    """Human-readable nested span report + counters."""
+    lines = []
+    for name, depth, ms in _spans():
+        lines.append(f"{'  ' * depth}{name} {ms:.2f} ms")
+    for name, n in sorted(_counters().items()):
+        lines.append(f"counter {name} = {n}")
+    return "\n".join(lines)
+
+
+def bench_line(op: str, j_t_ms: float, w_t_ms: float, lines: int) -> str:
+    """The reference's benchmark log shape (table_join_dist_test.cpp:52-56):
+    ``<op> j_t <ms> w_t <ms> lines <n>`` plus recorded phase totals."""
+    parts = [f"{op} j_t {j_t_ms:.2f} w_t {w_t_ms:.2f} lines {lines}"]
+    for name, ms in phase_totals().items():
+        parts.append(f"{name} {ms:.2f}")
+    return " ".join(parts)
+
+
+@contextlib.contextmanager
+def profile(path: str) -> Iterator[None]:
+    """XLA-level profiler trace (TensorBoard/Perfetto) around the block."""
+    import jax
+    with jax.profiler.trace(path):
+        yield

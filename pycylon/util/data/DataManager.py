@@ -52,6 +52,8 @@ class DataLoader:
         return self._dataset
 
     def _paths(self) -> List[str]:
+        if self.source_dir is None:
+            return list(self.source_files)
         return [os.path.join(self.source_dir, f) for f in self.source_files]
 
     def load(self):
@@ -117,10 +119,12 @@ class MiniBatcher:
     def generate_minibatches(data: np.ndarray, minibatch_size: int = 1
                              ) -> np.ndarray:
         n, width = data.shape
+        if n == 0:
+            return data.reshape(0, minibatch_size, width)
         num_batches = math.ceil(n / float(minibatch_size))
         full = (num_batches - 1) * minibatch_size
         rem = n - full
-        if rem == 0:
+        if rem == minibatch_size:  # exactly divisible: zero-copy reshape
             return data.reshape(num_batches, minibatch_size, width)
         body = data[:full].reshape(num_batches - 1, minibatch_size, width)
         tail = np.concatenate([data[full:],

@@ -12,7 +12,10 @@ setup(
     name="cylon_tpu",
     version="0.1.0",
     packages=["cylon_tpu", "cylon_tpu.ops", "cylon_tpu.parallel",
-              "cylon_tpu.native", "cylon_tpu.io", "pycylon"],
+              "cylon_tpu.native", "cylon_tpu.io",
+              "pycylon", "pycylon.common", "pycylon.ctx", "pycylon.data",
+              "pycylon.io", "pycylon.net", "pycylon.util",
+              "pycylon.util.data"],
     ext_modules=[
         Extension(
             "cylon_tpu.native._cylon_native",

@@ -173,3 +173,41 @@ class TestShuffleInvariance:
                     jax.device_get(sh.partition(p).column("k").data))):
                 assert seen.setdefault(int(k), p) == p, \
                     f"key {k} on shards {seen[int(k)]} and {p}"
+
+
+@pytest.mark.skipif(not native.have_native(),
+                    reason="C++ extension not built")
+class TestStagingArenaNative:
+    """Regressions for the C++ StagingArena: views keep the arena alive,
+    bad sizes raise instead of corrupting or aborting."""
+
+    def test_view_outlives_arena_handle(self):
+        import gc
+        from cylon_tpu.native import _cylon_native as ext
+
+        mv = ext.StagingArena(1024).allocate(64)  # arena temp dropped here
+        gc.collect()
+        mv[:] = bytes(range(64))
+        assert bytes(mv[:4]) == b"\x00\x01\x02\x03"
+
+    def test_negative_and_bad_capacity(self):
+        from cylon_tpu.native import _cylon_native as ext
+
+        with pytest.raises(ValueError):
+            ext.StagingArena(1024).allocate(-1)
+        with pytest.raises(ValueError):
+            ext.StagingArena(-5)
+        with pytest.raises(MemoryError):  # no std::terminate
+            ext.StagingArena(1 << 58)
+
+    def test_exhaustion_and_reset(self):
+        from cylon_tpu.native import _cylon_native as ext
+
+        a = ext.StagingArena(128)
+        a.allocate(64)
+        a.allocate(64)
+        with pytest.raises(MemoryError):
+            a.allocate(1)
+        a.reset()
+        v = a.allocate(128)
+        assert len(v) == 128 and a.bytes_in_use() == 128

@@ -225,6 +225,24 @@ class TestDataUtils:
         # tail batch reuses head rows to fill
         np.testing.assert_array_equal(batches[-1][:22], data[128:])
 
+    def test_minibatcher_exact_and_empty(self, rng):
+        from pycylon.util.data import MiniBatcher
+
+        data = rng.random((128, 4))
+        batches = MiniBatcher.generate_minibatches(data, 32)
+        assert batches.shape == (4, 32, 4)
+        np.testing.assert_array_equal(batches.reshape(128, 4), data)
+        empty = MiniBatcher.generate_minibatches(np.empty((0, 4)), 32)
+        assert empty.shape == (0, 32, 4)
+
+    def test_loader_absolute_paths(self, tmp_path, rng):
+        from pycylon.util.data import LocalDataLoader
+
+        p = tmp_path / "abs.csv"
+        pd.DataFrame({"x": rng.integers(0, 9, 5)}).to_csv(p, index=False)
+        ds = LocalDataLoader(source_files=[str(p)]).load()
+        assert len(ds) == 1 and ds[0].num_rows == 5
+
     def test_local_loader(self, tmp_path, rng):
         from pycylon.util.data import LocalDataLoader
 

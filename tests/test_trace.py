@@ -1,0 +1,77 @@
+"""Tracing subsystem: spans record phase wall-clock, counters tally, and
+the distributed ops emit the expected phase names (the structured mirror of
+the reference's glog spans, join/join.cpp:61-102 and the j_t/w_t bench
+lines, examples/bench/table_join_dist_test.cpp:52-56)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table, trace
+from cylon_tpu.config import JoinAlgorithm, JoinConfig
+from cylon_tpu.parallel import DTable, dist_join, dist_sort
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    trace.reset()
+    trace.enable()
+    yield
+    trace.disable()
+    trace.reset()
+
+
+def test_span_records_and_nests():
+    with trace.span("outer"):
+        with trace.span("inner"):
+            pass
+    spans = trace.get_spans()
+    assert [(n, d) for n, d, _ in spans] == [("inner", 1), ("outer", 0)]
+    assert all(ms >= 0 for _, _, ms in spans)
+    assert "inner" in trace.report() and "outer" in trace.report()
+
+
+def test_disabled_spans_cost_nothing():
+    trace.disable()
+    with trace.span("x"):
+        pass
+    trace.count("n", 5)
+    assert trace.get_spans() == []
+    assert trace.counters() == {}
+
+
+def test_counters_accumulate():
+    trace.count("eq_calls", 3)
+    trace.count("eq_calls", 4)
+    assert trace.counters()["eq_calls"] == 7
+
+
+def test_bench_line_shape():
+    with trace.span("join.shuffle"):
+        pass
+    line = trace.bench_line("join", 12.5, 0.1, 42)
+    assert line.startswith("join j_t 12.50 w_t 0.10 lines 42")
+    assert "join.shuffle" in line
+
+
+def test_dist_join_emits_phases(dctx):
+    df = pd.DataFrame({"k": np.arange(64) % 7, "v": np.arange(64)})
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    cfg = JoinConfig.InnerJoin(0, 0, algorithm=JoinAlgorithm.HASH)
+    trace.reset()
+    out = dist_join(dt, dt, cfg)
+    assert out.num_rows > 0
+    totals = trace.phase_totals()
+    for phase in ("join.partition", "join.shuffle", "join.count",
+                  "join.gather", "shuffle.counts", "shuffle.exchange"):
+        assert phase in totals, f"missing span {phase}: {sorted(totals)}"
+    assert trace.counters().get("join.out_rows", 0) == out.num_rows
+
+
+def test_dist_sort_emits_phases(dctx):
+    df = pd.DataFrame({"k": np.random.default_rng(0).integers(0, 50, 64)})
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    trace.reset()
+    dist_sort(dt, 0)
+    totals = trace.phase_totals()
+    for phase in ("sort.sample", "sort.shuffle", "sort.local"):
+        assert phase in totals
