@@ -112,6 +112,39 @@ def main() -> None:
     base_rows = len(base_out)
     del base_out
 
+    # TPC-H Q3 (BASELINE config 5): framework plan vs the same query in
+    # pandas, at CYLON_BENCH_TPCH_SF (0 disables).
+    tpch_detail = {}
+    sf = float(os.environ.get("CYLON_BENCH_TPCH_SF",
+                              "0.1" if platform == "tpu" else "0.02"))
+    if sf > 0:
+        from cylon_tpu.tpch import generate, queries
+        from cylon_tpu.tpch.datagen import date_to_days
+        data = generate(sf, seed=11)
+        dts = {name: DTable.from_table(ctx, Table.from_pandas(ctx, df))
+               for name, df in data.items()}
+        queries.q3(ctx, dts)  # compile
+        t0 = time.perf_counter()
+        queries.q3(ctx, dts)
+        q3_t = time.perf_counter() - t0
+        day = date_to_days("1995-03-15")  # q3's default date parameter
+        t0 = time.perf_counter()
+        c = data["customer"]; o = data["orders"]; li = data["lineitem"]
+        c = c[c["c_mktsegment"] == "BUILDING"]
+        o = o[o["o_orderdate"] < day]
+        li = li[li["l_shipdate"] > day].copy()
+        li["volume"] = li["l_extendedprice"] * (1.0 - li["l_discount"])
+        m = c.merge(o, left_on="c_custkey", right_on="o_custkey") \
+             .merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                  observed=True)["volume"].sum().reset_index() \
+         .sort_values("volume", ascending=False).head(10)
+        q3_pd = time.perf_counter() - t0
+        tpch_detail = {"tpch_sf": sf,
+                       "tpch_q3_ms": round(q3_t * 1e3, 2),
+                       "tpch_q3_pandas_ms": round(q3_pd * 1e3, 2),
+                       "tpch_q3_vs_pandas": round(q3_pd / q3_t, 3)}
+
     value = (2 * total) / j_t
     base_rps = (2 * total) / p_t
     print(json.dumps({
@@ -129,6 +162,7 @@ def main() -> None:
             "shuffle_rows_per_sec_per_chip": round(rows / s_t, 1),
             "pandas_join_ms": round(p_t * 1e3, 2),
             "phase_ms": phases,
+            **tpch_detail,
         },
     }))
 

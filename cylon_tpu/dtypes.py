@@ -179,6 +179,10 @@ def from_arrow_type(at) -> Type:
         return Type.TIME64
     if pa.types.is_duration(at):
         return Type.DURATION
+    if pa.types.is_dictionary(at):
+        # arrow dictionary arrays (e.g. pandas Categorical) land on the
+        # framework's native dictionary-encoded representation
+        return from_arrow_type(at.value_type)
     raise NotImplementedError(f"unsupported arrow type {at!r}")
 
 

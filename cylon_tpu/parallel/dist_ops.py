@@ -481,6 +481,158 @@ def _range_pids(dt: DTable, key_i: int, splitters: np.ndarray,
               jnp.int32(nparts - 1))
 
 
+# ---------------------------------------------------------------------------
+# embarrassingly-parallel ops: select / project / derived columns / head.
+# No shuffle — each shard transforms its own rows (reference local paths:
+# Select table_api.cpp:977-1005, Project table_api.cpp:1007-1029).
+# ---------------------------------------------------------------------------
+
+# Keyed on the predicate/function object itself: pass a stable callable
+# (module-level fn or a reused closure) to avoid re-tracing in loops.
+# Bounded FIFO so fresh-lambda callers can't grow it without limit (each
+# entry pins the closure + its compiled executable).
+_SELECT_CACHE_MAX = 256
+_select_cache: dict = {}
+
+
+def _cache_put(key, fn):
+    if len(_select_cache) >= _SELECT_CACHE_MAX:
+        _select_cache.pop(next(iter(_select_cache)))
+    _select_cache[key] = fn
+    return fn
+
+
+class _RecordingEnv(dict):
+    """Column-name → data-array env that records which columns the predicate
+    reads (at trace time), so nulls in exactly those columns can veto rows.
+
+    This matches SQL three-valued logic for conjunctive predicates (a NULL
+    comparand makes the conjunction non-TRUE ⇒ row dropped).  For predicates
+    where a NULL column must NOT veto the row — disjunctions over nullable
+    columns, IS NULL tests — read ``env.valid(name)`` and combine it
+    explicitly; doing so waives the automatic veto for that column."""
+
+    def __init__(self, items, validities):
+        super().__init__(items)
+        self._validities = validities
+        self.accessed = set()
+        self.null_handled = set()
+
+    def __getitem__(self, k):
+        self.accessed.add(k)
+        return super().__getitem__(k)
+
+    def valid(self, k):
+        """Per-row validity of column ``k`` (all-True when it has no nulls).
+        Reading it transfers NULL handling for ``k`` to the predicate."""
+        self.null_handled.add(k)
+        v = self._validities[k]
+        return jnp.ones(super().__getitem__(k).shape[0], bool) if v is None \
+            else v
+
+
+def _env(columns: Sequence[DColumn]) -> dict:
+    return {c.name: c.data for c in columns}
+
+
+def dist_select(dt: DTable, predicate) -> DTable:
+    """Distributed row filter: ``predicate`` maps {column name: sharded data
+    array} → bool mask; each shard compacts its surviving rows in place
+    (capacity unchanged, counts shrink).  Purely local — the reference's
+    Select is too (table_api.cpp:977-1005, per-row lambda → arrow Filter).
+    """
+    mesh, axis, cap = dt.ctx.mesh, dt.ctx.axis, dt.cap
+    names = tuple(c.name for c in dt.columns)
+    key = (mesh, axis, cap, names, predicate)
+    fn = _select_cache.get(key)
+    if fn is None:
+        def kernel(cnt, leaves):
+            env = _RecordingEnv({n: d for n, (d, _) in zip(names, leaves)},
+                                {n: v for n, (_, v) in zip(names, leaves)})
+            mask = predicate(env) & (jnp.arange(cap) < cnt[0])
+            # a NULL in a column the predicate read ⇒ comparison is
+            # "unknown" ⇒ the row is dropped, unless the predicate took
+            # over NULL handling for that column via env.valid(name)
+            for n, (_, v) in zip(names, leaves):
+                if n in env.accessed - env.null_handled and v is not None:
+                    mask = mask & v
+            idx, count = ops_compact.mask_to_indices(mask, cap)
+            outs = tuple(ops_gather.take(d, v, idx, fill_null=False)
+                         for d, v in leaves)
+            return outs, count[None].astype(jnp.int32)
+
+        spec = P(axis)
+        fn = _cache_put(key, jax.jit(shard_map(
+            kernel, mesh=mesh, in_specs=(spec, spec),
+            out_specs=(spec, spec))))
+    leaves = tuple((c.data, c.validity) for c in dt.columns)
+    outs, counts = fn(dt.counts, leaves)
+    cols = [DColumn(c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
+            for c, (d, v) in zip(dt.columns, outs)]
+    return DTable(dt.ctx, cols, cap, counts)
+
+
+def dist_project(dt: DTable, columns: Sequence[Union[int, str]]) -> DTable:
+    """Column subset — zero-copy, like the local Project
+    (reference table_api.cpp:1007-1029)."""
+    ids = _resolve_ids(dt, columns)
+    return DTable(dt.ctx, [dt.columns[i] for i in ids], dt.cap, dt.counts)
+
+
+def dist_with_column(dt: DTable, name: str, fn, out_type,
+                     validity_from: Sequence[str] = ()) -> DTable:
+    """Append a derived column ``name = fn({col name: data array})``.
+
+    Pure elementwise compute on the already-sharded arrays — no shard_map
+    needed; XLA propagates the mesh sharding through the expression.
+    ``validity_from`` names input columns whose nulls null the output.
+    """
+    from ..dtypes import DataType as _DT, device_dtype
+    jfn = _select_cache.get(("withcol", fn))
+    if jfn is None:
+        jfn = _cache_put(("withcol", fn), jax.jit(fn))
+    out = jfn(_env(dt.columns))
+    out = out.astype(device_dtype(out_type))
+    validity = None
+    for n in validity_from:
+        v = dt.column(n).validity
+        if v is not None:
+            validity = v if validity is None else (validity & v)
+    cols = list(dt.columns) + [DColumn(name, _DT(out_type), out, validity)]
+    return DTable(dt.ctx, cols, dt.cap, dt.counts)
+
+
+def dist_head(dt: DTable, n: int) -> "Table":
+    """First ``n`` global rows (shard-major order) as a local Table — the
+    small-result gather after a dist_sort (ORDER BY … LIMIT n)."""
+    from ..table import Column, Table
+    # one host transfer per column, then slice every shard from that copy
+    # (DTable.partition would re-transfer the full global array per shard)
+    cnts = dt.counts_host()
+    takes = []
+    got = 0
+    for i in range(dt.nparts):
+        take = min(n - got, int(cnts[i]))
+        takes.append(max(take, 0))
+        got += max(take, 0)
+    cols: List[Column] = []
+    for c in dt.columns:
+        host = np.asarray(jax.device_get(c.data))
+        data = jnp.asarray(np.concatenate(
+            [host[i * dt.cap:i * dt.cap + t] for i, t in enumerate(takes)]
+        )) if got else jnp.asarray(host[:0])
+        if c.validity is not None:
+            vh = np.asarray(jax.device_get(c.validity), bool)
+            validity = jnp.asarray(np.concatenate(
+                [vh[i * dt.cap:i * dt.cap + t] for i, t in enumerate(takes)]
+            )) if got else jnp.asarray(vh[:0])
+        else:
+            validity = None
+        cols.append(Column(c.name, c.dtype, data, validity,
+                           dictionary=c.dictionary, arrow_type=c.arrow_type))
+    return Table(dt.ctx, cols)
+
+
 @functools.lru_cache(maxsize=None)
 def _local_sort_fn(mesh, axis: str, cap: int, ascending: bool):
     def kernel(cnt, key_leaf, leaves):

@@ -190,16 +190,24 @@ class Table:
         reference: table.cpp (FromArrowTable) + type validation
         arrow/arrow_types.cpp:57-114.
         """
+        import pyarrow as pa
+
         cols: List[Column] = []
         for fld, col in zip(atable.schema, atable.columns):
             t = from_arrow_type(fld.type)
             arr = _combine(col)
+            ftype = fld.type
+            if pa.types.is_dictionary(ftype):
+                # decode to values; _encode_dictionary re-encodes onto the
+                # framework's sorted dictionary (code order == lexical order)
+                arr = arr.cast(ftype.value_type)
+                ftype = ftype.value_type
             if is_dictionary_encoded(t):
                 codes, dictionary, validity = _encode_dictionary(arr)
                 data = jnp.asarray(codes)
                 val = jnp.asarray(validity) if validity is not None else None
                 cols.append(Column(fld.name, DataType(t), data, val,
-                                   dictionary=dictionary, arrow_type=fld.type))
+                                   dictionary=dictionary, arrow_type=ftype))
             else:
                 npd = device_dtype(t)
                 if arr.null_count:

@@ -1,0 +1,13 @@
+"""TPC-H on cylon_tpu (BASELINE config 5).
+
+The reference has no TPC-H harness (SURVEY.md §6: "TPC-H is not in the
+reference"), but the driver's target metric is TPC-H distributed-join
+wall-clock, so this package supplies the whole pipeline: a dbgen-style
+generator (`datagen`) and queries composed from the distributed operator
+layer (`queries`).
+"""
+from .datagen import generate, TABLE_NAMES
+from .queries import QUERIES, q1, q3, q5, q6, q10
+
+__all__ = ["generate", "TABLE_NAMES", "QUERIES", "q1", "q3", "q5", "q6",
+           "q10"]

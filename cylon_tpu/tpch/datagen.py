@@ -1,0 +1,132 @@
+"""dbgen-style TPC-H data generator (numpy, deterministic).
+
+Cardinalities and value distributions follow the TPC-H 2.x spec shapes
+(lineitem ≈ 6M·SF via 1–7 lines per order, orders = 1.5M·SF, customer =
+150k·SF, supplier = 10k·SF, 25 nations over 5 regions); columns are limited
+to the ones the implemented queries (Q1/Q3/Q5/Q6/Q10) touch, typed for the
+device path: DATE → int32 days since 1992-01-01, money/quantity → float32,
+low-cardinality strings → dictionary-encoded.
+
+The reference's closest analogue is its uniform-int CSV generator for the
+scaling runs (reference: cpp/src/experiments/generate_csv.py:1-30,
+generate_files.py:20-52); TPC-H's skew (shared orderkeys across lineitems,
+date windows, segment/flag enums) exercises the same shuffle/join/groupby
+machinery much harder.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+import pandas as pd
+
+# day offsets from 1992-01-01 (the spec's STARTDATE); the order-date window
+# ends 1998-08-02 (day 2405) minus 151 days so l_receiptdate (orderdate
+# + ≤121 ship + ≤30 receipt) never overflows ENDDATE.
+DAYS_TOTAL = 2254
+_EPOCH = np.datetime64("1992-01-01")
+
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+RETURN_FLAGS = ["A", "N", "R"]
+LINE_STATUS = ["F", "O"]
+SHIP_MODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+NATIONS = [  # (name, region) — the spec's 25 nations over 5 regions
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+
+TABLE_NAMES = ("lineitem", "orders", "customer", "supplier", "nation",
+               "region")
+
+
+def date_to_days(iso: str) -> int:
+    """'1995-03-15' → int32 day offset used by every date column."""
+    return int((np.datetime64(iso) - _EPOCH).astype(int))
+
+
+def generate(scale: float, seed: int = 42) -> Dict[str, pd.DataFrame]:
+    """All six tables as pandas DataFrames (device typing happens at
+    Table.from_pandas ingest).  ``scale`` is the TPC-H SF; fractional scales
+    shrink every table proportionally (floor 1 row) for tests."""
+    rng = np.random.default_rng(seed)
+    n_cust = max(int(150_000 * scale), 1)
+    n_ord = max(int(1_500_000 * scale), 1)
+    n_supp = max(int(10_000 * scale), 1)
+
+    customer = pd.DataFrame({
+        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int32),
+        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2)
+        .astype(np.float32),
+        "c_mktsegment": pd.Categorical.from_codes(
+            rng.integers(0, len(SEGMENTS), n_cust), SEGMENTS),
+    })
+
+    o_orderdate = rng.integers(0, DAYS_TOTAL, n_ord).astype(np.int32)
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int64),
+        "o_orderdate": o_orderdate,
+        "o_orderpriority": pd.Categorical.from_codes(
+            rng.integers(0, len(PRIORITIES), n_ord), PRIORITIES),
+        "o_shippriority": np.zeros(n_ord, dtype=np.int32),
+        "o_totalprice": np.round(rng.uniform(900.0, 500_000.0, n_ord), 2)
+        .astype(np.float32),
+    })
+
+    # lineitem: 1–7 lines per order (spec 4.2.3) ⇒ E[lines] = 4 ⇒ ≈ 6M·SF
+    lines_per = rng.integers(1, 8, n_ord)
+    n_li = int(lines_per.sum())
+    l_orderkey = np.repeat(orders["o_orderkey"].to_numpy(), lines_per)
+    l_odate = np.repeat(o_orderdate, lines_per)
+    # ship/commit/receipt hang off the order date (spec: +1..121, +30..90, +1..30)
+    l_shipdate = l_odate + rng.integers(1, 122, n_li).astype(np.int32)
+    l_commitdate = l_odate + rng.integers(30, 91, n_li).astype(np.int32)
+    l_receiptdate = l_shipdate + rng.integers(1, 31, n_li).astype(np.int32)
+    lineitem = pd.DataFrame({
+        "l_orderkey": l_orderkey,
+        "l_suppkey": rng.integers(1, n_supp + 1, n_li).astype(np.int64),
+        "l_quantity": rng.integers(1, 51, n_li).astype(np.float32),
+        "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2)
+        .astype(np.float32),
+        "l_discount": np.round(rng.integers(0, 11, n_li) * 0.01, 2)
+        .astype(np.float32),
+        "l_tax": np.round(rng.integers(0, 9, n_li) * 0.01, 2)
+        .astype(np.float32),
+        "l_returnflag": pd.Categorical.from_codes(
+            rng.integers(0, len(RETURN_FLAGS), n_li), RETURN_FLAGS),
+        "l_linestatus": pd.Categorical.from_codes(
+            (l_shipdate > date_to_days("1995-06-17")).astype(np.int8),
+            LINE_STATUS),
+        "l_shipdate": l_shipdate,
+        "l_commitdate": l_commitdate,
+        "l_receiptdate": l_receiptdate,
+        "l_shipmode": pd.Categorical.from_codes(
+            rng.integers(0, len(SHIP_MODES), n_li), SHIP_MODES),
+    })
+
+    supplier = pd.DataFrame({
+        "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
+        "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int32),
+    })
+
+    nation = pd.DataFrame({
+        "n_nationkey": np.arange(25, dtype=np.int32),
+        "n_name": pd.Categorical([n for n, _ in NATIONS]),
+        "n_regionkey": np.array([r for _, r in NATIONS], dtype=np.int32),
+    })
+
+    region = pd.DataFrame({
+        "r_regionkey": np.arange(5, dtype=np.int32),
+        "r_name": pd.Categorical(REGIONS),
+    })
+
+    return {"lineitem": lineitem, "orders": orders, "customer": customer,
+            "supplier": supplier, "nation": nation, "region": region}

@@ -82,20 +82,10 @@ def span(name: str, sync=None) -> Iterator[None]:
     charged to the phase includes the device work it dispatched.  Nested
     spans record their depth for indented reports.
     """
-    if not _enabled:
-        yield
-        return
-    depth = getattr(_state, "depth", 0)
-    _state.depth = depth + 1
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
+    with span_sync(name) as sp:
         if sync is not None:
-            import jax
-            jax.block_until_ready(sync)
-        _spans().append((name, depth, (time.perf_counter() - t0) * 1e3))
-        _state.depth = depth
+            sp.sync(sync)
+        yield
 
 
 class _SyncSpan:

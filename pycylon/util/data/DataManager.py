@@ -127,6 +127,7 @@ class MiniBatcher:
         if rem == minibatch_size:  # exactly divisible: zero-copy reshape
             return data.reshape(num_batches, minibatch_size, width)
         body = data[:full].reshape(num_batches - 1, minibatch_size, width)
-        tail = np.concatenate([data[full:],
-                               data[:minibatch_size - rem]])[None]
+        # head rows fill the short tail, cycling when n < fill size
+        fill = np.resize(data, (minibatch_size - rem, width))
+        tail = np.concatenate([data[full:], fill])[None]
         return np.concatenate([body, tail], axis=0)

@@ -281,3 +281,36 @@ def test_dist_join_empty_table(dctx):
     fo = dist_join(lt, rt, JoinConfig.FullOuterJoin(0, 0))
     assert_same_rows(fo.to_table().to_pandas(),
                      oracle_join(ldf, rdf, "k", "k", "full_outer"))
+
+
+def test_dist_select_null_semantics(dctx):
+    """A NULL in a column the predicate reads drops the row (SQL semantics),
+    even when the 0-fill backing value would satisfy the predicate."""
+    from cylon_tpu.parallel import dist_select
+
+    df = pd.DataFrame({"x": pd.array([1.0, None, 10.0, -3.0, None],
+                                     dtype="Float64"),
+                       "y": np.arange(5, dtype=np.int64)})
+    dt = dtable_from_pandas(dctx, df)
+    out = dist_select(dt, lambda env: env["x"] < 5.0).to_table().to_pandas()
+    # nulls (0-filled on device, 0 < 5) must NOT survive
+    assert sorted(out["y"].tolist()) == [0, 3]
+    # predicate on the null-free column keeps null x rows intact
+    out2 = dist_select(dt, lambda env: env["y"] >= 3).to_table().to_pandas()
+    assert sorted(out2["y"].tolist()) == [3, 4]
+    assert out2.sort_values("y")["x"].isna().tolist() == [False, True]
+
+
+def test_dist_select_null_or_predicate(dctx):
+    """env.valid(name) lets a predicate take over NULL handling: a NULL x
+    must not veto rows that an OR branch on a non-null column keeps."""
+    from cylon_tpu.parallel import dist_select
+
+    df = pd.DataFrame({"x": pd.array([1.0, None, 10.0, None], dtype="Float64"),
+                       "y": np.array([0, 10, 0, 1], dtype=np.int64)})
+    dt = dtable_from_pandas(dctx, df)
+    out = dist_select(
+        dt, lambda env: ((env["x"] < 5.0) & env.valid("x"))
+        | (env["y"] > 3)).to_table().to_pandas()
+    # row 0: x<5 TRUE; row 1: x NULL but y>3 TRUE (kept); rows 2,3: FALSE
+    assert sorted(out["y"].tolist()) == [0, 10]

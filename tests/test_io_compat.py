@@ -235,6 +235,16 @@ class TestDataUtils:
         empty = MiniBatcher.generate_minibatches(np.empty((0, 4)), 32)
         assert empty.shape == (0, 32, 4)
 
+    def test_minibatcher_tiny_input(self, rng):
+        """n < minibatch_size/2: head rows must cycle to fill the batch."""
+        from pycylon.util.data import MiniBatcher
+
+        data = rng.random((2, 4))
+        batches = MiniBatcher.generate_minibatches(data, 32)
+        assert batches.shape == (1, 32, 4)
+        np.testing.assert_array_equal(batches[0][:2], data)
+        np.testing.assert_array_equal(batches[0][2:4], data)
+
     def test_loader_absolute_paths(self, tmp_path, rng):
         from pycylon.util.data import LocalDataLoader
 

@@ -1,0 +1,177 @@
+"""TPC-H queries vs pandas oracle on the 8-device CPU mesh.
+
+The oracle computes each query straight from the generated DataFrames with
+pandas; the framework path ingests the same frames, block-distributes them,
+and runs the composed distributed plan.  Comparison is row-set equality
+(sorted, with float tolerance) — the distributed plan makes no ordering
+promise beyond what each query's final sort states.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table
+from cylon_tpu.parallel import DTable
+from cylon_tpu.tpch import generate, queries
+from cylon_tpu.tpch.datagen import date_to_days
+
+SCALE = 0.002  # ≈12k lineitem rows — enough for every filter to catch data
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate(SCALE, seed=7)
+
+
+@pytest.fixture(scope="module")
+def dtables(dctx, data):
+    return {name: DTable.from_table(dctx, Table.from_pandas(dctx, df))
+            for name, df in data.items()}
+
+
+def _frame(t: Table) -> pd.DataFrame:
+    df = t.to_pandas()
+    for c in df.columns:  # decode categoricals for comparison
+        if isinstance(df[c].dtype, pd.CategoricalDtype):
+            df[c] = df[c].astype(str)
+    return df
+
+
+def _assert_rowset_equal(got: pd.DataFrame, want: pd.DataFrame, keys):
+    assert list(got.columns) == list(want.columns)
+    g = got.sort_values(keys).reset_index(drop=True)
+    w = want.sort_values(keys).reset_index(drop=True)
+    assert len(g) == len(w)
+    for c in g.columns:
+        if pd.api.types.is_float_dtype(w[c]):
+            np.testing.assert_allclose(g[c].to_numpy(dtype=np.float64),
+                                       w[c].to_numpy(dtype=np.float64),
+                                       rtol=1e-4)
+        else:
+            assert g[c].astype(str).tolist() == w[c].astype(str).tolist(), c
+
+
+def _rev(df):
+    return df["l_extendedprice"].astype(np.float64) * (1.0 - df["l_discount"].astype(np.float64))
+
+
+def test_q1(dctx, data, dtables):
+    got = _frame(queries.q1(dctx, dtables))
+    li = data["lineitem"]
+    f = li[li["l_shipdate"] <= date_to_days("1998-12-01") - 90].copy()
+    f["disc_price"] = _rev(f)
+    f["charge"] = _rev(f) * (1.0 + f["l_tax"].astype(np.float64))
+    w = (f.groupby(["l_returnflag", "l_linestatus"], observed=True)
+         .agg(sum_l_quantity=("l_quantity", "sum"),
+              sum_l_extendedprice=("l_extendedprice", "sum"),
+              sum_disc_price=("disc_price", "sum"),
+              sum_charge=("charge", "sum"),
+              mean_l_quantity=("l_quantity", "mean"),
+              mean_l_extendedprice=("l_extendedprice", "mean"),
+              mean_l_discount=("l_discount", "mean"),
+              count_l_orderkey=("l_orderkey", "count"))
+         .reset_index().sort_values(["l_returnflag", "l_linestatus"])
+         .reset_index(drop=True))
+    w["l_returnflag"] = w["l_returnflag"].astype(str)
+    w["l_linestatus"] = w["l_linestatus"].astype(str)
+    w["count_l_orderkey"] = w["count_l_orderkey"].astype(np.int64)
+    assert list(got.columns) == list(w.columns)
+    _assert_rowset_equal(got, w, ["l_returnflag", "l_linestatus"])
+
+
+def _oracle_q3(data, limit=10):
+    day = date_to_days("1995-03-15")
+    c = data["customer"]
+    c = c[c["c_mktsegment"] == "BUILDING"]
+    o = data["orders"]
+    o = o[o["o_orderdate"] < day]
+    li = data["lineitem"]
+    li = li[li["l_shipdate"] > day].copy()
+    li["volume"] = _rev(li)
+    m = c.merge(o, left_on="c_custkey", right_on="o_custkey")
+    m = m.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    g = (m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                   observed=True)["volume"].sum().reset_index()
+         .rename(columns={"volume": "sum_volume"}))
+    return g.sort_values("sum_volume", ascending=False).head(limit)
+
+
+def test_q3(dctx, data, dtables):
+    got = _frame(queries.q3(dctx, dtables))
+    want = _oracle_q3(data)
+    # LIMIT under ties: compare the value set of the sort column and the
+    # full rows for strictly-ordered prefixes
+    assert len(got) == len(want)
+    np.testing.assert_allclose(
+        np.sort(got["sum_volume"].to_numpy(np.float64)),
+        np.sort(want["sum_volume"].to_numpy(np.float64)), rtol=1e-4)
+    assert (got["sum_volume"].to_numpy(np.float64)[:-1]
+            >= got["sum_volume"].to_numpy(np.float64)[1:] - 1e-3).all()
+
+
+def test_q5(dctx, data, dtables):
+    got = _frame(queries.q5(dctx, dtables))
+    d0 = date_to_days("1994-01-01")
+    reg = data["region"]
+    reg = reg[reg["r_name"] == "ASIA"]
+    n = data["nation"].merge(reg, left_on="n_regionkey",
+                             right_on="r_regionkey")
+    s = data["supplier"].merge(n, left_on="s_nationkey",
+                               right_on="n_nationkey")
+    o = data["orders"]
+    o = o[(o["o_orderdate"] >= d0) & (o["o_orderdate"] < d0 + 365)]
+    m = data["customer"].merge(o, left_on="c_custkey", right_on="o_custkey")
+    m = m.merge(data["lineitem"], left_on="o_orderkey", right_on="l_orderkey")
+    m = m.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    m = m[m["c_nationkey"] == m["s_nationkey"]].copy()
+    m["volume"] = _rev(m)
+    w = (m.groupby("n_name", observed=True)["volume"].sum().reset_index()
+         .rename(columns={"volume": "sum_volume"}))
+    w["n_name"] = w["n_name"].astype(str)
+    _assert_rowset_equal(got, w[["n_name", "sum_volume"]], ["n_name"])
+    desc = got["sum_volume"].to_numpy(np.float64)
+    assert (desc[:-1] >= desc[1:] - 1e-3).all()
+
+
+def test_q6(dctx, data, dtables):
+    got = _frame(queries.q6(dctx, dtables))
+    d0 = date_to_days("1994-01-01")
+    li = data["lineitem"]
+    f = li[(li["l_shipdate"] >= d0) & (li["l_shipdate"] < d0 + 365)
+           & (li["l_discount"] >= 0.06 - 0.011)
+           & (li["l_discount"] <= 0.06 + 0.011)
+           & (li["l_quantity"] < 24)]
+    want = float((f["l_extendedprice"].astype(np.float64)
+                  * f["l_discount"].astype(np.float64)).sum())
+    assert got.shape == (1, 1)
+    np.testing.assert_allclose(float(got.iloc[0, 0]), want, rtol=1e-4)
+
+
+def test_q10(dctx, data, dtables):
+    got = _frame(queries.q10(dctx, dtables))
+    d0 = date_to_days("1993-10-01")
+    o = data["orders"]
+    o = o[(o["o_orderdate"] >= d0) & (o["o_orderdate"] < d0 + 92)]
+    li = data["lineitem"]
+    li = li[li["l_returnflag"] == "R"]
+    m = data["customer"].merge(o, left_on="c_custkey", right_on="o_custkey")
+    m = m.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    m = m.merge(data["nation"], left_on="c_nationkey",
+                right_on="n_nationkey").copy()
+    m["volume"] = _rev(m)
+    w = (m.groupby(["c_custkey", "n_name", "c_acctbal"], observed=True)
+         ["volume"].sum().reset_index()
+         .rename(columns={"volume": "sum_volume"})
+         .sort_values("sum_volume", ascending=False).head(20))
+    assert len(got) == len(w)
+    np.testing.assert_allclose(
+        np.sort(got["sum_volume"].to_numpy(np.float64)),
+        np.sort(w["sum_volume"].to_numpy(np.float64)), rtol=1e-4)
+
+
+def test_datagen_shapes(data):
+    li, o = data["lineitem"], data["orders"]
+    assert len(data["nation"]) == 25 and len(data["region"]) == 5
+    assert li["l_orderkey"].isin(o["o_orderkey"]).all()
+    assert (li["l_shipdate"] > li["l_orderkey"].map(
+        o.set_index("o_orderkey")["o_orderdate"])).all()
