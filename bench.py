@@ -37,7 +37,7 @@ def main() -> None:
     import pandas as pd
 
     from cylon_tpu import CylonContext, JoinAlgorithm, JoinConfig, Table
-    from cylon_tpu.parallel import DTable, dist_join, shuffle_table
+    from cylon_tpu.parallel import DTable, dist_join
 
     devs = jax.devices()
     platform = devs[0].platform
@@ -95,11 +95,18 @@ def main() -> None:
     phases = {k: round(v, 2) for k, v in trace.phase_totals().items()}
     trace.disable()
 
-    # phase breakdown: shuffle alone on the left table (same size both sides)
+    # shuffle machinery microbench: drive shuffle_leaves directly so the
+    # two-phase exchange runs even at world=1 (the dist ops short-circuit
+    # the identity shuffle on a 1-device mesh)
+    from cylon_tpu.parallel.dist_ops import _hash_pids
+    from cylon_tpu.parallel.shuffle import shuffle_leaves
+
     def run_shuffle():
         t0 = time.perf_counter()
-        sh = shuffle_table(left, [0])
-        jax.block_until_ready([c.data for c in sh.columns])
+        pid = _hash_pids(left, [0])
+        leaves, newcounts, _ = shuffle_leaves(
+            ctx, pid, [c.data for c in left.columns])
+        jax.block_until_ready(leaves)
         return time.perf_counter() - t0
     run_shuffle()
     s_t = min(run_shuffle() for _ in range(reps))
@@ -121,7 +128,7 @@ def main() -> None:
         from cylon_tpu.tpch import generate, queries
         from cylon_tpu.tpch.datagen import date_to_days
         data = generate(sf, seed=11)
-        dts = {name: DTable.from_table(ctx, Table.from_pandas(ctx, df))
+        dts = {name: DTable.from_pandas(ctx, df)
                for name, df in data.items()}
         queries.q3(ctx, dts)  # compile
         t0 = time.perf_counter()

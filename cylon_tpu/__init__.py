@@ -19,13 +19,14 @@ from . import trace
 from .config import JoinAlgorithm, JoinConfig, JoinType
 from .context import CylonContext
 from .dtypes import DataType, Layout, Type
+from .row import Row
 from .status import Code, CylonError, Status
 from .table import Column, Table
 
 __version__ = "0.1.0"
 
 __all__ = [
-    "CylonContext", "Table", "Column", "Status", "Code", "CylonError",
+    "CylonContext", "Table", "Column", "Row", "Status", "Code", "CylonError",
     "DataType", "Type", "Layout", "JoinConfig", "JoinType", "JoinAlgorithm",
     "trace", "__version__",
 ]

@@ -31,10 +31,17 @@ def pad_to(x: jax.Array, capacity: int, fill=0) -> jax.Array:
 
 
 def next_bucket(n: int, minimum: int = 1024) -> int:
-    """Round a dynamic size up to a power-of-two bucket.
+    """Round a dynamic size up to a quarter-step size-class bucket
+    (2^k · {4,5,6,7}/4 — ≤25% padding overhead vs ≤100% for pure powers
+    of two; gathers into the capacity buffer are the join's dominant cost).
 
     Bounds re-JIT count when materializing data-dependent shapes
     (SURVEY.md §7 hard part 1: capacity buffers + size-class bucketing).
     """
     cap = max(int(n), minimum)
-    return 1 << (cap - 1).bit_length()
+    pow2 = 1 << (cap - 1).bit_length()
+    for num in (5, 6, 7):  # 2^(k-1)·{1.25, 1.5, 1.75}
+        q = (pow2 // 8) * num
+        if q >= cap:
+            return q
+    return pow2

@@ -135,6 +135,8 @@ def _unify_dtable_dicts(a: DTable, b: DTable,
 
 def _shuffle_by_pids(dt: DTable, pid: jax.Array) -> DTable:
     """Exchange rows to their target shards; rebuild the DTable."""
+    if dt.ctx.get_world_size() == 1:
+        return dt  # one shard: every row is already home; no collective
     leaves: List[jax.Array] = []
     slots: List[Tuple[int, bool]] = []  # (column index, is_validity)
     for i, c in enumerate(dt.columns):
@@ -228,21 +230,23 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig) -> DTable:
             f"join key type mismatch {lt_k.name} vs {rt_k.name}"))
     left, right = _unify_dtable_dicts(left, right, [li_key], [ri_key])
 
-    with trace.span_sync("join.partition") as sp:
-        if config.algorithm == JoinAlgorithm.SORT:
-            splitters = _sample_splitters(
-                [(left, li_key), (right, ri_key)], ascending=True)
-            lpid = _range_pids(left, li_key, splitters, ascending=True)
-            rpid = _range_pids(right, ri_key, splitters, ascending=True)
-            alg = "sort"
-        else:
-            lpid = _hash_pids(left, [li_key])
-            rpid = _hash_pids(right, [ri_key])
-            alg = "hash"
-        sp.sync((lpid, rpid))
-    with trace.span("join.shuffle"):
-        lsh = _shuffle_by_pids(left, lpid)
-        rsh = _shuffle_by_pids(right, rpid)
+    alg = "sort" if config.algorithm == JoinAlgorithm.SORT else "hash"
+    if ctx.get_world_size() == 1:
+        lsh, rsh = left, right  # one shard: co-partitioning is a no-op
+    else:
+        with trace.span_sync("join.partition") as sp:
+            if config.algorithm == JoinAlgorithm.SORT:
+                splitters = _sample_splitters(
+                    [(left, li_key), (right, ri_key)], ascending=True)
+                lpid = _range_pids(left, li_key, splitters, ascending=True)
+                rpid = _range_pids(right, ri_key, splitters, ascending=True)
+            else:
+                lpid = _hash_pids(left, [li_key])
+                rpid = _hash_pids(right, [ri_key])
+            sp.sync((lpid, rpid))
+        with trace.span("join.shuffle"):
+            lsh = _shuffle_by_pids(left, lpid)
+            rsh = _shuffle_by_pids(right, rpid)
 
     how = config.join_type.value
     mesh, axis = ctx.mesh, ctx.axis
@@ -310,9 +314,12 @@ def _dist_set_op(a: DTable, b: DTable, op: str) -> DTable:
     a.verify_same_schema(b)
     a, b = _unify_dtable_dicts(a, b, range(a.num_columns),
                                range(b.num_columns))
-    with trace.span("setop.shuffle"):
-        ash = _shuffle_by_pids(a, _hash_pids(a, range(a.num_columns)))
-        bsh = _shuffle_by_pids(b, _hash_pids(b, range(b.num_columns)))
+    if a.ctx.get_world_size() == 1:
+        ash, bsh = a, b
+    else:
+        with trace.span("setop.shuffle"):
+            ash = _shuffle_by_pids(a, _hash_pids(a, range(a.num_columns)))
+            bsh = _shuffle_by_pids(b, _hash_pids(b, range(b.num_columns)))
     has_validity = tuple(
         ca.validity is not None or cb.validity is not None
         for ca, cb in zip(ash.columns, bsh.columns))
@@ -377,8 +384,11 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     for op in aggs:
         if op not in ops_groupby.AGG_OPS:
             raise CylonError(Status(Code.Invalid, f"unknown aggregation {op!r}"))
-    with trace.span("groupby.shuffle"):
-        sh = _shuffle_by_pids(dt, _hash_pids(dt, key_ids))
+    if dt.ctx.get_world_size() == 1:
+        sh = dt
+    else:
+        with trace.span("groupby.shuffle"):
+            sh = _shuffle_by_pids(dt, _hash_pids(dt, key_ids))
     key_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
                        for i in key_ids)
     val_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
@@ -522,6 +532,23 @@ class _RecordingEnv(dict):
         self.accessed.add(k)
         return super().__getitem__(k)
 
+    # every other read path records too, so no spelling of a predicate can
+    # silently bypass the null veto
+    def get(self, k, default=None):
+        if k in self:
+            return self[k]
+        return default
+
+    def items(self):
+        self.accessed.update(self.keys())
+        return [(k, super(_RecordingEnv, self).__getitem__(k))
+                for k in self.keys()]
+
+    def values(self):
+        self.accessed.update(self.keys())
+        return [super(_RecordingEnv, self).__getitem__(k)
+                for k in self.keys()]
+
     def valid(self, k):
         """Per-row validity of column ``k`` (all-True when it has no nulls).
         Reading it transfers NULL handling for ``k`` to the predicate."""
@@ -587,7 +614,12 @@ def dist_with_column(dt: DTable, name: str, fn, out_type,
     needed; XLA propagates the mesh sharding through the expression.
     ``validity_from`` names input columns whose nulls null the output.
     """
-    from ..dtypes import DataType as _DT, device_dtype
+    from ..dtypes import DataType as _DT, Type, device_dtype
+    if not jax.config.jax_enable_x64:
+        # the same logical-type downgrade ingest applies (table._narrow_host):
+        # declared type must match what the device actually stores
+        out_type = {Type.INT64: Type.INT32, Type.UINT64: Type.UINT32,
+                    Type.DOUBLE: Type.FLOAT}.get(out_type, out_type)
     jfn = _select_cache.get(("withcol", fn))
     if jfn is None:
         jfn = _cache_put(("withcol", fn), jax.jit(fn))
@@ -604,33 +636,9 @@ def dist_with_column(dt: DTable, name: str, fn, out_type,
 
 def dist_head(dt: DTable, n: int) -> "Table":
     """First ``n`` global rows (shard-major order) as a local Table — the
-    small-result gather after a dist_sort (ORDER BY … LIMIT n)."""
-    from ..table import Column, Table
-    # one host transfer per column, then slice every shard from that copy
-    # (DTable.partition would re-transfer the full global array per shard)
-    cnts = dt.counts_host()
-    takes = []
-    got = 0
-    for i in range(dt.nparts):
-        take = min(n - got, int(cnts[i]))
-        takes.append(max(take, 0))
-        got += max(take, 0)
-    cols: List[Column] = []
-    for c in dt.columns:
-        host = np.asarray(jax.device_get(c.data))
-        data = jnp.asarray(np.concatenate(
-            [host[i * dt.cap:i * dt.cap + t] for i, t in enumerate(takes)]
-        )) if got else jnp.asarray(host[:0])
-        if c.validity is not None:
-            vh = np.asarray(jax.device_get(c.validity), bool)
-            validity = jnp.asarray(np.concatenate(
-                [vh[i * dt.cap:i * dt.cap + t] for i, t in enumerate(takes)]
-            )) if got else jnp.asarray(vh[:0])
-        else:
-            validity = None
-        cols.append(Column(c.name, c.dtype, data, validity,
-                           dictionary=c.dictionary, arrow_type=c.arrow_type))
-    return Table(dt.ctx, cols)
+    small-result gather after a dist_sort (ORDER BY … LIMIT n).  Rows are
+    compacted on device first, so the transfer is O(n), not O(P·cap)."""
+    return dt.head(n)
 
 
 @functools.lru_cache(maxsize=None)
@@ -655,10 +663,14 @@ def dist_sort(dt: DTable, sort_column: Union[int, str],
     globally), so concatenating shards in mesh order is the sorted table.
     """
     key_i = dt.column_index(sort_column)
-    with trace.span("sort.sample"):
-        splitters = _sample_splitters([(dt, key_i)], ascending)
-    with trace.span("sort.shuffle"):
-        sh = _shuffle_by_pids(dt, _range_pids(dt, key_i, splitters, ascending))
+    if dt.ctx.get_world_size() == 1:
+        sh = dt  # one shard: a local sort is already globally ordered
+    else:
+        with trace.span("sort.sample"):
+            splitters = _sample_splitters([(dt, key_i)], ascending)
+        with trace.span("sort.shuffle"):
+            sh = _shuffle_by_pids(
+                dt, _range_pids(dt, key_i, splitters, ascending))
     kc = sh.columns[key_i]
     leaves = tuple((c.data, c.validity) for c in sh.columns)
     with trace.span_sync("sort.local") as sp:

@@ -145,6 +145,41 @@ class DTable:
         return DTable(ctx, cols, cap, counts)
 
     @staticmethod
+    def from_arrow(ctx: CylonContext, atable, cap: Optional[int] = None
+                   ) -> "DTable":
+        """Block-distribute an arrow table directly from host memory —
+        skips the intermediate single-device Table that ``from_table``
+        would build (and the extra host↔device round trip it costs)."""
+        from ..table import host_columns_from_arrow
+        Pn = ctx.get_world_size()
+        n = atable.num_rows
+        base, rem = divmod(n, Pn)
+        sizes = np.array([base + (1 if i < rem else 0) for i in range(Pn)],
+                         np.int32)
+        if cap is None:
+            cap = ops_compact.next_bucket(max(int(sizes.max(initial=0)), 1),
+                                          minimum=8)
+        offs = np.concatenate([[0], np.cumsum(sizes)])
+        cols: List[DColumn] = []
+        for name, t, npv, mask, dictionary, ftype in \
+                host_columns_from_arrow(atable):
+            data = _blocked_put(ctx, npv, sizes, offs, cap)
+            validity = (None if mask is None else
+                        _blocked_put(ctx, mask.astype(bool), sizes, offs, cap))
+            cols.append(DColumn(name, DataType(t), data, validity,
+                                dictionary, ftype))
+        counts = jax.device_put(sizes, ctx.sharding())
+        return DTable(ctx, cols, cap, counts)
+
+    @staticmethod
+    def from_pandas(ctx: CylonContext, df, cap: Optional[int] = None
+                    ) -> "DTable":
+        import pyarrow as pa
+
+        return DTable.from_arrow(
+            ctx, pa.Table.from_pandas(df, preserve_index=False), cap)
+
+    @staticmethod
     def from_partitions(ctx: CylonContext, parts: Sequence[Table],
                         cap: Optional[int] = None) -> "DTable":
         """Build from one local Table per mesh position (the per-rank-CSV
@@ -196,42 +231,47 @@ class DTable:
 
     # -- export --------------------------------------------------------------
 
-    def to_table(self) -> Table:
-        """Gather all shards to one local Table (drops padding)."""
-        cnts = self.counts_host()
+    def _export(self, takes: Sequence[int]) -> Table:
+        """Gather ``takes[i]`` leading rows of each shard as a local Table.
+
+        Rows are compacted ON DEVICE (one gather per column) before the
+        host transfer, so export cost scales with rows *taken*, not with
+        ``P * cap`` — a groupby result with 4 valid rows in a multi-million
+        capacity block transfers 4 rows, not the padded block.
+        """
+        idx_host = np.concatenate(
+            [i * self.cap + np.arange(t, dtype=np.int64)
+             for i, t in enumerate(takes)]) if sum(takes) else \
+            np.empty((0,), np.int64)
+        idx = jnp.asarray(idx_host)
         cols: List[Column] = []
         for c in self.columns:
-            host = np.asarray(jax.device_get(c.data))
-            parts = [host[i * self.cap:i * self.cap + cnts[i]]
-                     for i in range(self.nparts)]
-            data = jnp.asarray(np.concatenate(parts) if parts
-                               else host[:0])
-            if c.validity is not None:
-                vh = np.asarray(jax.device_get(c.validity), bool)
-                vparts = [vh[i * self.cap:i * self.cap + cnts[i]]
-                          for i in range(self.nparts)]
-                validity = jnp.asarray(np.concatenate(vparts))
-            else:
-                validity = None
+            data = jnp.asarray(jax.device_get(_export_take(c.data, idx)))
+            validity = (None if c.validity is None else
+                        jnp.asarray(jax.device_get(
+                            _export_take(c.validity, idx))))
             cols.append(Column(c.name, c.dtype, data, validity,
                                dictionary=c.dictionary, arrow_type=c.arrow_type))
         return Table(self.ctx, cols)
 
+    def to_table(self) -> Table:
+        """Gather all shards to one local Table (drops padding)."""
+        return self._export([int(c) for c in self.counts_host()])
+
+    def head(self, n: int) -> Table:
+        """First ``n`` global rows (shard-major order) as a local Table."""
+        takes, got = [], 0
+        for c in self.counts_host():
+            t = min(n - got, int(c))
+            takes.append(max(t, 0))
+            got += max(t, 0)
+        return self._export(takes)
+
     def partition(self, i: int) -> Table:
         """Shard *i*'s rows as a local Table (a rank's-eye view)."""
-        cnt = int(self.counts_host()[i])
-        cols: List[Column] = []
-        for c in self.columns:
-            host = np.asarray(jax.device_get(c.data))
-            data = jnp.asarray(host[i * self.cap:i * self.cap + cnt])
-            if c.validity is not None:
-                vh = np.asarray(jax.device_get(c.validity), bool)
-                validity = jnp.asarray(vh[i * self.cap:i * self.cap + cnt])
-            else:
-                validity = None
-            cols.append(Column(c.name, c.dtype, data, validity,
-                               dictionary=c.dictionary, arrow_type=c.arrow_type))
-        return Table(self.ctx, cols)
+        cnts = self.counts_host()
+        return self._export([int(cnts[j]) if j == i else 0
+                             for j in range(self.nparts)])
 
     def rename(self, names: Sequence[str]) -> "DTable":
         return DTable(self.ctx, [replace(c, name=n)
@@ -242,6 +282,12 @@ class DTable:
         cols = ", ".join(f"{c.name}:{c.dtype.type.name}" for c in self.columns)
         return (f"DTable[{self.num_rows} rows over {self.nparts} shards, "
                 f"cap={self.cap}]({cols})")
+
+
+@jax.jit
+def _export_take(a: jax.Array, idx: jax.Array) -> jax.Array:
+    """Device-side row compaction for export (re-traced per shape bucket)."""
+    return jnp.take(a, idx, axis=0)
 
 
 def _blocked_put(ctx: CylonContext, host: np.ndarray, sizes: np.ndarray,

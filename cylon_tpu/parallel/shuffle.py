@@ -14,11 +14,12 @@ Variable-length sends meet XLA's static shapes with the two-phase plan:
                       matrix on host (a tiny transfer — the analogue of the
                       reference's 8-int header messages).
   phase 2 (exchange)  rows grouped by target via one argsort, padded to a
-                      power-of-two block ``M = bucket(max count)``, one
+                      size-class block ``M = bucket(max count)``, one
                       ``lax.all_to_all`` per column leaf, then receiver-side
                       compaction to ``bucket(max rows received)``.
 
-Bucketing both shapes to powers of two bounds recompilation
+Bucketing both shapes to quarter-step size classes (2^k·{4,5,6,7}/4,
+ops/compact.next_bucket) bounds recompilation at ≤25% padding overhead
 (SURVEY.md §7 hard part 1).  Peak extra memory is ``P*M`` rows per column —
 the padded send buffer; the FIN protocol, backpressure caps and spin loops
 of the reference (table_api.cpp:260-261) have no equivalent because the

@@ -89,14 +89,14 @@ def _typed_numpy(arr, npd: np.dtype) -> np.ndarray:
     return np.ascontiguousarray(npv).astype(npd, copy=False)
 
 
-def _device_put(npv: np.ndarray, t: Type, col_name: str):
-    """jnp.asarray with explicit handling of x64-disabled narrowing.
+def _narrow_host(npv: np.ndarray, t: Type, col_name: str):
+    """Host-side handling of x64-disabled narrowing (numpy in, numpy out).
 
     Under JAX's default config 64-bit arrays silently narrow to 32-bit.
     Silent corruption is unacceptable: ints are range-checked (narrow +
     logical-type downgrade when lossless, error otherwise); floats narrow
     with a warning (precision loss is the expected trade on TPU).
-    Returns (device_array, effective_logical_type).
+    Returns (host_array, effective_logical_type).
     """
     import warnings
 
@@ -114,13 +114,63 @@ def _device_put(npv: np.ndarray, t: Type, col_name: str):
             warnings.warn(
                 f"column {col_name!r}: narrowing {npv.dtype} to 32-bit "
                 "(jax_enable_x64 is off)", stacklevel=3)
-            return jnp.asarray(npv.astype(narrow)), eff
+            return npv.astype(narrow), eff
         if npv.dtype.kind == "f":
             warnings.warn(
                 f"column {col_name!r}: narrowing float64 to float32 "
                 "(jax_enable_x64 is off)", stacklevel=3)
-            return jnp.asarray(npv.astype(np.float32)), Type.FLOAT if t == Type.DOUBLE else t
+            return npv.astype(np.float32), \
+                Type.FLOAT if t == Type.DOUBLE else t
+    return npv, t
+
+
+def _device_put(npv: np.ndarray, t: Type, col_name: str):
+    """jnp.asarray of ``_narrow_host`` — see that function for semantics."""
+    npv, t = _narrow_host(npv, t, col_name)
     return jnp.asarray(npv), t
+
+
+def host_columns_from_arrow(atable):
+    """Arrow table → per-column host tuples, the shared ingest front half.
+
+    Returns ``[(name, effective Type, np data, np validity|None,
+    dictionary|None, arrow value type), …]`` — everything decoded, null-
+    filled, dictionary-encoded and narrowed, but NOT yet transferred to
+    device.  ``Table.from_arrow`` device-puts these whole;
+    ``DTable.from_arrow`` block-distributes them over the mesh without an
+    intermediate single-device copy (the ingest path would otherwise move
+    every byte host→device→host→device).
+    """
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    out = []
+    for fld, col in zip(atable.schema, atable.columns):
+        t = from_arrow_type(fld.type)
+        arr = _combine(col)
+        ftype = fld.type
+        if pa.types.is_dictionary(ftype):
+            # decode to values; _encode_dictionary re-encodes onto the
+            # framework's sorted dictionary (code order == lexical order)
+            arr = arr.cast(ftype.value_type)
+            ftype = ftype.value_type
+        if is_dictionary_encoded(t):
+            codes, dictionary, validity = _encode_dictionary(arr)
+            out.append((fld.name, t, codes, validity, dictionary, ftype))
+            continue
+        npd = device_dtype(t)
+        if arr.null_count:
+            mask = np.asarray(
+                arr.is_valid().to_numpy(zero_copy_only=False), dtype=bool)
+            # lossless: fill nulls inside arrow (typed), never via float64
+            fill = False if t == Type.BOOL else 0
+            filled_arr = pc.fill_null(arr, pa.scalar(fill, type=arr.type))
+            npv, t = _narrow_host(_typed_numpy(filled_arr, npd), t, fld.name)
+            out.append((fld.name, t, npv, mask, None, ftype))
+        else:
+            npv, t = _narrow_host(_typed_numpy(arr, npd), t, fld.name)
+            out.append((fld.name, t, npv, None, None, ftype))
+    return out
 
 
 def _encode_dictionary(arr) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
@@ -173,6 +223,16 @@ class Table:
     def column_names(self) -> List[str]:
         return [c.name for c in self.columns]
 
+    def row(self, i: int):
+        """Typed accessor for one row (reference: row.hpp:22-50)."""
+        from .row import Row
+
+        return Row(self, i)
+
+    def iter_rows(self):
+        for i in range(self.num_rows):
+            yield self.row(i)
+
     def column(self, i: Union[int, str]) -> Column:
         if isinstance(i, str):
             for c in self.columns:
@@ -190,43 +250,13 @@ class Table:
         reference: table.cpp (FromArrowTable) + type validation
         arrow/arrow_types.cpp:57-114.
         """
-        import pyarrow as pa
-
         cols: List[Column] = []
-        for fld, col in zip(atable.schema, atable.columns):
-            t = from_arrow_type(fld.type)
-            arr = _combine(col)
-            ftype = fld.type
-            if pa.types.is_dictionary(ftype):
-                # decode to values; _encode_dictionary re-encodes onto the
-                # framework's sorted dictionary (code order == lexical order)
-                arr = arr.cast(ftype.value_type)
-                ftype = ftype.value_type
-            if is_dictionary_encoded(t):
-                codes, dictionary, validity = _encode_dictionary(arr)
-                data = jnp.asarray(codes)
-                val = jnp.asarray(validity) if validity is not None else None
-                cols.append(Column(fld.name, DataType(t), data, val,
-                                   dictionary=dictionary, arrow_type=ftype))
-            else:
-                npd = device_dtype(t)
-                if arr.null_count:
-                    import pyarrow.compute as pc
-
-                    mask = np.asarray(
-                        arr.is_valid().to_numpy(zero_copy_only=False), dtype=bool)
-                    # lossless: fill nulls inside arrow (typed), never via float64
-                    fill = False if t == Type.BOOL else 0
-                    import pyarrow as pa
-                    filled_arr = pc.fill_null(arr, pa.scalar(fill, type=arr.type))
-                    npv = _typed_numpy(filled_arr, npd)
-                    data, t = _device_put(npv, t, fld.name)
-                    val = jnp.asarray(mask)
-                else:
-                    npv = _typed_numpy(arr, npd)
-                    (data, t), val = _device_put(npv, t, fld.name), None
-                cols.append(Column(fld.name, DataType(t), data, val,
-                                   arrow_type=fld.type))
+        for name, t, npv, mask, dictionary, ftype in \
+                host_columns_from_arrow(atable):
+            data = jnp.asarray(npv)
+            val = jnp.asarray(mask) if mask is not None else None
+            cols.append(Column(name, DataType(t), data, val,
+                               dictionary=dictionary, arrow_type=ftype))
         return Table(ctx, cols)
 
     @staticmethod

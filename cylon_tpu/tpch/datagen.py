@@ -20,10 +20,12 @@ from typing import Dict, List
 import numpy as np
 import pandas as pd
 
-# day offsets from 1992-01-01 (the spec's STARTDATE); the order-date window
-# ends 1998-08-02 (day 2405) minus 151 days so l_receiptdate (orderdate
-# + ≤121 ship + ≤30 receipt) never overflows ENDDATE.
-DAYS_TOTAL = 2254
+# day offsets from 1992-01-01 (the spec's STARTDATE).  o_orderdate spans
+# [STARTDATE, ENDDATE−151 days] = [day 0, day 2405 = 1998-08-02], so
+# l_receiptdate (orderdate + ≤121 ship + ≤30 receipt) never overflows
+# ENDDATE = 1998-12-31 (day 2556).  Q1's cutoff (1998-12-01 − 90 = day
+# 2436) then filters the ~4% of lineitems shipped after it, per spec.
+DAYS_TOTAL = 2406
 _EPOCH = np.datetime64("1992-01-01")
 
 SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]

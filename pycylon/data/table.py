@@ -81,6 +81,10 @@ class Table:
     def column_names(self):
         return self._t.column_names
 
+    def row(self, i: int):
+        """Typed per-cell accessor (reference Row, cpp/src/cylon/row.hpp)."""
+        return self._t.row(i)
+
     def show(self):
         self._t.show()
 

@@ -155,3 +155,40 @@ def test_from_columns_unsupported_dtype(ctx):
     from cylon_tpu import CylonError
     with pytest.raises(CylonError):
         Table.from_columns(ctx, {"t": np.array([1], dtype="datetime64[ns]")})
+
+
+class TestRow:
+    def test_row_accessor_typed_and_nulls(self, ctx):
+        import pandas as pd
+        from cylon_tpu import Row, Table
+        from cylon_tpu.status import CylonError
+
+        df = pd.DataFrame({
+            "i": pd.array([1, None, 3], dtype="Int32"),
+            "f": np.array([1.5, 2.5, 3.5], dtype=np.float32),
+            "s": ["aa", "bb", None],
+        })
+        t = Table.from_pandas(ctx, df)
+        r0 = t.row(0)
+        assert r0.get_int32("i") == 1
+        assert r0.get_float("f") == 1.5
+        assert r0.get_string("s") == "aa"
+        assert r0["i"] == 1 and r0[2] == "aa"
+        r1 = t.row(1)
+        assert r1.get("i") is None  # null cell
+        r2 = t.row(2)
+        assert r2.get("s") is None
+        assert r2.values() == (3, 3.5, None)
+        with pytest.raises(CylonError):
+            r0.get_string("i")  # type mismatch
+        with pytest.raises(CylonError):
+            t.row(5)
+        assert t.row(-1).row_index() == 2
+        assert [r["i"] for r in t.iter_rows()] == [1, None, 3]
+
+    def test_pycylon_row(self, ctx):
+        import pandas as pd
+        from pycylon.data.table import Table as PTable
+
+        pt = PTable.from_pandas(pd.DataFrame({"a": [10, 20]}))
+        assert pt.row(1).get("a") == 20
