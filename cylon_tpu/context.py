@@ -54,6 +54,10 @@ class CylonContext:
         self._devices = devs
         self._mesh = Mesh(np.array(devs), (MESH_AXIS,))
         self._finalized = False
+        from . import logging as glog
+        glog.vlog(1, "CylonContext: backend=%s world=%d platform=%s",
+                  backend or "local", len(devs),
+                  devs[0].platform if devs else "none")
 
     # -- reference API parity (ctx/cylon_context.hpp) -----------------------
 

@@ -1,0 +1,89 @@
+"""glog-style logging (the reference's only observability channel).
+
+The reference logs through glog exclusively (reference:
+cpp/src/cylon/CMakeLists.txt:91 links glog; LOG(INFO/ERROR/FATAL) at op
+phase granularity throughout, e.g. join/join.cpp:61-102, table_api.cpp:
+636-662).  This module reproduces the operational surface on stdlib
+logging: the one-letter-severity line format, ``FATAL`` aborting, and a
+``vlog`` verbosity gate — so reference-style example/bench scripts read
+the same.
+
+Format: ``I0730 12:34:56.789012 file.py:42] message``
+
+Env knobs (glog names, minus the GLOG_ prefix):
+  CYLON_MINLOGLEVEL  0=INFO 1=WARNING 2=ERROR 3=FATAL (default 0)
+  CYLON_V            vlog verbosity, ``vlog(n)`` logs when n <= CYLON_V
+"""
+from __future__ import annotations
+
+import io
+import os
+import sys
+import time
+import traceback
+from typing import Any
+
+INFO, WARNING, ERROR, FATAL = 0, 1, 2, 3
+_LETTER = "IWEF"
+
+_min_level = int(os.environ.get("CYLON_MINLOGLEVEL", "0"))
+_verbosity = int(os.environ.get("CYLON_V", "0"))
+_sink = sys.stderr
+
+
+def set_min_level(level: int) -> None:
+    global _min_level
+    _min_level = level
+
+
+def set_verbosity(v: int) -> None:
+    global _verbosity
+    _verbosity = v
+
+
+def set_sink(stream) -> None:
+    """Redirect log lines (tests, file capture)."""
+    global _sink
+    _sink = stream
+
+
+def _emit(level: int, msg: str, depth: int = 2) -> None:
+    if level < _min_level:
+        return
+    frame = sys._getframe(depth)
+    now = time.time()
+    lt = time.localtime(now)
+    us = int((now % 1) * 1e6)
+    fname = os.path.basename(frame.f_code.co_filename)
+    line = (f"{_LETTER[level]}{lt.tm_mon:02d}{lt.tm_mday:02d} "
+            f"{lt.tm_hour:02d}:{lt.tm_min:02d}:{lt.tm_sec:02d}.{us:06d} "
+            f"{fname}:{frame.f_lineno}] {msg}")
+    print(line, file=_sink)
+
+
+def info(msg: Any, *args) -> None:
+    _emit(INFO, str(msg) % args if args else str(msg))
+
+
+def warning(msg: Any, *args) -> None:
+    _emit(WARNING, str(msg) % args if args else str(msg))
+
+
+def error(msg: Any, *args) -> None:
+    _emit(ERROR, str(msg) % args if args else str(msg))
+
+
+def fatal(msg: Any, *args) -> None:
+    """LOG(FATAL): log with a stack trace, then abort (glog semantics —
+    the reference relies on this in e.g. mpi_channel.cpp:85)."""
+    text = str(msg) % args if args else str(msg)
+    buf = io.StringIO()
+    traceback.print_stack(sys._getframe(1), file=buf)
+    _emit(FATAL, f"{text}\n{buf.getvalue()}")
+    raise SystemExit(1)
+
+
+def vlog(verbosity: int, msg: Any, *args) -> None:
+    """VLOG(n): emitted at INFO severity when ``n <= CYLON_V``."""
+    if verbosity <= _verbosity:
+        _emit(INFO, str(msg) % args if args else str(msg))

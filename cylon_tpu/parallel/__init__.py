@@ -13,10 +13,11 @@ from .shuffle import shuffle_leaves
 from .dist_ops import (dist_groupby, dist_head, dist_intersect, dist_join,
                        dist_project, dist_select, dist_sort, dist_subtract,
                        dist_union, dist_with_column, shuffle_table)
+from .streaming import dist_join_streaming
 
 __all__ = [
     "DColumn", "DTable", "shuffle_leaves", "shuffle_table",
-    "dist_join", "dist_union", "dist_intersect", "dist_subtract",
-    "dist_groupby", "dist_sort", "dist_select", "dist_project",
-    "dist_with_column", "dist_head",
+    "dist_join", "dist_join_streaming", "dist_union", "dist_intersect",
+    "dist_subtract", "dist_groupby", "dist_sort", "dist_select",
+    "dist_project", "dist_with_column", "dist_head",
 ]

@@ -231,24 +231,38 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig) -> DTable:
     left, right = _unify_dtable_dicts(left, right, [li_key], [ri_key])
 
     alg = "sort" if config.algorithm == JoinAlgorithm.SORT else "hash"
-    if ctx.get_world_size() == 1:
-        lsh, rsh = left, right  # one shard: co-partitioning is a no-op
-    else:
-        with trace.span_sync("join.partition") as sp:
-            if config.algorithm == JoinAlgorithm.SORT:
-                splitters = _sample_splitters(
-                    [(left, li_key), (right, ri_key)], ascending=True)
-                lpid = _range_pids(left, li_key, splitters, ascending=True)
-                rpid = _range_pids(right, ri_key, splitters, ascending=True)
-            else:
-                lpid = _hash_pids(left, [li_key])
-                rpid = _hash_pids(right, [ri_key])
-            sp.sync((lpid, rpid))
-        with trace.span("join.shuffle"):
-            lsh = _shuffle_by_pids(left, lpid)
-            rsh = _shuffle_by_pids(right, rpid)
+    splitters = (None if alg == "hash" or ctx.get_world_size() == 1 else
+                 _sample_splitters([(left, li_key), (right, ri_key)],
+                                   ascending=True))
+    lsh = _copartition(left, li_key, alg, splitters)
+    rsh = _copartition(right, ri_key, alg, splitters)
+    return _join_copartitioned(lsh, rsh, li_key, ri_key,
+                               config.join_type.value, alg)
 
-    how = config.join_type.value
+
+def _copartition(dt: DTable, key_i: int, alg: str,
+                 splitters) -> DTable:
+    """Route rows to their join shard (hash or range partitioning).
+
+    Separated from the join tail so callers that join one side repeatedly
+    (streaming.dist_join_streaming) shuffle it only once.
+    """
+    if dt.ctx.get_world_size() == 1:
+        return dt  # one shard: co-partitioning is a no-op
+    with trace.span_sync("join.partition") as sp:
+        if alg == "sort":
+            pid = _range_pids(dt, key_i, splitters, ascending=True)
+        else:
+            pid = _hash_pids(dt, [key_i])
+        sp.sync(pid)
+    with trace.span("join.shuffle"):
+        return _shuffle_by_pids(dt, pid)
+
+
+def _join_copartitioned(lsh: DTable, rsh: DTable, li_key: int, ri_key: int,
+                        how: str, alg: str) -> DTable:
+    """Masked local join of already co-partitioned sides (dist_join's tail)."""
+    ctx = lsh.ctx
     mesh, axis = ctx.mesh, ctx.axis
     lkc, rkc = lsh.columns[li_key], rsh.columns[ri_key]
     with trace.span("join.count"):
@@ -259,6 +273,10 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig) -> DTable:
     capacity = ops_compact.next_bucket(max(int(per_shard.max(initial=0)), 1),
                                        minimum=8)
     trace.count("join.out_rows", int(per_shard.sum()))
+    from .. import logging as glog
+    glog.vlog(1, "dist_join[%s/%s]: out=%d rows, shard max=%d, cap=%d",
+              how, alg, int(per_shard.sum()), int(per_shard.max(initial=0)),
+              capacity)
 
     fill_left = how in ("right", "full_outer")
     fill_right = how in ("left", "full_outer")

@@ -239,10 +239,18 @@ class DTable:
         ``P * cap`` — a groupby result with 4 valid rows in a multi-million
         capacity block transfers 4 rows, not the padded block.
         """
+        # int32 gather indices unless x64 is on: jnp.asarray would silently
+        # wrap int64 positions ≥ 2^31 to negative (clamping to row 0)
+        if self.nparts * self.cap > np.iinfo(np.int32).max \
+                and not jax.config.jax_enable_x64:
+            raise CylonError(Status(Code.ExecutionError,
+                f"export of a {self.nparts}x{self.cap} block needs 64-bit "
+                "gather indices — enable jax_enable_x64"))
+        idt = np.int64 if jax.config.jax_enable_x64 else np.int32
         idx_host = np.concatenate(
-            [i * self.cap + np.arange(t, dtype=np.int64)
+            [i * self.cap + np.arange(t, dtype=idt)
              for i, t in enumerate(takes)]) if sum(takes) else \
-            np.empty((0,), np.int64)
+            np.empty((0,), idt)
         idx = jnp.asarray(idx_host)
         cols: List[Column] = []
         for c in self.columns:

@@ -1,0 +1,157 @@
+"""Streaming (chunked) distributed join: bounded left-side buffers.
+
+TPU-native answer to the reference's ``ArrowJoin`` streaming pipeline
+(reference: cpp/src/cylon/arrow/arrow_join.cpp + join tail of
+arrow_all_to_all.cpp — right table resident, left batches streamed through
+the AllToAll and joined incrementally as they land).  The right side is
+co-partitioned ONCE and stays resident; the left side is processed in
+``chunks`` row-slices of the padded block, so the left shuffle's in-flight
+buffers are one chunk wide — the analogue of the reference's bounded
+AllToAll buffers (its backpressure cap).  Chunks run serially: each
+chunk's join sizes its output from a host-side count read (the two-phase
+capacity protocol), which is a sync point by design.
+
+Per-chunk outputs are re-packed to the front of each shard block
+(concat + compaction) so the result honours the DTable invariant
+(rows [0, count) valid).  Chunk widths and the packed output capacity are
+rounded to ``next_bucket`` size classes to preserve the bounded-recompile
+property of the one-shot path.
+
+Semantically identical to ``dist_join`` for INNER/LEFT; RIGHT/FULL_OUTER
+fall back to the one-shot join — a right row is unmatched only with
+respect to ALL left chunks, which a streaming pass cannot decide per
+chunk (the reference's ArrowJoin streams inner joins only).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .. import trace
+from ..config import JoinAlgorithm, JoinConfig
+from ..ops import compact as ops_compact
+from ..ops import gather as ops_gather
+from .dist_ops import (_copartition, _join_copartitioned, _sample_splitters,
+                       dist_join)
+from .dtable import DColumn, DTable
+
+
+@functools.lru_cache(maxsize=None)
+def _slice_fn(nparts: int, cap: int, lo: int, hi: int):
+    w = hi - lo
+
+    @jax.jit
+    def f(a):
+        return a.reshape(nparts, cap)[:, lo:hi].reshape(nparts * w)
+
+    return f
+
+
+def _slice_rows(dt: DTable, lo: int, hi: int) -> DTable:
+    """Rows [lo, hi) of every shard's padded block, as a narrower DTable."""
+    f = _slice_fn(dt.nparts, dt.cap, lo, hi)
+    w = hi - lo
+    cols = [DColumn(c.name, c.dtype, f(c.data),
+                    None if c.validity is None else f(c.validity),
+                    c.dictionary, c.arrow_type) for c in dt.columns]
+    counts = jnp.clip(dt.counts - lo, 0, w).astype(jnp.int32)
+    return DTable(dt.ctx, cols, w, counts)
+
+
+@functools.lru_cache(maxsize=None)
+def _repack_fn(mesh, axis: str, caps: Tuple[int, ...], outcap: int,
+               has_v: Tuple[bool, ...]):
+    """Concat per-chunk shard blocks and compact valid rows to the front,
+    into an ``outcap``-wide (size-class) block."""
+
+    def kernel(cnts, leaves):
+        cnts = cnts.reshape(-1)  # [1, K] shard block -> [K] chunk counts
+        valid = jnp.concatenate([jnp.arange(ck) < cnts[k]
+                                 for k, ck in enumerate(caps)])
+        idx, total = ops_compact.mask_to_indices(valid, outcap)
+        outs = []
+        for per_chunk, hv in zip(leaves, has_v):
+            data = jnp.concatenate([d for d, _ in per_chunk])
+            if hv:
+                v = jnp.concatenate([
+                    jnp.ones(ck, bool) if vv is None else vv
+                    for (_, vv), ck in zip(per_chunk, caps)])
+            else:
+                v = None
+            outs.append(ops_gather.take(data, v, idx, fill_null=False))
+        return tuple(outs), total[None].astype(jnp.int32)  # outs: (d, v)
+
+    spec = P(axis)
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(spec, spec), out_specs=(spec, spec)))
+
+
+def _concat_compact(parts: List[DTable]) -> DTable:
+    if len(parts) == 1:
+        return parts[0]
+    head = parts[0]
+    ctx = head.ctx
+    caps = tuple(p.cap for p in parts)
+    outcap = ops_compact.next_bucket(sum(caps), minimum=8)
+    has_v = tuple(any(p.columns[i].validity is not None for p in parts)
+                  for i in range(head.num_columns))
+    cnts = jnp.stack([p.counts for p in parts], axis=1)  # [P, K]
+    leaves = tuple(
+        tuple((p.columns[i].data, p.columns[i].validity) for p in parts)
+        for i in range(head.num_columns))
+    outs, counts = _repack_fn(ctx.mesh, ctx.axis, caps, outcap, has_v)(
+        cnts, leaves)
+    cols = [DColumn(c.name, c.dtype, d, v if has else None,
+                    c.dictionary, c.arrow_type)
+            for c, (d, v), has in zip(head.columns, outs, has_v)]
+    return DTable(ctx, cols, outcap, counts)
+
+
+def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
+                        chunks: int = 4) -> DTable:
+    """Chunked distributed join of ``left`` against a resident ``right``.
+
+    ``chunks`` bounds the left side's in-flight shuffle buffers to
+    ``~cap/chunks`` rows per shard; the right side is co-partitioned once.
+    Output row SET equals ``dist_join``'s (row order is chunk-major, which
+    the DTable contract leaves undefined).  See the module docstring for
+    the INNER/LEFT restriction.
+    """
+    if (chunks <= 1 or left.cap < chunks
+            or config.join_type.value in ("right", "full_outer")):
+        return dist_join(left, right, config)
+
+    ctx = left.ctx
+    li_key = left.column_index(config.left_column_idx)
+    ri_key = right.column_index(config.right_column_idx)
+    lt_k = left.columns[li_key].dtype.type
+    rt_k = right.columns[ri_key].dtype.type
+    if lt_k != rt_k:
+        from ..status import Code, CylonError, Status
+        raise CylonError(Status(Code.TypeError,
+            f"join key type mismatch {lt_k.name} vs {rt_k.name}"))
+    from .dist_ops import _unify_dtable_dicts
+    left, right = _unify_dtable_dicts(left, right, [li_key], [ri_key])
+    alg = "sort" if config.algorithm == JoinAlgorithm.SORT else "hash"
+    splitters = (None if alg == "hash" or ctx.get_world_size() == 1 else
+                 _sample_splitters([(left, li_key), (right, ri_key)],
+                                   ascending=True))
+    rsh = _copartition(right, ri_key, alg, splitters)  # once, resident
+
+    w = ops_compact.next_bucket(math.ceil(left.cap / chunks), minimum=8)
+    parts: List[DTable] = []
+    how = config.join_type.value
+    with trace.span("join.streaming"):
+        for lo in range(0, left.cap, w):
+            hi = min(lo + w, left.cap)
+            chunk = _slice_rows(left, lo, hi)
+            csh = _copartition(chunk, li_key, alg, splitters)
+            parts.append(_join_copartitioned(csh, rsh, li_key, ri_key,
+                                             how, alg))
+    return _concat_compact(parts)

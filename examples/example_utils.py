@@ -1,0 +1,44 @@
+"""Shared bits for the example scripts.
+
+Mirrors the reference examples' setup (reference:
+cpp/src/examples/test_utils.hpp, experiments/generate_csv.py): a small CSV
+generator with the scaling-run column shape (int key with ~1% duplicates +
+value columns) and an arg helper that generates inputs on the fly when the
+caller doesn't pass CSV paths — so every example runs with no arguments.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def generate_csv(path: str, rows: int, seed: int, dup_ratio: float = 0.99,
+                 cols: int = 4) -> str:
+    """4-column CSV in the scaling protocol's shape (reference:
+    cpp/src/experiments/generate_csv.py, generate_files.py:30,49)."""
+    rng = np.random.default_rng(seed)
+    krange = max(int(rows * dup_ratio), 1)
+    data = {"0": rng.integers(0, krange, rows)}
+    for i in range(1, cols):
+        data[str(i)] = np.round(rng.random(rows), 6)
+    header = ",".join(data)
+    body = np.column_stack([v.astype(str) for v in data.values()])
+    with open(path, "w") as f:
+        f.write(header + "\n")
+        for row in body:
+            f.write(",".join(row) + "\n")
+    return path
+
+
+def input_csvs(argv, rows: int = 5000):
+    """(left_path, right_path) from argv, generating temp files if absent."""
+    if len(argv) >= 3:
+        return argv[1], argv[2]
+    d = tempfile.mkdtemp(prefix="cylon_example_")
+    return (generate_csv(os.path.join(d, "csv1_0.csv"), rows, seed=1),
+            generate_csv(os.path.join(d, "csv2_0.csv"), rows, seed=2))

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""TPC-H end to end: generate SF-0.01 data, run every implemented query.
+
+Usage: python tpch_example.py [scale_factor]
+"""
+import sys
+import time
+
+import example_utils  # noqa: F401  (sys.path side effect)
+
+from cylon_tpu import CylonContext
+from cylon_tpu import logging as glog
+from cylon_tpu.parallel import DTable
+from cylon_tpu.tpch import QUERIES, generate
+
+
+def main() -> int:
+    sf = float(sys.argv[1]) if len(sys.argv) > 1 else 0.01
+    ctx = CylonContext("tpu")
+
+    t0 = time.perf_counter()
+    data = generate(sf, seed=42)
+    dts = {name: DTable.from_pandas(ctx, df) for name, df in data.items()}
+    glog.info("generated + ingested SF=%g (%d lineitems) in %.1f [ms]", sf,
+              len(data["lineitem"]), (time.perf_counter() - t0) * 1e3)
+
+    for name, q in QUERIES.items():
+        t0 = time.perf_counter()
+        out = q(ctx, dts)
+        glog.info("%s: %d rows in %.1f [ms]", name, out.num_rows,
+                  (time.perf_counter() - t0) * 1e3)
+        out.show(0, 5)
+    ctx.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Weak / strong scaling harness over virtual device meshes.
+
+Mirror of the reference's cluster orchestration (reference:
+cpp/src/experiments/run_dist_scaling.py — mpirun over world sizes
+{1..160}, rows in millions, 4 reps, weak or strong).  Without a multi-chip
+slice this drives the same protocol over **virtual device counts**: each
+case runs bench-style dist_join in a fresh subprocess with
+``--xla_force_host_platform_device_count=W`` (the scaling signal is the
+shuffle/kernel scaling behavior under SPMD, not absolute CPU throughput;
+on a real v5e slice, point JAX_PLATFORMS at tpu and drop the flag).
+
+    python experiments/run_scaling.py -s w -r 0.1 -w 1 2 4 8 --reps 2
+
+Writes one CSV (world,rows_per_worker,rep,j_t_ms) and prints a summary.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from cylon_tpu import CylonContext, JoinAlgorithm, JoinConfig, Table
+from cylon_tpu.parallel import DTable, dist_join
+
+world = {world}
+rows = {rows}
+reps = {reps}
+devs = jax.devices("cpu")
+assert len(devs) == world, (len(devs), world)
+ctx = CylonContext({{"backend": "tpu", "devices": devs}})
+rng = np.random.default_rng(7)
+total = rows * world
+krange = max(int(total * 0.99), 1)
+
+def make(n):
+    return {{"k": rng.integers(0, krange, n).astype(np.int32),
+             "v0": rng.random(n, dtype=np.float32)}}
+
+left = DTable.from_table(ctx, Table.from_columns(ctx, make(total)))
+right = DTable.from_table(ctx, Table.from_columns(ctx, make(total)))
+cfg = JoinConfig.InnerJoin(0, 0, algorithm=JoinAlgorithm.HASH)
+
+def run():
+    t0 = time.perf_counter()
+    out = dist_join(left, right, cfg)
+    jax.block_until_ready([c.data for c in out.columns])
+    return (time.perf_counter() - t0) * 1e3
+
+run()  # compile
+print(json.dumps([run() for _ in range(reps)]))
+"""
+
+
+def run_case(world: int, rows: int, reps: int):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={world}"
+    env["JAX_PLATFORMS"] = "cpu"
+    code = _CHILD.format(repo=REPO, world=world, rows=rows, reps=reps)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=1800, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(f"world={world} failed:\n{r.stderr[-2000:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-s", dest="scaling", choices=("w", "s"), default="w",
+                   help="weak (rows per worker fixed) or strong (total fixed)")
+    p.add_argument("-r", dest="rows", type=float, default=0.05,
+                   help="rows in millions (per worker for weak, total for strong)")
+    p.add_argument("-w", dest="world", type=int, nargs="+",
+                   default=[1, 2, 4, 8])
+    p.add_argument("--reps", type=int, default=2)
+    p.add_argument("-o", dest="out", default="scaling_results.csv")
+    args = p.parse_args()
+
+    rows_m = int(args.rows * 1_000_000)
+    results = []
+    for w in args.world:
+        per_worker = rows_m if args.scaling == "w" else max(rows_m // w, 1)
+        times = run_case(w, per_worker, args.reps)
+        for rep, t in enumerate(times):
+            results.append((w, per_worker, rep, round(t, 2)))
+        best = min(times)
+        total = per_worker * w * 2
+        print(f"world={w:<4d} rows/worker={per_worker:<10d} "
+              f"j_t={best:8.1f} ms   {total / best * 1e3 / 1e6:8.2f} M rows/s",
+              flush=True)
+
+    with open(args.out, "w", newline="") as f:
+        wtr = csv.writer(f)
+        wtr.writerow(["world", "rows_per_worker", "rep", "j_t_ms"])
+        wtr.writerows(results)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -314,3 +314,29 @@ def test_dist_select_null_or_predicate(dctx):
         | (env["y"] > 3)).to_table().to_pandas()
     # row 0: x<5 TRUE; row 1: x NULL but y>3 TRUE (kept); rows 2,3: FALSE
     assert sorted(out["y"].tolist()) == [0, 10]
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full_outer"])
+def test_dist_join_streaming_vs_oneshot(dctx, rng, how):
+    """Chunked streaming join must produce the same row set as dist_join,
+    including null keys, strings, and uneven chunk boundaries."""
+    from cylon_tpu.parallel import dist_join_streaming
+
+    ldf, rdf = _join_dfs(rng, 137, 93, with_nulls=True)
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    cfg = JoinConfig(JoinType(how), JoinAlgorithm.HASH, 0, 0)
+    want = dist_join(lt, rt, cfg).to_table().to_pandas()
+    got = dist_join_streaming(lt, rt, cfg, chunks=3).to_table().to_pandas()
+    assert_same_rows(got, want)
+
+
+def test_dist_join_streaming_oracle(dctx, rng):
+    from cylon_tpu.parallel import dist_join_streaming
+
+    ldf, rdf = _join_dfs(rng, 200, 150, with_nulls=False)
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    cfg = JoinConfig.InnerJoin(0, 0, algorithm=JoinAlgorithm.SORT)
+    got = dist_join_streaming(lt, rt, cfg, chunks=5).to_table().to_pandas()
+    assert_same_rows(got, oracle_join(ldf, rdf, "k", "k", "inner"))

@@ -75,3 +75,45 @@ def test_dist_sort_emits_phases(dctx):
     totals = trace.phase_totals()
     for phase in ("sort.sample", "sort.shuffle", "sort.local"):
         assert phase in totals
+
+
+class TestGlog:
+    def test_format_and_levels(self, capsys):
+        import io
+        from cylon_tpu import logging as glog
+
+        buf = io.StringIO()
+        glog.set_sink(buf)
+        try:
+            glog.info("hello %d", 42)
+            glog.error("bad thing")
+            glog.vlog(5, "too verbose")  # above default verbosity: dropped
+            glog.set_verbosity(5)
+            glog.vlog(5, "now visible")
+            glog.set_min_level(glog.ERROR)
+            glog.info("suppressed")
+        finally:
+            glog.set_sink(__import__("sys").stderr)
+            glog.set_min_level(0)
+            glog.set_verbosity(0)
+        lines = buf.getvalue().strip().splitlines()
+        assert len(lines) == 3
+        assert lines[0].startswith("I") and lines[0].endswith("hello 42")
+        assert "test_trace.py" in lines[0]
+        assert lines[1].startswith("E")
+        assert lines[2].endswith("now visible")
+
+    def test_fatal_raises(self):
+        import io
+        import pytest
+        from cylon_tpu import logging as glog
+
+        buf = io.StringIO()
+        glog.set_sink(buf)
+        try:
+            with pytest.raises(SystemExit):
+                glog.fatal("abort")
+        finally:
+            glog.set_sink(__import__("sys").stderr)
+        assert buf.getvalue().startswith("F")
+        assert "abort" in buf.getvalue()
