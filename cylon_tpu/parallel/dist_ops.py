@@ -221,23 +221,33 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig) -> DTable:
             local sort-merge join — shards are ordered by key ranges, so
             the join output is additionally globally key-ordered.
     """
-    ctx = left.ctx
-    li_key = left.column_index(config.left_column_idx)
-    ri_key = right.column_index(config.right_column_idx)
-    lt_k, rt_k = left.columns[li_key].dtype.type, right.columns[ri_key].dtype.type
-    if lt_k != rt_k:
-        raise CylonError(Status(Code.TypeError,
-            f"join key type mismatch {lt_k.name} vs {rt_k.name}"))
-    left, right = _unify_dtable_dicts(left, right, [li_key], [ri_key])
-
-    alg = "sort" if config.algorithm == JoinAlgorithm.SORT else "hash"
-    splitters = (None if alg == "hash" or ctx.get_world_size() == 1 else
-                 _sample_splitters([(left, li_key), (right, ri_key)],
-                                   ascending=True))
+    left, right, li_key, ri_key, alg, splitters = _join_prologue(
+        left, right, config)
     lsh = _copartition(left, li_key, alg, splitters)
     rsh = _copartition(right, ri_key, alg, splitters)
     return _join_copartitioned(lsh, rsh, li_key, ri_key,
                                config.join_type.value, alg)
+
+
+def _join_prologue(left: DTable, right: DTable, config: JoinConfig):
+    """Shared setup for the one-shot and streaming joins: key resolution,
+    type check, dictionary unification, algorithm + sort splitters."""
+    li_key = left.column_index(config.left_column_idx)
+    ri_key = right.column_index(config.right_column_idx)
+    lt_k = left.columns[li_key].dtype.type
+    rt_k = right.columns[ri_key].dtype.type
+    if lt_k != rt_k:
+        raise CylonError(Status(Code.TypeError,
+            f"join key type mismatch {lt_k.name} vs {rt_k.name}"))
+    left, right = _unify_dtable_dicts(left, right, [li_key], [ri_key])
+    alg = "sort" if config.algorithm == JoinAlgorithm.SORT else "hash"
+    if alg == "hash" or left.ctx.get_world_size() == 1:
+        splitters = None
+    else:
+        with trace.span("join.sample"):
+            splitters = _sample_splitters(
+                [(left, li_key), (right, ri_key)], ascending=True)
+    return left, right, li_key, ri_key, alg, splitters
 
 
 def _copartition(dt: DTable, key_i: int, alg: str,

@@ -34,10 +34,10 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import trace
-from ..config import JoinAlgorithm, JoinConfig
+from ..config import JoinConfig
 from ..ops import compact as ops_compact
 from ..ops import gather as ops_gather
-from .dist_ops import (_copartition, _join_copartitioned, _sample_splitters,
+from .dist_ops import (_copartition, _join_copartitioned, _join_prologue,
                        dist_join)
 from .dtable import DColumn, DTable
 
@@ -127,21 +127,8 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
             or config.join_type.value in ("right", "full_outer")):
         return dist_join(left, right, config)
 
-    ctx = left.ctx
-    li_key = left.column_index(config.left_column_idx)
-    ri_key = right.column_index(config.right_column_idx)
-    lt_k = left.columns[li_key].dtype.type
-    rt_k = right.columns[ri_key].dtype.type
-    if lt_k != rt_k:
-        from ..status import Code, CylonError, Status
-        raise CylonError(Status(Code.TypeError,
-            f"join key type mismatch {lt_k.name} vs {rt_k.name}"))
-    from .dist_ops import _unify_dtable_dicts
-    left, right = _unify_dtable_dicts(left, right, [li_key], [ri_key])
-    alg = "sort" if config.algorithm == JoinAlgorithm.SORT else "hash"
-    splitters = (None if alg == "hash" or ctx.get_world_size() == 1 else
-                 _sample_splitters([(left, li_key), (right, ri_key)],
-                                   ascending=True))
+    left, right, li_key, ri_key, alg, splitters = _join_prologue(
+        left, right, config)
     rsh = _copartition(right, ri_key, alg, splitters)  # once, resident
 
     w = ops_compact.next_bucket(math.ceil(left.cap / chunks), minimum=8)

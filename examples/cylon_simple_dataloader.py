@@ -7,7 +7,11 @@ forward pass in minibatches via pycylon.util.data.MiniBatcher.  Torch is
 CPU-only in this image; the compute path demonstrated is the data plumbing,
 not TPU training.
 """
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 from example_utils import input_csvs
 

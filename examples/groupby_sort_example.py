@@ -5,7 +5,11 @@ BASELINE configs 3 and 4 as a runnable demo: hash-shuffle groupby with
 sum/mean/count, then a distributed sample-sort of the aggregate, printed
 via dist_head (ORDER BY ... LIMIT).
 """
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 from example_utils import input_csvs

@@ -8,7 +8,11 @@ DistributedJoin on column 0, log read/join timings.  Usage:
 
 With no arguments, inputs are generated (scaling-protocol shape).
 """
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 from example_utils import input_csvs

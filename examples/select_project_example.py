@@ -6,7 +6,11 @@ rows of a CSV table by a predicate on column 0, then project two columns.
 The predicate here is a vectorized expression over named columns — the
 TPU-native replacement for the reference's per-row lambda.
 """
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 from example_utils import input_csvs

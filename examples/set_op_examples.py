@@ -7,7 +7,11 @@ reference programs differ only in the operator line).  Usage:
 
     python set_op_examples.py [union|intersect|subtract] [a.csv b.csv]
 """
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 from example_utils import input_csvs

@@ -3,10 +3,13 @@
 
 Usage: python tpch_example.py [scale_factor]
 """
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
-import example_utils  # noqa: F401  (sys.path side effect)
 
 from cylon_tpu import CylonContext
 from cylon_tpu import logging as glog

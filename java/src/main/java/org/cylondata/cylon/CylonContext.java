@@ -1,0 +1,104 @@
+package org.cylondata.cylon;
+
+import java.io.BufferedReader;
+import java.io.IOException;
+import java.io.InputStreamReader;
+import java.io.OutputStreamWriter;
+import java.io.Writer;
+import java.nio.charset.StandardCharsets;
+import java.util.Map;
+
+import org.cylondata.cylon.exception.CylonRuntimeException;
+
+/**
+ * Entry point to the cylon_tpu engine from Java.
+ *
+ * Mirrors the reference's {@code CylonContext} surface
+ * (reference: java/src/main/java/org/cylondata/cylon/CylonContext.java —
+ * init/barrier/finalizeCtx/getWorldSize), but instead of loading a JNI
+ * library it owns a gateway subprocess running
+ * {@code python -m pycylon.java_gateway} and speaks the id-addressed
+ * newline-JSON protocol documented there.  Table handles on the Java side
+ * are the same registry ids the reference passes through JNI.
+ *
+ * The python executable can be overridden with the system property
+ * {@code cylon.gateway.python} (default {@code python3}).
+ */
+public class CylonContext implements AutoCloseable {
+
+  private final Process gateway;
+  private final Writer toGateway;
+  private final BufferedReader fromGateway;
+  private boolean finalized = false;
+
+  private CylonContext(Process gateway) {
+    this.gateway = gateway;
+    this.toGateway = new OutputStreamWriter(
+        gateway.getOutputStream(), StandardCharsets.UTF_8);
+    this.fromGateway = new BufferedReader(new InputStreamReader(
+        gateway.getInputStream(), StandardCharsets.UTF_8));
+  }
+
+  /** Reference spelling: {@code CylonContext.init()}. */
+  public static CylonContext init() {
+    return init("mpi");
+  }
+
+  public static CylonContext init(String backend) {
+    String python = System.getProperty("cylon.gateway.python", "python3");
+    ProcessBuilder pb = new ProcessBuilder(
+        python, "-m", "pycylon.java_gateway", backend);
+    pb.redirectErrorStream(false);
+    try {
+      return new CylonContext(pb.start());
+    } catch (IOException e) {
+      throw new CylonRuntimeException("failed to start gateway: " + e, e);
+    }
+  }
+
+  /** One request/response round trip; package-private for Table. */
+  synchronized Map<String, Object> request(Map<String, Object> req) {
+    if (finalized) {
+      throw new CylonRuntimeException("context already finalized");
+    }
+    try {
+      toGateway.write(Json.write(req));
+      toGateway.write("\n");
+      toGateway.flush();
+      String line = fromGateway.readLine();
+      if (line == null) {
+        throw new CylonRuntimeException("gateway closed unexpectedly");
+      }
+      Map<String, Object> reply = Json.parseObject(line);
+      if (!Boolean.TRUE.equals(reply.get("ok"))) {
+        throw new CylonRuntimeException(String.valueOf(reply.get("error")));
+      }
+      return reply;
+    } catch (IOException e) {
+      throw new CylonRuntimeException("gateway I/O failed: " + e, e);
+    }
+  }
+
+  /** The engine is single-controller; barrier is one gateway round trip. */
+  public void barrier() {
+    request(Json.map("op", "ping"));
+  }
+
+  /** Reference spelling: {@code ctx.finalizeCtx()}. */
+  public void finalizeCtx() {
+    if (finalized) {
+      return;
+    }
+    try {
+      request(Json.map("op", "shutdown"));
+    } finally {
+      finalized = true;
+      gateway.destroy();
+    }
+  }
+
+  @Override
+  public void close() {
+    finalizeCtx();
+  }
+}

@@ -1,0 +1,226 @@
+package org.cylondata.cylon;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+import org.cylondata.cylon.exception.CylonRuntimeException;
+
+/**
+ * Minimal JSON for the gateway line protocol — flat objects whose values
+ * are strings, numbers, booleans, null, or flat arrays of those.  Kept
+ * dependency-free on purpose: the binding ships as plain sources like the
+ * reference's (no build system beyond javac needed).
+ */
+final class Json {
+
+  private Json() {
+  }
+
+  static Map<String, Object> map(Object... kv) {
+    Map<String, Object> m = new LinkedHashMap<>();
+    for (int i = 0; i < kv.length; i += 2) {
+      m.put((String) kv[i], kv[i + 1]);
+    }
+    return m;
+  }
+
+  // -- writer ---------------------------------------------------------------
+
+  static String write(Map<String, Object> obj) {
+    StringBuilder sb = new StringBuilder("{");
+    boolean first = true;
+    for (Map.Entry<String, Object> e : obj.entrySet()) {
+      if (!first) {
+        sb.append(',');
+      }
+      first = false;
+      writeString(sb, e.getKey());
+      sb.append(':');
+      writeValue(sb, e.getValue());
+    }
+    return sb.append('}').toString();
+  }
+
+  private static void writeValue(StringBuilder sb, Object v) {
+    if (v == null) {
+      sb.append("null");
+    } else if (v instanceof String) {
+      writeString(sb, (String) v);
+    } else if (v instanceof Boolean || v instanceof Number) {
+      sb.append(v);
+    } else {
+      throw new CylonRuntimeException("unsupported JSON value: " + v);
+    }
+  }
+
+  private static void writeString(StringBuilder sb, String s) {
+    sb.append('"');
+    for (int i = 0; i < s.length(); i++) {
+      char c = s.charAt(i);
+      switch (c) {
+        case '"': sb.append("\\\""); break;
+        case '\\': sb.append("\\\\"); break;
+        case '\n': sb.append("\\n"); break;
+        case '\r': sb.append("\\r"); break;
+        case '\t': sb.append("\\t"); break;
+        default:
+          if (c < 0x20) {
+            sb.append(String.format("\\u%04x", (int) c));
+          } else {
+            sb.append(c);
+          }
+      }
+    }
+    sb.append('"');
+  }
+
+  // -- parser ---------------------------------------------------------------
+
+  static Map<String, Object> parseObject(String text) {
+    Parser p = new Parser(text);
+    p.ws();
+    Object v = p.value();
+    if (!(v instanceof Map)) {
+      throw new CylonRuntimeException("expected JSON object: " + text);
+    }
+    @SuppressWarnings("unchecked")
+    Map<String, Object> m = (Map<String, Object>) v;
+    return m;
+  }
+
+  private static final class Parser {
+    private final String s;
+    private int i = 0;
+
+    Parser(String s) {
+      this.s = s;
+    }
+
+    void ws() {
+      while (i < s.length() && Character.isWhitespace(s.charAt(i))) {
+        i++;
+      }
+    }
+
+    Object value() {
+      ws();
+      char c = s.charAt(i);
+      if (c == '{') {
+        return object();
+      }
+      if (c == '[') {
+        return array();
+      }
+      if (c == '"') {
+        return string();
+      }
+      if (s.startsWith("true", i)) {
+        i += 4;
+        return Boolean.TRUE;
+      }
+      if (s.startsWith("false", i)) {
+        i += 5;
+        return Boolean.FALSE;
+      }
+      if (s.startsWith("null", i)) {
+        i += 4;
+        return null;
+      }
+      return number();
+    }
+
+    Map<String, Object> object() {
+      Map<String, Object> m = new LinkedHashMap<>();
+      i++;  // '{'
+      ws();
+      if (s.charAt(i) == '}') {
+        i++;
+        return m;
+      }
+      while (true) {
+        ws();
+        String k = string();
+        ws();
+        expect(':');
+        m.put(k, value());
+        ws();
+        if (s.charAt(i) == ',') {
+          i++;
+          continue;
+        }
+        expect('}');
+        return m;
+      }
+    }
+
+    List<Object> array() {
+      List<Object> out = new ArrayList<>();
+      i++;  // '['
+      ws();
+      if (s.charAt(i) == ']') {
+        i++;
+        return out;
+      }
+      while (true) {
+        out.add(value());
+        ws();
+        if (s.charAt(i) == ',') {
+          i++;
+          continue;
+        }
+        expect(']');
+        return out;
+      }
+    }
+
+    String string() {
+      expect('"');
+      StringBuilder sb = new StringBuilder();
+      while (true) {
+        char c = s.charAt(i++);
+        if (c == '"') {
+          return sb.toString();
+        }
+        if (c == '\\') {
+          char e = s.charAt(i++);
+          switch (e) {
+            case 'n': sb.append('\n'); break;
+            case 'r': sb.append('\r'); break;
+            case 't': sb.append('\t'); break;
+            case 'b': sb.append('\b'); break;
+            case 'f': sb.append('\f'); break;
+            case 'u':
+              sb.append((char) Integer.parseInt(s.substring(i, i + 4), 16));
+              i += 4;
+              break;
+            default: sb.append(e);
+          }
+        } else {
+          sb.append(c);
+        }
+      }
+    }
+
+    Number number() {
+      int start = i;
+      while (i < s.length() && "+-0123456789.eE".indexOf(s.charAt(i)) >= 0) {
+        i++;
+      }
+      String t = s.substring(start, i);
+      if (t.indexOf('.') >= 0 || t.indexOf('e') >= 0 || t.indexOf('E') >= 0) {
+        return Double.parseDouble(t);
+      }
+      return Long.parseLong(t);
+    }
+
+    void expect(char c) {
+      if (s.charAt(i) != c) {
+        throw new CylonRuntimeException(
+            "bad JSON at " + i + ", expected '" + c + "': " + s);
+      }
+      i++;
+    }
+  }
+}

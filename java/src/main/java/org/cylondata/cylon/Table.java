@@ -1,0 +1,125 @@
+package org.cylondata.cylon;
+
+import java.util.List;
+import java.util.Map;
+
+import org.cylondata.cylon.join.JoinConfig;
+
+/**
+ * Id-addressed table handle, mirroring the reference's Java {@code Table}
+ * (reference: java/src/main/java/org/cylondata/cylon/Table.java — a uuid
+ * plus static natives fromCSV/nativeJoin/union/…; ids resolve in the
+ * engine-side registry).  Every operation returns a new immutable handle.
+ */
+public class Table {
+
+  private final CylonContext ctx;
+  private final String id;
+
+  Table(CylonContext ctx, String id) {
+    this.ctx = ctx;
+    this.id = id;
+  }
+
+  public String getId() {
+    return id;
+  }
+
+  // -- ingest ---------------------------------------------------------------
+
+  public static Table fromCSV(CylonContext ctx, String path) {
+    Map<String, Object> r = ctx.request(
+        Json.map("op", "from_csv", "path", path));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  // -- relational ops (reference Table.java surface) ------------------------
+
+  public Table join(Table right, JoinConfig config) {
+    return joinInternal(right, config, false);
+  }
+
+  public Table distributedJoin(Table right, JoinConfig config) {
+    return joinInternal(right, config, true);
+  }
+
+  private Table joinInternal(Table right, JoinConfig c, boolean distributed) {
+    Map<String, Object> r = ctx.request(Json.map(
+        "op", "join", "left", id, "right", right.id,
+        "join_type", c.getJoinType().name().toLowerCase(),
+        "algorithm", c.getJoinAlgorithm().name().toLowerCase(),
+        "left_col", c.getLeftIndex(), "right_col", c.getRightIndex(),
+        "distributed", distributed));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  public Table union(Table other) {
+    return setOp("union", other, false);
+  }
+
+  public Table distributedUnion(Table other) {
+    return setOp("union", other, true);
+  }
+
+  public Table intersect(Table other) {
+    return setOp("intersect", other, false);
+  }
+
+  public Table distributedIntersect(Table other) {
+    return setOp("intersect", other, true);
+  }
+
+  public Table subtract(Table other) {
+    return setOp("subtract", other, false);
+  }
+
+  public Table distributedSubtract(Table other) {
+    return setOp("subtract", other, true);
+  }
+
+  private Table setOp(String op, Table other, boolean distributed) {
+    Map<String, Object> r = ctx.request(Json.map(
+        "op", op, "left", id, "right", other.id,
+        "distributed", distributed));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  public Table sort(int column) {
+    Map<String, Object> r = ctx.request(Json.map(
+        "op", "sort", "id", id, "column", column));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  // -- shape / export -------------------------------------------------------
+
+  public long getRowCount() {
+    return ((Number) ctx.request(
+        Json.map("op", "rows", "id", id)).get("value")).longValue();
+  }
+
+  public int getColumnCount() {
+    return ((Number) ctx.request(
+        Json.map("op", "columns", "id", id)).get("value")).intValue();
+  }
+
+  @SuppressWarnings("unchecked")
+  public List<String> getColumnNames() {
+    return (List<String>) ctx.request(
+        Json.map("op", "column_names", "id", id)).get("value");
+  }
+
+  /** Reference spelling: {@code tb.print()}. */
+  public void print() {
+    System.out.print(ctx.request(
+        Json.map("op", "show", "id", id)).get("value"));
+  }
+
+  public void toCSV(String path) {
+    ctx.request(Json.map("op", "to_csv", "id", id, "path", path));
+  }
+
+  /** Release the engine-side registry entry. */
+  public void free() {
+    ctx.request(Json.map("op", "free", "id", id));
+  }
+}

@@ -1,0 +1,13 @@
+package org.cylondata.cylon.exception;
+
+/** Engine/gateway failure surfaced to Java callers. */
+public class CylonRuntimeException extends RuntimeException {
+
+  public CylonRuntimeException(String message) {
+    super(message);
+  }
+
+  public CylonRuntimeException(String message, Throwable cause) {
+    super(message, cause);
+  }
+}

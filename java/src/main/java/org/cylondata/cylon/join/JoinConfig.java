@@ -1,0 +1,63 @@
+package org.cylondata.cylon.join;
+
+/**
+ * join type x algorithm x key column per side — reference:
+ * java/src/main/java/org/cylondata/cylon/join/JoinConfig.java and the C++
+ * builder it mirrors (cpp/src/cylon/join/join_config.hpp:22-89).
+ */
+public class JoinConfig {
+
+  public enum Type {
+    INNER, LEFT, RIGHT, FULL_OUTER
+  }
+
+  public enum Algorithm {
+    SORT, HASH
+  }
+
+  private final Type joinType;
+  private final Algorithm joinAlgorithm;
+  private final int leftIndex;
+  private final int rightIndex;
+
+  public JoinConfig(Type type, Algorithm algorithm,
+                    int leftIndex, int rightIndex) {
+    this.joinType = type;
+    this.joinAlgorithm = algorithm;
+    this.leftIndex = leftIndex;
+    this.rightIndex = rightIndex;
+  }
+
+  public static JoinConfig innerJoin(int leftIndex, int rightIndex) {
+    return new JoinConfig(Type.INNER, Algorithm.HASH, leftIndex, rightIndex);
+  }
+
+  public static JoinConfig leftJoin(int leftIndex, int rightIndex) {
+    return new JoinConfig(Type.LEFT, Algorithm.HASH, leftIndex, rightIndex);
+  }
+
+  public static JoinConfig rightJoin(int leftIndex, int rightIndex) {
+    return new JoinConfig(Type.RIGHT, Algorithm.HASH, leftIndex, rightIndex);
+  }
+
+  public static JoinConfig fullOuterJoin(int leftIndex, int rightIndex) {
+    return new JoinConfig(Type.FULL_OUTER, Algorithm.HASH,
+        leftIndex, rightIndex);
+  }
+
+  public Type getJoinType() {
+    return joinType;
+  }
+
+  public Algorithm getJoinAlgorithm() {
+    return joinAlgorithm;
+  }
+
+  public int getLeftIndex() {
+    return leftIndex;
+  }
+
+  public int getRightIndex() {
+    return rightIndex;
+  }
+}

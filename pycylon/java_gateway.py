@@ -1,0 +1,140 @@
+"""Gateway process for the Java binding.
+
+The reference's Java API is id-addressed: every native call passes table
+ids into JNI and gets ids back (reference:
+java/src/main/java/org/cylondata/cylon/Table.java — uuid per table,
+nativeJoin(id, id, …) → new id; Table.cpp resolves ids through
+table_api's registry).  This module is the same contract over a process
+boundary instead of JNI: the Java side spawns
+
+    python -m pycylon.java_gateway
+
+and speaks newline-delimited JSON on stdin/stdout.  Tables are exchanged
+by CSV path (the reference Java surface is fromCSV/print/toCsv-shaped);
+ops run on the resident engine and return new table ids.
+
+Why a gateway and not JNI: the engine is the JAX runtime in-process —
+embedding a CPython interpreter inside libjvm via JNI buys nothing over a
+subprocess and couples the JVM to the interpreter's lifetime.  The
+id-addressed protocol is transport-independent, so a JNI shim could later
+speak the same `handle()` dictionary API.
+
+Protocol (one JSON object per line; every reply carries "ok"):
+  {"op": "from_csv", "path": p}                    -> {"ok": true, "id": t}
+  {"op": "join", "left": t, "right": u,
+   "join_type": "inner", "algorithm": "hash",
+   "left_col": 0, "right_col": 0, "distributed": false} -> {"id": v}
+  {"op": "union"/"intersect"/"subtract", "left": t, "right": u,
+   "distributed": false}                           -> {"id": v}
+  {"op": "sort", "id": t, "column": 0}             -> {"id": v}
+  {"op": "rows"/"columns", "id": t}                -> {"value": n}
+  {"op": "column_names", "id": t}                  -> {"value": [...]}
+  {"op": "to_csv", "id": t, "path": p}             -> {"ok": true}
+  {"op": "show", "id": t}                          -> {"value": str}
+  {"op": "free", "id": t}                          -> {"ok": true}
+  {"op": "shutdown"}                               -> {"ok": true} + exit
+"""
+from __future__ import annotations
+
+import io
+import json
+import sys
+from typing import Any, Dict
+
+
+class Gateway:
+    """One engine context + table registry; transport-independent core."""
+
+    def __init__(self, backend: str = "mpi"):
+        from pycylon import CylonContext, csv_reader
+        from pycylon.data.table import Table
+
+        self._ctx = CylonContext(backend)
+        self._csv_reader = csv_reader
+        self._Table = Table
+        self._tables: Dict[str, Any] = {}
+
+    def _get(self, tid: str):
+        try:
+            return self._tables[tid]
+        except KeyError:
+            raise KeyError(f"unknown table id {tid!r}") from None
+
+    def _put(self, table) -> str:
+        self._tables[table.id] = table
+        return table.id
+
+    def handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        if op == "from_csv":
+            t = self._csv_reader.read(self._ctx, req["path"],
+                                      req.get("delimiter", ","))
+            return {"ok": True, "id": self._put(t)}
+        if op == "join":
+            left, right = self._get(req["left"]), self._get(req["right"])
+            method = ("distributed_join" if req.get("distributed")
+                      else "join")
+            out = getattr(left, method)(
+                self._ctx, right,
+                join_type=req.get("join_type", "inner"),
+                algorithm=req.get("algorithm", "hash"),
+                left_col=int(req.get("left_col", 0)),
+                right_col=int(req.get("right_col", 0)))
+            return {"ok": True, "id": self._put(out)}
+        if op in ("union", "intersect", "subtract"):
+            left, right = self._get(req["left"]), self._get(req["right"])
+            method = f"distributed_{op}" if req.get("distributed") else op
+            out = getattr(left, method)(self._ctx, right)
+            return {"ok": True, "id": self._put(out)}
+        if op == "sort":
+            out = self._get(req["id"]).sort(self._ctx, req.get("column", 0))
+            return {"ok": True, "id": self._put(out)}
+        if op == "rows":
+            return {"ok": True, "value": self._get(req["id"]).rows}
+        if op == "columns":
+            return {"ok": True, "value": self._get(req["id"]).columns}
+        if op == "column_names":
+            return {"ok": True,
+                    "value": list(self._get(req["id"]).column_names)}
+        if op == "to_csv":
+            self._get(req["id"]).to_csv(req["path"])
+            return {"ok": True}
+        if op == "show":
+            buf = io.StringIO()
+            stdout, sys.stdout = sys.stdout, buf
+            try:
+                self._get(req["id"]).show()
+            finally:
+                sys.stdout = stdout
+            return {"ok": True, "value": buf.getvalue()}
+        if op == "free":
+            self._tables.pop(req["id"], None)
+            return {"ok": True}
+        if op == "ping":  # liveness / barrier round trip
+            self._ctx.barrier()
+            return {"ok": True}
+        if op == "shutdown":
+            return {"ok": True, "shutdown": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+def serve(stdin=None, stdout=None, backend: str = "mpi") -> None:
+    """Blocking line loop (the Java client's peer)."""
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    gw = Gateway(backend)
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            reply = gw.handle(json.loads(line))
+        except Exception as e:  # protocol errors must not kill the gateway
+            reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(reply), file=stdout, flush=True)
+        if reply.get("shutdown"):
+            break
+
+
+if __name__ == "__main__":
+    serve(backend=sys.argv[1] if len(sys.argv) > 1 else "mpi")

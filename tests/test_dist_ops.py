@@ -316,7 +316,7 @@ def test_dist_select_null_or_predicate(dctx):
     assert sorted(out["y"].tolist()) == [0, 10]
 
 
-@pytest.mark.parametrize("how", ["inner", "left", "right", "full_outer"])
+@pytest.mark.parametrize("how", ["inner", "left"])
 def test_dist_join_streaming_vs_oneshot(dctx, rng, how):
     """Chunked streaming join must produce the same row set as dist_join,
     including null keys, strings, and uneven chunk boundaries."""
@@ -329,6 +329,29 @@ def test_dist_join_streaming_vs_oneshot(dctx, rng, how):
     want = dist_join(lt, rt, cfg).to_table().to_pandas()
     got = dist_join_streaming(lt, rt, cfg, chunks=3).to_table().to_pandas()
     assert_same_rows(got, want)
+
+
+@pytest.mark.parametrize("how", ["right", "full_outer"])
+def test_dist_join_streaming_fallback_dispatch(dctx, rng, how, monkeypatch):
+    """RIGHT/FULL_OUTER must dispatch to the one-shot join (a streaming
+    pass cannot decide right-side unmatched rows per chunk)."""
+    from cylon_tpu.parallel import dist_join_streaming, streaming
+
+    called = {}
+
+    def spy(left, right, config):
+        called["oneshot"] = True
+        return dist_join(left, right, config)
+
+    monkeypatch.setattr(streaming, "dist_join", spy)
+    ldf, rdf = _join_dfs(rng, 30, 20, with_nulls=False)
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    cfg = JoinConfig(JoinType(how), JoinAlgorithm.HASH, 0, 0)
+    out = dist_join_streaming(lt, rt, cfg, chunks=3)
+    assert called.get("oneshot"), "fallback to dist_join did not happen"
+    assert_same_rows(out.to_table().to_pandas(),
+                     oracle_join(ldf, rdf, "k", "k", how))
 
 
 def test_dist_join_streaming_oracle(dctx, rng):

@@ -1,0 +1,89 @@
+"""The Java binding's engine side: drive the gateway protocol end to end
+over a real subprocess pipe, exactly as the Java client does (java/
+src/main/java/org/cylondata/cylon/CylonContext.java request())."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def gateway():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    p = subprocess.Popen(
+        [sys.executable, "-m", "pycylon.java_gateway"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env, cwd=REPO)
+    yield p
+    if p.poll() is None:
+        p.kill()
+    p.wait(timeout=30)
+
+
+def _rpc(p, **req):
+    p.stdin.write(json.dumps(req) + "\n")
+    p.stdin.flush()
+    line = p.stdout.readline()
+    assert line, p.stderr.read()[-2000:]
+    return json.loads(line)
+
+
+def test_gateway_protocol_end_to_end(gateway, tmp_path, rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 40, 80),
+                        "v": np.round(rng.random(80), 6)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 40, 60),
+                        "w": np.round(rng.random(60), 6)})
+    lp, rp = tmp_path / "l.csv", tmp_path / "r.csv"
+    ldf.to_csv(lp, index=False)
+    rdf.to_csv(rp, index=False)
+
+    assert _rpc(gateway, op="ping")["ok"]
+
+    left = _rpc(gateway, op="from_csv", path=str(lp))
+    right = _rpc(gateway, op="from_csv", path=str(rp))
+    assert left["ok"] and right["ok"]
+
+    r = _rpc(gateway, op="rows", id=left["id"])
+    assert r["value"] == 80
+    assert _rpc(gateway, op="columns", id=left["id"])["value"] == 2
+    assert _rpc(gateway, op="column_names", id=left["id"])["value"] == ["k", "v"]
+
+    joined = _rpc(gateway, op="join", left=left["id"], right=right["id"],
+                  join_type="inner", algorithm="hash",
+                  left_col=0, right_col=0, distributed=True)
+    assert joined["ok"]
+    want = len(ldf.merge(rdf, on="k"))
+    assert _rpc(gateway, op="rows", id=joined["id"])["value"] == want
+
+    un = _rpc(gateway, op="union", left=left["id"], right=left["id"])
+    assert _rpc(gateway, op="rows", id=un["id"])["value"] == \
+        len(ldf.drop_duplicates())
+
+    srt = _rpc(gateway, op="sort", id=left["id"], column=0)
+    out = tmp_path / "out.csv"
+    assert _rpc(gateway, op="to_csv", id=srt["id"], path=str(out))["ok"]
+    back = pd.read_csv(out)
+    assert back["k"].is_monotonic_increasing
+
+    shown = _rpc(gateway, op="show", id=left["id"])
+    assert "k" in shown["value"]
+
+    assert _rpc(gateway, op="free", id=left["id"])["ok"]
+    err = _rpc(gateway, op="rows", id=left["id"])
+    assert not err["ok"] and "unknown table id" in err["error"]
+    err2 = _rpc(gateway, op="bogus")
+    assert not err2["ok"]
+
+    bye = _rpc(gateway, op="shutdown")
+    assert bye["ok"]
+    gateway.wait(timeout=30)
+    assert gateway.returncode == 0
