@@ -1,0 +1,112 @@
+"""Pallas TPU kernel for the shuffle's send side: fused murmur3 row hash
+→ partition id.
+
+SURVEY.md §7 hard part 3 commits the HASH-algorithm config to a Pallas
+kernel.  A Pallas *linear-probe hash table* was evaluated and rejected:
+open addressing needs contended scatter (insert → collide → reprobe),
+which serializes on the TPU's vector memory — the survey's own guidance
+("contended scatter is awkward; prefer sort-based equivalents").  The
+direct-address build over dense ranks (ops/hashjoin.py) is the TPU-shaped
+hash join.  What IS a natural Pallas target is the partition hash — the
+per-row murmur3 + 31·h combine + ``% P`` that fronts every shuffle
+(reference: arrow_partition_kernels.hpp:28-164 HashPartitionKernel /
+RowHashingKernel): pure VPU arithmetic, one VMEM pass over each key
+column, no gather/scatter.  This module fuses that chain into one kernel
+(hash mix + multi-column combine + validity zeroing + mod) where the jnp
+formulation in ops/hash.py emits it as a chain XLA must re-fuse.
+
+The jnp path (ops/hash.py) remains the reference implementation and the
+fallback on non-TPU backends; parity is asserted in tests (and the TPU
+kernel is numerically identical — same mix constants, same null→0 rule).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import hash as jnp_hash
+
+_BLOCK = 64 * 1024  # rows per grid step: 256 KiB of uint32 per operand
+
+
+def _mix_words(h, k):
+    k = k * jnp.uint32(0xCC9E2D51)
+    k = (k << jnp.uint32(15)) | (k >> jnp.uint32(17))
+    k = k * jnp.uint32(0x1B873593)
+    h = h ^ k
+    h = (h << jnp.uint32(13)) | (h >> jnp.uint32(19))
+    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def _kernel(nwords: Tuple[int, ...], has_valid: Tuple[bool, ...],
+            nparts: int, *refs):
+    """refs = [word refs per column..., validity refs per column..., out]."""
+    out_ref = refs[-1]
+    word_refs = refs[:sum(nwords)]
+    valid_refs = refs[sum(nwords):-1]
+
+    row_h = jnp.zeros(out_ref.shape, jnp.uint32)
+    wi = vi = 0
+    for ci, nw in enumerate(nwords):
+        h = jnp.zeros(out_ref.shape, jnp.uint32)
+        for _ in range(nw):
+            h = _mix_words(h, word_refs[wi][:])
+            wi += 1
+        h = h ^ jnp.uint32(4 * nw)
+        h = h ^ (h >> jnp.uint32(16))
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> jnp.uint32(13))
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> jnp.uint32(16))
+        if has_valid[ci]:
+            h = jnp.where(valid_refs[vi][:] != 0, h, jnp.uint32(0))
+            vi += 1
+        row_h = row_h * jnp.uint32(31) + h
+    out_ref[:] = (row_h % jnp.uint32(nparts)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "nparts", "interpret", "nwords", "has_valid", "n"))
+def _call(words, valids_present, nparts: int, interpret: bool,
+          nwords, has_valid, n: int):
+    grid = (pl.cdiv(n, _BLOCK),)
+    spec = pl.BlockSpec((_BLOCK,), lambda i: (i,))
+    kernel = functools.partial(_kernel, nwords, has_valid, nparts)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        grid=grid,
+        in_specs=[spec] * (len(words) + len(valids_present)),
+        out_specs=spec,
+        interpret=interpret,
+    )(*words, *valids_present)
+
+
+def partition_ids_fused(cols: Sequence[jax.Array],
+                        validities: Sequence[Optional[jax.Array]],
+                        nparts: int,
+                        interpret: Optional[bool] = None) -> jax.Array:
+    """Fused row-hash + ``% nparts`` partition ids via Pallas.
+
+    Matches ``partition_ids(row_hash(cols, validities), nparts)`` from
+    ops/hash.py bit-for-bit.  ``interpret=None`` auto-selects: compiled on
+    TPU backends, interpreter elsewhere (CPU tests).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    words = []
+    nwords = []
+    for c in cols:
+        ws = jnp_hash._to_u32_words(c)
+        words.extend(ws)
+        nwords.append(len(ws))
+    # validity as uint32 lanes (TPU-friendly; bool VMEM tiles are awkward)
+    valids_present = [v.astype(jnp.uint32) for v in validities
+                      if v is not None]
+    has_valid = tuple(v is not None for v in validities)
+    return _call(tuple(words), tuple(valids_present), nparts, interpret,
+                 tuple(nwords), has_valid, cols[0].shape[0])

@@ -48,7 +48,9 @@ public class CylonContext implements AutoCloseable {
     String python = System.getProperty("cylon.gateway.python", "python3");
     ProcessBuilder pb = new ProcessBuilder(
         python, "-m", "pycylon.java_gateway", backend);
-    pb.redirectErrorStream(false);
+    // stderr must drain (engine logs are chatty); inheriting avoids a
+    // pipe-buffer deadlock blocking the gateway mid-reply
+    pb.redirectError(ProcessBuilder.Redirect.INHERIT);
     try {
       return new CylonContext(pb.start());
     } catch (IOException e) {

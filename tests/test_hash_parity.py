@@ -211,3 +211,33 @@ class TestStagingArenaNative:
         a.reset()
         v = a.allocate(128)
         assert len(v) == 128 and a.bytes_in_use() == 128
+
+
+class TestPallasPartition:
+    """The fused Pallas partition kernel (ops/hash_pallas.py) must match
+    the jnp reference path bit for bit — same murmur3 constants, same
+    null→0 rule, same 31·h combine, same % P."""
+
+    @pytest.mark.parametrize("nparts", [1, 7, 16])
+    def test_fused_matches_jnp(self, rng, nparts):
+        from cylon_tpu.ops import hash as oh
+        from cylon_tpu.ops.hash_pallas import partition_ids_fused
+
+        n = 4096 + 17  # off-block-size tail
+        k1 = jnp.asarray(
+            rng.integers(-2**31, 2**31, n, dtype=np.int64).astype(np.int32))
+        k2 = jnp.asarray(rng.random(n, dtype=np.float32))
+        v2 = jnp.asarray(rng.random(n) < 0.9)
+        want = oh.partition_ids(oh.row_hash((k1, k2), (None, v2)), nparts)
+        got = partition_ids_fused((k1, k2), (None, v2), nparts,
+                                  interpret=True)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    def test_fused_int64_x64(self, rng):
+        from cylon_tpu.ops import hash as oh
+        from cylon_tpu.ops.hash_pallas import partition_ids_fused
+
+        k = jnp.asarray(rng.integers(-2**62, 2**62, 1000, dtype=np.int64))
+        want = oh.partition_ids(oh.row_hash((k,), (None,)), 8)
+        got = partition_ids_fused((k,), (None,), 8, interpret=True)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
