@@ -111,13 +111,18 @@ def main() -> None:
     run_shuffle()
     s_t = min(run_shuffle() for _ in range(reps))
 
-    # baseline: single-core pandas hash join on identical data
+    # baseline: single-core pandas hash join on identical data, measured
+    # the same way as the framework side (one warmup, min over `reps` —
+    # single-shot pd.merge timings vary ~2-3x with allocator state)
     ldf, rdf = pd.DataFrame(ldata), pd.DataFrame(rdata)
-    t0 = time.perf_counter()
-    base_out = ldf.merge(rdf, on="k", how="inner")
-    p_t = time.perf_counter() - t0
-    base_rows = len(base_out)
-    del base_out
+    base_rows = len(ldf.merge(rdf, on="k", how="inner"))  # warmup
+    p_ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        base_out = ldf.merge(rdf, on="k", how="inner")
+        p_ts.append(time.perf_counter() - t0)
+        del base_out
+    p_t = min(p_ts)
 
     # TPC-H Q3 (BASELINE config 5): framework plan vs the same query in
     # pandas, at CYLON_BENCH_TPCH_SF (0 disables).

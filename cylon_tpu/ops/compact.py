@@ -30,6 +30,37 @@ def pad_to(x: jax.Array, capacity: int, fill=0) -> jax.Array:
     return jnp.pad(x, pad_width, constant_values=fill)
 
 
+def update_size_hint(hints: dict, key, need: tuple,
+                     shrink_after: int = 3) -> None:
+    """Grow-fast / shrink-slow policy for optimistic-dispatch size hints
+    (``need`` is a tuple of size classes, compared component-wise).
+
+    Growing immediately is mandatory (an undersized hint forces a redo
+    every call); shrinking only after ``shrink_after`` consecutive smaller
+    observations keeps alternating small/large workloads from paying a
+    wasted full dispatch on every large call.
+    """
+    cur = hints.get(key)
+    if cur is None:
+        hints[key] = (tuple(need), 0)
+        return
+    cv = cur[0]
+    if any(n > c for n, c in zip(need, cv)):
+        hints[key] = (tuple(max(n, c) for n, c in zip(need, cv)), 0)
+        return
+    if tuple(need) == cv:
+        hints[key] = (cv, 0)
+        return
+    streak = cur[1] + 1
+    hints[key] = ((tuple(need), 0) if streak >= shrink_after
+                  else (cv, streak))
+
+
+def hint_value(hints: dict, key):
+    cur = hints.get(key)
+    return None if cur is None else cur[0]
+
+
 def next_bucket(n: int, minimum: int = 1024) -> int:
     """Round a dynamic size up to a quarter-step size-class bucket
     (2^k · {4,5,6,7}/4 — ≤25% padding overhead vs ≤100% for pure powers

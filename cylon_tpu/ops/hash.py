@@ -18,8 +18,10 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# plain ints, cast at use: jnp constants at module scope would be captured
+# consts inside the Pallas kernel that reuses these helpers (hash_pallas.py)
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
 
 
 def _rotl32(x, r: int):
@@ -36,9 +38,9 @@ def _fmix32(h):
 
 
 def _mix_block(h, k):
-    k = k * _C1
+    k = k * jnp.uint32(_C1)
     k = _rotl32(k, 15)
-    k = k * _C2
+    k = k * jnp.uint32(_C2)
     h = h ^ k
     h = _rotl32(h, 13)
     return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)

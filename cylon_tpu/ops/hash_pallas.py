@@ -33,18 +33,13 @@ from . import hash as jnp_hash
 _BLOCK = 64 * 1024  # rows per grid step: 256 KiB of uint32 per operand
 
 
-def _mix_words(h, k):
-    k = k * jnp.uint32(0xCC9E2D51)
-    k = (k << jnp.uint32(15)) | (k >> jnp.uint32(17))
-    k = k * jnp.uint32(0x1B873593)
-    h = h ^ k
-    h = (h << jnp.uint32(13)) | (h >> jnp.uint32(19))
-    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
-
-
 def _kernel(nwords: Tuple[int, ...], has_valid: Tuple[bool, ...],
             nparts: int, *refs):
-    """refs = [word refs per column..., validity refs per column..., out]."""
+    """refs = [word refs per column..., validity refs per column..., out].
+
+    The mix/finalize steps are the jnp reference helpers themselves
+    (ops/hash.py _mix_block/_fmix32 — plain jnp ops, valid inside a Pallas
+    kernel), so backend parity can't drift."""
     out_ref = refs[-1]
     word_refs = refs[:sum(nwords)]
     valid_refs = refs[sum(nwords):-1]
@@ -54,14 +49,9 @@ def _kernel(nwords: Tuple[int, ...], has_valid: Tuple[bool, ...],
     for ci, nw in enumerate(nwords):
         h = jnp.zeros(out_ref.shape, jnp.uint32)
         for _ in range(nw):
-            h = _mix_words(h, word_refs[wi][:])
+            h = jnp_hash._mix_block(h, word_refs[wi][:])
             wi += 1
-        h = h ^ jnp.uint32(4 * nw)
-        h = h ^ (h >> jnp.uint32(16))
-        h = h * jnp.uint32(0x85EBCA6B)
-        h = h ^ (h >> jnp.uint32(13))
-        h = h * jnp.uint32(0xC2B2AE35)
-        h = h ^ (h >> jnp.uint32(16))
+        h = jnp_hash._fmix32(h ^ jnp.uint32(4 * nw))
         if has_valid[ci]:
             h = jnp.where(valid_refs[vi][:] != 0, h, jnp.uint32(0))
             vi += 1

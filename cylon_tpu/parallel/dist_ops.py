@@ -284,6 +284,14 @@ def _copartition(dt: DTable, key_i: int, alg: str,
         return _shuffle_by_pids(dt, pid)
 
 
+# Last bucketed output capacity per join signature: lets the next identical
+# join dispatch phase 2 optimistically BEFORE the host reads the counts, so
+# the count sync overlaps device work instead of stalling dispatch (one
+# host round trip per join in steady state).  Bounded: keyed by the
+# size-class caps + join kind.
+_capacity_hints: dict = {}
+
+
 def _join_copartitioned(lsh: DTable, rsh: DTable, li_key: int, ri_key: int,
                         how: str, alg: str) -> DTable:
     """Masked local join of already co-partitioned sides (dist_join's tail)."""
@@ -294,24 +302,38 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_key: int, ri_key: int,
         l_rank, r_rank, cnts = _join_phase1_fn(mesh, axis, how, alg)(
             lsh.counts, rsh.counts, (lkc.data,), (lkc.validity,),
             (rkc.data,), (rkc.validity,))
-        per_shard = np.asarray(jax.device_get(cnts))
-    capacity = ops_compact.next_bucket(max(int(per_shard.max(initial=0)), 1),
-                                       minimum=8)
-    trace.count("join.out_rows", int(per_shard.sum()))
-    from .. import logging as glog
-    glog.vlog(1, "dist_join[%s/%s]: out=%d rows, shard max=%d, cap=%d",
-              how, alg, int(per_shard.sum()), int(per_shard.max(initial=0)),
-              capacity)
 
     fill_left = how in ("right", "full_outer")
     fill_right = how in ("left", "full_outer")
     l_leaves = tuple((c.data, c.validity) for c in lsh.columns)
     r_leaves = tuple((c.data, c.validity) for c in rsh.columns)
-    with trace.span_sync("join.gather") as sp:
-        louts, routs, counts = _join_phase2_fn(
-            mesh, axis, how, alg, capacity, fill_left, fill_right)(
+    hint_key = (mesh, lsh.cap, rsh.cap, how, alg)
+    hinted = ops_compact.hint_value(_capacity_hints, hint_key)
+    hint = None if hinted is None else hinted[0]
+
+    def phase2(cap: int):
+        return _join_phase2_fn(mesh, axis, how, alg, cap,
+                               fill_left, fill_right)(
             lsh.counts, rsh.counts, l_rank, r_rank, l_leaves, r_leaves)
+
+    with trace.span_sync("join.gather") as sp:
+        if hint is not None:
+            louts, routs, counts = phase2(hint)  # optimistic dispatch
+        per_shard = np.asarray(jax.device_get(cnts))
+        need = ops_compact.next_bucket(
+            max(int(per_shard.max(initial=0)), 1), minimum=8)
+        if hint is None or need > hint:
+            louts, routs, counts = phase2(need)  # miss or overflow
+            capacity = need
+        else:
+            capacity = hint
         sp.sync((louts, routs))
+    ops_compact.update_size_hint(_capacity_hints, hint_key, (need,))
+    trace.count("join.out_rows", int(per_shard.sum()))
+    from .. import logging as glog
+    glog.vlog(1, "dist_join[%s/%s]: out=%d rows, shard max=%d, cap=%d",
+              how, alg, int(per_shard.sum()), int(per_shard.max(initial=0)),
+              capacity)
 
     cols = [DColumn("lt-" + c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
             for c, (d, v) in zip(lsh.columns, louts)]

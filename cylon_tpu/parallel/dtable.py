@@ -252,12 +252,23 @@ class DTable:
              for i, t in enumerate(takes)]) if sum(takes) else \
             np.empty((0,), idt)
         idx = jnp.asarray(idx_host)
-        cols: List[Column] = []
+        # dispatch every compaction first, then ONE batched host transfer
+        # (per-column device_get would pay a round trip per array)
+        pulls = []
         for c in self.columns:
-            data = jnp.asarray(jax.device_get(_export_take(c.data, idx)))
-            validity = (None if c.validity is None else
-                        jnp.asarray(jax.device_get(
-                            _export_take(c.validity, idx))))
+            pulls.append(_export_take(c.data, idx))
+            if c.validity is not None:
+                pulls.append(_export_take(c.validity, idx))
+        hosts = jax.device_get(pulls)
+        cols: List[Column] = []
+        hi = 0
+        for c in self.columns:
+            data = jnp.asarray(hosts[hi])
+            hi += 1
+            validity = None
+            if c.validity is not None:
+                validity = jnp.asarray(hosts[hi])
+                hi += 1
             cols.append(Column(c.name, c.dtype, data, validity,
                                dictionary=c.dictionary, arrow_type=c.arrow_type))
         return Table(self.ctx, cols)

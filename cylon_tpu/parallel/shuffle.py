@@ -44,6 +44,13 @@ def _bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
     return mask.reshape(mask.shape + (1,) * (like.ndim - mask.ndim))
 
 
+# Last (send block, receive capacity) per shuffle signature — lets the next
+# same-shaped shuffle dispatch the exchange before the host has read the
+# count matrix (the count sync then overlaps device work).  Validated after
+# the fact; undersized hints re-run with correct sizes.
+_block_hints: dict = {}
+
+
 @functools.lru_cache(maxsize=None)
 def _counts_fn(mesh, axis: str, nparts: int):
     """pid [P*cap] → counts [P, P]; counts[s, t] = rows sender s has for t."""
@@ -115,17 +122,32 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
     HashPartition+split+AllToAll+concat pipeline is phase1+phase2.
     """
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
+    hint_key = (mesh, Pn, pid.shape[0])
+    hint = ops_compact.hint_value(_block_hints, hint_key)
     with trace.span("shuffle.counts"):
-        counts = np.asarray(jax.device_get(_counts_fn(mesh, axis, Pn)(pid)))
-    block = ops_compact.next_bucket(max(int(counts.max(initial=0)), 1),
-                                    minimum=8)
-    per_recv = counts.sum(axis=0)
-    outcap = ops_compact.next_bucket(max(int(per_recv.max(initial=0)), 1),
-                                     minimum=8)
+        cnt_dev = _counts_fn(mesh, axis, Pn)(pid)  # async dispatch
+    with trace.span_sync("shuffle.exchange") as sp:
+        if hint is not None:
+            # optimistic: exchange at the last-seen block sizes while the
+            # host is still waiting for the count matrix
+            newcounts, outs = _exchange_fn(mesh, axis, Pn, *hint)(
+                pid, tuple(leaves))
+        counts = np.asarray(jax.device_get(cnt_dev))
+        block = ops_compact.next_bucket(max(int(counts.max(initial=0)), 1),
+                                        minimum=8)
+        per_recv = counts.sum(axis=0)
+        outcap = ops_compact.next_bucket(
+            max(int(per_recv.max(initial=0)), 1), minimum=8)
+        if hint is None or block > hint[0] or outcap > hint[1]:
+            # miss or overflow (a hinted block too small would TRUNCATE
+            # sends — the validation above is what makes the optimism safe)
+            newcounts, outs = _exchange_fn(mesh, axis, Pn, block, outcap)(
+                pid, tuple(leaves))
+            used_outcap = outcap
+        else:
+            used_outcap = hint[1]
+        sp.sync(outs)
+    ops_compact.update_size_hint(_block_hints, hint_key, (block, outcap))
     trace.count("shuffle.rows_sent",
                 int(counts.sum() - np.trace(counts)))
-    with trace.span_sync("shuffle.exchange") as sp:
-        newcounts, outs = _exchange_fn(mesh, axis, Pn, block, outcap)(
-            pid, tuple(leaves))
-        sp.sync(outs)
-    return list(outs), newcounts, outcap
+    return list(outs), newcounts, used_outcap

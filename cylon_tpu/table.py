@@ -345,13 +345,19 @@ class Table:
         return Table(self.ctx, [replace(c, name=n)
                                 for c, n in zip(self.columns, names)])
 
-    def show(self, row1: int = 0, row2: Optional[int] = None,
-             col1: int = 0, col2: Optional[int] = None) -> None:
-        """Print a window of the table (reference: table_api.cpp Print*)."""
+    def to_string(self, row1: int = 0, row2: Optional[int] = None,
+                  col1: int = 0, col2: Optional[int] = None) -> str:
+        """A window of the table, formatted (reference: table_api.cpp
+        PrintToOStream — the misc-util stringify behind Print/WriteCSV)."""
         df = self.to_pandas()
         row2 = df.shape[0] if row2 is None else row2
         col2 = df.shape[1] if col2 is None else col2
-        print(df.iloc[row1:row2, col1:col2].to_string(index=False))
+        return df.iloc[row1:row2, col1:col2].to_string(index=False)
+
+    def show(self, row1: int = 0, row2: Optional[int] = None,
+             col1: int = 0, col2: Optional[int] = None) -> None:
+        """Print a window of the table (reference: table_api.cpp Print*)."""
+        print(self.to_string(row1, row2, col1, col2))
 
     def __repr__(self) -> str:
         cols = ", ".join(f"{c.name}:{c.dtype.type.name}" for c in self.columns)

@@ -363,3 +363,51 @@ def test_dist_join_streaming_oracle(dctx, rng):
     cfg = JoinConfig.InnerJoin(0, 0, algorithm=JoinAlgorithm.SORT)
     got = dist_join_streaming(lt, rt, cfg, chunks=5).to_table().to_pandas()
     assert_same_rows(got, oracle_join(ldf, rdf, "k", "k", "inner"))
+
+
+def test_capacity_hint_overflow_redo(dctx):
+    """Optimistic phase-2 dispatch must redo when a same-shaped join
+    produces a larger output than the hinted capacity (and also when it
+    shrinks, the result must stay correct)."""
+    import cylon_tpu.parallel.dist_ops as dops
+
+    def run(dup):
+        n = 64
+        ldf = pd.DataFrame({"k": np.repeat(np.arange(n // dup, dtype=np.int64),
+                                           dup)[:n],
+                            "v": np.arange(n, dtype=np.float64)})
+        rdf = pd.DataFrame({"k": ldf["k"].to_numpy().copy(),
+                            "w": np.arange(n, dtype=np.float64)})
+        lt = dtable_from_pandas(dctx, ldf)
+        rt = dtable_from_pandas(dctx, rdf)
+        got = dist_join(lt, rt, JoinConfig.InnerJoin(0, 0)).to_table() \
+            .to_pandas()
+        assert_same_rows(got, oracle_join(ldf, rdf, "k", "k", "inner"))
+
+    dops._capacity_hints.clear()
+    run(1)    # small output seeds the hint
+    run(8)    # 8x duplicate keys: output overflows the hint -> redo path
+    run(1)    # shrink back: hint larger than needed, result still exact
+
+
+def test_shuffle_hint_overflow_redo(dctx, rng):
+    """A same-shaped shuffle with worse skew must not truncate sends when
+    the hinted block is too small."""
+    from cylon_tpu.parallel import shuffle as shmod
+    from cylon_tpu.parallel import shuffle_table
+
+    def run(skewed):
+        n = 256
+        if skewed:  # every row hashes to one shard's key
+            k = np.zeros(n, dtype=np.int64)
+        else:
+            k = rng.integers(0, 1000, n)
+        df = pd.DataFrame({"k": k, "v": np.arange(n, dtype=np.float64)})
+        dt = dtable_from_pandas(dctx, df)
+        sh = shuffle_table(dt, [0]).to_table().to_pandas()
+        assert_same_rows(sh, df)
+
+    shmod._block_hints.clear()
+    run(False)   # balanced shuffle seeds the hint
+    run(True)    # all rows to one shard: block/outcap overflow -> redo
+    run(False)

@@ -245,3 +245,22 @@ def test_join_on_multi_column_with_nulls_and_strings(ctx):
                       left_on=["lt-k1", "lt-k2"],
                       right_on=["rt-k1", "rt-k2"], how="inner")
     assert_same_rows(ours, oracle)
+
+
+def test_update_size_hint_policy():
+    """Grow-fast / shrink-slow: growth is immediate (componentwise max),
+    shrink only after 3 consecutive smaller observations."""
+    from cylon_tpu.ops.compact import hint_value, update_size_hint
+
+    h = {}
+    update_size_hint(h, "k", (64, 128))
+    assert hint_value(h, "k") == (64, 128)
+    update_size_hint(h, "k", (256, 64))   # grow one comp -> max both
+    assert hint_value(h, "k") == (256, 128)
+    for _ in range(2):
+        update_size_hint(h, "k", (64, 64))
+        assert hint_value(h, "k") == (256, 128)  # not yet
+    update_size_hint(h, "k", (64, 64))    # third consecutive -> shrink
+    assert hint_value(h, "k") == (64, 64)
+    update_size_hint(h, "k", (64, 64))    # equal resets nothing
+    assert hint_value(h, "k") == (64, 64)
