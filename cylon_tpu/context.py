@@ -69,6 +69,31 @@ class CylonContext:
     def InitDistributed(config: Any = "tpu") -> "CylonContext":
         return CylonContext(config if config is not None else "tpu")
 
+    @staticmethod
+    def InitMultiHost(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None) -> "CylonContext":
+        """Multi-host (DCN / multi-slice) initialization.
+
+        The mpirun-launch analogue for pods: every host process calls this
+        FIRST (it must precede any other JAX use — backend init pins the
+        device set), then gets a context whose mesh spans all hosts'
+        devices; the same shuffle interface then rides ICI within a slice
+        and DCN across slices, per SURVEY §7 hard part 5.  Arguments
+        default to the JAX coordination env vars
+        (COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID or TPU metadata).
+        reference: net/mpi/mpi_communicator.cpp:23-62 (MPI_Init).
+
+        Status: the collective paths keep their host-visible count outputs
+        replicated (all_gathered) so every controller can read them, and
+        single-process operation is tested; true multi-host runs await pod
+        hardware — export paths (``DTable.to_table``/``head``) gather
+        global rows and are meant for small results or single-host use.
+        """
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+        return CylonContext("tpu")
+
     def get_rank(self) -> int:
         """Lowest rank this controller drives.
 

@@ -61,6 +61,32 @@ def hint_value(hints: dict, key):
     return None if cur is None else cur[0]
 
 
+def optimistic_dispatch(hints: dict, key, dispatch, read_need):
+    """The optimistic two-phase pattern shared by shuffle and join:
+
+    1. if a hint exists, ``dispatch(hint_sizes)`` immediately (device work
+       starts while the host still waits on the counts);
+    2. ``read_need()`` blocks on the counts and returns the bucketed size
+       tuple actually required;
+    3. redo ``dispatch(need)`` on a miss or any undersized component —
+       this validation is what makes the optimism safe (an undersized
+       dispatch would have produced truncated output);
+    4. record the observation (grow-fast / shrink-slow).
+
+    Returns ``(result, used_sizes)``.
+    """
+    hint = hint_value(hints, key)
+    result = dispatch(hint) if hint is not None else None
+    need = tuple(read_need())
+    if hint is None or any(n > h for n, h in zip(need, hint)):
+        result = dispatch(need)
+        used = need
+    else:
+        used = hint
+    update_size_hint(hints, key, need)
+    return result, used
+
+
 def next_bucket(n: int, minimum: int = 1024) -> int:
     """Round a dynamic size up to a quarter-step size-class bucket
     (2^k · {4,5,6,7}/4 — ≤25% padding overhead vs ≤100% for pure powers

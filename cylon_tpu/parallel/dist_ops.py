@@ -196,11 +196,17 @@ def _join_phase1_fn(mesh, axis: str, how: str, alg: str):
         lr, rr = ops_join.dense_ranks(lkeys, lvalids, rkeys, rvalids,
                                       l_count=l_cnt[0], r_count=r_cnt[0])
         cnt = count_fn(lr, rr, how, l_count=l_cnt[0], r_count=r_cnt[0])
-        return lr, rr, cnt.astype(jnp.int32)[None]
+        # counts replicated (all_gather of one int per shard) so any
+        # controller process can device_get them under multi-host
+        return lr, rr, jax.lax.all_gather(cnt.astype(jnp.int32), axis)
 
     spec = P(axis)
+    # check_vma=False: the all_gathered counts are replicated, which
+    # shard_map cannot statically infer
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(spec,) * 6, out_specs=(spec,) * 3))
+                             in_specs=(spec,) * 6,
+                             out_specs=(spec, spec, P()),
+                             check_vma=False))
 
 
 @functools.lru_cache(maxsize=None)
@@ -308,27 +314,25 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_key: int, ri_key: int,
     l_leaves = tuple((c.data, c.validity) for c in lsh.columns)
     r_leaves = tuple((c.data, c.validity) for c in rsh.columns)
     hint_key = (mesh, lsh.cap, rsh.cap, how, alg)
-    hinted = ops_compact.hint_value(_capacity_hints, hint_key)
-    hint = None if hinted is None else hinted[0]
+    state = {}
 
-    def phase2(cap: int):
-        return _join_phase2_fn(mesh, axis, how, alg, cap,
+    def dispatch(sizes):
+        return _join_phase2_fn(mesh, axis, how, alg, sizes[0],
                                fill_left, fill_right)(
             lsh.counts, rsh.counts, l_rank, r_rank, l_leaves, r_leaves)
 
-    with trace.span_sync("join.gather") as sp:
-        if hint is not None:
-            louts, routs, counts = phase2(hint)  # optimistic dispatch
+    def read_need():
         per_shard = np.asarray(jax.device_get(cnts))
-        need = ops_compact.next_bucket(
-            max(int(per_shard.max(initial=0)), 1), minimum=8)
-        if hint is None or need > hint:
-            louts, routs, counts = phase2(need)  # miss or overflow
-            capacity = need
-        else:
-            capacity = hint
+        state["per_shard"] = per_shard
+        return (ops_compact.next_bucket(
+            max(int(per_shard.max(initial=0)), 1), minimum=8),)
+
+    with trace.span_sync("join.gather") as sp:
+        (louts, routs, counts), used = ops_compact.optimistic_dispatch(
+            _capacity_hints, hint_key, dispatch, read_need)
+        capacity = used[0]
         sp.sync((louts, routs))
-    ops_compact.update_size_hint(_capacity_hints, hint_key, (need,))
+    per_shard = state["per_shard"]
     trace.count("join.out_rows", int(per_shard.sum()))
     from .. import logging as glog
     glog.vlog(1, "dist_join[%s/%s]: out=%d rows, shard max=%d, cap=%d",

@@ -53,14 +53,21 @@ _block_hints: dict = {}
 
 @functools.lru_cache(maxsize=None)
 def _counts_fn(mesh, axis: str, nparts: int):
-    """pid [P*cap] → counts [P, P]; counts[s, t] = rows sender s has for t."""
+    """pid [P*cap] → counts [P, P]; counts[s, t] = rows sender s has for t.
+
+    The matrix comes back replicated (an all_gather of P ints per shard)
+    so every controller process can ``device_get`` it — a sharded count
+    output would span non-addressable devices under multi-host."""
 
     def kernel(pid_blk):
         cnt = jnp.bincount(pid_blk, length=nparts + 1)[:nparts]
-        return cnt.astype(jnp.int32)[None, :]
+        return jax.lax.all_gather(cnt.astype(jnp.int32), axis)
 
+    # check_vma=False: the all_gather makes the output replicated, which
+    # shard_map cannot statically infer
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=P(axis), out_specs=P(axis)))
+                             in_specs=P(axis), out_specs=P(),
+                             check_vma=False))
 
 
 @functools.lru_cache(maxsize=None)
@@ -123,31 +130,28 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
     """
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
     hint_key = (mesh, Pn, pid.shape[0])
-    hint = ops_compact.hint_value(_block_hints, hint_key)
     with trace.span("shuffle.counts"):
         cnt_dev = _counts_fn(mesh, axis, Pn)(pid)  # async dispatch
-    with trace.span_sync("shuffle.exchange") as sp:
-        if hint is not None:
-            # optimistic: exchange at the last-seen block sizes while the
-            # host is still waiting for the count matrix
-            newcounts, outs = _exchange_fn(mesh, axis, Pn, *hint)(
-                pid, tuple(leaves))
+    state = {}
+
+    def dispatch(sizes):
+        return _exchange_fn(mesh, axis, Pn, *sizes)(pid, tuple(leaves))
+
+    def read_need():
         counts = np.asarray(jax.device_get(cnt_dev))
-        block = ops_compact.next_bucket(max(int(counts.max(initial=0)), 1),
-                                        minimum=8)
+        state["counts"] = counts
+        block = ops_compact.next_bucket(
+            max(int(counts.max(initial=0)), 1), minimum=8)
         per_recv = counts.sum(axis=0)
         outcap = ops_compact.next_bucket(
             max(int(per_recv.max(initial=0)), 1), minimum=8)
-        if hint is None or block > hint[0] or outcap > hint[1]:
-            # miss or overflow (a hinted block too small would TRUNCATE
-            # sends — the validation above is what makes the optimism safe)
-            newcounts, outs = _exchange_fn(mesh, axis, Pn, block, outcap)(
-                pid, tuple(leaves))
-            used_outcap = outcap
-        else:
-            used_outcap = hint[1]
+        return block, outcap
+
+    with trace.span_sync("shuffle.exchange") as sp:
+        (newcounts, outs), used = ops_compact.optimistic_dispatch(
+            _block_hints, hint_key, dispatch, read_need)
         sp.sync(outs)
-    ops_compact.update_size_hint(_block_hints, hint_key, (block, outcap))
+    counts = state["counts"]
     trace.count("shuffle.rows_sent",
                 int(counts.sum() - np.trace(counts)))
-    return list(outs), newcounts, used_outcap
+    return list(outs), newcounts, used[1]

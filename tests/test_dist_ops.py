@@ -385,9 +385,18 @@ def test_capacity_hint_overflow_redo(dctx):
         assert_same_rows(got, oracle_join(ldf, rdf, "k", "k", "inner"))
 
     dops._capacity_hints.clear()
-    run(1)    # small output seeds the hint
-    run(8)    # 8x duplicate keys: output overflows the hint -> redo path
-    run(1)    # shrink back: hint larger than needed, result still exact
+    run(1)    # seeds hints
+    # force every hint far below any real need so the next join MUST take
+    # the overflow->redo branch regardless of which key it hits
+    for k in list(dops._capacity_hints):
+        dops._capacity_hints[k] = ((8,), 0)
+    run(8)    # 8x duplicate keys at a tiny hinted capacity: redo path
+    # the join run(8) performed must have grown its hint past the sabotage
+    # (an undersized hint kept silently would also fail the row assertions
+    # above with truncated output)
+    assert any(v[0][0] > 8 for v in dops._capacity_hints.values()), \
+        "overflow was not observed (no hint grew)"
+    run(1)    # shrink regime: hint larger than needed, result still exact
 
 
 def test_shuffle_hint_overflow_redo(dctx, rng):
