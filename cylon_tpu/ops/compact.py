@@ -66,25 +66,28 @@ def optimistic_dispatch(hints: dict, key, dispatch, read_need):
 
     1. if a hint exists, ``dispatch(hint_sizes)`` immediately (device work
        starts while the host still waits on the counts);
-    2. ``read_need()`` blocks on the counts and returns the bucketed size
-       tuple actually required;
+    2. ``read_need()`` blocks on the counts and returns
+       ``(bucketed size tuple actually required, payload)`` — the payload
+       carries whatever host-side byproduct the caller needs (the raw
+       count matrix / per-shard counts);
     3. redo ``dispatch(need)`` on a miss or any undersized component —
        this validation is what makes the optimism safe (an undersized
        dispatch would have produced truncated output);
     4. record the observation (grow-fast / shrink-slow).
 
-    Returns ``(result, used_sizes)``.
+    Returns ``(result, used_sizes, payload)``.
     """
     hint = hint_value(hints, key)
     result = dispatch(hint) if hint is not None else None
-    need = tuple(read_need())
+    need, payload = read_need()
+    need = tuple(need)
     if hint is None or any(n > h for n, h in zip(need, hint)):
         result = dispatch(need)
         used = need
     else:
         used = hint
     update_size_hint(hints, key, need)
-    return result, used
+    return result, used, payload
 
 
 def next_bucket(n: int, minimum: int = 1024) -> int:

@@ -314,7 +314,6 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_key: int, ri_key: int,
     l_leaves = tuple((c.data, c.validity) for c in lsh.columns)
     r_leaves = tuple((c.data, c.validity) for c in rsh.columns)
     hint_key = (mesh, lsh.cap, rsh.cap, how, alg)
-    state = {}
 
     def dispatch(sizes):
         return _join_phase2_fn(mesh, axis, how, alg, sizes[0],
@@ -323,16 +322,15 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_key: int, ri_key: int,
 
     def read_need():
         per_shard = np.asarray(jax.device_get(cnts))
-        state["per_shard"] = per_shard
         return (ops_compact.next_bucket(
-            max(int(per_shard.max(initial=0)), 1), minimum=8),)
+            max(int(per_shard.max(initial=0)), 1), minimum=8),), per_shard
 
     with trace.span_sync("join.gather") as sp:
-        (louts, routs, counts), used = ops_compact.optimistic_dispatch(
-            _capacity_hints, hint_key, dispatch, read_need)
+        (louts, routs, counts), used, per_shard = \
+            ops_compact.optimistic_dispatch(
+                _capacity_hints, hint_key, dispatch, read_need)
         capacity = used[0]
         sp.sync((louts, routs))
-    per_shard = state["per_shard"]
     trace.count("join.out_rows", int(per_shard.sum()))
     from .. import logging as glog
     glog.vlog(1, "dist_join[%s/%s]: out=%d rows, shard max=%d, cap=%d",

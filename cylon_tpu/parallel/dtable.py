@@ -131,16 +131,17 @@ class DTable:
                                           minimum=8)
         offs = np.concatenate([[0], np.cumsum(sizes)])
         cols: List[DColumn] = []
+        staged = StagedIngest(ctx)
         for c in table.columns:
-            data = _blocked_put(ctx, np.asarray(jax.device_get(c.data)),
-                                sizes, offs, cap)
+            data = staged.put(np.asarray(jax.device_get(c.data)),
+                              sizes, offs, cap)
             validity = (None if c.validity is None else
-                        _blocked_put(ctx,
-                                     np.asarray(jax.device_get(c.validity),
-                                                dtype=bool),
-                                     sizes, offs, cap))
+                        staged.put(np.asarray(jax.device_get(c.validity),
+                                              dtype=bool),
+                                   sizes, offs, cap))
             cols.append(DColumn(c.name, c.dtype, data, validity,
                                 c.dictionary, c.arrow_type))
+        staged.finish()
         counts = jax.device_put(sizes, ctx.sharding())
         return DTable(ctx, cols, cap, counts)
 
@@ -161,13 +162,15 @@ class DTable:
                                           minimum=8)
         offs = np.concatenate([[0], np.cumsum(sizes)])
         cols: List[DColumn] = []
+        staged = StagedIngest(ctx)
         for name, t, npv, mask, dictionary, ftype in \
                 host_columns_from_arrow(atable):
-            data = _blocked_put(ctx, npv, sizes, offs, cap)
+            data = staged.put(npv, sizes, offs, cap)
             validity = (None if mask is None else
-                        _blocked_put(ctx, mask.astype(bool), sizes, offs, cap))
+                        staged.put(mask.astype(bool), sizes, offs, cap))
             cols.append(DColumn(name, DataType(t), data, validity,
                                 dictionary, ftype))
+        staged.finish()
         counts = jax.device_put(sizes, ctx.sharding())
         return DTable(ctx, cols, cap, counts)
 
@@ -309,10 +312,76 @@ def _export_take(a: jax.Array, idx: jax.Array) -> jax.Array:
     return jnp.take(a, idx, axis=0)
 
 
+_ARENA_CAP = 256 << 20
+_arena = None
+
+
+class StagedIngest:
+    """One table's worth of staged H2D transfers through the native arena.
+
+    Columns bump-allocate staging blocks from the shared arena (C++
+    allocator, cylon_tpu/native/_cylon_native.cpp; numpy fallback — the
+    role the reference's MemoryPool plays on its ingest path,
+    ctx/memory_pool.hpp:25-66), every ``device_put`` stays asynchronous so
+    transfers overlap the next column's assembly, and ``finish()`` blocks
+    ONCE and resets the arena when all buffers have been read.
+
+    CPU backends can zero-copy-alias numpy buffers into device arrays, so
+    arena reuse would clobber live data there — the arena engages only
+    for real H2D targets, where ``device_put`` copies (``force_arena``
+    exists for tests on such targets; never set it on CPU).  A column
+    that doesn't fit the remaining arena space falls back to a one-off
+    allocation.
+    """
+
+    def __init__(self, ctx: CylonContext, force_arena: bool = False):
+        global _arena
+        self._ctx = ctx
+        platform = ctx.mesh.devices.flat[0].platform
+        if platform != "cpu" or force_arena:
+            if _arena is None:
+                from ..native.runtime import StagingArena
+                _arena = StagingArena(_ARENA_CAP)
+            self._arena = _arena
+        else:
+            self._arena = None
+        self._pending: List[jax.Array] = []
+
+    def _block(self, shape, dtype) -> np.ndarray:
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if self._arena is not None:
+            try:
+                buf = self._arena.allocate(nbytes)
+            except MemoryError:
+                return np.zeros(shape, dtype)
+            block = np.frombuffer(buf, dtype=dtype,
+                                  count=int(np.prod(shape))).reshape(shape)
+            block[:] = 0
+            return block
+        return np.zeros(shape, dtype)
+
+    def put(self, host: np.ndarray, sizes: np.ndarray, offs: np.ndarray,
+            cap: int) -> jax.Array:
+        """Assemble one column's padded shard blocks; async transfer."""
+        Pn = len(sizes)
+        block = self._block((Pn * cap,) + host.shape[1:], host.dtype)
+        for i in range(Pn):
+            block[i * cap:i * cap + sizes[i]] = host[offs[i]:offs[i + 1]]
+        out = jax.device_put(block, self._ctx.sharding())
+        self._pending.append(out)
+        return out
+
+    def finish(self) -> None:
+        if self._arena is not None and self._pending:
+            jax.block_until_ready(self._pending)  # buffers all consumed
+            self._arena.reset()
+        self._pending = []
+
+
 def _blocked_put(ctx: CylonContext, host: np.ndarray, sizes: np.ndarray,
                  offs: np.ndarray, cap: int) -> jax.Array:
-    Pn = len(sizes)
-    block = np.zeros((Pn * cap,) + host.shape[1:], host.dtype)
-    for i in range(Pn):
-        block[i * cap:i * cap + sizes[i]] = host[offs[i]:offs[i + 1]]
-    return jax.device_put(block, ctx.sharding())
+    """One-column convenience wrapper over StagedIngest."""
+    staged = StagedIngest(ctx)
+    out = staged.put(host, sizes, offs, cap)
+    staged.finish()
+    return out

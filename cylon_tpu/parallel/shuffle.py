@@ -132,26 +132,23 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
     hint_key = (mesh, Pn, pid.shape[0])
     with trace.span("shuffle.counts"):
         cnt_dev = _counts_fn(mesh, axis, Pn)(pid)  # async dispatch
-    state = {}
 
     def dispatch(sizes):
         return _exchange_fn(mesh, axis, Pn, *sizes)(pid, tuple(leaves))
 
     def read_need():
         counts = np.asarray(jax.device_get(cnt_dev))
-        state["counts"] = counts
         block = ops_compact.next_bucket(
             max(int(counts.max(initial=0)), 1), minimum=8)
         per_recv = counts.sum(axis=0)
         outcap = ops_compact.next_bucket(
             max(int(per_recv.max(initial=0)), 1), minimum=8)
-        return block, outcap
+        return (block, outcap), counts
 
     with trace.span_sync("shuffle.exchange") as sp:
-        (newcounts, outs), used = ops_compact.optimistic_dispatch(
+        (newcounts, outs), used, counts = ops_compact.optimistic_dispatch(
             _block_hints, hint_key, dispatch, read_need)
         sp.sync(outs)
-    counts = state["counts"]
     trace.count("shuffle.rows_sent",
                 int(counts.sum() - np.trace(counts)))
     return list(outs), newcounts, used[1]

@@ -264,3 +264,32 @@ def test_update_size_hint_policy():
     assert hint_value(h, "k") == (64, 64)
     update_size_hint(h, "k", (64, 64))    # equal resets nothing
     assert hint_value(h, "k") == (64, 64)
+
+
+def test_optimistic_dispatch_semantics():
+    """The hint/validate/redo core: an undersized hint MUST redo; an
+    adequate hint must not; payload passes through."""
+    from cylon_tpu.ops.compact import optimistic_dispatch
+
+    calls = []
+
+    def dispatch(sizes):
+        calls.append(tuple(sizes))
+        return f"result@{sizes}"
+
+    hints = {}
+    # miss: no optimistic dispatch, one sized dispatch
+    r, used, pay = optimistic_dispatch(
+        hints, "k", dispatch, lambda: ((64,), "p0"))
+    assert calls == [(64,)] and used == (64,) and pay == "p0"
+    # hit, adequate: one optimistic dispatch, NO redo
+    calls.clear()
+    r, used, pay = optimistic_dispatch(
+        hints, "k", dispatch, lambda: ((32,), "p1"))
+    assert calls == [(64,)] and used == (64,)
+    # hit, undersized: optimistic dispatch then mandatory redo at need
+    calls.clear()
+    r, used, pay = optimistic_dispatch(
+        hints, "k", dispatch, lambda: ((128,), "p2"))
+    assert calls == [(64,), (128,)], "undersized hint did not redo"
+    assert used == (128,) and r == "result@(128,)"
