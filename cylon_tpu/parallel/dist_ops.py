@@ -218,10 +218,10 @@ def _join_phase2_fn(mesh, axis: str, how: str, alg: str, capacity: int,
     def kernel(l_cnt, r_cnt, l_rank, r_rank, l_leaves, r_leaves):
         li, ri, cnt = idx_fn(l_rank, r_rank, how, capacity,
                              l_count=l_cnt[0], r_count=r_cnt[0])
-        louts = tuple(ops_gather.take(d, v, li, fill_null=fill_left)
-                      for d, v in l_leaves)
-        routs = tuple(ops_gather.take(d, v, ri, fill_null=fill_right)
-                      for d, v in r_leaves)
+        louts = tuple(ops_gather.take_many(l_leaves, li,
+                                           fill_null=fill_left))
+        routs = tuple(ops_gather.take_many(r_leaves, ri,
+                                           fill_null=fill_right))
         return louts, routs, cnt[None]
 
     spec = P(axis)

@@ -19,6 +19,7 @@ dictionaries onto a shared one at ingest.
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, replace
 from typing import Any, List, Optional, Sequence, Union
 
@@ -132,16 +133,18 @@ class DTable:
         offs = np.concatenate([[0], np.cumsum(sizes)])
         cols: List[DColumn] = []
         staged = StagedIngest(ctx)
-        for c in table.columns:
-            data = staged.put(np.asarray(jax.device_get(c.data)),
-                              sizes, offs, cap)
-            validity = (None if c.validity is None else
-                        staged.put(np.asarray(jax.device_get(c.validity),
-                                              dtype=bool),
-                                   sizes, offs, cap))
-            cols.append(DColumn(c.name, c.dtype, data, validity,
-                                c.dictionary, c.arrow_type))
-        staged.finish()
+        try:
+            for c in table.columns:
+                data = staged.put(np.asarray(jax.device_get(c.data)),
+                                  sizes, offs, cap)
+                validity = (None if c.validity is None else
+                            staged.put(np.asarray(jax.device_get(c.validity),
+                                                  dtype=bool),
+                                       sizes, offs, cap))
+                cols.append(DColumn(c.name, c.dtype, data, validity,
+                                    c.dictionary, c.arrow_type))
+        finally:
+            staged.finish()
         counts = jax.device_put(sizes, ctx.sharding())
         return DTable(ctx, cols, cap, counts)
 
@@ -163,14 +166,16 @@ class DTable:
         offs = np.concatenate([[0], np.cumsum(sizes)])
         cols: List[DColumn] = []
         staged = StagedIngest(ctx)
-        for name, t, npv, mask, dictionary, ftype in \
-                host_columns_from_arrow(atable):
-            data = staged.put(npv, sizes, offs, cap)
-            validity = (None if mask is None else
-                        staged.put(mask.astype(bool), sizes, offs, cap))
-            cols.append(DColumn(name, DataType(t), data, validity,
-                                dictionary, ftype))
-        staged.finish()
+        try:
+            for name, t, npv, mask, dictionary, ftype in \
+                    host_columns_from_arrow(atable):
+                data = staged.put(npv, sizes, offs, cap)
+                validity = (None if mask is None else
+                            staged.put(mask.astype(bool), sizes, offs, cap))
+                cols.append(DColumn(name, DataType(t), data, validity,
+                                    dictionary, ftype))
+        finally:
+            staged.finish()
         counts = jax.device_put(sizes, ctx.sharding())
         return DTable(ctx, cols, cap, counts)
 
@@ -314,6 +319,7 @@ def _export_take(a: jax.Array, idx: jax.Array) -> jax.Array:
 
 _ARENA_CAP = 256 << 20
 _arena = None
+_arena_lock = threading.Lock()
 
 
 class StagedIngest:
@@ -337,12 +343,19 @@ class StagedIngest:
     def __init__(self, ctx: CylonContext, force_arena: bool = False):
         global _arena
         self._ctx = ctx
+        self._owns_arena = False
         platform = ctx.mesh.devices.flat[0].platform
         if platform != "cpu" or force_arena:
-            if _arena is None:
-                from ..native.runtime import StagingArena
-                _arena = StagingArena(_ARENA_CAP)
-            self._arena = _arena
+            # exclusive ownership: a second concurrent ingest must not
+            # reset the arena under the first one's in-flight transfers
+            if _arena_lock.acquire(blocking=False):
+                self._owns_arena = True
+                if _arena is None:
+                    from ..native.runtime import StagingArena
+                    _arena = StagingArena(_ARENA_CAP)
+                self._arena = _arena
+            else:
+                self._arena = None
         else:
             self._arena = None
         self._pending: List[jax.Array] = []
@@ -372,16 +385,15 @@ class StagedIngest:
         return out
 
     def finish(self) -> None:
-        if self._arena is not None and self._pending:
-            jax.block_until_ready(self._pending)  # buffers all consumed
-            self._arena.reset()
-        self._pending = []
-
-
-def _blocked_put(ctx: CylonContext, host: np.ndarray, sizes: np.ndarray,
-                 offs: np.ndarray, cap: int) -> jax.Array:
-    """One-column convenience wrapper over StagedIngest."""
-    staged = StagedIngest(ctx)
-    out = staged.put(host, sizes, offs, cap)
-    staged.finish()
-    return out
+        """Block on outstanding transfers, reset + release the arena.
+        Idempotent; callers run it in a ``finally``."""
+        try:
+            if self._arena is not None and self._pending:
+                jax.block_until_ready(self._pending)  # buffers consumed
+                self._arena.reset()
+        finally:
+            self._pending = []
+            self._arena = None
+            if self._owns_arena:
+                self._owns_arena = False
+                _arena_lock.release()

@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import trace
 from ..ops import compact as ops_compact
+from ..ops import gather as ops_gather
 
 
 def _bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
@@ -98,17 +99,33 @@ def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
         newcount = jnp.sum(rcnt).astype(jnp.int32)
         keep = jnp.arange(outcap, dtype=jnp.int32) < newcount
 
-        outs = []
-        for leaf in leaves:
-            as_bool = leaf.dtype == jnp.bool_
-            x = leaf.astype(jnp.uint8) if as_bool else leaf
-            S = jnp.take(x, send_idx, axis=0)         # [P, block, ...]
-            S = jnp.where(_bcast(valid_send, S), S, jnp.zeros((), S.dtype))
-            R = jax.lax.all_to_all(S, axis, 0, 0, tiled=True)
-            flat = R.reshape((nparts * block,) + R.shape[2:])
-            C = jnp.take(flat, vidx, axis=0)
-            C = jnp.where(_bcast(keep, C), C, jnp.zeros((), C.dtype))
-            outs.append(C.astype(jnp.bool_) if as_bool else C)
+        outs = [None] * len(leaves)
+        if all(lf.ndim == 1 for lf in leaves):
+            # width-classed wide path: one gather + ONE all_to_all + one
+            # compaction per byte-width group instead of per column
+            for M, positions, dtypes in ops_gather.pack_columns(leaves):
+                S = jnp.take(M, send_idx, axis=0)       # [P, block, C]
+                S = jnp.where(valid_send[:, :, None], S,
+                              jnp.zeros((), S.dtype))
+                R = jax.lax.all_to_all(S, axis, 0, 0, tiled=True)
+                flat = R.reshape((nparts * block, R.shape[2]))
+                C = jnp.take(flat, vidx, axis=0)
+                C = jnp.where(keep[:, None], C, jnp.zeros((), C.dtype))
+                for col, pos in zip(ops_gather.unpack_columns(C, dtypes),
+                                    positions):
+                    outs[pos] = col
+        else:  # trailing-dim leaves: per-leaf path
+            for pos, leaf in enumerate(leaves):
+                as_bool = leaf.dtype == jnp.bool_
+                x = leaf.astype(jnp.uint8) if as_bool else leaf
+                S = jnp.take(x, send_idx, axis=0)       # [P, block, ...]
+                S = jnp.where(_bcast(valid_send, S), S,
+                              jnp.zeros((), S.dtype))
+                R = jax.lax.all_to_all(S, axis, 0, 0, tiled=True)
+                flat = R.reshape((nparts * block,) + R.shape[2:])
+                C = jnp.take(flat, vidx, axis=0)
+                C = jnp.where(_bcast(keep, C), C, jnp.zeros((), C.dtype))
+                outs[pos] = C.astype(jnp.bool_) if as_bool else C
         return newcount[None], tuple(outs)
 
     f = shard_map(kernel, mesh=mesh,

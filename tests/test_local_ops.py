@@ -293,3 +293,31 @@ def test_optimistic_dispatch_semantics():
         hints, "k", dispatch, lambda: ((128,), "p2"))
     assert calls == [(64,), (128,)], "undersized hint did not redo"
     assert used == (128,) and r == "result@(128,)"
+
+
+def test_take_many_matches_take_with_nulls():
+    """take_many must match per-column take exactly — including zeroing
+    data under the combined validity (canonical zeros under nulls are what
+    set-op row equality keys on)."""
+    import jax.numpy as jnp
+    from cylon_tpu.ops.gather import take, take_many
+
+    rng = np.random.default_rng(3)
+    n = 100
+    leaves = []
+    for dt in (np.int32, np.float32, np.float64, np.int64):
+        d = jnp.asarray(rng.integers(1, 1000, n).astype(dt))
+        v = jnp.asarray(rng.random(n) < 0.8)
+        leaves.append((d, v))
+    leaves.append((jnp.asarray(rng.random(n) < 0.5), None))  # bool, no nulls
+    idx = jnp.asarray(np.concatenate([
+        rng.integers(0, n, 40), np.full(10, -1)]).astype(np.int32))
+    for fill in (False, True):
+        wide = take_many(leaves, idx, fill_null=fill)
+        for (d, v), (wd, wv) in zip(leaves, wide):
+            sd, sv = take(d, v, idx, fill_null=fill)
+            np.testing.assert_array_equal(np.asarray(sd), np.asarray(wd))
+            if sv is None:
+                assert wv is None
+            else:
+                np.testing.assert_array_equal(np.asarray(sv), np.asarray(wv))
