@@ -368,8 +368,8 @@ def _setop_fn(mesh, axis: str, op: str, cap_a: int, cap_b: int,
                                       jnp.arange(cap_b) < b_cnt[0]])
         idx, count = ops_setops.set_op_indices(tuple(cols), tuple(vals),
                                                cap_a, op, valid=valid_rows)
-        outs = tuple(ops_gather.take(c, v, idx, fill_null=False)
-                     for c, v in zip(cols, vals))
+        outs = tuple(ops_gather.take_many(list(zip(cols, vals)), idx,
+                                          fill_null=False))
         return outs, count[None]
 
     spec = P(axis)
@@ -429,8 +429,8 @@ def _groupby_fn(mesh, axis: str, cap: int, aggs: Tuple[str, ...]):
         row_valid = jnp.arange(cap) < cnt[0]
         key_idx, outs, out_valids, ngroups = ops_groupby.groupby_aggregate(
             kcols, kvals, vcols, vvals, aggs, row_valid=row_valid)
-        keys_out = tuple(ops_gather.take(d, v, key_idx, fill_null=False)
-                         for d, v in key_leaves)
+        keys_out = tuple(ops_gather.take_many(key_leaves, key_idx,
+                                              fill_null=False))
         return keys_out, outs, out_valids, ngroups[None]
 
     spec = P(axis)
@@ -651,8 +651,7 @@ def dist_select(dt: DTable, predicate) -> DTable:
                 if n in env.accessed - env.null_handled and v is not None:
                     mask = mask & v
             idx, count = ops_compact.mask_to_indices(mask, cap)
-            outs = tuple(ops_gather.take(d, v, idx, fill_null=False)
-                         for d, v in leaves)
+            outs = tuple(ops_gather.take_many(leaves, idx, fill_null=False))
             return outs, count[None].astype(jnp.int32)
 
         spec = P(axis)
@@ -713,9 +712,7 @@ def _local_sort_fn(mesh, axis: str, cap: int, ascending: bool):
     def kernel(cnt, key_leaf, leaves):
         col, validity = key_leaf
         order = ops_sort.sort_indices_masked(col, validity, cnt[0], ascending)
-        outs = tuple(ops_gather.take(d, v, order, fill_null=False)
-                     for d, v in leaves)
-        return outs
+        return tuple(ops_gather.take_many(leaves, order, fill_null=False))
 
     spec = P(axis)
     return jax.jit(shard_map(kernel, mesh=mesh,

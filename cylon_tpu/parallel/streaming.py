@@ -75,7 +75,7 @@ def _repack_fn(mesh, axis: str, caps: Tuple[int, ...], outcap: int,
         valid = jnp.concatenate([jnp.arange(ck) < cnts[k]
                                  for k, ck in enumerate(caps)])
         idx, total = ops_compact.mask_to_indices(valid, outcap)
-        outs = []
+        concat = []
         for per_chunk, hv in zip(leaves, has_v):
             data = jnp.concatenate([d for d, _ in per_chunk])
             if hv:
@@ -84,8 +84,9 @@ def _repack_fn(mesh, axis: str, caps: Tuple[int, ...], outcap: int,
                     for (_, vv), ck in zip(per_chunk, caps)])
             else:
                 v = None
-            outs.append(ops_gather.take(data, v, idx, fill_null=False))
-        return tuple(outs), total[None].astype(jnp.int32)  # outs: (d, v)
+            concat.append((data, v))
+        outs = tuple(ops_gather.take_many(concat, idx, fill_null=False))
+        return outs, total[None].astype(jnp.int32)  # outs: (d, v)
 
     spec = P(axis)
     return jax.jit(shard_map(kernel, mesh=mesh,
