@@ -50,7 +50,9 @@ class CylonContext:
             devs = list(jax.devices()) if devices is None else list(devices)
             self._distributed = True
         else:
-            raise ValueError(f"unknown backend config {config!r}")
+            from .status import Code, CylonError, Status
+            raise CylonError(Status(Code.Invalid,
+                                    f"unknown backend config {config!r}"))
         self._devices = devs
         self._mesh = Mesh(np.array(devs), (MESH_AXIS,))
         self._finalized = False
@@ -165,7 +167,10 @@ class CylonContext:
             lambda x: jax.lax.psum(x, MESH_AXIS),
             mesh=self._mesh, in_specs=P(MESH_AXIS), out_specs=P(),
         )(ones)
-        jax.block_until_ready(out)
+        # host-read the psum: a real completion barrier even on tunneled
+        # backends where block_until_ready only drains the dispatch queue
+        from . import trace
+        trace.hard_sync(out)
 
     def finalize(self) -> None:
         self._finalized = True

@@ -55,6 +55,63 @@ def _pad_sentinel(dtype):
     return jnp.array(jnp.iinfo(dtype).max, dtype)
 
 
+def _concat_key_parts(l_cols, l_valids, r_cols, r_valids, l_count, r_count):
+    """Shared key assembly for both join keying kernels: pad masks and, per
+    key column, the concatenated values with nulls collapsed to one group
+    (value zeroed under a null so all nulls compare equal, distinct from
+    every real value via the isnull flag)."""
+    n_l, n_r = l_cols[0].shape[0], r_cols[0].shape[0]
+    pad_l = (jnp.zeros(n_l, bool) if l_count is None
+             else jnp.arange(n_l) >= l_count)
+    pad_r = (jnp.zeros(n_r, bool) if r_count is None
+             else jnp.arange(n_r) >= r_count)
+    pad = jnp.concatenate([pad_l, pad_r])
+    comps = []  # (value, isnull-or-None) per key column, most significant first
+    for lc, lv, rc, rv in zip(l_cols, l_valids, r_cols, r_valids):
+        c = jnp.concatenate([lc, rc])
+        if lv is None and rv is None:
+            isnull = None
+        else:
+            nl = jnp.zeros(n_l, bool) if lv is None else ~lv
+            nr = jnp.zeros(n_r, bool) if rv is None else ~rv
+            isnull = jnp.concatenate([nl, nr])
+            # all nulls are ONE group regardless of the slot value under them
+            c = jnp.where(isnull, jnp.zeros((), c.dtype), c)
+        comps.append((c, isnull))
+    # sort-operand form: pad (most significant), then per key column its
+    # isnull flag (when nullable) followed by the null-collapsed values
+    key_ops = [pad]
+    for c, isnull in comps:
+        if isnull is not None:
+            key_ops.append(isnull)
+        key_ops.append(c)
+    return pad_l, pad_r, key_ops
+
+
+def sorted_key_structure(key_operands, n: int):
+    """ONE carried-values sort of ``key_operands`` (most significant first)
+    with the row index appended as the final sort key (stability for free).
+
+    The shared idiom of every keyed kernel here (dense_ranks,
+    sort_join_plan, groupby): keys and row ids travel through one
+    ``lax.sort`` — nothing is gathered afterwards — and group boundaries
+    come off the sorted operands by adjacent compare.
+
+    Returns ``(sorted_key_operands, idxS, is_first)``: the sorted key
+    arrays, the original row index per sorted position, and the
+    group-start flags.
+    """
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort((*key_operands, idx),
+                              num_keys=len(key_operands) + 1)
+    idxS = sorted_ops[-1]
+    one = jnp.ones((1,), bool)
+    is_first = jnp.concatenate([one, jnp.zeros(n - 1, bool)])
+    for ks in sorted_ops[:-1]:
+        is_first = is_first | jnp.concatenate([one, ks[1:] != ks[:-1]])
+    return sorted_ops[:-1], idxS, is_first
+
+
 @jax.jit
 def dense_ranks(l_cols, l_valids, r_cols, r_valids, l_count=None, r_count=None):
     """Composite join keys → dense int32 ranks comparable across both sides.
@@ -75,41 +132,11 @@ def dense_ranks(l_cols, l_valids, r_cols, r_valids, l_count=None, r_count=None):
     if n == 0:
         z = jnp.zeros((0,), jnp.int32)
         return z, z
-    pad_l = (jnp.zeros(n_l, bool) if l_count is None
-             else jnp.arange(n_l) >= l_count)
-    pad_r = (jnp.zeros(n_r, bool) if r_count is None
-             else jnp.arange(n_r) >= r_count)
-    pad = jnp.concatenate([pad_l, pad_r])
-    comps = []  # (value, isnull) per key column, most-significant first
-    for lc, lv, rc, rv in zip(l_cols, l_valids, r_cols, r_valids):
-        c = jnp.concatenate([lc, rc])
-        if lv is None and rv is None:
-            isnull = None
-        else:
-            nl = jnp.zeros(n_l, bool) if lv is None else ~lv
-            nr = jnp.zeros(n_r, bool) if rv is None else ~rv
-            isnull = jnp.concatenate([nl, nr])
-            # all nulls are ONE group regardless of the slot value under them
-            c = jnp.where(isnull, jnp.zeros((), c.dtype), c)
-        comps.append((c, isnull))
-    # jnp.lexsort: LAST key is primary ⇒ reversed significance order
-    flat = []
-    for c, isnull in reversed(comps):
-        flat.append(c)
-        if isnull is not None:
-            flat.append(isnull)
-    flat.append(pad)
-    order = jnp.lexsort(tuple(flat))
-    is_first = jnp.zeros(n, bool).at[0].set(True)
-    one = jnp.ones((1,), bool)
-    for c, isnull in comps:
-        cs = jnp.take(c, order)
-        is_first = is_first | jnp.concatenate([one, cs[1:] != cs[:-1]])
-        if isnull is not None:
-            ns = jnp.take(isnull, order)
-            is_first = is_first | jnp.concatenate([one, ns[1:] != ns[:-1]])
+    pad_l, pad_r, key_ops = _concat_key_parts(
+        l_cols, l_valids, r_cols, r_valids, l_count, r_count)
+    _, idxS, is_first = sorted_key_structure(key_ops, n)
     group_id = (jnp.cumsum(is_first) - 1).astype(jnp.int32)
-    rank = jnp.zeros(n, jnp.int32).at[order].set(group_id)
+    rank = jnp.zeros(n, jnp.int32).at[idxS].set(group_id)
     l_rank = jnp.where(pad_l, jnp.iinfo(jnp.int32).max, rank[:n_l])
     r_rank = jnp.where(pad_r, jnp.iinfo(jnp.int32).max, rank[n_l:])
     return l_rank, r_rank
@@ -229,7 +256,8 @@ def append_right_tail(j, total_lpart, unmatched_r, n_r: int, idt,
     for the hash kernel).
     """
     n_um = jnp.sum(unmatched_r.astype(idt))
-    um_pos = jnp.flatnonzero(unmatched_r, size=n_r, fill_value=0)
+    from .compact import compact_indices
+    um_pos = compact_indices(unmatched_r, n_r, fill=0)
     k = jnp.clip(j - total_lpart, 0, max(n_r - 1, 0))
     in_rpart = j >= total_lpart
     r_only = right_orig(jnp.take(um_pos, k))
@@ -283,6 +311,181 @@ def join_indices(l_key: jax.Array, r_key: jax.Array, how: str, capacity: int,
     else:
         total = total_lpart if how == LEFT else jnp.sum(cnt)
 
+    return mask_past_total(j, total, left_idx, right_idx)
+
+
+# ---------------------------------------------------------------------------
+# Fused single-sort join (the fast SORT-algorithm path)
+# ---------------------------------------------------------------------------
+#
+# ``dense_ranks`` + ``join_count``/``join_indices`` sort twice and pay
+# several 8M-row random gathers/scatters (ranks scattered back to original
+# order, then re-sorted by the match phase).  The fused path sorts ONCE —
+# keys and row ids travel together as lax.sort operands, so nothing is
+# gathered after the sort — and derives every per-row match quantity with
+# O(n) scans in sorted space:
+#
+#   plan   (probe order ls, build order rs, first-match offset lo,
+#           match count cnt [, unmatched-build mask um]) — phase 1;
+#   total  masked reductions over the plan — phase 1;
+#   expand the shared run-length machinery (expand_pairs) — phase 2.
+#
+# Measured on a v5e chip at 4M+4M rows this halves join device time vs the
+# dense-rank pipeline (reference comparison point: the sort-merge join of
+# join.cpp:26-232, whose advance() merge loop this replaces wholesale).
+
+def sort_join_plan(l_cols, l_valids, r_cols, r_valids, how: str = INNER,
+                   l_count=None, r_count=None):
+    """Phase 1 of the fused sort join: one sort + scans -> match plan.
+
+    The plan stays in SORTED space — no slot compaction (measured: XLA's
+    flatnonzero costs ~4x a scan at 8M rows) and no per-array gathers;
+    phase 2 reads everything it needs through ONE wide (packed) gather.
+    Plan tuple (probe orientation; n = n_probe + n_build):
+
+      idxS   [n]        original row index per sorted position (< n_probe
+                        ⇒ probe row, else build row at idxS - n_probe);
+      lo_p   [n]        position's first match in build order;
+      cnt_p  [n]        position's match count (build rows in its segment);
+      left_s [n]  bool  valid probe row at this position;
+      rs     [n_build]  original build-row index per build-order slot
+                        (scatter-compacted);
+      um     [n_build]  (FULL_OUTER only) unmatched-build mask in rs space.
+
+    For ``how == 'right'`` the plan is built with sides swapped (probe =
+    right); ``plan_total``/``plan_indices`` undo the swap — both receive the
+    same static ``how``, so the orientation is always consistent.
+    """
+    if how == RIGHT:
+        return sort_join_plan(r_cols, r_valids, l_cols, l_valids, LEFT,
+                              r_count, l_count)
+    n_l, n_r = l_cols[0].shape[0], r_cols[0].shape[0]
+    n = n_l + n_r
+    if n_l == 0 or n_r == 0:
+        plan = (jnp.zeros(n, jnp.int32), jnp.zeros(n, jnp.int32),
+                jnp.zeros(n, jnp.int32), jnp.zeros(n, bool),
+                jnp.zeros(n_r, jnp.int32))
+        return plan + ((jnp.zeros(n_r, bool),) if how == FULL_OUTER else ())
+    _, _, key_ops = _concat_key_parts(
+        l_cols, l_valids, r_cols, r_valids, l_count, r_count)
+    sortedK, idxS, is_first = sorted_key_structure(key_ops, n)
+    padS = sortedK[0]
+    one = jnp.ones((1,), bool)
+    valid = ~padS
+    left_s = (idxS < n_l) & valid
+    right_s = (idxS >= n_l) & valid
+    maxi = jnp.iinfo(jnp.int32).max
+    last = jnp.concatenate([is_first[1:], one])
+
+    def seg_span(member):
+        """Per sorted position: members of my key segment (total) and the
+        exclusive member count before my segment, via two scans."""
+        m32 = member.astype(jnp.int32)
+        cm = jnp.cumsum(m32)  # inclusive
+        end = jax.lax.cummin(jnp.where(last, cm, maxi), reverse=True)
+        excl = jax.lax.cummax(jnp.where(is_first, cm - m32, 0))
+        return end - excl, excl, cm
+
+    cnt_p, lo_p, cr = seg_span(right_s)
+    # build-side original ids in build order, by scatter-compaction
+    rslot = jnp.where(right_s, cr - 1, jnp.int32(n_r))
+    rs = jnp.zeros(n_r, jnp.int32).at[rslot].set(
+        idxS - jnp.int32(n_l), mode="drop")
+    plan = (idxS, lo_p, cnt_p, left_s, rs)
+    if how == FULL_OUTER:
+        l_in_seg, _, _ = seg_span(left_s)
+        um_sorted = right_s & (l_in_seg == 0)
+        um = jnp.zeros(n_r, bool).at[rslot].set(um_sorted, mode="drop")
+        plan = plan + (um,)
+    return plan
+
+
+def _plan_sizes(plan):
+    n, n_r = plan[0].shape[0], plan[4].shape[0]
+    return n - n_r, n_r
+
+
+def _plan_emit(plan, how, idt):
+    _, _, cnt_p, left_s, _ = plan[:5]
+    if how == INNER:
+        return jnp.where(left_s, cnt_p, 0).astype(idt)
+    return jnp.where(left_s, jnp.maximum(cnt_p, 1), 0).astype(idt)
+
+
+def plan_total(plan, how: str = INNER, l_count=None, r_count=None):
+    """Output row count from a ``sort_join_plan`` (phase 1's tiny transfer)."""
+    if how == RIGHT:
+        return plan_total(plan, LEFT, r_count, l_count)
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    n_l, n_r = _plan_sizes(plan)
+    if n_l == 0 or n_r == 0:
+        _, _, total = _degenerate(jnp.zeros(n_l, jnp.int32),
+                                  jnp.zeros(n_r, jnp.int32), how, 1, idt,
+                                  l_count, r_count)
+        return total.astype(idt)
+    _, _, cnt_p, left_s, _ = plan[:5]
+    total = jnp.sum(jnp.where(left_s, cnt_p, 0).astype(idt))
+    if how == INNER:
+        return total
+    left_total = total + jnp.sum(left_s & (cnt_p == 0))
+    if how == LEFT:
+        return left_total
+    if how == FULL_OUTER:
+        return left_total + jnp.sum(plan[5].astype(idt))
+    raise ValueError(f"unknown join type {how!r}")
+
+
+def plan_indices(plan, how: str, capacity: int, l_count=None, r_count=None
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Phase 2 of the fused sort join: pure run-length expansion of the plan.
+
+    Same contract as ``join_indices``: (left_idx[cap], right_idx[cap],
+    count), −1 ⇒ null-fill row.  One scatter-max + one prefix-max decode
+    the output position → sorted position map; every per-position quantity
+    (probe row id, match offset/count, run start) then arrives through a
+    single packed 4-wide gather — wide gathers cost the same as narrow
+    ones on TPU, so this is 3 gathers cheaper than reading the plan
+    arrays separately.
+    """
+    if how == RIGHT:
+        ri, li, cnt = plan_indices(plan, LEFT, capacity, r_count, l_count)
+        return li, ri, cnt
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    n_l, n_r = _plan_sizes(plan)
+    if n_l == 0 or n_r == 0:
+        return _degenerate(jnp.zeros(n_l, jnp.int32),
+                           jnp.zeros(n_r, jnp.int32), how, capacity, idt,
+                           l_count, r_count)
+    idxS, lo_p, cnt_p, left_s, rs = plan[:5]
+    n = idxS.shape[0]
+    emit = _plan_emit(plan, how, idt)
+    offs_incl = jnp.cumsum(emit)
+    total_lpart = offs_incl[-1]
+    starts_p = (offs_incl - emit).astype(jnp.int32)
+    # output-slot -> sorted-position decode: among probe positions sharing
+    # a start (a run of zero-emit rows ending at an emitter), the max
+    # position is the emitter; scatter-max + prefix-max fills the runs
+    tgt = jnp.where(left_s, jnp.minimum(starts_p, capacity), capacity)
+    scat = jnp.zeros(capacity, jnp.int32).at[tgt].max(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    pos_c = jax.lax.cummax(scat)
+    packed = jnp.stack([idxS, lo_p, cnt_p, starts_p], axis=1)
+    g = jnp.take(packed, pos_c, axis=0)      # ONE wide gather
+    j = jnp.arange(capacity, dtype=idt)
+    within = j - g[:, 3]
+    left_idx = g[:, 0]
+    r_pos = jnp.clip(g[:, 1] + within, 0, n_r - 1).astype(jnp.int32)
+    if how == INNER:
+        right_idx = jnp.take(rs, r_pos)
+    else:
+        matched = within < g[:, 2]
+        right_idx = jnp.where(matched, jnp.take(rs, r_pos), jnp.int32(-1))
+    if how == FULL_OUTER:
+        left_idx, right_idx, total = append_right_tail(
+            j, total_lpart, plan[5], n_r, idt, left_idx, right_idx,
+            right_orig=lambda pos: jnp.take(rs, pos))
+    else:
+        total = total_lpart if how == LEFT else jnp.sum(emit)
     return mask_past_total(j, total, left_idx, right_idx)
 
 

@@ -108,7 +108,8 @@ def set_op_indices(cols: Sequence[jax.Array],
         raise ValueError(f"unknown set op {op!r}")
 
     keep_row = is_first & jnp.take(keep_group, group_id)
-    pos = jnp.flatnonzero(keep_row, size=capacity, fill_value=-1)
+    from .compact import compact_indices
+    pos = compact_indices(keep_row, capacity, fill=-1)
     count = jnp.sum(keep_row).astype(jnp.int32)
     idx = jnp.where(pos >= 0,
                     jnp.take(order, jnp.clip(pos, 0, n - 1)).astype(jnp.int32),

@@ -8,6 +8,7 @@ table ops built on it (reference: cpp/src/cylon/table_api.cpp:214-352,
 rendezvous/AllToAll protocol collapses into a two-phase static-shape
 ``lax.all_to_all`` under ``shard_map`` (SURVEY.md §2.4).
 """
+from ..ops.compact import run_pipeline
 from .dtable import DColumn, DTable
 from .shuffle import shuffle_leaves
 from .dist_ops import (dist_groupby, dist_head, dist_intersect, dist_join,
@@ -19,5 +20,5 @@ __all__ = [
     "DColumn", "DTable", "shuffle_leaves", "shuffle_table",
     "dist_join", "dist_join_streaming", "dist_union", "dist_intersect",
     "dist_subtract", "dist_groupby", "dist_sort", "dist_select",
-    "dist_project", "dist_with_column", "dist_head",
+    "dist_project", "dist_with_column", "dist_head", "run_pipeline",
 ]

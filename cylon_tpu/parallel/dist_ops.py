@@ -189,35 +189,51 @@ def shuffle_table(dt: DTable, key_columns: Sequence[Union[int, str]]
 
 @functools.lru_cache(maxsize=None)
 def _join_phase1_fn(mesh, axis: str, how: str, alg: str):
-    count_fn = (ops_hashjoin.hash_join_count if alg == "hash"
-                else ops_join.join_count)
+    """Phase 1 per shard: the join "plan" + replicated output counts.
+
+    ``hash``: dense ranks (the direct-address kernel's domain), plan =
+    (l_rank, r_rank).  ``sort``: the fused single-sort plan
+    (ops/join.py sort_join_plan) — one lax.sort instead of the
+    rank/re-sort pipeline.
+    """
 
     def kernel(l_cnt, r_cnt, lkeys, lvalids, rkeys, rvalids):
-        lr, rr = ops_join.dense_ranks(lkeys, lvalids, rkeys, rvalids,
-                                      l_count=l_cnt[0], r_count=r_cnt[0])
-        cnt = count_fn(lr, rr, how, l_count=l_cnt[0], r_count=r_cnt[0])
+        if alg == "hash":
+            lr, rr = ops_join.dense_ranks(lkeys, lvalids, rkeys, rvalids,
+                                          l_count=l_cnt[0], r_count=r_cnt[0])
+            plan = (lr, rr)
+            cnt = ops_hashjoin.hash_join_count(
+                lr, rr, how, l_count=l_cnt[0], r_count=r_cnt[0])
+        else:
+            plan = ops_join.sort_join_plan(lkeys, lvalids, rkeys, rvalids,
+                                           how, l_count=l_cnt[0],
+                                           r_count=r_cnt[0])
+            cnt = ops_join.plan_total(plan, how, l_count=l_cnt[0],
+                                      r_count=r_cnt[0])
         # counts replicated (all_gather of one int per shard) so any
         # controller process can device_get them under multi-host
-        return lr, rr, jax.lax.all_gather(cnt.astype(jnp.int32), axis)
+        return plan, jax.lax.all_gather(cnt.astype(jnp.int32), axis)
 
     spec = P(axis)
     # check_vma=False: the all_gathered counts are replicated, which
     # shard_map cannot statically infer
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(spec,) * 6,
-                             out_specs=(spec, spec, P()),
+                             out_specs=(spec, P()),
                              check_vma=False))
 
 
 @functools.lru_cache(maxsize=None)
 def _join_phase2_fn(mesh, axis: str, how: str, alg: str, capacity: int,
                     fill_left: bool, fill_right: bool):
-    idx_fn = (ops_hashjoin.hash_join_indices if alg == "hash"
-              else ops_join.join_indices)
-
-    def kernel(l_cnt, r_cnt, l_rank, r_rank, l_leaves, r_leaves):
-        li, ri, cnt = idx_fn(l_rank, r_rank, how, capacity,
-                             l_count=l_cnt[0], r_count=r_cnt[0])
+    def kernel(l_cnt, r_cnt, plan, l_leaves, r_leaves):
+        if alg == "hash":
+            li, ri, cnt = ops_hashjoin.hash_join_indices(
+                plan[0], plan[1], how, capacity,
+                l_count=l_cnt[0], r_count=r_cnt[0])
+        else:
+            li, ri, cnt = ops_join.plan_indices(
+                plan, how, capacity, l_count=l_cnt[0], r_count=r_cnt[0])
         louts = tuple(ops_gather.take_many(l_leaves, li,
                                            fill_null=fill_left))
         routs = tuple(ops_gather.take_many(r_leaves, ri,
@@ -226,7 +242,7 @@ def _join_phase2_fn(mesh, axis: str, how: str, alg: str, capacity: int,
 
     spec = P(axis)
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(spec,) * 6, out_specs=(spec,) * 3))
+                             in_specs=(spec,) * 5, out_specs=(spec,) * 3))
 
 
 def dist_join(left: DTable, right: DTable, config: JoinConfig) -> DTable:
@@ -305,7 +321,7 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_key: int, ri_key: int,
     mesh, axis = ctx.mesh, ctx.axis
     lkc, rkc = lsh.columns[li_key], rsh.columns[ri_key]
     with trace.span("join.count"):
-        l_rank, r_rank, cnts = _join_phase1_fn(mesh, axis, how, alg)(
+        plan, cnts = _join_phase1_fn(mesh, axis, how, alg)(
             lsh.counts, rsh.counts, (lkc.data,), (lkc.validity,),
             (rkc.data,), (rkc.validity,))
 
@@ -318,24 +334,24 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_key: int, ri_key: int,
     def dispatch(sizes):
         return _join_phase2_fn(mesh, axis, how, alg, sizes[0],
                                fill_left, fill_right)(
-            lsh.counts, rsh.counts, l_rank, r_rank, l_leaves, r_leaves)
+            lsh.counts, rsh.counts, plan, l_leaves, r_leaves)
 
-    def read_need():
-        per_shard = np.asarray(jax.device_get(cnts))
+    def post(per_shard):
         return (ops_compact.next_bucket(
-            max(int(per_shard.max(initial=0)), 1), minimum=8),), per_shard
+            max(int(per_shard.max(initial=0)), 1), minimum=8),)
 
     with trace.span_sync("join.gather") as sp:
         (louts, routs, counts), used, per_shard = \
             ops_compact.optimistic_dispatch(
-                _capacity_hints, hint_key, dispatch, read_need)
+                _capacity_hints, hint_key, dispatch, cnts, post)
         capacity = used[0]
         sp.sync((louts, routs))
-    trace.count("join.out_rows", int(per_shard.sum()))
-    from .. import logging as glog
-    glog.vlog(1, "dist_join[%s/%s]: out=%d rows, shard max=%d, cap=%d",
-              how, alg, int(per_shard.sum()), int(per_shard.max(initial=0)),
-              capacity)
+    if per_shard is not None:  # None ⇒ deferred validation
+        trace.count("join.out_rows", int(per_shard.sum()))
+        from .. import logging as glog
+        glog.vlog(1, "dist_join[%s/%s]: out=%d rows, shard max=%d, cap=%d",
+                  how, alg, int(per_shard.sum()),
+                  int(per_shard.max(initial=0)), capacity)
 
     cols = [DColumn("lt-" + c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
             for c, (d, v) in zip(lsh.columns, louts)]
@@ -515,8 +531,8 @@ def _sample_splitters(sides: Sequence[Tuple[DTable, int]], ascending: bool
         vals, ok = _sample_fn(dt.ctx.mesh, dt.ctx.axis, dt.cap,
                               _SAMPLES_PER_SHARD, ascending)(
             dt.counts, c.data, c.validity)
-        vals = np.asarray(jax.device_get(vals))
-        ok = np.asarray(jax.device_get(ok))
+        ops_compact.flush_pending()  # samples must be validation-clean
+        vals, ok = (np.asarray(a) for a in jax.device_get((vals, ok)))
         pooled.append(vals[ok])
     sample = np.concatenate(pooled) if pooled else np.empty((0,))
     if sample.size == 0:

@@ -19,6 +19,7 @@ dictionaries onto a shared one at ingest.
 """
 from __future__ import annotations
 
+import functools as _functools
 import threading
 from dataclasses import dataclass, replace
 from typing import Any, List, Optional, Sequence, Union
@@ -77,6 +78,9 @@ class DTable:
 
     def counts_host(self) -> np.ndarray:
         if self._counts_host is None:
+            # resolve queued optimistic-capacity validations before trusting
+            # any host-visible row counts (see ops.compact.deferred_region)
+            ops_compact.flush_pending()
             self._counts_host = np.asarray(jax.device_get(self.counts))
         return self._counts_host
 
@@ -247,6 +251,7 @@ class DTable:
         ``P * cap`` — a groupby result with 4 valid rows in a multi-million
         capacity block transfers 4 rows, not the padded block.
         """
+        ops_compact.flush_pending()  # payload must be validation-clean
         # int32 gather indices unless x64 is on: jnp.asarray would silently
         # wrap int64 positions ≥ 2^31 to negative (clamping to row 0)
         if self.nparts * self.cap > np.iinfo(np.int32).max \
@@ -286,13 +291,45 @@ class DTable:
         return self._export([int(c) for c in self.counts_host()])
 
     def head(self, n: int) -> Table:
-        """First ``n`` global rows (shard-major order) as a local Table."""
-        takes, got = [], 0
-        for c in self.counts_host():
-            t = min(n - got, int(c))
-            takes.append(max(t, 0))
-            got += max(t, 0)
-        return self._export(takes)
+        """First ``n`` global rows (shard-major order) as a local Table.
+
+        Single round trip: the bounded gather runs entirely on device
+        (per-shard scatter into a replicated [n] block, combined by psum
+        over disjoint positions), and the transfer shares one batched
+        ``device_get`` with any queued capacity validations
+        (ops.compact.flush_pending_with) — the ORDER BY … LIMIT tail of a
+        pipeline costs one host read total.
+        """
+        n_eff = min(int(n), self.nparts * self.cap)
+        if n_eff <= 0:
+            return self._export([0] * self.nparts)
+        leaves = tuple((c.data, c.validity) for c in self.columns)
+        outs, got = _head_fn(self.ctx.mesh, self.ctx.axis, self.cap, n_eff,
+                             tuple(c.validity is not None
+                                   for c in self.columns))(self.counts, leaves)
+        flat: List[Any] = [got]
+        for d, v in outs:
+            flat.append(d)
+            if v is not None:
+                flat.append(v)
+        ok, vals = ops_compact.flush_pending_with(flat)
+        # inside a failed deferred region the data may be truncated garbage;
+        # run_pipeline discards this attempt and replays — still return a
+        # well-formed table so the attempt completes
+        take = int(np.asarray(vals[0]))
+        cols: List[Column] = []
+        hi = 1
+        for c in self.columns:
+            data = jnp.asarray(np.asarray(vals[hi])[:take])
+            hi += 1
+            validity = None
+            if c.validity is not None:
+                validity = jnp.asarray(np.asarray(vals[hi])[:take])
+                hi += 1
+            cols.append(Column(c.name, c.dtype, data, validity,
+                               dictionary=c.dictionary,
+                               arrow_type=c.arrow_type))
+        return Table(self.ctx, cols)
 
     def partition(self, i: int) -> Table:
         """Shard *i*'s rows as a local Table (a rank's-eye view)."""
@@ -315,6 +352,48 @@ class DTable:
 def _export_take(a: jax.Array, idx: jax.Array) -> jax.Array:
     """Device-side row compaction for export (re-traced per shape bucket)."""
     return jnp.take(a, idx, axis=0)
+
+
+@_functools.lru_cache(maxsize=None)
+def _head_fn(mesh, axis: str, cap: int, n: int, has_v):
+    """Per shard: scatter my first ``take`` rows into a replicated [n]
+    block at my global shard-major offset; shards write disjoint slots, so
+    a psum combines them.  Returns ((data, validity), …) + rows-taken."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def kernel(cnt_blk, leaves):
+        gcnts = jax.lax.all_gather(cnt_blk, axis, tiled=True)  # [P]
+        me = jax.lax.axis_index(axis)
+        before = jnp.sum(jnp.where(jnp.arange(gcnts.shape[0]) < me,
+                                   gcnts, 0)).astype(jnp.int32)
+        i = jnp.arange(cap, dtype=jnp.int32)
+        pos = before + i
+        keep = (i < cnt_blk[0]) & (pos < n)
+        tgt = jnp.where(keep, pos, jnp.int32(n))
+        outs = []
+        for (d, v), hv in zip(leaves, has_v):
+            od = jnp.zeros((n,) + d.shape[1:], d.dtype).at[tgt].set(
+                jnp.where(keep.reshape((-1,) + (1,) * (d.ndim - 1)), d,
+                          jnp.zeros((), d.dtype)), mode="drop")
+            od = jax.lax.psum(od, axis)
+            if hv:
+                vv = v if v is not None else jnp.ones(cap, bool)
+                ov = jnp.zeros((n,), jnp.uint8).at[tgt].set(
+                    jnp.where(keep, vv, False).astype(jnp.uint8),
+                    mode="drop")
+                ov = jax.lax.psum(ov, axis).astype(bool)
+            else:
+                ov = None
+            outs.append((od, ov))
+        got = jnp.minimum(jnp.sum(gcnts), n).astype(jnp.int32)
+        return tuple(outs), got
+
+    spec = P(axis)
+    # check_vma=False: psum outputs are replicated
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(spec, spec), out_specs=(P(), P()),
+                             check_vma=False))
 
 
 _ARENA_CAP = 256 << 20

@@ -95,7 +95,7 @@ def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
         rcnt = jax.lax.all_to_all(cnt, axis, 0, 0, tiled=True)  # [P]
         recv_valid = (jnp.arange(block, dtype=jnp.int32)[None, :]
                       < rcnt[:, None]).reshape(-1)    # [P*block]
-        vidx = jnp.flatnonzero(recv_valid, size=outcap, fill_value=0)
+        vidx = ops_compact.compact_indices(recv_valid, outcap, fill=0)
         newcount = jnp.sum(rcnt).astype(jnp.int32)
         keep = jnp.arange(outcap, dtype=jnp.int32) < newcount
 
@@ -153,19 +153,19 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
     def dispatch(sizes):
         return _exchange_fn(mesh, axis, Pn, *sizes)(pid, tuple(leaves))
 
-    def read_need():
-        counts = np.asarray(jax.device_get(cnt_dev))
+    def post(counts):
         block = ops_compact.next_bucket(
             max(int(counts.max(initial=0)), 1), minimum=8)
         per_recv = counts.sum(axis=0)
         outcap = ops_compact.next_bucket(
             max(int(per_recv.max(initial=0)), 1), minimum=8)
-        return (block, outcap), counts
+        return (block, outcap)
 
     with trace.span_sync("shuffle.exchange") as sp:
         (newcounts, outs), used, counts = ops_compact.optimistic_dispatch(
-            _block_hints, hint_key, dispatch, read_need)
+            _block_hints, hint_key, dispatch, cnt_dev, post)
         sp.sync(outs)
-    trace.count("shuffle.rows_sent",
-                int(counts.sum() - np.trace(counts)))
+    if counts is not None:  # None ⇒ deferred validation (no host read yet)
+        trace.count("shuffle.rows_sent",
+                    int(counts.sum() - np.trace(counts)))
     return list(outs), newcounts, used[1]

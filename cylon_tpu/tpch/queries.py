@@ -110,7 +110,12 @@ def _const_zero(env):
 
 def q1(ctx, t: Tables, delta_days: int = 90) -> Table:
     cutoff = date_to_days("1998-12-01") - delta_days
-    li = dist_select(t["lineitem"], _pred_le("l_shipdate", cutoff))
+    # projection pushdown: select compacts every column it keeps, so drop
+    # the 9 lineitem columns the query never touches before filtering
+    li = dist_project(t["lineitem"], [
+        "l_shipdate", "l_returnflag", "l_linestatus", "l_quantity",
+        "l_extendedprice", "l_discount", "l_tax", "l_orderkey"])
+    li = dist_select(li, _pred_le("l_shipdate", cutoff))
     li = dist_with_column(li, "disc_price", _revenue, Type.DOUBLE)
     li = dist_with_column(li, "charge", _charge, Type.DOUBLE)
     g = dist_groupby(li, ["l_returnflag", "l_linestatus"], [
@@ -130,9 +135,17 @@ def q3(ctx, t: Tables, segment: str = "BUILDING",
     day = date_to_days(date)
     seg = _dict_code(t["customer"], "c_mktsegment", segment)
 
-    cust = dist_select(t["customer"], _pred_eq("c_mktsegment", seg))
-    orders = dist_select(t["orders"], _pred_lt("o_orderdate", day))
-    li = dist_select(t["lineitem"], _pred_gt("l_shipdate", day))
+    cust = dist_select(dist_project(t["customer"],
+                                    ["c_custkey", "c_mktsegment"]),
+                       _pred_eq("c_mktsegment", seg))
+    orders = dist_select(dist_project(t["orders"],
+                                      ["o_orderkey", "o_custkey",
+                                       "o_orderdate", "o_shippriority"]),
+                         _pred_lt("o_orderdate", day))
+    li = dist_select(dist_project(t["lineitem"],
+                                  ["l_orderkey", "l_shipdate",
+                                   "l_extendedprice", "l_discount"]),
+                     _pred_gt("l_shipdate", day))
 
     co = _strip_prefixes(dist_join(cust, orders, _cfg("c_custkey", "o_custkey")))
     col = _strip_prefixes(dist_join(co, li, _cfg("o_orderkey", "l_orderkey")))

@@ -35,8 +35,37 @@ from typing import Dict, Iterator, List, Optional, Tuple
 __all__ = [
     "enable", "disable", "enabled", "span", "count", "reset",
     "get_spans", "phase_totals", "counters", "report", "bench_line",
-    "profile",
+    "profile", "hard_sync",
 ]
+
+
+def hard_sync(tree) -> None:
+    """Block the host until every array in ``tree`` has materialized.
+
+    ``jax.block_until_ready`` only drains the *dispatch* queue on remote /
+    tunneled TPU backends (e.g. the axon plugin) — it can return while the
+    device is still executing, which would make every timing span a lie.
+    A host read of one element per leaf is an unambiguous completion
+    barrier on every backend: the transfer cannot start before the
+    producing program finishes.
+    """
+    import jax
+
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "ravel") and getattr(x, "size", 0)]
+    if not leaves:
+        jax.block_until_ready(tree)
+        return
+    reads = []
+    for x in leaves:
+        if getattr(x, "is_fully_addressable", True):
+            reads.append(x.ravel()[:1])
+        else:
+            # multi-host: only this process's shards can be read
+            shards = getattr(x, "addressable_shards", None)
+            if shards:
+                reads.append(shards[0].data.ravel()[:1])
+    jax.device_get(reads)
 
 _state = threading.local()
 
@@ -118,8 +147,7 @@ def span_sync(name: str) -> Iterator[_SyncSpan]:
         yield sp
     finally:
         if sp._target is not None:
-            import jax
-            jax.block_until_ready(sp._target)
+            hard_sync(sp._target)
         _spans().append((name, depth, (time.perf_counter() - t0) * 1e3))
         _state.depth = depth
 

@@ -1,0 +1,106 @@
+"""Fused single-sort join plan (ops/join.py sort_join_plan/plan_indices)
+vs the rank-based kernels, which are themselves oracle-tested.
+
+The two implementations must agree on the SET of emitted (left, right)
+pairs (output order is unspecified by the join contract) and on the exact
+output count, across join types, padded counts, nulls, and multi-column
+keys.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cylon_tpu.ops import join as oj
+
+HOWS = ["inner", "left", "right", "full_outer"]
+
+
+def _pairs(li, ri, n):
+    li = np.asarray(li)[:n]
+    ri = np.asarray(ri)[:n]
+    return sorted(zip(li.tolist(), ri.tolist()))
+
+
+def _run_both(l_cols, l_valids, r_cols, r_valids, how,
+              l_count=None, r_count=None):
+    lc = None if l_count is None else jnp.int32(l_count)
+    rc = None if r_count is None else jnp.int32(r_count)
+    lr, rr = oj.dense_ranks(tuple(l_cols), tuple(l_valids),
+                            tuple(r_cols), tuple(r_valids),
+                            l_count=lc, r_count=rc)
+    ref_total = int(oj.join_count(lr, rr, how, l_count=lc, r_count=rc))
+    cap = max(ref_total, 1) + 8
+    rli, rri, rn = oj.join_indices(lr, rr, how, cap, l_count=lc, r_count=rc)
+
+    plan = oj.sort_join_plan(tuple(l_cols), tuple(l_valids),
+                             tuple(r_cols), tuple(r_valids), how,
+                             l_count=lc, r_count=rc)
+    total = int(oj.plan_total(plan, how, l_count=lc, r_count=rc))
+    pli, pri, pn = oj.plan_indices(plan, how, cap, l_count=lc, r_count=rc)
+
+    assert total == ref_total
+    assert int(pn) == int(rn) == ref_total
+    assert _pairs(pli, pri, total) == _pairs(rli, rri, ref_total)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_plan_matches_rank_kernel_int_keys(rng, how):
+    for trial in range(3):
+        n_l = int(rng.integers(1, 200))
+        n_r = int(rng.integers(1, 200))
+        lk = rng.integers(0, 40, n_l).astype(np.int32)
+        rk = rng.integers(0, 40, n_r).astype(np.int32)
+        _run_both([jnp.asarray(lk)], [None], [jnp.asarray(rk)], [None], how)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_plan_padded_counts(rng, how):
+    n_l, n_r = 64, 96
+    lk = rng.integers(0, 25, n_l).astype(np.int32)
+    rk = rng.integers(0, 25, n_r).astype(np.int32)
+    _run_both([jnp.asarray(lk)], [None], [jnp.asarray(rk)], [None], how,
+              l_count=41, r_count=17)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_plan_null_keys_and_extreme_values(rng, how):
+    n_l, n_r = 80, 80
+    info = np.iinfo(np.int32)
+    pool = np.array([0, 1, 2, info.max, info.min], np.int32)
+    lk = rng.choice(pool, n_l)
+    rk = rng.choice(pool, n_r)
+    lv = rng.random(n_l) > 0.25
+    rv = rng.random(n_r) > 0.25
+    _run_both([jnp.asarray(lk)], [jnp.asarray(lv)],
+              [jnp.asarray(rk)], [jnp.asarray(rv)], how,
+              l_count=70, r_count=75)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_plan_multi_column_keys(rng, how):
+    n_l, n_r = 120, 90
+    lk0 = rng.integers(0, 6, n_l).astype(np.int32)
+    lk1 = rng.integers(0, 6, n_l).astype(np.int32)
+    rk0 = rng.integers(0, 6, n_r).astype(np.int32)
+    rk1 = rng.integers(0, 6, n_r).astype(np.int32)
+    lv1 = rng.random(n_l) > 0.15
+    _run_both([jnp.asarray(lk0), jnp.asarray(lk1)], [None, jnp.asarray(lv1)],
+              [jnp.asarray(rk0), jnp.asarray(rk1)], [None, None], how)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_plan_empty_and_all_padding(how):
+    lk = jnp.asarray(np.arange(8, dtype=np.int32))
+    rk = jnp.asarray(np.arange(8, dtype=np.int32))
+    # fully padded right side: no real rows
+    _run_both([lk], [None], [rk], [None], how, l_count=5, r_count=0)
+    _run_both([lk], [None], [rk], [None], how, l_count=0, r_count=0)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_plan_statically_empty_side(how):
+    lk = jnp.zeros((0,), jnp.int32)
+    rk = jnp.asarray(np.array([1, 2, 2], np.int32))
+    _run_both([lk], [None], [rk], [None], how)
+    _run_both([rk], [None], [lk], [None], how)

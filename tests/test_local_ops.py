@@ -268,7 +268,8 @@ def test_update_size_hint_policy():
 
 def test_optimistic_dispatch_semantics():
     """The hint/validate/redo core: an undersized hint MUST redo; an
-    adequate hint must not; payload passes through."""
+    adequate hint must not; the raw counts pass through."""
+    import jax.numpy as jnp
     from cylon_tpu.ops.compact import optimistic_dispatch
 
     calls = []
@@ -277,20 +278,24 @@ def test_optimistic_dispatch_semantics():
         calls.append(tuple(sizes))
         return f"result@{sizes}"
 
+    def post_from(need):
+        return lambda counts: (need,)
+
+    cnt_dev = jnp.asarray([0], jnp.int32)
     hints = {}
     # miss: no optimistic dispatch, one sized dispatch
-    r, used, pay = optimistic_dispatch(
-        hints, "k", dispatch, lambda: ((64,), "p0"))
-    assert calls == [(64,)] and used == (64,) and pay == "p0"
+    r, used, counts = optimistic_dispatch(
+        hints, "k", dispatch, cnt_dev, post_from(64))
+    assert calls == [(64,)] and used == (64,) and counts is not None
     # hit, adequate: one optimistic dispatch, NO redo
     calls.clear()
-    r, used, pay = optimistic_dispatch(
-        hints, "k", dispatch, lambda: ((32,), "p1"))
+    r, used, counts = optimistic_dispatch(
+        hints, "k", dispatch, cnt_dev, post_from(32))
     assert calls == [(64,)] and used == (64,)
     # hit, undersized: optimistic dispatch then mandatory redo at need
     calls.clear()
-    r, used, pay = optimistic_dispatch(
-        hints, "k", dispatch, lambda: ((128,), "p2"))
+    r, used, counts = optimistic_dispatch(
+        hints, "k", dispatch, cnt_dev, post_from(128))
     assert calls == [(64,), (128,)], "undersized hint did not redo"
     assert used == (128,) and r == "result@(128,)"
 
@@ -321,3 +326,27 @@ def test_take_many_matches_take_with_nulls():
                 assert wv is None
             else:
                 np.testing.assert_array_equal(np.asarray(sv), np.asarray(wv))
+
+
+def test_groupby_float32_precision_small_group_after_large():
+    """The float sum path must accumulate per group, not by global
+    prefix-sum difference: in float32 a tiny group following a huge one
+    would otherwise inherit rounding from the ~1e10 global prefix
+    (eps(f32) at 1e10 is ~1024 — larger than the group's true sum)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from cylon_tpu.ops.groupby import groupby_aggregate
+
+    n_big = 1_000_000
+    keys = np.concatenate([np.zeros(n_big, np.int32),
+                           np.ones(2, np.int32)])
+    vals = np.concatenate([np.full(n_big, 1.0e4, np.float32),
+                           np.array([1.0, 2.0], np.float32)])
+    with jax.enable_x64(False):
+        _, outs, _, ngroups = groupby_aggregate(
+            (jnp.asarray(keys),), (None,),
+            (jnp.asarray(vals),), (None,), ("sum",))
+        assert int(ngroups) == 2
+        small = float(np.asarray(outs[0])[1])
+    assert abs(small - 3.0) < 1e-3, small

@@ -1,0 +1,86 @@
+"""Deferred-validation pipelines (ops.compact.run_pipeline).
+
+The optimistic two-phase dispatch normally blocks per op on a host count
+read; inside run_pipeline those reads queue up and resolve in ONE batched
+device_get, with a full replay if any hinted dispatch was undersized.
+These tests pin the three contract points: results identical to the
+synchronous path, correct replay on a forced undersized hint, and hint
+state convergence.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table
+from cylon_tpu.config import JoinAlgorithm, JoinConfig, JoinType
+from cylon_tpu.ops import compact as ops_compact
+from cylon_tpu.parallel import DTable, dist_groupby, dist_join, run_pipeline
+from cylon_tpu.parallel import dist_ops as dops
+
+
+def _mk(dctx, rng, n, kmax):
+    df = pd.DataFrame({
+        "k": rng.integers(0, kmax, n).astype(np.int32),
+        "v": rng.random(n).astype(np.float32),
+    })
+    return df, DTable.from_table(dctx, Table.from_pandas(dctx, df))
+
+
+def _oracle_join_groupby(ldf, rdf):
+    m = ldf.merge(rdf, on="k", how="inner", suffixes=("_l", "_r"))
+    g = m.groupby("k", as_index=False)["v_l"].sum()
+    return g.sort_values("k").reset_index(drop=True)
+
+
+def _run_query(left, right):
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+    j = dist_join(left, right, cfg)
+    g = dist_groupby(j.rename(["k", "vl", "k2", "vr"]), ["k"],
+                     [("vl", "sum")])
+    out = g.to_table().to_pandas()
+    return out.sort_values("k").reset_index(drop=True)
+
+
+def test_pipeline_matches_sync(dctx, rng):
+    ldf, left = _mk(dctx, rng, 400, 60)
+    rdf, right = _mk(dctx, rng, 300, 60)
+    expect = _oracle_join_groupby(ldf, rdf)
+
+    sync_out = _run_query(left, right)          # also seeds the hints
+    pipe_out = run_pipeline(lambda: _run_query(left, right))
+    for out in (sync_out, pipe_out):
+        np.testing.assert_array_equal(out["k"], expect["k"])
+        np.testing.assert_allclose(out["sum_vl"], expect["v_l"], rtol=1e-5)
+
+
+def test_pipeline_replays_on_undersized_hint(dctx, rng):
+    ldf, left = _mk(dctx, rng, 500, 10)   # heavy duplication ⇒ big join out
+    rdf, right = _mk(dctx, rng, 400, 10)
+    expect = _oracle_join_groupby(ldf, rdf)
+
+    _run_query(left, right)  # seed real hints
+    # sabotage every join-capacity hint down to the minimum size class so
+    # the deferred dispatch is undersized and the pipeline must replay
+    for key in list(dops._capacity_hints):
+        dops._capacity_hints[key] = ((8,), 0)
+
+    out = run_pipeline(lambda: _run_query(left, right))
+    np.testing.assert_array_equal(out["k"], expect["k"])
+    np.testing.assert_allclose(out["sum_vl"], expect["v_l"], rtol=1e-5)
+    # replay GREW the sabotaged hints (the join output far exceeds the
+    # minimum size class, so an un-updated hint would still read (8,))
+    assert any(h[0][0] > 8 for h in dops._capacity_hints.values()), \
+        dops._capacity_hints
+
+
+def test_pipeline_no_pending_left_behind(dctx, rng):
+    _, left = _mk(dctx, rng, 100, 5)
+    _, right = _mk(dctx, rng, 100, 5)
+    run_pipeline(lambda: _run_query(left, right))
+    assert ops_compact._deferred.pending == []
+    assert not ops_compact.deferred_mode()
+
+
+def test_flush_pending_idempotent_outside_region():
+    assert ops_compact.flush_pending() is True
+    assert ops_compact.flush_pending() is True
