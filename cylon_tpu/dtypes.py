@@ -108,6 +108,23 @@ _INTEGRAL = {
 _FLOATING = {Type.HALF_FLOAT, Type.FLOAT, Type.DOUBLE}
 
 
+def extreme_value(dtype, largest: bool):
+    """The dtype's largest (or smallest) ordered value, as a 0-d jax array.
+
+    The shared sentinel picker for padding sort keys (sorts last),
+    min/max aggregation identities, and degenerate sample-sort splitters —
+    one definition so a dtype addition updates every kernel at once.
+    """
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if largest else -jnp.inf, dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(largest, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if largest else info.min, dtype)
+
+
 def device_dtype(t: Type) -> np.dtype:
     """numpy/jnp dtype used for this logical type's device storage."""
     try:

@@ -101,11 +101,18 @@ def optimistic_dispatch(hints: dict, key, dispatch, cnt_dev, post):
     the region (``run_pipeline`` automates this).  The returned counts are
     ``None`` in deferred mode.
     """
+    _abort_if_poisoned()  # don't pile device work onto a doomed attempt
     hint = hint_value(hints, key)
     if hint is not None and _deferred.depth > 0:
         result = dispatch(hint)
         _deferred.pending.append((hints, key, hint, cnt_dev, post))
         return result, hint, None
+    if _deferred.depth > 0:
+        # no hint ⇒ we must block on the count; resolve queued upstream
+        # validations first — a count computed downstream of an undersized
+        # dispatch must never size a dispatch or feed the hints
+        flush_pending()
+        _abort_if_poisoned()
     result = dispatch(hint) if hint is not None else None
     counts = _read_counts(cnt_dev)
     need = tuple(post(counts))
@@ -133,6 +140,20 @@ class _DeferredState(threading.local):
 
 
 _deferred = _DeferredState()
+
+
+class ReplayNeeded(Exception):
+    """Raised at a host boundary inside a deferred region once an
+    optimistic dispatch is known to have been undersized: everything
+    downstream of it computed on truncated data, so continuing the attempt
+    would consume poisoned counts (a zero-filled exchange can explode a
+    join count toward cap² — an OOM-scale allocation).  ``run_pipeline``
+    catches this, corrects the hints recorded so far, and replays."""
+
+
+def _abort_if_poisoned() -> None:
+    if _deferred.depth > 0 and not _deferred.ok:
+        raise ReplayNeeded()
 
 
 def deferred_mode() -> bool:
@@ -216,9 +237,12 @@ def run_pipeline(fn, max_attempts: int = 3):
     count read per pipeline instead of one blocking read per op.
     """
     for _ in range(max_attempts):
-        with deferred_region():
-            out = fn()
-            ok = flush_pending()
+        try:
+            with deferred_region():
+                out = fn()
+                ok = flush_pending()
+        except ReplayNeeded:
+            continue  # a host boundary detected the undersize mid-attempt
         if ok:
             return out
     return fn()  # hints now corrected; plain mode validates per op

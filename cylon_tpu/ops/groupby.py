@@ -144,13 +144,8 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
                 i_ref = len(iplan)
                 iplan.append(z.astype(idt))
         if agg in (MIN, MAX):
-            if jnp.issubdtype(col.dtype, jnp.floating):
-                sentinel = jnp.array(jnp.inf if agg == MIN else -jnp.inf,
-                                     col.dtype)
-            else:
-                info = jnp.iinfo(col.dtype)
-                sentinel = jnp.array(info.max if agg == MIN else info.min,
-                                     col.dtype)
+            from ..dtypes import extreme_value
+            sentinel = extreme_value(col.dtype, largest=(agg == MIN))
             mplan.append((slot, agg, jnp.where(vmask, col, sentinel),
                           cnt_ref))
         assembly.append((slot, agg, f_ref, i_ref, cnt_ref, col.dtype))
@@ -205,11 +200,20 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
             outs[slot] = s.astype(fdt) / denom
             out_valids[slot] = cnt > 0
 
+    # min/max columns pack per (op, dtype) so k same-op aggregations share
+    # one segmented scan — the same width-amortization as the sum packs
+    mgroups: dict = {}
     for slot, agg, masked, cnt_ref in mplan:
-        ms = jnp.take(masked, idxS)               # sorted order
+        mgroups.setdefault((agg, masked.dtype), []).append(
+            (slot, masked, cnt_ref))
+    for (agg, _), entries in mgroups.items():
         op = jnp.minimum if agg == MIN else jnp.maximum
-        scanned = _seg_scan(ms, is_first, op)
-        outs[slot] = jnp.take(scanned, ends)
-        out_valids[slot] = isums[:, cnt_ref] > 0
+        pk = jnp.stack([m for _, m, _ in entries], axis=1)
+        ps = jnp.take(pk, idxS, axis=0)           # sorted order
+        scanned = _seg_scan(ps, is_first, op)
+        res = jnp.take(scanned, ends, axis=0)
+        for j, (slot, _, cnt_ref) in enumerate(entries):
+            outs[slot] = res[:, j]
+            out_valids[slot] = isums[:, cnt_ref] > 0
 
     return key_idx, tuple(outs), tuple(out_valids), num_groups

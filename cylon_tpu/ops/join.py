@@ -50,9 +50,8 @@ INNER, LEFT, RIGHT, FULL_OUTER = "inner", "left", "right", "full_outer"
 def _pad_sentinel(dtype):
     """Rank substituted for padding rows; sorts last.  Dense ranks are
     bounded by the row count, so the max value is never a real rank."""
-    if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.array(jnp.finfo(dtype).max, dtype)
-    return jnp.array(jnp.iinfo(dtype).max, dtype)
+    from ..dtypes import extreme_value
+    return extreme_value(dtype, largest=True)
 
 
 def _concat_key_parts(l_cols, l_valids, r_cols, r_valids, l_count, r_count):

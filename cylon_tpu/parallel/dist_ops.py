@@ -520,27 +520,54 @@ def _sample_fn(mesh, axis: str, cap: int, nsamples: int, ascending: bool):
                              in_specs=(spec,) * 3, out_specs=(spec, spec)))
 
 
+@functools.lru_cache(maxsize=None)
+def _pool_splitters_fn(mesh, axis: str, nsides: int, nparts: int,
+                       ascending: bool):
+    """Pool every side's per-shard samples (all_gather), sort the pool on
+    device, and pick P−1 evenly-spaced pivots — replicated, never touching
+    the host.  With zero valid samples the pivots collapse to the dtype's
+    extreme so every row routes to shard 0 (degenerate but correct)."""
+
+    def kernel(*flat):
+        vals, oks = flat[:nsides], flat[nsides:]
+        pv = jnp.concatenate([jax.lax.all_gather(v, axis, tiled=True)
+                              for v in vals])
+        po = jnp.concatenate([jax.lax.all_gather(o, axis, tiled=True)
+                              for o in oks])
+        key = pv if ascending else ops_sort._invert(pv)
+        _, _, sv = jax.lax.sort((~po, key, pv), num_keys=2)  # invalids last
+        m = jnp.sum(po).astype(jnp.int32)
+        total = pv.shape[0]
+        pos = jnp.clip((jnp.arange(1, nparts) * m) // nparts, 0, total - 1)
+        sp = jnp.take(sv, pos)
+        from ..dtypes import extreme_value
+        return jnp.where(m > 0, sp, extreme_value(pv.dtype,
+                                                  largest=ascending))
+
+    spec = P(axis)
+    # check_vma=False: the pooled splitters are replicated
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(spec,) * (2 * nsides), out_specs=P(),
+                             check_vma=False))
+
+
 def _sample_splitters(sides: Sequence[Tuple[DTable, int]], ascending: bool
-                      ) -> np.ndarray:
+                      ) -> jax.Array:
     """Pool per-shard samples from every (table, key column) side and pick
-    P−1 splitters — the sample-sort pivot selection."""
-    nparts = sides[0][0].ctx.get_world_size()
-    pooled = []
+    P−1 splitters — the sample-sort pivot selection.  Entirely on device
+    (the former host pooling cost one blocking round trip per sort/join)."""
+    ctx = sides[0][0].ctx
+    nparts = ctx.get_world_size()
+    flat = []
     for dt, key_i in sides:
         c = dt.columns[key_i]
-        vals, ok = _sample_fn(dt.ctx.mesh, dt.ctx.axis, dt.cap,
-                              _SAMPLES_PER_SHARD, ascending)(
-            dt.counts, c.data, c.validity)
-        ops_compact.flush_pending()  # samples must be validation-clean
-        vals, ok = (np.asarray(a) for a in jax.device_get((vals, ok)))
-        pooled.append(vals[ok])
-    sample = np.concatenate(pooled) if pooled else np.empty((0,))
-    if sample.size == 0:
-        return sample  # degenerate: everything lands on shard 0
-    sample = np.sort(sample)
-    pos = (np.arange(1, nparts) * sample.size) // nparts
-    return np.unique(sample[pos]) if ascending else \
-        np.unique(sample[pos])[::-1].copy()
+        flat.append(_sample_fn(dt.ctx.mesh, dt.ctx.axis, dt.cap,
+                               _SAMPLES_PER_SHARD, ascending)(
+            dt.counts, c.data, c.validity))
+    vals = [v for v, _ in flat]
+    oks = [o for _, o in flat]
+    return _pool_splitters_fn(ctx.mesh, ctx.axis, len(sides), nparts,
+                              ascending)(*vals, *oks)
 
 
 @jax.jit
@@ -561,14 +588,14 @@ def _range_pids_desc_kernel(col, validity, mask, splitters, nparts_arr,
     return jnp.where(mask, pid, nparts_arr)
 
 
-def _range_pids(dt: DTable, key_i: int, splitters: np.ndarray,
+def _range_pids(dt: DTable, key_i: int, splitters: jax.Array,
                 ascending: bool) -> jax.Array:
     c = dt.columns[key_i]
     nparts = dt.ctx.get_world_size()
     mask = _row_mask(dt)
-    if splitters.size == 0:
+    if splitters.shape[0] == 0:
         return jnp.where(mask, jnp.int32(0), jnp.int32(nparts))
-    sp = jnp.asarray(splitters.astype(np.dtype(c.data.dtype), copy=False))
+    sp = splitters.astype(c.data.dtype)
     fn = _range_pids_kernel if ascending else _range_pids_desc_kernel
     return fn(c.data, c.validity, mask, sp, jnp.int32(nparts),
               jnp.int32(nparts - 1))

@@ -79,8 +79,10 @@ class DTable:
     def counts_host(self) -> np.ndarray:
         if self._counts_host is None:
             # resolve queued optimistic-capacity validations before trusting
-            # any host-visible row counts (see ops.compact.deferred_region)
+            # any host-visible row counts; inside a failed deferred attempt
+            # abort for replay instead of materializing poisoned counts
             ops_compact.flush_pending()
+            ops_compact._abort_if_poisoned()
             self._counts_host = np.asarray(jax.device_get(self.counts))
         return self._counts_host
 
@@ -252,6 +254,7 @@ class DTable:
         capacity block transfers 4 rows, not the padded block.
         """
         ops_compact.flush_pending()  # payload must be validation-clean
+        ops_compact._abort_if_poisoned()
         # int32 gather indices unless x64 is on: jnp.asarray would silently
         # wrap int64 positions ≥ 2^31 to negative (clamping to row 0)
         if self.nparts * self.cap > np.iinfo(np.int32).max \
@@ -313,9 +316,10 @@ class DTable:
             if v is not None:
                 flat.append(v)
         ok, vals = ops_compact.flush_pending_with(flat)
-        # inside a failed deferred region the data may be truncated garbage;
-        # run_pipeline discards this attempt and replays — still return a
-        # well-formed table so the attempt completes
+        if not ok:
+            # inside a failed deferred attempt: abort for replay rather
+            # than hand truncated garbage to the caller
+            ops_compact._abort_if_poisoned()
         take = int(np.asarray(vals[0]))
         cols: List[Column] = []
         hi = 1
