@@ -39,9 +39,13 @@ SUM, COUNT, MEAN, MIN, MAX = "sum", "count", "mean", "min", "max"
 AGG_OPS = (SUM, COUNT, MEAN, MIN, MAX)
 
 
-def _sorted_structure(key_cols, key_validities, row_valid):
+def group_structure(key_cols, key_validities, row_valid):
     """One carried-values sort → (idxS, is_first, rvS): original row index
-    per sorted position, group-start flags, sorted row-validity."""
+    per sorted position, group-start flags, sorted row-validity.
+
+    Exposed so a two-phase caller (parallel.dist_groupby) can compute the
+    group count from phase 1 and pass the structure into
+    ``groupby_aggregate`` with a bucketed ``out_capacity``."""
     from .join import sorted_key_structure
     n = key_cols[0].shape[0]
     ops = []
@@ -56,12 +60,17 @@ def _sorted_structure(key_cols, key_validities, row_valid):
     return idxS, is_first, rvS
 
 
-def _seg_scan(vals: jax.Array, is_first: jax.Array, op):
-    """Segmented inclusive prefix scan: ``op`` accumulates within a group,
-    resetting at group starts.
+def num_groups_of(structure) -> jax.Array:
+    _, is_first, rvS = structure
+    return jnp.sum(is_first & rvS).astype(jnp.int32)
 
-    Hillis-Steele formulation — log2(n) static-shift passes of
-    ``vals[i] = vals[i] if boundary-within-window else op(vals[i],
+
+_SEG_BLOCK = 128  # within-block scan width (log2 = 7 shift passes)
+
+
+def _seg_scan_flat(vals: jax.Array, is_first: jax.Array, op):
+    """Hillis-Steele segmented inclusive scan: log2(n) static-shift passes
+    of ``vals[i] = vals[i] if boundary-within-window else op(vals[i],
     vals[i-d])`` — instead of ``lax.associative_scan`` with a (value,
     flag) combine, whose compile time explodes at multi-million-row
     shapes (>15 min at 6M on a v5e; the unrolled shift loop compiles in
@@ -83,40 +92,103 @@ def _seg_scan(vals: jax.Array, is_first: jax.Array, op):
     return vals
 
 
-@functools.partial(jax.jit, static_argnames=("aggs",))
+def _seg_scan(vals: jax.Array, is_first: jax.Array, op):
+    """Blocked segmented inclusive scan, ~3x less memory traffic than the
+    flat formulation at large n.
+
+    Rows reshape to [B, M] blocks (M = 128): a within-block scan with
+    forced resets at block starts needs only log2(M) = 7 shift passes over
+    the full array; the cross-block continuation is a flat segmented scan
+    over the B block tails (tiny) whose carries are applied to exactly the
+    positions whose group started before their block.  Accumulation stays
+    per-group (never a global prefix difference), so float rounding keeps
+    the per-group bound."""
+    n = vals.shape[0]
+    M = _SEG_BLOCK
+    if n <= 2 * M:
+        return _seg_scan_flat(vals, is_first, op)
+    B = -(-n // M)
+    pad = B * M - n
+    rest = vals.shape[1:]
+    if pad:
+        # padding rows form their own groups of one; they are sliced away
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((pad,) + rest, vals.dtype)], axis=0)
+        is_first = jnp.concatenate([is_first, jnp.ones(pad, bool)])
+    V = vals.reshape((B, M) + rest)
+    F0 = is_first.reshape(B, M)               # real group starts
+    G = F0.at[:, 0].set(True)                 # forced block resets
+    gshape = (slice(None), slice(None)) + (None,) * len(rest)
+    W = V
+    d = 1
+    while d < M:
+        sv = jnp.concatenate(
+            [jnp.zeros((B, d) + rest, W.dtype), W[:, :-d]], axis=1)
+        sf = jnp.concatenate([jnp.ones((B, d), bool), G[:, :-d]], axis=1)
+        W = jnp.where(G[gshape], W, op(W, sv))
+        G = G | sf
+        d *= 2
+    # cross-block carries: block b's tail partial chains into b+1 while no
+    # real boundary interrupts; a flat segmented scan over the B tails
+    s_tail = W[:, -1]                         # [B, *rest]
+    has_reset = jnp.any(F0, axis=1)           # [B]
+    y = _seg_scan_flat(s_tail, has_reset, op)
+    c = jnp.concatenate(
+        [jnp.zeros((1,) + rest, y.dtype), y[:-1]], axis=0)  # carry INTO b
+    # position (b, j) extends a prior block's group iff no real boundary
+    # at or before j within block b; block 0 never takes a carry (its
+    # zeros-init carry slot is never read, so no op identity is needed)
+    before_reset = jax.lax.cummax(F0.astype(jnp.int8), axis=1) == 0
+    cond = before_reset & (jnp.arange(B) > 0)[:, None]
+    W = jnp.where(cond[gshape], op(W, c[:, None]), W)
+    out = W.reshape((B * M,) + rest)
+    return out[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("aggs", "out_capacity"))
 def groupby_aggregate(key_cols: Sequence[jax.Array],
                       key_validities: Sequence[Optional[jax.Array]],
                       value_cols: Sequence[jax.Array],
                       value_validities: Sequence[Optional[jax.Array]],
                       aggs: Tuple[str, ...],
-                      row_valid: Optional[jax.Array] = None):
+                      row_valid: Optional[jax.Array] = None,
+                      structure=None, out_capacity: Optional[int] = None):
     """Aggregate ``value_cols[i]`` with ``aggs[i]`` per distinct key row.
+
+    ``structure`` (from ``group_structure``) and ``out_capacity`` support
+    the two-phase distributed path: outputs shrink from [n] to
+    [out_capacity] (a size-class bucket of the group count), so the
+    per-group gathers and every downstream op touch group-count-sized
+    blocks instead of input-capacity blocks.  ``out_capacity`` must be
+    ≥ the true group count (the caller validates via the count protocol).
 
     ``row_valid`` marks real rows in padded blocks (None = all real);
     padding rows sort last, form their own (dropped) groups, and group ids
     [0, count) are exactly the real groups.
 
-    Returns (key_row_indices[n] padded −1, agg_arrays (one per value col,
-    each [n]; entries past the group count are unspecified), agg
-    validities, count).  Null handling is pandas-style: null values are
-    skipped; a group with no valid values yields null (for min/max/mean)
-    or 0 (sum/count).
+    Returns (key_row_indices[C] padded −1, agg_arrays (one per value col,
+    each [C]; entries past the group count are unspecified), agg
+    validities, count) where ``C = out_capacity or n``.  Null handling is
+    pandas-style: null values are skipped; a group with no valid values
+    yields null (for min/max/mean) or 0 (sum/count).
     """
     n = key_cols[0].shape[0]
     idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    idxS, is_first, rvS = _sorted_structure(key_cols, key_validities,
-                                            row_valid)
+    if structure is None:
+        structure = group_structure(key_cols, key_validities, row_valid)
+    idxS, is_first, rvS = structure
+    C = n if out_capacity is None else out_capacity
     keep_first = is_first & rvS  # padding groups start with an invalid row
     num_groups = jnp.sum(keep_first).astype(jnp.int32)
     from .compact import compact_indices
-    starts = compact_indices(keep_first, n, fill=-1)   # per group g
+    starts = compact_indices(keep_first, C, fill=-1)   # per group g
     safe_starts = jnp.clip(starts, 0, n - 1)
     key_idx = jnp.where(starts >= 0, jnp.take(idxS, safe_starts),
                         jnp.int32(-1))
     one = jnp.ones((1,), bool)
     last_of_group = jnp.concatenate([is_first[1:], one])
-    ends = compact_indices(last_of_group, n, fill=n - 1)  # aligned with g
+    ends = compact_indices(last_of_group, C, fill=n - 1)  # aligned with g
 
     # -- assemble packed sum-family inputs in ORIGINAL order ------------------
     # fplan/iplan collect columns for the float/int accumulator packs;

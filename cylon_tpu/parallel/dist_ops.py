@@ -436,51 +436,119 @@ def dist_subtract(a: DTable, b: DTable) -> DTable:
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _groupby_fn(mesh, axis: str, cap: int, aggs: Tuple[str, ...]):
-    def kernel(cnt, key_leaves, val_leaves):
+def _groupby_phase1_fn(mesh, axis: str, cap: int, has_where: bool):
+    """Group structure + replicated per-shard group counts (tiny).
+
+    The ``has_where=False`` variant takes no mask argument at all — the
+    common path must not pay a [P*cap] bool ballast allocation."""
+
+    def kernel(cnt, key_leaves, *maybe_mask):
+        kcols = tuple(d for d, _ in key_leaves)
+        kvals = tuple(v for _, v in key_leaves)
+        row_valid = (maybe_mask[0] if has_where
+                     else (jnp.arange(cap) < cnt[0]))
+        structure = ops_groupby.group_structure(kcols, kvals, row_valid)
+        ng = ops_groupby.num_groups_of(structure)
+        return structure, row_valid, jax.lax.all_gather(ng, axis)
+
+    spec = P(axis)
+    nargs = 3 if has_where else 2
+    # check_vma=False: the all_gathered counts are replicated
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(spec,) * nargs,
+                             out_specs=(spec, spec, P()),
+                             check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _groupby_phase2_fn(mesh, axis: str, aggs: Tuple[str, ...], out_cap: int):
+    """Aggregations + key gather into a bucketed [out_cap] block."""
+
+    def kernel(structure, row_valid, key_leaves, val_leaves):
         kcols = tuple(d for d, _ in key_leaves)
         kvals = tuple(v for _, v in key_leaves)
         vcols = tuple(d for d, _ in val_leaves)
         vvals = tuple(v for _, v in val_leaves)
-        row_valid = jnp.arange(cap) < cnt[0]
         key_idx, outs, out_valids, ngroups = ops_groupby.groupby_aggregate(
-            kcols, kvals, vcols, vvals, aggs, row_valid=row_valid)
+            kcols, kvals, vcols, vvals, aggs, row_valid=row_valid,
+            structure=structure, out_capacity=out_cap)
         keys_out = tuple(ops_gather.take_many(key_leaves, key_idx,
                                               fill_null=False))
         return keys_out, outs, out_valids, ngroups[None]
 
     spec = P(axis)
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(spec,) * 3, out_specs=(spec,) * 4))
+                             in_specs=(spec,) * 4, out_specs=(spec,) * 4))
+
+
+# Last bucketed group-count capacity per groupby signature (the optimistic
+# dispatch pattern shared with join phase 2 / shuffle).
+_group_cap_hints: dict = {}
 
 
 def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
-                 aggregations: Sequence[Tuple[Union[int, str], str]]
-                 ) -> DTable:
+                 aggregations: Sequence[Tuple[Union[int, str], str]],
+                 where=None) -> DTable:
     """Distributed groupby-aggregate: shuffle on key hash (equal keys
     co-locate ⇒ each group lives wholly on one shard), then the local
     segment-reduction kernel per shard.  Aggs: sum/count/mean/min/max.
-    Output columns: keys, then ``{op}_{col}``."""
+    Output columns: keys, then ``{op}_{col}``.
+
+    ``where`` is an optional row predicate (same env protocol + SQL null
+    semantics as ``dist_select``) applied as FILTER PUSHDOWN: on a
+    multi-shard mesh failing rows are dropped at the partition step (they
+    never enter the shuffle), and locally they are masked out of the
+    aggregation — either way the filter costs no extra memory pass,
+    unlike select-then-groupby which materializes the filtered table.
+
+    Output blocks are sized to a bucket of the per-shard GROUP count (the
+    two-phase count protocol), not the input row capacity — a 4-group
+    aggregate over millions of rows yields a tiny DTable, and every
+    downstream op (sort/head/export) touches group-count-sized arrays.
+    """
     key_ids = _resolve_ids(dt, key_columns)
     val_ids = [dt.column_index(c) for c, _ in aggregations]
     aggs = tuple(op for _, op in aggregations)
     for op in aggs:
         if op not in ops_groupby.AGG_OPS:
             raise CylonError(Status(Code.Invalid, f"unknown aggregation {op!r}"))
+    pmask = None if where is None else _predicate_mask(dt, where)
     if dt.ctx.get_world_size() == 1:
         sh = dt
     else:
         with trace.span("groupby.shuffle"):
-            sh = _shuffle_by_pids(dt, _hash_pids(dt, key_ids))
+            pid = _hash_pids(dt, key_ids)
+            if pmask is not None:
+                # filter pushdown: failing rows never enter the exchange
+                pid = jnp.where(pmask, pid, jnp.int32(dt.ctx.get_world_size()))
+                pmask = None  # rows arrive pre-filtered
+            sh = _shuffle_by_pids(dt, pid)
+    mesh, axis = dt.ctx.mesh, dt.ctx.axis
     key_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
                        for i in key_ids)
     val_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
                        for i in val_ids)
+    with trace.span("groupby.count"):
+        args = (sh.counts, key_leaves) + (() if pmask is None else (pmask,))
+        structure, row_valid, ngs = _groupby_phase1_fn(
+            mesh, axis, sh.cap, pmask is not None)(*args)
+
+    hint_key = (mesh, sh.cap, aggs)
+
+    def dispatch(sizes):
+        return _groupby_phase2_fn(mesh, axis, aggs, sizes[0])(
+            structure, row_valid, key_leaves, val_leaves)
+
+    def post(per_shard):
+        return (ops_compact.next_bucket(
+            max(int(per_shard.max(initial=0)), 1), minimum=8),)
+
     with trace.span_sync("groupby.local") as sp:
-        keys_out, outs, out_valids, counts = _groupby_fn(
-            dt.ctx.mesh, dt.ctx.axis, sh.cap, aggs)(
-            sh.counts, key_leaves, val_leaves)
+        (keys_out, outs, out_valids, counts), used, per_shard = \
+            ops_compact.optimistic_dispatch(
+                _group_cap_hints, hint_key, dispatch, ngs, post)
         sp.sync(outs)
+    out_cap = used[0]
 
     cols = []
     for i, (d, v) in zip(key_ids, keys_out):
@@ -491,7 +559,7 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
         base = sh.columns[dt.column_index(cref)]
         t_out = _agg_output_type(base.dtype.type, op)
         cols.append(DColumn(f"{op}_{base.name}", DataType(t_out), arr, validity))
-    return DTable(dt.ctx, cols, sh.cap, counts)
+    return DTable(dt.ctx, cols, out_cap, counts)
 
 
 # ---------------------------------------------------------------------------
@@ -672,6 +740,37 @@ def _env(columns: Sequence[DColumn]) -> dict:
     return {c.name: c.data for c in columns}
 
 
+def _masked_predicate(names, predicate, base_mask, leaves):
+    """The ONE definition of predicate evaluation semantics: the recording
+    env (so nulls in exactly the columns the predicate read veto the row —
+    SQL three-valued logic, waived per column via ``env.valid``), AND'ed
+    with ``base_mask``.  Shared by dist_select and every filter-pushdown
+    path so the semantics cannot diverge."""
+    env = _RecordingEnv({n: d for n, (d, _) in zip(names, leaves)},
+                        {n: v for n, (_, v) in zip(names, leaves)})
+    mask = predicate(env) & base_mask
+    for n, (_, v) in zip(names, leaves):
+        if n in env.accessed - env.null_handled and v is not None:
+            mask = mask & v
+    return mask
+
+
+def _predicate_mask(dt: DTable, predicate) -> jax.Array:
+    """Row mask [P*cap] for ``predicate``, AND'ed with the valid-row mask.
+    Pure elementwise — XLA propagates the mesh sharding; used by the
+    filter-pushdown paths (dist_groupby ``where``)."""
+    names = tuple(c.name for c in dt.columns)
+    key = ("pmask", dt.cap, names, predicate)
+    fn = _select_cache.get(key)
+    if fn is None:
+        def kernel(base_mask, leaves):
+            return _masked_predicate(names, predicate, base_mask, leaves)
+
+        fn = _cache_put(key, jax.jit(kernel))
+    leaves = tuple((c.data, c.validity) for c in dt.columns)
+    return fn(_row_mask(dt), leaves)
+
+
 def dist_select(dt: DTable, predicate) -> DTable:
     """Distributed row filter: ``predicate`` maps {column name: sharded data
     array} → bool mask; each shard compacts its surviving rows in place
@@ -684,15 +783,8 @@ def dist_select(dt: DTable, predicate) -> DTable:
     fn = _select_cache.get(key)
     if fn is None:
         def kernel(cnt, leaves):
-            env = _RecordingEnv({n: d for n, (d, _) in zip(names, leaves)},
-                                {n: v for n, (_, v) in zip(names, leaves)})
-            mask = predicate(env) & (jnp.arange(cap) < cnt[0])
-            # a NULL in a column the predicate read ⇒ comparison is
-            # "unknown" ⇒ the row is dropped, unless the predicate took
-            # over NULL handling for that column via env.valid(name)
-            for n, (_, v) in zip(names, leaves):
-                if n in env.accessed - env.null_handled and v is not None:
-                    mask = mask & v
+            mask = _masked_predicate(names, predicate,
+                                     jnp.arange(cap) < cnt[0], leaves)
             idx, count = ops_compact.mask_to_indices(mask, cap)
             outs = tuple(ops_gather.take_many(leaves, idx, fill_null=False))
             return outs, count[None].astype(jnp.int32)

@@ -115,15 +115,16 @@ def q1(ctx, t: Tables, delta_days: int = 90) -> Table:
     li = dist_project(t["lineitem"], [
         "l_shipdate", "l_returnflag", "l_linestatus", "l_quantity",
         "l_extendedprice", "l_discount", "l_tax", "l_orderkey"])
-    li = dist_select(li, _pred_le("l_shipdate", cutoff))
     li = dist_with_column(li, "disc_price", _revenue, Type.DOUBLE)
     li = dist_with_column(li, "charge", _charge, Type.DOUBLE)
+    # filter pushdown: the shipdate predicate rides the groupby's row mask
+    # instead of materializing a filtered copy of lineitem
     g = dist_groupby(li, ["l_returnflag", "l_linestatus"], [
         ("l_quantity", "sum"), ("l_extendedprice", "sum"),
         ("disc_price", "sum"), ("charge", "sum"),
         ("l_quantity", "mean"), ("l_extendedprice", "mean"),
         ("l_discount", "mean"), ("l_orderkey", "count"),
-    ])
+    ], where=_pred_le("l_shipdate", cutoff))
     from ..compute import sort_multi
     return sort_multi(g.to_table(), ["l_returnflag", "l_linestatus"])
 
@@ -187,13 +188,13 @@ def q5(ctx, t: Tables, region: str = "ASIA",
 def q6(ctx, t: Tables, date: str = "1994-01-01", discount: float = 0.06,
        quantity: float = 24.0) -> Table:
     d0 = date_to_days(date)
-    li = dist_select(t["lineitem"],
-                     _pred_q6(d0, d0 + 365, discount - 0.011,
-                              discount + 0.011, quantity))
-    li = dist_with_column(li, "rev", _disc_rev, Type.DOUBLE)
-    # global scalar reduce = groupby on a constant key
+    li = dist_with_column(t["lineitem"], "rev", _disc_rev, Type.DOUBLE)
+    # global scalar reduce = groupby on a constant key; the date/discount/
+    # quantity filter rides the groupby row mask (pushdown)
     li = dist_with_column(li, "_one", _const_zero, Type.INT32)
-    g = dist_groupby(li, ["_one"], [("rev", "sum")])
+    g = dist_groupby(li, ["_one"], [("rev", "sum")],
+                     where=_pred_q6(d0, d0 + 365, discount - 0.011,
+                                    discount + 0.011, quantity))
     return dist_project(g, ["sum_rev"]).to_table()
 
 

@@ -350,3 +350,34 @@ def test_groupby_float32_precision_small_group_after_large():
         assert int(ngroups) == 2
         small = float(np.asarray(outs[0])[1])
     assert abs(small - 3.0) < 1e-3, small
+
+
+def test_groupby_blocked_scan_spanning_groups(ctx):
+    """Exercise the blocked segmented scan (n >> block size) with groups
+    that span many 128-row blocks, all agg kinds, and nulls."""
+    rng = np.random.default_rng(5)
+    n = 5000
+    df = pd.DataFrame({
+        "g": np.sort(rng.integers(0, 7, n)).astype(np.int64),
+        "v": rng.normal(size=n),
+        "w": rng.integers(-50, 50, n).astype(np.int64),
+    })
+    df.loc[rng.random(n) < 0.1, "v"] = np.nan
+    t = Table.from_pandas(ctx, df)
+    ours = compute.groupby(t, ["g"], [("v", "sum"), ("v", "mean"),
+                                      ("v", "min"), ("v", "max"),
+                                      ("w", "min"), ("w", "max"),
+                                      ("w", "count")]).to_pandas()
+    oracle = df.groupby("g", as_index=False).agg(
+        sum_v=("v", "sum"), mean_v=("v", "mean"),
+        min_v=("v", "min"), max_v=("v", "max"),
+        min_w=("w", "min"), max_w=("w", "max"),
+        count_w=("w", "count"))
+    ours = ours.sort_values("g").reset_index(drop=True)
+    np.testing.assert_array_equal(ours["g"], oracle["g"])
+    for col, ocol in [("sum_v", "sum_v"), ("mean_v", "mean_v"),
+                      ("min_v", "min_v"), ("max_v", "max_v"),
+                      ("min_w", "min_w"), ("max_w", "max_w"),
+                      ("count_w", "count_w")]:
+        np.testing.assert_allclose(ours[col].astype(float),
+                                   oracle[ocol].astype(float), rtol=1e-9)

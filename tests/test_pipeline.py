@@ -84,3 +84,34 @@ def test_pipeline_no_pending_left_behind(dctx, rng):
 def test_flush_pending_idempotent_outside_region():
     assert ops_compact.flush_pending() is True
     assert ops_compact.flush_pending() is True
+
+
+def test_pipeline_hint_miss_after_poisoned_dispatch(dctx, rng):
+    """An op with NO size hint inside a deferred region must not size
+    itself from counts computed downstream of an undersized dispatch: the
+    region flushes, detects the poison, raises ReplayNeeded internally,
+    and run_pipeline replays to the correct result."""
+    ldf, left = _mk(dctx, rng, 600, 8)    # heavy duplication
+    rdf, right = _mk(dctx, rng, 500, 8)
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+
+    def query():
+        j = dist_join(left, right, cfg)
+        j2 = dist_join(j.rename(["k", "v1", "k2", "v2"]),
+                       right.rename(["k", "w"]),
+                       JoinConfig(JoinType.INNER, JoinAlgorithm.HASH, 0, 0))
+        return j2.to_table().num_rows
+
+    expect = query()  # sync seeding of all hints
+    # sabotage ONLY the first join's capacity hints; drop every other join
+    # hint so the second join takes the no-hint (blocking) path mid-region
+    sab = {}
+    for key in list(dops._capacity_hints):
+        if key[3] == "inner" and key[4] == "sort":
+            sab[key] = ((8,), 0)
+    assert sab, "expected a sort-join hint to sabotage"
+    dops._capacity_hints.clear()
+    dops._capacity_hints.update(sab)
+
+    got = run_pipeline(query)
+    assert got == expect
