@@ -184,6 +184,11 @@ def deferred_region():
         raise
     finally:
         _deferred.depth -= 1
+        if _deferred.depth == 0:
+            # a failed attempt must not leak ok=False to depth 0: later
+            # flush_pending() calls outside any region (and DTable.head's
+            # not-ok branch) would observe a stale failure
+            _deferred.ok = True
 
 
 def flush_pending() -> bool:

@@ -76,7 +76,10 @@ def _seg_scan_flat(vals: jax.Array, is_first: jax.Array, op):
     shapes (>15 min at 6M on a v5e; the unrolled shift loop compiles in
     seconds and is bandwidth-bound at runtime)."""
     n = vals.shape[0]
-    flags = is_first
+    # zero-padded shifted lanes below are only safe when position 0 opens a
+    # segment (true for every sorted-key caller); force it so a future
+    # caller can't silently corrupt min/max with the padded zeros
+    flags = is_first.at[0].set(True)
     vshape = (slice(None),) + (None,) * (vals.ndim - 1)
     d = 1
     while d < n:

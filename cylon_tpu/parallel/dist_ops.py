@@ -533,7 +533,11 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
         structure, row_valid, ngs = _groupby_phase1_fn(
             mesh, axis, sh.cap, pmask is not None)(*args)
 
-    hint_key = (mesh, sh.cap, aggs)
+    # key-column identity and the filter decide the group count, so they
+    # belong in the hint key — two different groupbys sharing one hint
+    # would mis-hint each other into redundant redispatches/replays
+    # (predicates are identity-hashable, same as _select_cache's key)
+    hint_key = (mesh, sh.cap, aggs, tuple(key_ids), where)
 
     def dispatch(sizes):
         return _groupby_phase2_fn(mesh, axis, aggs, sizes[0])(

@@ -35,6 +35,12 @@ from ..status import Code, CylonError, Status
 from ..table import Column, Table
 
 
+# head(n) at or below this row count uses the fused single-round-trip
+# kernel (replicated [n] block + psum); above it, the counts-based export
+# path, whose transfer scales with rows taken instead of O(P·n) memory
+_HEAD_FUSED_MAX = 4096
+
+
 @dataclass
 class DColumn:
     """One distributed column: global sharded data + optional validity.
@@ -306,6 +312,18 @@ class DTable:
         n_eff = min(int(n), self.nparts * self.cap)
         if n_eff <= 0:
             return self._export([0] * self.nparts)
+        if n_eff > _HEAD_FUSED_MAX:
+            # the fused kernel replicates an [n_eff] block per device and
+            # psums it — O(P·n) memory for a big head().  Past a modest n
+            # the counts-based export (transfers only the taken rows, one
+            # blocking count read) is strictly better.
+            cnts = self.counts_host()
+            takes, remaining = [], n_eff
+            for i in range(self.nparts):
+                t = min(int(cnts[i]), remaining)
+                takes.append(t)
+                remaining -= t
+            return self._export(takes)
         leaves = tuple((c.data, c.validity) for c in self.columns)
         outs, got = _head_fn(self.ctx.mesh, self.ctx.axis, self.cap, n_eff,
                              tuple(c.validity is not None
