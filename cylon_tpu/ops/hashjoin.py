@@ -118,19 +118,20 @@ def hash_join_indices(l_rank: jax.Array, r_rank: jax.Array, how: str,
     emit = (match_cnt if how == INNER
             else jnp.where(valid_l, jnp.maximum(match_cnt, 1), 0))
 
-    # pre-gather each probe row's bucket offset at probe size, so the
-    # expansion needs only capacity-sized gathers of offs_l and grouped
+    # pre-gather each probe row's bucket offset at probe size; it rides the
+    # expansion's packed decode gather (extras), so the expansion pays only
+    # ONE capacity-sized gather beyond the grouped lookup
     offs_l = jnp.take(offs, jnp.minimum(g, n_ranks - 1))
 
-    def right_at(pos, within):
-        r_pos = jnp.clip(jnp.take(offs_l, pos) + within, 0, n_r - 1)
+    def right_at(pos, within, offs_c):
+        r_pos = jnp.clip(offs_c + within, 0, n_r - 1)
         return jnp.take(grouped, r_pos.astype(jnp.int32))
 
     j, left_idx, right_idx, total_lpart = expand_pairs(
         emit, match_cnt, capacity, idt, n_l,
         left_at=lambda pos: pos.astype(jnp.int32),   # probe in original order
         right_at=right_at,
-        inner=(how == INNER))
+        inner=(how == INNER), extras=(offs_l,))
 
     if how == FULL_OUTER:
         l_present = jnp.bincount(g, length=n_ranks + 1).at[n_ranks].set(0) > 0

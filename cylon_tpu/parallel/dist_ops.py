@@ -482,8 +482,11 @@ def _groupby_phase2_fn(mesh, axis: str, aggs: Tuple[str, ...], out_cap: int):
 
 
 # Last bucketed group-count capacity per groupby signature (the optimistic
-# dispatch pattern shared with join phase 2 / shuffle).
+# dispatch pattern shared with join phase 2 / shuffle).  Bounded: the key
+# includes the caller's `where` predicate object, so a fresh-lambda-per-call
+# pattern would otherwise grow it (and pin the closures) forever.
 _group_cap_hints: dict = {}
+_GROUP_HINTS_MAX = 256
 
 
 def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
@@ -538,6 +541,8 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     # would mis-hint each other into redundant redispatches/replays
     # (predicates are identity-hashable, same as _select_cache's key)
     hint_key = (mesh, sh.cap, aggs, tuple(key_ids), where)
+    while len(_group_cap_hints) > _GROUP_HINTS_MAX:
+        _group_cap_hints.pop(next(iter(_group_cap_hints)))
 
     def dispatch(sizes):
         return _groupby_phase2_fn(mesh, axis, aggs, sizes[0])(

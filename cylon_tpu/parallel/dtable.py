@@ -302,12 +302,16 @@ class DTable:
     def head(self, n: int) -> Table:
         """First ``n`` global rows (shard-major order) as a local Table.
 
-        Single round trip: the bounded gather runs entirely on device
-        (per-shard scatter into a replicated [n] block, combined by psum
-        over disjoint positions), and the transfer shares one batched
+        For ``n`` ≤ _HEAD_FUSED_MAX (the LIMIT-sized case): single round
+        trip — the bounded gather runs entirely on device (per-shard
+        scatter into a replicated [n] block, combined by psum over
+        disjoint positions), and the transfer shares one batched
         ``device_get`` with any queued capacity validations
         (ops.compact.flush_pending_with) — the ORDER BY … LIMIT tail of a
-        pipeline costs one host read total.
+        pipeline costs one host read total.  Larger ``n`` takes the
+        counts-based export path instead (two round trips: counts, then
+        rows) — the fused kernel's replicated [n] block would cost
+        O(P·n) memory.
         """
         n_eff = min(int(n), self.nparts * self.cap)
         if n_eff <= 0:

@@ -1,0 +1,156 @@
+"""Microbenchmark decomposition of the 4M+4M single-chip join (round-3
+perf work).  Times each sub-kernel of the sort and hash join pipelines on
+the real chip, so the 441 ms headline can be attributed before anything
+is rewritten.
+
+The axon-tunneled TPU pays a ~130 ms fixed host-sync round trip, so a
+single dispatch+sync measures mostly tunnel latency.  Each op is timed by
+dispatching K1 then K2 back-to-back device-dependent iterations with ONE
+final sync each; per-op cost = (t2 - t1) / (K2 - K1), which cancels both
+the tunnel latency and dispatch overheads.
+
+Run: python experiments/profile_join.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu.trace import hard_sync
+
+N = int(os.environ.get("N", 4_000_000))
+KRANGE = max(int(2 * N * 0.99), 1)
+CAP = 4_194_304  # next_bucket(~4.04M)
+K1, K2 = 2, 10
+
+
+def timeit(name, fn, *args):
+    """fn: args -> out; chain(out, args) -> new args for the next iter.
+    Default chaining reuses the original args (ops are device-dependent via
+    donation-free dispatch order on one stream, which serializes anyway)."""
+    out = fn(*args)
+    hard_sync(out)  # compile + warm
+
+    def run(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = fn(*args)
+        hard_sync(out)
+        return time.perf_counter() - t0
+
+    best = min((run(K2) - run(K1)) / (K2 - K1) for _ in range(2))
+    print(f"{name:48s} {best*1e3:9.2f} ms")
+    return out
+
+
+def main():
+    rng = np.random.default_rng(3)
+    lk = jnp.asarray(rng.integers(0, KRANGE, N).astype(np.int32))
+    rk = jnp.asarray(rng.integers(0, KRANGE, N).astype(np.int32))
+    both = jnp.concatenate([lk, rk])
+    n = 2 * N
+    idx = jnp.arange(n, dtype=jnp.int32)
+    pad = jnp.zeros(n, bool)
+    print(f"platform={jax.devices()[0].platform} N={N} n={n} cap={CAP}")
+
+    timeit("null (x[:1])", jax.jit(lambda x: x[:1]), both)
+
+    # --- raw sorts ---------------------------------------------------------
+    timeit("lax.sort 8M 1op (key only)",
+           jax.jit(lambda k: jax.lax.sort((k,), num_keys=1)), both)
+    timeit("lax.sort 8M 2op (key,idx)",
+           jax.jit(lambda k, i: jax.lax.sort((k, i), num_keys=2)), both, idx)
+    timeit("lax.sort 8M 3op (pad,key,idx)",
+           jax.jit(lambda p, k, i: jax.lax.sort((p, k, i), num_keys=3)),
+           pad, both, idx)
+    timeit("argsort 4M stable",
+           jax.jit(lambda k: jnp.argsort(k, stable=True)), rk)
+
+    # --- scans / elementwise ----------------------------------------------
+    timeit("cumsum 8M i32", jax.jit(lambda x: jnp.cumsum(x)), idx)
+    timeit("cummax 8M i32", jax.jit(lambda x: jax.lax.cummax(x)), idx)
+
+    def three_scans(m, last, isf):
+        m32 = m.astype(jnp.int32)
+        cm = jnp.cumsum(m32)
+        end = jax.lax.cummin(jnp.where(last, cm, 2**31 - 1), reverse=True)
+        excl = jax.lax.cummax(jnp.where(isf, cm - m32, 0))
+        return end - excl, excl, cm
+
+    timeit("seg_span (3 scans) 8M", jax.jit(three_scans), pad, pad, pad)
+
+    # --- scatters / gathers ------------------------------------------------
+    starts = jnp.asarray(rng.integers(0, CAP, n).astype(np.int32))
+    timeit("scatter-max 8M -> cap",
+           jax.jit(lambda s: jnp.zeros(CAP, jnp.int32).at[s].max(
+               jnp.arange(n, dtype=jnp.int32), mode="drop")), starts)
+    gidx = jnp.asarray(rng.integers(0, N, CAP).astype(np.int32))
+    one_col = jnp.asarray(rng.random(N, dtype=np.float32))
+    timeit("gather 1 col cap<-4M",
+           jax.jit(lambda c, i: jnp.take(c, i)), one_col, gidx)
+    cols4 = tuple(jnp.asarray(rng.random(N, dtype=np.float32))
+                  for _ in range(4))
+    timeit("gather 4 cols separately cap<-4M",
+           jax.jit(lambda cs, i: tuple(jnp.take(c, i) for c in cs)),
+           cols4, gidx)
+    packed4 = jnp.stack(cols4, axis=1)
+    timeit("gather 4 cols packed (stack outside) cap<-4M",
+           jax.jit(lambda p, i: jnp.take(p, i, axis=0)), packed4, gidx)
+    timeit("stack 4 cols -> [4M,4]",
+           jax.jit(lambda cs: jnp.stack(cs, axis=1)), cols4)
+
+    # --- hash-path pieces --------------------------------------------------
+    timeit("bincount 4M vals -> 8M+1 table",
+           jax.jit(lambda r: jnp.bincount(r, length=n + 1)), rk)
+    timeit("bincount 4M vals -> 4M-range table",
+           jax.jit(lambda r: jnp.bincount(r, length=KRANGE + 1)), rk)
+    timeit("take(cnt)[4M probe]",
+           jax.jit(lambda c, g: jnp.take(c, g)),
+           jnp.ones(KRANGE + 1, jnp.int32), lk)
+
+    # --- full phase-1 pipelines -------------------------------------------
+    from cylon_tpu.ops import join as ops_join
+    from cylon_tpu.ops import hashjoin as ops_hashjoin
+
+    def sort_plan(lc, rc):
+        plan = ops_join.sort_join_plan((lc,), (None,), (rc,), (None,),
+                                       "inner", l_count=N, r_count=N)
+        return plan, ops_join.plan_total(plan, "inner", N, N)
+
+    plan, _ = timeit("sort_join_plan+total (phase1 sort path)",
+                     jax.jit(sort_plan), lk, rk)
+
+    def hash_p1(lc, rc):
+        lr, rr = ops_join.dense_ranks((lc,), (None,), (rc,), (None,),
+                                      l_count=N, r_count=N)
+        return lr, rr, ops_hashjoin.hash_join_count(lr, rr, "inner", N, N)
+
+    timeit("dense_ranks+hash_count (phase1 hash path)",
+           jax.jit(hash_p1), lk, rk)
+
+    def sort_p2(plan):
+        return ops_join.plan_indices(plan, "inner", CAP, N, N)
+
+    li, ri, _ = timeit("plan_indices (phase2 expand)",
+                       jax.jit(sort_p2), plan)
+
+    from cylon_tpu.ops import gather as ops_gather
+    leaves = tuple((jnp.asarray(rng.random(N, dtype=np.float32)), None)
+                   for _ in range(4))
+
+    def gather_side(leaves, li):
+        return tuple(ops_gather.take_many(leaves, li, fill_null=False))
+
+    timeit("take_many 4 leaves (one side)",
+           jax.jit(gather_side), leaves, li)
+
+
+if __name__ == "__main__":
+    main()
