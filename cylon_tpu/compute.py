@@ -203,7 +203,9 @@ def sort(t: Table, sort_column: Union[int, str], ascending: bool = True) -> Tabl
 
 
 def sort_multi(t: Table, sort_columns: Sequence[Union[int, str]],
-               ascending: bool = True) -> Table:
+               ascending=True) -> Table:
+    """Stable multi-key local sort; ``ascending`` is one bool or a
+    per-column sequence (ORDER BY mixed ASC/DESC)."""
     cols = [t.column(c) for c in sort_columns]
     order = ops_sort.lexsort_indices([c.data for c in cols],
                                      [c.validity for c in cols], ascending)

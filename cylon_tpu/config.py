@@ -38,10 +38,14 @@ class JoinConfig:
     reference: join/join_config.hpp:29-89
     """
 
+    # key spec: a column index/name, or a tuple of them for composite keys
+    # (the kernels are multi-column throughout; the reference's config is
+    # single-column — join_config.hpp:22-89 — composite keys are an
+    # intentional extension, used e.g. by TPC-H Q9's (partkey, suppkey))
     join_type: JoinType = JoinType.INNER
     algorithm: JoinAlgorithm = JoinAlgorithm.SORT
-    left_column_idx: int = 0
-    right_column_idx: int = 0
+    left_column_idx: object = 0
+    right_column_idx: object = 0
 
     @staticmethod
     def InnerJoin(left_column_idx: int = 0, right_column_idx: int = 0,

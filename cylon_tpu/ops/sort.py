@@ -32,11 +32,15 @@ def sort_indices(col: jax.Array, validity: Optional[jax.Array] = None,
 
 def lexsort_indices(cols: Sequence[jax.Array],
                     validities: Optional[Sequence[Optional[jax.Array]]] = None,
-                    ascending: bool = True) -> jax.Array:
-    """Stable lexicographic argsort; cols[0] is the primary key."""
+                    ascending=True) -> jax.Array:
+    """Stable lexicographic argsort; cols[0] is the primary key.
+    ``ascending`` is one bool for all keys or a per-column sequence
+    (ORDER BY mixed ASC/DESC)."""
+    asc = ([ascending] * len(cols) if isinstance(ascending, bool)
+           else list(ascending))
     keys = []
     for i, c in enumerate(cols):
-        k = c if ascending else _invert(c)
+        k = c if asc[i] else _invert(c)
         v = validities[i] if validities is not None else None
         if v is not None:
             keys.append((~v, k))

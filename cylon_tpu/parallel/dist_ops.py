@@ -258,36 +258,53 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig) -> DTable:
             local sort-merge join — shards are ordered by key ranges, so
             the join output is additionally globally key-ordered.
     """
-    left, right, li_key, ri_key, alg, splitters = _join_prologue(
+    left, right, li_keys, ri_keys, alg, splitters = _join_prologue(
         left, right, config)
-    lsh = _copartition(left, li_key, alg, splitters)
-    rsh = _copartition(right, ri_key, alg, splitters)
-    return _join_copartitioned(lsh, rsh, li_key, ri_key,
+    lsh = _copartition(left, li_keys, alg, splitters)
+    rsh = _copartition(right, ri_keys, alg, splitters)
+    return _join_copartitioned(lsh, rsh, li_keys, ri_keys,
                                config.join_type.value, alg)
+
+
+def _join_keys(dt: DTable, spec) -> List[int]:
+    """Key spec → column-index list: an int/str, or a tuple/list of them
+    (composite keys; the kernels are multi-column throughout, the config
+    merely carries the spec — reference join_config.hpp is single-column,
+    composite keys are an intentional extension)."""
+    if isinstance(spec, (tuple, list)):
+        return [dt.column_index(c) for c in spec]
+    return [dt.column_index(spec)]
 
 
 def _join_prologue(left: DTable, right: DTable, config: JoinConfig):
     """Shared setup for the one-shot and streaming joins: key resolution,
     type check, dictionary unification, algorithm + sort splitters."""
-    li_key = left.column_index(config.left_column_idx)
-    ri_key = right.column_index(config.right_column_idx)
-    lt_k = left.columns[li_key].dtype.type
-    rt_k = right.columns[ri_key].dtype.type
-    if lt_k != rt_k:
-        raise CylonError(Status(Code.TypeError,
-            f"join key type mismatch {lt_k.name} vs {rt_k.name}"))
-    left, right = _unify_dtable_dicts(left, right, [li_key], [ri_key])
+    li_keys = _join_keys(left, config.left_column_idx)
+    ri_keys = _join_keys(right, config.right_column_idx)
+    if len(li_keys) != len(ri_keys):
+        raise CylonError(Status(Code.Invalid,
+            f"join key arity mismatch: {len(li_keys)} vs {len(ri_keys)}"))
+    for li, ri in zip(li_keys, ri_keys):
+        lt_k = left.columns[li].dtype.type
+        rt_k = right.columns[ri].dtype.type
+        if lt_k != rt_k:
+            raise CylonError(Status(Code.TypeError,
+                f"join key type mismatch {lt_k.name} vs {rt_k.name}"))
+    left, right = _unify_dtable_dicts(left, right, li_keys, ri_keys)
     alg = "sort" if config.algorithm == JoinAlgorithm.SORT else "hash"
     if alg == "hash" or left.ctx.get_world_size() == 1:
         splitters = None
     else:
+        # range partition samples the PRIMARY key column; equal primary
+        # values land on one shard, and equal composite keys share their
+        # primary value, so composite keys still co-locate
         with trace.span("join.sample"):
             splitters = _sample_splitters(
-                [(left, li_key), (right, ri_key)], ascending=True)
-    return left, right, li_key, ri_key, alg, splitters
+                [(left, li_keys[0]), (right, ri_keys[0])], ascending=True)
+    return left, right, li_keys, ri_keys, alg, splitters
 
 
-def _copartition(dt: DTable, key_i: int, alg: str,
+def _copartition(dt: DTable, key_is: Sequence[int], alg: str,
                  splitters) -> DTable:
     """Route rows to their join shard (hash or range partitioning).
 
@@ -298,9 +315,9 @@ def _copartition(dt: DTable, key_i: int, alg: str,
         return dt  # one shard: co-partitioning is a no-op
     with trace.span_sync("join.partition") as sp:
         if alg == "sort":
-            pid = _range_pids(dt, key_i, splitters, ascending=True)
+            pid = _range_pids(dt, key_is[0], splitters, ascending=True)
         else:
-            pid = _hash_pids(dt, [key_i])
+            pid = _hash_pids(dt, key_is)
         sp.sync(pid)
     with trace.span("join.shuffle"):
         return _shuffle_by_pids(dt, pid)
@@ -314,16 +331,18 @@ def _copartition(dt: DTable, key_i: int, alg: str,
 _capacity_hints: dict = {}
 
 
-def _join_copartitioned(lsh: DTable, rsh: DTable, li_key: int, ri_key: int,
-                        how: str, alg: str) -> DTable:
+def _join_copartitioned(lsh: DTable, rsh: DTable, li_keys: Sequence[int],
+                        ri_keys: Sequence[int], how: str, alg: str) -> DTable:
     """Masked local join of already co-partitioned sides (dist_join's tail)."""
     ctx = lsh.ctx
     mesh, axis = ctx.mesh, ctx.axis
-    lkc, rkc = lsh.columns[li_key], rsh.columns[ri_key]
+    lkcs = [lsh.columns[i] for i in li_keys]
+    rkcs = [rsh.columns[i] for i in ri_keys]
     with trace.span("join.count"):
         plan, cnts = _join_phase1_fn(mesh, axis, how, alg)(
-            lsh.counts, rsh.counts, (lkc.data,), (lkc.validity,),
-            (rkc.data,), (rkc.validity,))
+            lsh.counts, rsh.counts,
+            tuple(c.data for c in lkcs), tuple(c.validity for c in lkcs),
+            tuple(c.data for c in rkcs), tuple(c.validity for c in rkcs))
 
     fill_left = how in ("right", "full_outer")
     fill_right = how in ("left", "full_outer")

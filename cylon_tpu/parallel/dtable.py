@@ -89,7 +89,14 @@ class DTable:
             # abort for replay instead of materializing poisoned counts
             ops_compact.flush_pending()
             ops_compact._abort_if_poisoned()
-            self._counts_host = np.asarray(jax.device_get(self.counts))
+            c = self.counts
+            if not c.is_fully_addressable:
+                # multi-controller: this process only holds its own shards;
+                # replicate via all_gather so every controller can read the
+                # full count vector (reference: every MPI rank knows the
+                # exchange header counts, mpi_channel.cpp's 8-int header)
+                c = _replicate_counts_fn(self.ctx.mesh, self.ctx.axis)(c)
+            self._counts_host = np.asarray(jax.device_get(c))
         return self._counts_host
 
     @property
@@ -378,6 +385,21 @@ class DTable:
 def _export_take(a: jax.Array, idx: jax.Array) -> jax.Array:
     """Device-side row compaction for export (re-traced per shape bucket)."""
     return jnp.take(a, idx, axis=0)
+
+
+@_functools.lru_cache(maxsize=None)
+def _replicate_counts_fn(mesh, axis: str):
+    """[P]-sharded counts → replicated copy every controller can read."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def kernel(cnt_blk):
+        return jax.lax.all_gather(cnt_blk[0], axis)
+
+    # check_vma=False: the all_gathered output is replicated, which
+    # shard_map cannot statically infer
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(), check_vma=False))
 
 
 @_functools.lru_cache(maxsize=None)

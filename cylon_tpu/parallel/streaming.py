@@ -126,6 +126,11 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
     """
     if (chunks <= 1 or left.cap < chunks
             or config.join_type.value in ("right", "full_outer")):
+        from .. import logging as glog
+        glog.vlog(1, "dist_join_streaming[%s]: falling back to one-shot "
+                  "dist_join (chunks=%d, cap=%d) — RIGHT/FULL_OUTER cannot "
+                  "stream (unmatched-right needs all left chunks)",
+                  config.join_type.value, chunks, left.cap)
         return dist_join(left, right, config)
 
     left, right, li_key, ri_key, alg, splitters = _join_prologue(

@@ -345,6 +345,10 @@ class Table:
         return Table(self.ctx, [replace(c, name=n)
                                 for c, n in zip(self.columns, names)])
 
+    def rename_column(self, old: str, new: str) -> "Table":
+        return self.rename([new if c.name == old else c.name
+                            for c in self.columns])
+
     def to_string(self, row1: int = 0, row2: Optional[int] = None,
                   col1: int = 0, col2: Optional[int] = None) -> str:
         """A window of the table, formatted (reference: table_api.cpp

@@ -2,16 +2,19 @@
 
 Cardinalities and value distributions follow the TPC-H 2.x spec shapes
 (lineitem ≈ 6M·SF via 1–7 lines per order, orders = 1.5M·SF, customer =
-150k·SF, supplier = 10k·SF, 25 nations over 5 regions); columns are limited
-to the ones the implemented queries (Q1/Q3/Q5/Q6/Q10) touch, typed for the
-device path: DATE → int32 days since 1992-01-01, money/quantity → float32,
-low-cardinality strings → dictionary-encoded.
+150k·SF, part = 200k·SF, partsupp = 4 suppliers per part, supplier =
+10k·SF, 25 nations over 5 regions); columns are limited to the ones the
+implemented queries (Q1/Q3/Q4/Q5/Q6/Q9/Q10/Q12/Q14/Q18/Q19) touch, typed
+for the device path: DATE → int32 days since 1992-01-01, money/quantity →
+float32, low-cardinality strings → dictionary-encoded.  All integer keys
+are int32-native (valid to SF ≈ 1400 — o_orderkey = 1.5M·SF is the widest)
+so TPU ingest with x64 off narrows nothing.
 
 The reference's closest analogue is its uniform-int CSV generator for the
 scaling runs (reference: cpp/src/experiments/generate_csv.py:1-30,
 generate_files.py:20-52); TPC-H's skew (shared orderkeys across lineitems,
-date windows, segment/flag enums) exercises the same shuffle/join/groupby
-machinery much harder.
+the partsupp supplier formula, date windows, segment/flag enums) exercises
+the same shuffle/join/groupby machinery much harder.
 """
 from __future__ import annotations
 
@@ -27,6 +30,11 @@ import pandas as pd
 # 2436) then filters the ~4% of lineitems shipped after it, per spec.
 DAYS_TOTAL = 2406
 _EPOCH = np.datetime64("1992-01-01")
+
+# calendar-year boundaries as day offsets (1992 and 1996 are leap years);
+# YEAR_BOUNDS[i] = first day of year 1992+i.  Q9 groups by o_year.
+YEAR_BOUNDS = np.array([0, 366, 731, 1096, 1461, 1827, 2192, 2557],
+                       dtype=np.int32)
 
 SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
 RETURN_FLAGS = ["A", "N", "R"]
@@ -44,8 +52,25 @@ NATIONS = [  # (name, region) — the spec's 25 nations over 5 regions
 ]
 REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
 
+# part enums (spec 4.2.2-ish shapes, trimmed to what the queries filter on)
+P_NAME_WORDS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blanched", "blue", "blush", "brown", "burlywood", "burnished",
+    "chartreuse", "chiffon", "chocolate", "coral", "cornflower", "cream",
+    "cyan", "dark", "deep", "dim", "dodger", "drab", "firebrick", "floral",
+    "forest", "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey",
+    "honeydew", "hot", "hotpink", "indian", "ivory", "khaki",
+]
+P_TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+P_TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+P_TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+P_CONTAINER_1 = ["SM", "MED", "LG", "JUMBO", "WRAP"]
+P_CONTAINER_2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+
 TABLE_NAMES = ("lineitem", "orders", "customer", "supplier", "nation",
-               "region")
+               "region", "part", "partsupp")
+
+SUPPLIERS_PER_PART = 4
 
 
 def date_to_days(iso: str) -> int:
@@ -53,17 +78,34 @@ def date_to_days(iso: str) -> int:
     return int((np.datetime64(iso) - _EPOCH).astype(int))
 
 
+def days_to_year(days: np.ndarray) -> np.ndarray:
+    """Day offsets → calendar year (1992..1998), numpy side (the device
+    side uses the same YEAR_BOUNDS via searchsorted)."""
+    return (1992 + np.searchsorted(YEAR_BOUNDS, days, side="right")
+            - 1).astype(np.int32)
+
+
+def part_supp_key(partkey: np.ndarray, i: np.ndarray,
+                  n_supp: int) -> np.ndarray:
+    """The spec's supplier-of-part formula: the i-th (0..3) supplier of
+    part p is ((p + i·(S/4)) mod S) + 1 — every (l_partkey, l_suppkey)
+    pair generated with it exists in partsupp by construction."""
+    step = max(n_supp // SUPPLIERS_PER_PART, 1)
+    return (((partkey - 1) + i * step) % n_supp + 1).astype(np.int32)
+
+
 def generate(scale: float, seed: int = 42) -> Dict[str, pd.DataFrame]:
-    """All six tables as pandas DataFrames (device typing happens at
+    """All eight tables as pandas DataFrames (device typing happens at
     Table.from_pandas ingest).  ``scale`` is the TPC-H SF; fractional scales
     shrink every table proportionally (floor 1 row) for tests."""
     rng = np.random.default_rng(seed)
     n_cust = max(int(150_000 * scale), 1)
     n_ord = max(int(1_500_000 * scale), 1)
-    n_supp = max(int(10_000 * scale), 1)
+    n_supp = max(int(10_000 * scale), SUPPLIERS_PER_PART)
+    n_part = max(int(200_000 * scale), 1)
 
     customer = pd.DataFrame({
-        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int32),
         "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int32),
         "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2)
         .astype(np.float32),
@@ -73,8 +115,8 @@ def generate(scale: float, seed: int = 42) -> Dict[str, pd.DataFrame]:
 
     o_orderdate = rng.integers(0, DAYS_TOTAL, n_ord).astype(np.int32)
     orders = pd.DataFrame({
-        "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
-        "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int64),
+        "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int32),
+        "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int32),
         "o_orderdate": o_orderdate,
         "o_orderpriority": pd.Categorical.from_codes(
             rng.integers(0, len(PRIORITIES), n_ord), PRIORITIES),
@@ -88,13 +130,18 @@ def generate(scale: float, seed: int = 42) -> Dict[str, pd.DataFrame]:
     n_li = int(lines_per.sum())
     l_orderkey = np.repeat(orders["o_orderkey"].to_numpy(), lines_per)
     l_odate = np.repeat(o_orderdate, lines_per)
+    l_partkey = rng.integers(1, n_part + 1, n_li).astype(np.int32)
+    l_suppkey = part_supp_key(l_partkey,
+                              rng.integers(0, SUPPLIERS_PER_PART, n_li),
+                              n_supp)
     # ship/commit/receipt hang off the order date (spec: +1..121, +30..90, +1..30)
     l_shipdate = l_odate + rng.integers(1, 122, n_li).astype(np.int32)
     l_commitdate = l_odate + rng.integers(30, 91, n_li).astype(np.int32)
     l_receiptdate = l_shipdate + rng.integers(1, 31, n_li).astype(np.int32)
     lineitem = pd.DataFrame({
         "l_orderkey": l_orderkey,
-        "l_suppkey": rng.integers(1, n_supp + 1, n_li).astype(np.int64),
+        "l_partkey": l_partkey,
+        "l_suppkey": l_suppkey,
         "l_quantity": rng.integers(1, 51, n_li).astype(np.float32),
         "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2)
         .astype(np.float32),
@@ -115,8 +162,55 @@ def generate(scale: float, seed: int = 42) -> Dict[str, pd.DataFrame]:
     })
 
     supplier = pd.DataFrame({
-        "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
+        "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int32),
         "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int32),
+    })
+
+    # part: names are two color words (Q9 filters '%green%'), types the
+    # spec's 3-syllable cross product (Q14/Q19 filter 'PROMO%'/specific)
+    w1 = rng.integers(0, len(P_NAME_WORDS), n_part)
+    w2 = rng.integers(0, len(P_NAME_WORDS), n_part)
+    name_pool = sorted({f"{P_NAME_WORDS[a]} {P_NAME_WORDS[b]}"
+                        for a in range(len(P_NAME_WORDS))
+                        for b in range(len(P_NAME_WORDS))})
+    name_code = {s: i for i, s in enumerate(name_pool)}
+    # word pair -> code via a [W, W] LUT (vectorized; 2M-part scales must
+    # not pay 4M Python-level string formats per generate())
+    lut = np.empty((len(P_NAME_WORDS), len(P_NAME_WORDS)), np.int32)
+    for a, wa in enumerate(P_NAME_WORDS):
+        for b, wb in enumerate(P_NAME_WORDS):
+            lut[a, b] = name_code[f"{wa} {wb}"]
+    types = [f"{a} {b} {c}" for a in P_TYPE_S1 for b in P_TYPE_S2
+             for c in P_TYPE_S3]
+    containers = [f"{a} {b}" for a in P_CONTAINER_1 for b in P_CONTAINER_2]
+    brands = [f"Brand#{m}{n}" for m in range(1, 6) for n in range(1, 6)]
+    part = pd.DataFrame({
+        "p_partkey": np.arange(1, n_part + 1, dtype=np.int32),
+        "p_name": pd.Categorical.from_codes(lut[w1, w2], name_pool),
+        "p_type": pd.Categorical.from_codes(
+            rng.integers(0, len(types), n_part), types),
+        "p_brand": pd.Categorical.from_codes(
+            rng.integers(0, len(brands), n_part), brands),
+        "p_container": pd.Categorical.from_codes(
+            rng.integers(0, len(containers), n_part), containers),
+        "p_size": rng.integers(1, 51, n_part).astype(np.int32),
+        "p_retailprice": np.round(rng.uniform(900.0, 2000.0, n_part), 2)
+        .astype(np.float32),
+    })
+
+    # partsupp: exactly the 4 suppliers the lineitem formula can draw
+    ps_partkey = np.repeat(part["p_partkey"].to_numpy(),
+                           SUPPLIERS_PER_PART)
+    ps_i = np.tile(np.arange(SUPPLIERS_PER_PART), n_part)
+    partsupp = pd.DataFrame({
+        "ps_partkey": ps_partkey,
+        "ps_suppkey": part_supp_key(ps_partkey, ps_i, n_supp),
+        "ps_supplycost": np.round(
+            rng.uniform(1.0, 1000.0, n_part * SUPPLIERS_PER_PART), 2)
+        .astype(np.float32),
+        "ps_availqty": rng.integers(1, 10_000,
+                                    n_part * SUPPLIERS_PER_PART)
+        .astype(np.int32),
     })
 
     nation = pd.DataFrame({
@@ -131,4 +225,5 @@ def generate(scale: float, seed: int = 42) -> Dict[str, pd.DataFrame]:
     })
 
     return {"lineitem": lineitem, "orders": orders, "customer": customer,
-            "supplier": supplier, "nation": nation, "region": region}
+            "supplier": supplier, "nation": nation, "region": region,
+            "part": part, "partsupp": partsupp}

