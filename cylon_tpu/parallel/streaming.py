@@ -127,10 +127,13 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
     if (chunks <= 1 or left.cap < chunks
             or config.join_type.value in ("right", "full_outer")):
         from .. import logging as glog
+        reason = ("RIGHT/FULL_OUTER cannot stream (unmatched-right needs "
+                  "all left chunks)"
+                  if config.join_type.value in ("right", "full_outer")
+                  else f"chunks={chunks} does not divide cap={left.cap} "
+                  "into multiple slices")
         glog.vlog(1, "dist_join_streaming[%s]: falling back to one-shot "
-                  "dist_join (chunks=%d, cap=%d) — RIGHT/FULL_OUTER cannot "
-                  "stream (unmatched-right needs all left chunks)",
-                  config.join_type.value, chunks, left.cap)
+                  "dist_join — %s", config.join_type.value, reason)
         return dist_join(left, right, config)
 
     left, right, li_key, ri_key, alg, splitters = _join_prologue(

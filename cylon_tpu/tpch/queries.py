@@ -437,7 +437,9 @@ def _indicator_notin(col: str, codes: tuple):
 
 def q14(ctx, t: Tables, date: str = "1995-09-01") -> Table:
     d0 = date_to_days(date)
-    d1 = date_to_days("1995-10-01")
+    # spec window: [date, date + 1 month)
+    d1 = date_to_days(str((np.datetime64(date, "M") + 1)
+                          .astype("datetime64[D]")))
     li = dist_select(dist_project(t["lineitem"],
                                   ["l_partkey", "l_shipdate",
                                    "l_extendedprice", "l_discount"]),
@@ -455,8 +457,8 @@ def q14(ctx, t: Tables, date: str = "1995-09-01") -> Table:
     g = dist_groupby(m, ["_one"], [("promo_rev", "sum"), ("rev", "sum")])
     out = g.to_table().to_pandas()
     import pandas as pd
-    pr = float(out["sum_promo_rev"].iloc[0])
-    rv = float(out["sum_rev"].iloc[0])
+    pr = float(out["sum_promo_rev"].iloc[0]) if len(out) else 0.0
+    rv = float(out["sum_rev"].iloc[0]) if len(out) else 0.0
     return Table.from_pandas(ctx, pd.DataFrame(
         {"promo_revenue": np.float32([100.0 * pr / rv if rv else 0.0])}))
 

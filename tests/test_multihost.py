@@ -46,6 +46,9 @@ def test_two_process_mesh():
         assert rc == 0, f"worker {pid} rc={rc}\n{out}\n{err[-3000:]}"
         assert f"MULTIHOST_OK {pid} world=8" in out, (out, err[-2000:])
     # both controllers agree on the data-dependent results
-    tail0 = outs[0][1].strip().splitlines()[-1].split("world=8")[1]
-    tail1 = outs[1][1].strip().splitlines()[-1].split("world=8")[1]
-    assert tail0 == tail1, (tail0, tail1)
+    def ok_line(out: str) -> str:
+        lines = [l for l in out.splitlines() if "MULTIHOST_OK" in l]
+        assert lines, out
+        return lines[-1].split("world=8")[1]
+
+    assert ok_line(outs[0][1]) == ok_line(outs[1][1])
