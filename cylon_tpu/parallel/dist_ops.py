@@ -590,6 +590,92 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     return DTable(dt.ctx, cols, out_cap, counts)
 
 
+@functools.lru_cache(maxsize=None)
+def _scalar_agg_fn(mesh, axis: str, cap: int, aggs: Tuple[str, ...],
+                   has_where: bool):
+    """Whole-table reductions: per-shard masked fold + one psum each —
+    no sort, no groups.  The constant-key groupby a scalar aggregate would
+    otherwise ride sorts the entire padded block (measured 2.6 s for a
+    SF-10 Q6 at 67M cap; this path is ~30 ms device)."""
+
+    def kernel(cnt, val_leaves, *maybe_mask):
+        base = (maybe_mask[0] if has_where
+                else (jnp.arange(cap) < cnt[0]))
+        outs = []
+        nonempty = []  # SQL: min/max/mean over zero rows are NULL
+        for (d, v), op in zip(val_leaves, aggs):
+            m = base if v is None else (base & v)
+            c = jax.lax.psum(jnp.sum(m).astype(jnp.int32), axis)
+            nonempty.append(c > 0)
+            if op in ("sum", "mean"):
+                s = jax.lax.psum(jnp.where(m, d, 0).sum(), axis)
+            if op == "sum":
+                outs.append(s)
+            elif op == "count":
+                outs.append(c)
+            elif op == "mean":
+                outs.append(s / jnp.maximum(c, 1).astype(d.dtype))
+            elif op in ("min", "max"):
+                from ..dtypes import extreme_value
+                fill = extreme_value(d.dtype, largest=(op == "min"))
+                folded = jnp.where(m, d, fill)
+                local = folded.min() if op == "min" else folded.max()
+                outs.append(jax.lax.pmin(local, axis) if op == "min"
+                            else jax.lax.pmax(local, axis))
+            else:
+                raise ValueError(f"unknown aggregation {op!r}")
+        return tuple(outs), tuple(nonempty)
+
+    spec = P(axis)
+    nargs = 3 if has_where else 2
+    # check_vma=False: psum outputs are replicated
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * nargs,
+                             out_specs=((P(),) * len(aggs),) * 2,
+                             check_vma=False))
+
+
+def dist_aggregate(dt: DTable,
+                   aggregations: Sequence[Tuple[Union[int, str], str]],
+                   where=None) -> "Table":
+    """Whole-table (scalar) aggregate — the GROUP BY-less SELECT SUM(…)
+    shape.  Returns a ONE-row local Table with columns ``{op}_{col}``.
+
+    ``where`` follows the same predicate protocol (and SQL null semantics)
+    as ``dist_select``/``dist_groupby``; it rides the reduction mask, so a
+    filtered scalar aggregate is one fused device pass + one host read.
+    """
+    val_ids = [dt.column_index(c) for c, _ in aggregations]
+    aggs = tuple(op for _, op in aggregations)
+    pmask = None if where is None else _predicate_mask(dt, where)
+    val_leaves = tuple((dt.columns[i].data, dt.columns[i].validity)
+                       for i in val_ids)
+    args = (dt.counts, val_leaves) + (() if pmask is None else (pmask,))
+    with trace.span_sync("aggregate.scalar") as sp:
+        outs, nonempty = _scalar_agg_fn(dt.ctx.mesh, dt.ctx.axis, dt.cap,
+                                        aggs, pmask is not None)(*args)
+        sp.sync(outs)
+    from ..compute import _agg_output_type
+    from ..dtypes import DataType, Type, device_dtype
+    from ..table import Column, Table
+    cols = []
+    for (cref, op), val, ne in zip(aggregations, outs, nonempty):
+        base = dt.columns[dt.column_index(cref)]
+        t_out = _agg_output_type(base.dtype.type, op)
+        if not jax.config.jax_enable_x64:
+            # declared type must match device storage (same logical-type
+            # downgrade as ingest / dist_with_column)
+            t_out = {Type.INT64: Type.INT32, Type.UINT64: Type.UINT32,
+                     Type.DOUBLE: Type.FLOAT}.get(t_out, t_out)
+        # SQL semantics: SUM/COUNT over zero rows are 0; MIN/MAX/AVG are
+        # NULL (matches dist_groupby's empty-aggregate validity)
+        validity = (None if op in ("sum", "count")
+                    else jnp.asarray(ne)[None])
+        cols.append(Column(f"{op}_{base.name}", DataType(t_out),
+                           jnp.asarray(val, device_dtype(t_out))[None],
+                           validity))
+    return Table(dt.ctx, cols)
+
+
 # ---------------------------------------------------------------------------
 # distributed sample-sort (BASELINE config 4; absent in reference v0)
 # ---------------------------------------------------------------------------
@@ -799,33 +885,75 @@ def _predicate_mask(dt: DTable, predicate) -> jax.Array:
     return fn(_row_mask(dt), leaves)
 
 
+# Last bucketed output capacity per select signature (optimistic dispatch,
+# same pattern as join phase 2): a selective filter must SHRINK the block —
+# leaving survivors in the input-sized capacity makes every downstream op
+# (join sorts especially) pay for the dead padding.  Measured at TPC-H
+# SF-10: a month filter on lineitem leaves 748k rows in a 67M block, and
+# the following part join took 6.8 s; with compaction it is ~100 ms.
+_select_cap_hints: dict = {}
+
+
 def dist_select(dt: DTable, predicate) -> DTable:
     """Distributed row filter: ``predicate`` maps {column name: sharded data
-    array} → bool mask; each shard compacts its surviving rows in place
-    (capacity unchanged, counts shrink).  Purely local — the reference's
-    Select is too (table_api.cpp:977-1005, per-row lambda → arrow Filter).
+    array} → bool mask; surviving rows compact into a size-class block
+    bucketed to the max per-shard survivor count.  Purely local compute —
+    the reference's Select is too (table_api.cpp:977-1005, per-row lambda →
+    arrow Filter) — plus the tiny replicated count all_gather every
+    two-phase op shares.
     """
     mesh, axis, cap = dt.ctx.mesh, dt.ctx.axis, dt.cap
     names = tuple(c.name for c in dt.columns)
-    key = (mesh, axis, cap, names, predicate)
-    fn = _select_cache.get(key)
-    if fn is None:
-        def kernel(cnt, leaves):
+    key1 = ("selmask", mesh, axis, cap, names, predicate)
+    p1 = _select_cache.get(key1)
+    if p1 is None:
+        def mask_kernel(cnt, leaves):
             mask = _masked_predicate(names, predicate,
                                      jnp.arange(cap) < cnt[0], leaves)
-            idx, count = ops_compact.mask_to_indices(mask, cap)
-            outs = tuple(ops_gather.take_many(leaves, idx, fill_null=False))
-            return outs, count[None].astype(jnp.int32)
+            n = jnp.sum(mask).astype(jnp.int32)
+            return mask, jax.lax.all_gather(n, axis)
 
         spec = P(axis)
-        fn = _cache_put(key, jax.jit(shard_map(
-            kernel, mesh=mesh, in_specs=(spec, spec),
-            out_specs=(spec, spec))))
+        # check_vma=False: the all_gathered counts are replicated
+        p1 = _cache_put(key1, jax.jit(shard_map(
+            mask_kernel, mesh=mesh, in_specs=(spec, spec),
+            out_specs=(spec, P()), check_vma=False)))
     leaves = tuple((c.data, c.validity) for c in dt.columns)
-    outs, counts = fn(dt.counts, leaves)
+    mask, cnts = p1(dt.counts, leaves)
+
+    nleaves = len(leaves)
+
+    def dispatch(sizes):
+        outcap = sizes[0]
+        key2 = ("selgather", mesh, axis, cap, outcap, nleaves)
+        p2 = _select_cache.get(key2)
+        if p2 is None:
+            def gather_kernel(mask, leaves):
+                idx, count = ops_compact.mask_to_indices(mask, outcap)
+                outs = tuple(ops_gather.take_many(leaves, idx,
+                                                  fill_null=False))
+                return outs, count[None].astype(jnp.int32)
+
+            spec = P(axis)
+            p2 = _cache_put(key2, jax.jit(shard_map(
+                gather_kernel, mesh=mesh, in_specs=(spec, spec),
+                out_specs=(spec, spec))))
+        return p2(mask, leaves)
+
+    def post(per_shard):
+        return (ops_compact.next_bucket(
+            max(int(per_shard.max(initial=0)), 1), minimum=8),)
+
+    while len(_select_cap_hints) > _GROUP_HINTS_MAX:  # predicate keys pin closures
+        _select_cap_hints.pop(next(iter(_select_cap_hints)))
+    with trace.span_sync("select.gather") as sp:
+        (outs, counts), used, _ = ops_compact.optimistic_dispatch(
+            _select_cap_hints, ("sel", mesh, cap, names, predicate),
+            dispatch, cnts, post)
+        sp.sync(outs)
     cols = [DColumn(c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
             for c, (d, v) in zip(dt.columns, outs)]
-    return DTable(dt.ctx, cols, cap, counts)
+    return DTable(dt.ctx, cols, used[0], counts)
 
 
 def dist_project(dt: DTable, columns: Sequence[Union[int, str]]) -> DTable:

@@ -26,8 +26,8 @@ import jax.numpy as jnp
 from ..config import JoinAlgorithm, JoinConfig, JoinType
 from ..dtypes import Type
 from ..table import Table
-from ..parallel import (DTable, dist_groupby, dist_head, dist_join,
-                        dist_project, dist_select, dist_sort,
+from ..parallel import (DTable, dist_aggregate, dist_groupby, dist_head,
+                        dist_join, dist_project, dist_select, dist_sort,
                         dist_with_column)
 from .datagen import date_to_days
 
@@ -152,10 +152,6 @@ def _disc_rev(env):
     return env["l_extendedprice"] * env["l_discount"]
 
 
-def _const_zero(env):
-    return jnp.zeros_like(env["l_shipdate"])
-
-
 # -- Q1: pricing summary report ---------------------------------------------
 
 def q1(ctx, t: Tables, delta_days: int = 90) -> Table:
@@ -253,13 +249,12 @@ def q6(ctx, t: Tables, date: str = "1994-01-01", discount: float = 0.06,
        quantity: float = 24.0) -> Table:
     d0 = date_to_days(date)
     li = dist_with_column(t["lineitem"], "rev", _disc_rev, Type.DOUBLE)
-    # global scalar reduce = groupby on a constant key; the date/discount/
-    # quantity filter rides the groupby row mask (pushdown)
-    li = dist_with_column(li, "_one", _const_zero, Type.INT32)
-    g = dist_groupby(li, ["_one"], [("rev", "sum")],
-                     where=_pred_q6(d0, d0 + 365, discount - 0.011,
-                                    discount + 0.011, quantity))
-    return dist_project(g, ["sum_rev"]).to_table()
+    # global scalar reduce: dist_aggregate folds the filtered rows with
+    # masked reductions + psum — no sort, no groups (the constant-key
+    # groupby formulation sorted the whole padded block)
+    return dist_aggregate(li, [("rev", "sum")],
+                          where=_pred_q6(d0, d0 + 365, discount - 0.011,
+                                         discount + 0.011, quantity))
 
 
 # -- Q10: returned item reporting -------------------------------------------
@@ -437,9 +432,12 @@ def _indicator_notin(col: str, codes: tuple):
 
 def q14(ctx, t: Tables, date: str = "1995-09-01") -> Table:
     d0 = date_to_days(date)
-    # spec window: [date, date + 1 month)
-    d1 = date_to_days(str((np.datetime64(date, "M") + 1)
-                          .astype("datetime64[D]")))
+    # spec window: [date, date + 1 month) — day-preserving month add via
+    # the length of date's month (exact for the spec's first-of-month
+    # parameters, monotone for any other day)
+    m = np.datetime64(date, "M")
+    d1 = d0 + int(((m + 1).astype("datetime64[D]")
+                   - m.astype("datetime64[D]")).astype(int))
     li = dist_select(dist_project(t["lineitem"],
                                   ["l_partkey", "l_shipdate",
                                    "l_extendedprice", "l_discount"]),
@@ -453,12 +451,11 @@ def q14(ctx, t: Tables, date: str = "1995-09-01") -> Table:
     m = dist_with_column(m, "promo_ind", _indicator_isin("p_type", promo),
                          Type.INT32)
     m = dist_with_column(m, "promo_rev", _promo_rev, Type.DOUBLE)
-    m = dist_with_column(m, "_one", _const_zero_i32, Type.INT32)
-    g = dist_groupby(m, ["_one"], [("promo_rev", "sum"), ("rev", "sum")])
-    out = g.to_table().to_pandas()
+    out = dist_aggregate(m, [("promo_rev", "sum"),
+                             ("rev", "sum")]).to_pandas()
     import pandas as pd
-    pr = float(out["sum_promo_rev"].iloc[0]) if len(out) else 0.0
-    rv = float(out["sum_rev"].iloc[0]) if len(out) else 0.0
+    pr = float(out["sum_promo_rev"].iloc[0])
+    rv = float(out["sum_rev"].iloc[0])
     return Table.from_pandas(ctx, pd.DataFrame(
         {"promo_revenue": np.float32([100.0 * pr / rv if rv else 0.0])}))
 
@@ -466,11 +463,6 @@ def q14(ctx, t: Tables, date: str = "1995-09-01") -> Table:
 def _promo_rev(env):
     return (env["promo_ind"].astype(jnp.float32)
             * env["l_extendedprice"] * (1.0 - env["l_discount"]))
-
-
-def _const_zero_i32(env):
-    k = next(iter(env))
-    return jnp.zeros_like(env[k], jnp.int32)
 
 
 # -- Q18: large volume customer -----------------------------------------------
@@ -528,13 +520,10 @@ def q19(ctx, t: Tables) -> Table:
                                  (1.0, 10.0, 20.0), (11.0, 20.0, 30.0),
                                  (5, 10, 15)))
     m = dist_with_column(m, "rev", _revenue, Type.DOUBLE)
-    m = dist_with_column(m, "_one", _const_zero_i32, Type.INT32)
-    g = dist_groupby(m, ["_one"], [("rev", "sum")])
-    out = dist_project(g, ["sum_rev"]).to_table().to_pandas()
+    out = dist_aggregate(m, [("rev", "sum")]).to_pandas()
     import pandas as pd
-    val = float(out["sum_rev"].iloc[0]) if len(out) else 0.0
     return Table.from_pandas(ctx, pd.DataFrame(
-        {"revenue": np.float32([val])}))
+        {"revenue": np.float32([float(out["sum_rev"].iloc[0])])}))
 
 
 QUERIES: Dict[str, Callable] = {
