@@ -666,8 +666,9 @@ def dist_aggregate(dt: DTable,
             # downgrade as ingest / dist_with_column)
             t_out = {Type.INT64: Type.INT32, Type.UINT64: Type.UINT32,
                      Type.DOUBLE: Type.FLOAT}.get(t_out, t_out)
-        # SQL semantics: SUM/COUNT over zero rows are 0; MIN/MAX/AVG are
-        # NULL (matches dist_groupby's empty-aggregate validity)
+        # Empty-input semantics are pandas-style, matching the oracle the
+        # whole test-suite verifies against: SUM and COUNT over zero rows
+        # are 0 (strict SQL would make SUM NULL); MIN/MAX/AVG are NULL.
         validity = (None if op in ("sum", "count")
                     else jnp.asarray(ne)[None])
         cols.append(Column(f"{op}_{base.name}", DataType(t_out),
