@@ -39,13 +39,17 @@ SUM, COUNT, MEAN, MIN, MAX = "sum", "count", "mean", "min", "max"
 AGG_OPS = (SUM, COUNT, MEAN, MIN, MAX)
 
 
-def group_structure(key_cols, key_validities, row_valid):
-    """One carried-values sort → (idxS, is_first, rvS): original row index
-    per sorted position, group-start flags, sorted row-validity.
+def group_structure(key_cols, key_validities, row_valid, carry=()):
+    """One carried-values sort → (idxS, is_first, rvS, carried): original
+    row index per sorted position, group-start flags, sorted row-validity,
+    and ``carry`` arrays permuted into sorted order.
 
     Exposed so a two-phase caller (parallel.dist_groupby) can compute the
     group count from phase 1 and pass the structure into
-    ``groupby_aggregate`` with a bucketed ``out_capacity``."""
+    ``groupby_aggregate`` with a bucketed ``out_capacity``.  Riding the
+    value columns through the sort via ``carry`` replaces the n-row pack
+    gather the aggregation would otherwise pay (extra sort operands are
+    ~free; a 67M-row gather is ~0.4 s on a v5e)."""
     from .join import sorted_key_structure
     n = key_cols[0].shape[0]
     ops = []
@@ -55,14 +59,35 @@ def group_structure(key_cols, key_validities, row_valid):
         if v is not None:
             ops.append(~v)
         ops.append(c)
-    sortedK, idxS, is_first = sorted_key_structure(ops, n)
+    sortedK, idxS, is_first, carried = sorted_key_structure(ops, n, carry)
     rvS = ~sortedK[0] if row_valid is not None else jnp.ones(n, bool)
-    return idxS, is_first, rvS
+    return idxS, is_first, rvS, carried
 
 
 def num_groups_of(structure) -> jax.Array:
-    _, is_first, rvS = structure
+    _, is_first, rvS = structure[:3]
     return jnp.sum(is_first & rvS).astype(jnp.int32)
+
+
+def carry_pack(value_cols, value_validities):
+    """Flatten value leaves into ``group_structure``'s carry tuple in the
+    FIXED layout ``(data columns…, validity masks of the nullable ones…)``.
+    Callers are responsible for passing each distinct column once (several
+    aggregations over one column must not ride the sort as repeated n-row
+    operands — dist_groupby dedupes to unique columns + a slot map)."""
+    return (tuple(value_cols)
+            + tuple(v for v in value_validities if v is not None))
+
+
+def carry_unpack(carried, value_validities):
+    """Positional inverse of ``carry_pack`` given the static nullability
+    template (which entries have a validity mask)."""
+    k = len(value_validities)
+    cols_s = tuple(carried[:k])
+    it = iter(carried[k:])
+    valids_s = tuple(next(it) if v is not None else None
+                     for v in value_validities)
+    return cols_s, valids_s
 
 
 _SEG_BLOCK = 128  # within-block scan width (log2 = 7 shift passes)
@@ -155,7 +180,8 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
                       value_validities: Sequence[Optional[jax.Array]],
                       aggs: Tuple[str, ...],
                       row_valid: Optional[jax.Array] = None,
-                      structure=None, out_capacity: Optional[int] = None):
+                      structure=None, out_capacity: Optional[int] = None,
+                      sorted_values=None):
     """Aggregate ``value_cols[i]`` with ``aggs[i]`` per distinct key row.
 
     ``structure`` (from ``group_structure``) and ``out_capacity`` support
@@ -178,9 +204,35 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
     n = key_cols[0].shape[0]
     idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    if structure is None:
-        structure = group_structure(key_cols, key_validities, row_valid)
-    idxS, is_first, rvS = structure
+    if structure is None and sorted_values is None:
+        # single-phase path: dedupe value leaves by identity (safe here —
+        # these are concrete arrays, not per-trace tracer objects), ride
+        # the distinct ones through the sort, re-expand per slot
+        uniq, pos = [], {}
+        for a in (tuple(value_cols)
+                  + tuple(v for v in value_validities if v is not None)):
+            if id(a) not in pos:
+                pos[id(a)] = len(uniq)
+                uniq.append(a)
+        structure = group_structure(key_cols, key_validities, row_valid,
+                                    carry=tuple(uniq))
+        carried = structure[3]
+        sorted_values = (
+            tuple(carried[pos[id(a)]] for a in value_cols),
+            tuple(carried[pos[id(v)]] if v is not None else None
+                  for v in value_validities))
+    idxS, is_first, rvS = structure[:3]
+    if sorted_values is not None:
+        # value columns (and their validities) rode the structure sort:
+        # all plan math happens directly in sorted space, eliminating the
+        # [n, k] pack gather (docs/tpu_perf_notes.md: ~6 ns/row/pass)
+        cols_src, valids_src = sorted_values
+        rv_src = rvS
+        pre_sorted = True
+    else:
+        cols_src, valids_src = tuple(value_cols), tuple(value_validities)
+        rv_src = row_valid
+        pre_sorted = False
     C = n if out_capacity is None else out_capacity
     keep_first = is_first & rvS  # padding groups start with an invalid row
     num_groups = jnp.sum(keep_first).astype(jnp.int32)
@@ -189,19 +241,26 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
     safe_starts = jnp.clip(starts, 0, n - 1)
     key_idx = jnp.where(starts >= 0, jnp.take(idxS, safe_starts),
                         jnp.int32(-1))
-    one = jnp.ones((1,), bool)
-    last_of_group = jnp.concatenate([is_first[1:], one])
-    ends = compact_indices(last_of_group, C, fill=n - 1)  # aligned with g
+    # group g ends where group g+1 starts; the LAST real group ends at the
+    # last valid row (valid rows occupy sorted positions [0, nvalid) —
+    # padding sorts strictly after).  Derived by a shift instead of a
+    # second n-update compaction scatter (scatter cost ∝ updates; at 67M
+    # rows the saved scatter is ~0.5 s on a v5e).  Entries past the group
+    # count are unspecified, as documented.
+    nvalid = jnp.sum(rvS).astype(jnp.int32)
+    nxt = jnp.concatenate([starts[1:], jnp.full((1,), -1, jnp.int32)])
+    ends = jnp.where(nxt >= 0, nxt - 1, jnp.maximum(nvalid - 1, 0))
 
-    # -- assemble packed sum-family inputs in ORIGINAL order ------------------
+    # -- assemble packed sum-family inputs (sorted space when the values
+    # rode the structure sort, original order otherwise) ---------------------
     # fplan/iplan collect columns for the float/int accumulator packs;
     # assembly records where each aggregation's results live in the packs
     fplan, iplan, mplan, assembly = [], [], [], []
     for slot, (col, validity, agg) in enumerate(
-            zip(value_cols, value_validities, aggs)):
+            zip(cols_src, valids_src, aggs)):
         if agg not in AGG_OPS:
             raise ValueError(f"unknown aggregation {agg!r}")
-        valid = row_valid
+        valid = rv_src
         if validity is not None:
             valid = validity if valid is None else (valid & validity)
         vmask = jnp.ones(n, bool) if valid is None else valid
@@ -231,7 +290,7 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
         if not cols:
             return None
         P = jnp.stack(cols, axis=1)
-        PS = jnp.take(P, idxS, axis=0)            # ONE wide gather to sorted
+        PS = P if pre_sorted else jnp.take(P, idxS, axis=0)
         C = jnp.cumsum(PS, axis=0, dtype=dtype)
         Cex = C - PS.astype(dtype)
         return jnp.take(C, ends, axis=0) - jnp.take(Cex, safe_starts, axis=0)
@@ -248,7 +307,7 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
         if not cols:
             return None
         P = jnp.stack(cols, axis=1).astype(dtype)
-        PS = jnp.take(P, idxS, axis=0)
+        PS = P if pre_sorted else jnp.take(P, idxS, axis=0)
         scanned = _seg_scan(PS, is_first, jnp.add)
         return jnp.take(scanned, ends, axis=0)
 
@@ -284,7 +343,7 @@ def groupby_aggregate(key_cols: Sequence[jax.Array],
     for (agg, _), entries in mgroups.items():
         op = jnp.minimum if agg == MIN else jnp.maximum
         pk = jnp.stack([m for _, m, _ in entries], axis=1)
-        ps = jnp.take(pk, idxS, axis=0)           # sorted order
+        ps = pk if pre_sorted else jnp.take(pk, idxS, axis=0)
         scanned = _seg_scan(ps, is_first, op)
         res = jnp.take(scanned, ends, axis=0)
         for j, (slot, _, cnt_ref) in enumerate(entries):

@@ -87,28 +87,30 @@ def _concat_key_parts(l_cols, l_valids, r_cols, r_valids, l_count, r_count):
     return pad_l, pad_r, key_ops
 
 
-def sorted_key_structure(key_operands, n: int):
+def sorted_key_structure(key_operands, n: int, carry=()):
     """ONE carried-values sort of ``key_operands`` (most significant first)
     with the row index appended as the final sort key (stability for free).
 
     The shared idiom of every keyed kernel here (dense_ranks,
     sort_join_plan, groupby): keys and row ids travel through one
     ``lax.sort`` — nothing is gathered afterwards — and group boundaries
-    come off the sorted operands by adjacent compare.
+    come off the sorted operands by adjacent compare.  ``carry`` arrays
+    ride the sort as non-key operands and come back permuted: extra sort
+    operands cost ~nothing on TPU where a post-hoc n-row gather costs
+    ~6 ns/row (docs/tpu_perf_notes.md).
 
-    Returns ``(sorted_key_operands, idxS, is_first)``: the sorted key
-    arrays, the original row index per sorted position, and the
-    group-start flags.
+    Returns ``(sorted_key_operands, idxS, is_first, carried)``.
     """
     idx = jnp.arange(n, dtype=jnp.int32)
-    sorted_ops = jax.lax.sort((*key_operands, idx),
-                              num_keys=len(key_operands) + 1)
-    idxS = sorted_ops[-1]
+    nk = len(key_operands) + 1
+    sorted_ops = jax.lax.sort((*key_operands, idx, *carry), num_keys=nk)
+    idxS = sorted_ops[nk - 1]
+    carried = sorted_ops[nk:]
     one = jnp.ones((1,), bool)
     is_first = jnp.concatenate([one, jnp.zeros(n - 1, bool)])
-    for ks in sorted_ops[:-1]:
+    for ks in sorted_ops[:nk - 1]:
         is_first = is_first | jnp.concatenate([one, ks[1:] != ks[:-1]])
-    return sorted_ops[:-1], idxS, is_first
+    return sorted_ops[:nk - 1], idxS, is_first, carried
 
 
 @jax.jit
@@ -133,7 +135,7 @@ def dense_ranks(l_cols, l_valids, r_cols, r_valids, l_count=None, r_count=None):
         return z, z
     pad_l, pad_r, key_ops = _concat_key_parts(
         l_cols, l_valids, r_cols, r_valids, l_count, r_count)
-    _, idxS, is_first = sorted_key_structure(key_ops, n)
+    _, idxS, is_first, _ = sorted_key_structure(key_ops, n)
     group_id = (jnp.cumsum(is_first) - 1).astype(jnp.int32)
     rank = jnp.zeros(n, jnp.int32).at[idxS].set(group_id)
     l_rank = jnp.where(pad_l, jnp.iinfo(jnp.int32).max, rank[:n_l])
@@ -382,7 +384,7 @@ def sort_join_plan(l_cols, l_valids, r_cols, r_valids, how: str = INNER,
         return plan + ((jnp.zeros(n_r, bool),) if how == FULL_OUTER else ())
     _, _, key_ops = _concat_key_parts(
         l_cols, l_valids, r_cols, r_valids, l_count, r_count)
-    sortedK, idxS, is_first = sorted_key_structure(key_ops, n)
+    sortedK, idxS, is_first, _ = sorted_key_structure(key_ops, n)
     padS = sortedK[0]
     one = jnp.ones((1,), bool)
     valid = ~padS
