@@ -328,6 +328,47 @@ def join_indices(l_key: jax.Array, r_key: jax.Array, how: str, capacity: int,
     return mask_past_total(j, total, left_idx, right_idx)
 
 
+@jax.jit
+def semi_mask(l_cols, l_valids, r_cols, r_valids, l_count=None, r_count=None
+              ) -> jax.Array:
+    """Per-left-row presence bits: ``mask[i]`` ⇔ left row *i* is valid and
+    its composite key occurs among the valid right rows.
+
+    The semi/anti-join primitive (EXISTS / NOT EXISTS without multiplicity):
+    one merged sort of both sides' keys (the same ``_concat_key_parts`` +
+    ``sorted_key_structure`` idiom as the join kernels), a per-segment
+    right-row count via two scans, and ONE scatter back to left row space.
+    No pair expansion, no capacity buffer — output is bounded by the left
+    side, so callers compact survivors exactly like a filter.
+
+    Key semantics match the join kernels (null == null, composite keys,
+    padded blocks); the reference has no semi-join operator — its users
+    spell EXISTS as join + dedup (the shape this primitive replaces).
+    """
+    n_l, n_r = l_cols[0].shape[0], r_cols[0].shape[0]
+    if n_l == 0 or n_r == 0:
+        return jnp.zeros(n_l, bool)
+    n = n_l + n_r
+    _, _, key_ops = _concat_key_parts(
+        l_cols, l_valids, r_cols, r_valids, l_count, r_count)
+    sortedK, idxS, is_first, _ = sorted_key_structure(key_ops, n)
+    valid = ~sortedK[0]  # pad flag is the most-significant sort operand
+    left_s = (idxS < n_l) & valid
+    right_s = (idxS >= n_l) & valid
+    # right rows in my key segment: segment totals via forward cumsum +
+    # segment-end backfill (the seg_span idiom of sort_join_plan)
+    one = jnp.ones((1,), bool)
+    last = jnp.concatenate([is_first[1:], one])
+    maxi = jnp.iinfo(jnp.int32).max
+    m32 = right_s.astype(jnp.int32)
+    cm = jnp.cumsum(m32)
+    end = jax.lax.cummin(jnp.where(last, cm, maxi), reverse=True)
+    excl = jax.lax.cummax(jnp.where(is_first, cm - m32, 0))
+    has_r = (end - excl) > 0
+    tgt = jnp.where(left_s, idxS, jnp.int32(n_l))
+    return jnp.zeros(n_l, bool).at[tgt].set(has_r, mode="drop")
+
+
 # ---------------------------------------------------------------------------
 # Fused single-sort join (the fast SORT-algorithm path)
 # ---------------------------------------------------------------------------

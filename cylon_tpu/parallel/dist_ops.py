@@ -923,6 +923,48 @@ def _predicate_mask(dt: DTable, predicate) -> jax.Array:
 _select_cap_hints: dict = {}
 
 
+def _compact_survivors(dt: DTable, mask: jax.Array, cnts, hint_key,
+                       span_name: str) -> DTable:
+    """Shared tail of every row-filter-shaped op (select, semi/anti join):
+    compact the rows ``mask`` keeps into a size-class block bucketed to the
+    max per-shard survivor count, via the optimistic-dispatch protocol.
+    ``cnts`` is the replicated per-shard survivor-count array."""
+    mesh, axis, cap = dt.ctx.mesh, dt.ctx.axis, dt.cap
+    leaves = tuple((c.data, c.validity) for c in dt.columns)
+    nleaves = len(leaves)
+
+    def dispatch(sizes):
+        outcap = sizes[0]
+        key2 = ("selgather", mesh, axis, cap, outcap, nleaves)
+        p2 = _select_cache.get(key2)
+        if p2 is None:
+            def gather_kernel(mask, leaves):
+                idx, count = ops_compact.mask_to_indices(mask, outcap)
+                outs = tuple(ops_gather.take_many(leaves, idx,
+                                                  fill_null=False))
+                return outs, count[None].astype(jnp.int32)
+
+            spec = P(axis)
+            p2 = _cache_put(key2, jax.jit(shard_map(
+                gather_kernel, mesh=mesh, in_specs=(spec, spec),
+                out_specs=(spec, spec))))
+        return p2(mask, leaves)
+
+    def post(per_shard):
+        return (ops_compact.next_bucket(
+            max(int(per_shard.max(initial=0)), 1), minimum=8),)
+
+    while len(_select_cap_hints) > _GROUP_HINTS_MAX:  # predicate keys pin closures
+        _select_cap_hints.pop(next(iter(_select_cap_hints)))
+    with trace.span_sync(span_name) as sp:
+        (outs, counts), used, _ = ops_compact.optimistic_dispatch(
+            _select_cap_hints, hint_key, dispatch, cnts, post)
+        sp.sync(outs)
+    cols = [DColumn(c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
+            for c, (d, v) in zip(dt.columns, outs)]
+    return DTable(dt.ctx, cols, used[0], counts)
+
+
 def dist_select(dt: DTable, predicate) -> DTable:
     """Distributed row filter: ``predicate`` maps {column name: sharded data
     array} → bool mask; surviving rows compact into a size-class block
@@ -949,40 +991,86 @@ def dist_select(dt: DTable, predicate) -> DTable:
             out_specs=(spec, P()), check_vma=False)))
     leaves = tuple((c.data, c.validity) for c in dt.columns)
     mask, cnts = p1(dt.counts, leaves)
+    return _compact_survivors(dt, mask, cnts,
+                              ("sel", mesh, cap, names, predicate),
+                              "select.gather")
 
-    nleaves = len(leaves)
 
-    def dispatch(sizes):
-        outcap = sizes[0]
-        key2 = ("selgather", mesh, axis, cap, outcap, nleaves)
-        p2 = _select_cache.get(key2)
-        if p2 is None:
-            def gather_kernel(mask, leaves):
-                idx, count = ops_compact.mask_to_indices(mask, outcap)
-                outs = tuple(ops_gather.take_many(leaves, idx,
-                                                  fill_null=False))
-                return outs, count[None].astype(jnp.int32)
+@functools.lru_cache(maxsize=None)
+def _semi_mask_fn(mesh, axis: str, cap_l: int, cap_r: int, anti: bool):
+    """Keep-mask for semi/anti join + replicated survivor counts."""
 
-            spec = P(axis)
-            p2 = _cache_put(key2, jax.jit(shard_map(
-                gather_kernel, mesh=mesh, in_specs=(spec, spec),
-                out_specs=(spec, spec))))
-        return p2(mask, leaves)
+    def kernel(l_cnt, r_cnt, lkeys, lvalids, rkeys, rvalids):
+        present = ops_join.semi_mask(lkeys, lvalids, rkeys, rvalids,
+                                     l_count=l_cnt[0], r_count=r_cnt[0])
+        if anti:
+            keep = (jnp.arange(cap_l) < l_cnt[0]) & ~present
+        else:
+            keep = present  # semi_mask is already False on padding rows
+        n = jnp.sum(keep).astype(jnp.int32)
+        return keep, jax.lax.all_gather(n, axis)
 
-    def post(per_shard):
-        return (ops_compact.next_bucket(
-            max(int(per_shard.max(initial=0)), 1), minimum=8),)
+    spec = P(axis)
+    # check_vma=False: the all_gathered counts are replicated
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
+                             out_specs=(spec, P()), check_vma=False))
 
-    while len(_select_cap_hints) > _GROUP_HINTS_MAX:  # predicate keys pin closures
-        _select_cap_hints.pop(next(iter(_select_cap_hints)))
-    with trace.span_sync("select.gather") as sp:
-        (outs, counts), used, _ = ops_compact.optimistic_dispatch(
-            _select_cap_hints, ("sel", mesh, cap, names, predicate),
-            dispatch, cnts, post)
-        sp.sync(outs)
-    cols = [DColumn(c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
-            for c, (d, v) in zip(dt.columns, outs)]
-    return DTable(dt.ctx, cols, used[0], counts)
+
+def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
+                       anti: bool) -> DTable:
+    li_keys = _join_keys(left, left_on)
+    ri_keys = _join_keys(right, right_on)
+    if len(li_keys) != len(ri_keys):
+        raise CylonError(Status(Code.Invalid,
+            f"join key arity mismatch: {len(li_keys)} vs {len(ri_keys)}"))
+    for li, ri in zip(li_keys, ri_keys):
+        if left.columns[li].dtype.type != right.columns[ri].dtype.type:
+            raise CylonError(Status(Code.TypeError,
+                "semi-join key type mismatch "
+                f"{left.columns[li].dtype.type.name} vs "
+                f"{right.columns[ri].dtype.type.name}"))
+    left, right = _unify_dtable_dicts(left, right, li_keys, ri_keys)
+    # the probe only ever reads the right side's KEY columns — drop the
+    # rest before the exchange so non-key payload never crosses the wire
+    right = dist_project(right, ri_keys)
+    ri_keys = list(range(len(ri_keys)))
+    if left.ctx.get_world_size() > 1:
+        with trace.span("semijoin.shuffle"):
+            left = _shuffle_by_pids(left, _hash_pids(left, li_keys))
+            right = _shuffle_by_pids(right, _hash_pids(right, ri_keys))
+    mesh, axis = left.ctx.mesh, left.ctx.axis
+    lkcs = [left.columns[i] for i in li_keys]
+    rkcs = [right.columns[i] for i in ri_keys]
+    with trace.span("semijoin.mask"):
+        mask, cnts = _semi_mask_fn(mesh, axis, left.cap, right.cap, anti)(
+            left.counts, right.counts,
+            tuple(c.data for c in lkcs), tuple(c.validity for c in lkcs),
+            tuple(c.data for c in rkcs), tuple(c.validity for c in rkcs))
+    hint_key = ("semi", mesh, left.cap, right.cap, tuple(li_keys), anti)
+    return _compact_survivors(left, mask, cnts, hint_key, "semijoin.gather")
+
+
+def dist_semi_join(left: DTable, right: DTable, left_on, right_on) -> DTable:
+    """Distributed LEFT SEMI join: the rows of ``left`` whose key has at
+    least one match in ``right`` — each such row emitted ONCE regardless of
+    match multiplicity (SQL EXISTS / IN).  Output schema = left's schema.
+
+    Co-partition both sides on the key hash, then the one-sort presence
+    kernel (ops/join.py semi_mask) + survivor compaction per shard.  The
+    reference spells EXISTS as inner join + dedup (no semi-join operator in
+    table_api.cpp); that shape explodes with match multiplicity and pays a
+    near-table-cardinality groupby — this primitive replaces it.  Null
+    keys follow the join kernels' convention (null == null).
+    """
+    return _dist_semi_or_anti(left, right, left_on, right_on, anti=False)
+
+
+def dist_anti_join(left: DTable, right: DTable, left_on, right_on) -> DTable:
+    """Distributed LEFT ANTI join: the rows of ``left`` whose key has NO
+    match in ``right`` (SQL NOT EXISTS).  Complement of ``dist_semi_join``
+    over the valid left rows: a null left key equals a null right key, so
+    with any null right key present, null-keyed left rows are dropped."""
+    return _dist_semi_or_anti(left, right, left_on, right_on, anti=True)
 
 
 def dist_project(dt: DTable, columns: Sequence[Union[int, str]]) -> DTable:

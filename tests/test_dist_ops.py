@@ -526,3 +526,78 @@ def test_dist_aggregate_empty_filter_nulls(dctx, rng):
     assert int(out["count_v"][0]) == 0
     assert out["min_v"].isna()[0] and out["max_v"].isna()[0]
     assert out["mean_v"].isna()[0]
+
+
+# ---------------------------------------------------------------------------
+# distributed semi / anti join (EXISTS / NOT EXISTS without multiplicity)
+# ---------------------------------------------------------------------------
+
+def test_dist_semi_join_vs_oracle(dctx, rng):
+    from cylon_tpu.parallel import dist_semi_join
+    ldf = pd.DataFrame({"k": rng.integers(0, 40, 150),
+                        "a": rng.normal(size=150)})
+    # right side with heavy multiplicity: each matching left row must still
+    # be emitted exactly once
+    rdf = pd.DataFrame({"k": np.repeat(rng.integers(0, 40, 25), 7),
+                        "b": rng.normal(size=175)})
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf, n_empty_shards=2)
+    ours = dist_semi_join(lt, rt, "k", "k").to_table().to_pandas()
+    oracle = ldf[ldf["k"].isin(rdf["k"].unique())]
+    assert_same_rows(ours, oracle)
+
+
+def test_dist_anti_join_vs_oracle(dctx, rng):
+    from cylon_tpu.parallel import dist_anti_join
+    ldf = pd.DataFrame({"k": rng.integers(0, 40, 150),
+                        "a": rng.normal(size=150)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 40, 60),
+                        "b": rng.normal(size=60)})
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    ours = dist_anti_join(lt, rt, "k", "k").to_table().to_pandas()
+    oracle = ldf[~ldf["k"].isin(rdf["k"].unique())]
+    assert_same_rows(ours, oracle)
+
+
+def test_dist_semi_join_composite_keys_and_strings(dctx, rng):
+    from cylon_tpu.parallel import dist_semi_join
+    ldf = pd.DataFrame({"s": rng.choice(["x", "y", "z", "w"], 80),
+                        "n": rng.integers(0, 5, 80),
+                        "a": np.arange(80, dtype=np.float64)})
+    rdf = pd.DataFrame({"s": rng.choice(["x", "y", "q"], 30),
+                        "n": rng.integers(0, 5, 30)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    ours = dist_semi_join(lt, rt, ("s", "n"), ("s", "n")) \
+        .to_table().to_pandas()
+    rset = set(zip(rdf["s"], rdf["n"]))
+    oracle = ldf[[t in rset for t in zip(ldf["s"], ldf["n"])]]
+    assert_same_rows(ours, oracle)
+
+
+def test_dist_semi_anti_null_keys(dctx):
+    """Null == null, the join kernels' convention: a null-keyed left row is
+    kept by semi (dropped by anti) iff the right side has a null key."""
+    from cylon_tpu.parallel import dist_anti_join, dist_semi_join
+    ldf = pd.DataFrame({"k": pd.array([1, None, 3, None, 5], dtype="Int64"),
+                        "a": np.arange(5, dtype=np.float64)})
+    r_with = pd.DataFrame({"k": pd.array([1, None], dtype="Int64")})
+    r_without = pd.DataFrame({"k": pd.array([1, 4], dtype="Int64")})
+    lt = dtable_from_pandas(dctx, ldf)
+    semi_w = dist_semi_join(lt, dtable_from_pandas(dctx, r_with),
+                            "k", "k").to_table().to_pandas()
+    assert_same_rows(semi_w, ldf[ldf["k"].isna() | (ldf["k"] == 1)])
+    anti_wo = dist_anti_join(lt, dtable_from_pandas(dctx, r_without),
+                             "k", "k").to_table().to_pandas()
+    assert_same_rows(anti_wo, ldf[ldf["k"].isna() | ldf["k"].isin([3, 5])])
+
+
+def test_dist_semi_join_empty_right(dctx, rng):
+    from cylon_tpu.parallel import dist_anti_join, dist_semi_join
+    ldf = pd.DataFrame({"k": rng.integers(0, 9, 30),
+                        "a": rng.normal(size=30)})
+    rdf = pd.DataFrame({"k": np.array([], dtype=np.int64)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    assert dist_semi_join(lt, rt, "k", "k").to_table().num_rows == 0
+    assert_same_rows(dist_anti_join(lt, rt, "k", "k").to_table().to_pandas(),
+                     ldf)
