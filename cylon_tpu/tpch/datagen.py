@@ -70,6 +70,36 @@ P_CONTAINER_2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
 TABLE_NAMES = ("lineitem", "orders", "customer", "supplier", "nation",
                "region", "part", "partsupp")
 
+# comment pools: dictionary-encoded 3-word phrases standing in for dbgen's
+# free-text comments — small enough to dictionary-encode, rich enough that
+# the LIKE patterns the queries push down ('%special%requests%' in Q13,
+# '%Customer%Complaints%' in Q16) match a realistic minority of rows via
+# the host-side dictionary scan (_dict_codes_where)
+_COMMENT_WORDS = [
+    "carefully", "quickly", "furiously", "slyly", "blithely", "ironic",
+    "regular", "final", "pending", "express", "special", "unusual",
+    "requests", "deposits", "packages", "accounts", "instructions",
+    "theodolites", "Customer", "Complaints", "platelets", "foxes",
+]
+
+
+_COMMENT_POOL = [f"{a} {b} {c}" for a in _COMMENT_WORDS
+                 for b in _COMMENT_WORDS for c in _COMMENT_WORDS]
+
+
+def _comment_codes(rng, n: int, pattern_words) -> np.ndarray:
+    """Codes into ``_COMMENT_POOL`` (22³ phrases): random 3-word comments,
+    ~2% forced to contain ``pattern_words`` in order — fully vectorized
+    (code = a·W² + b·W + c indexes the pool in (a, b, c) order)."""
+    W = len(_COMMENT_WORDS)
+    a = rng.integers(0, W, n)
+    b = rng.integers(0, W, n)
+    c = rng.integers(0, W, n)
+    hit = rng.random(n) < 0.02
+    a[hit] = _COMMENT_WORDS.index(pattern_words[0])
+    c[hit] = _COMMENT_WORDS.index(pattern_words[1])
+    return (a * W * W + b * W + c).astype(np.int32)
+
 SUPPLIERS_PER_PART = 4
 
 
@@ -104,25 +134,38 @@ def generate(scale: float, seed: int = 42) -> Dict[str, pd.DataFrame]:
     n_supp = max(int(10_000 * scale), SUPPLIERS_PER_PART)
     n_part = max(int(200_000 * scale), 1)
 
+    c_nationkey = rng.integers(0, 25, n_cust).astype(np.int32)
     customer = pd.DataFrame({
         "c_custkey": np.arange(1, n_cust + 1, dtype=np.int32),
-        "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int32),
+        "c_nationkey": c_nationkey,
         "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2)
         .astype(np.float32),
         "c_mktsegment": pd.Categorical.from_codes(
             rng.integers(0, len(SEGMENTS), n_cust), SEGMENTS),
+        # spec 4.2.2.9: phone country code = nationkey + 10; stored as the
+        # int32 code directly (substring(c_phone,1,2) pushdown for Q22 —
+        # free-text phone bodies are a documented deviation)
+        "c_phone_cc": (c_nationkey + 10).astype(np.int32),
     })
 
     o_orderdate = rng.integers(0, DAYS_TOTAL, n_ord).astype(np.int32)
+    # spec 4.2.3: o_custkey is never a multiple of 3 — a third of customers
+    # place no orders (Q13's zero-order spike, Q22's anti-join cohort).
+    # Index the valid keys 1,2,4,5,7,8,… directly: key = 3·(i//2) + 1 + i%2.
+    n_valid_cust = n_cust - n_cust // 3
+    ci = rng.integers(0, max(n_valid_cust, 1), n_ord)
     orders = pd.DataFrame({
         "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int32),
-        "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int32),
+        "o_custkey": (3 * (ci // 2) + 1 + ci % 2).astype(np.int32),
         "o_orderdate": o_orderdate,
         "o_orderpriority": pd.Categorical.from_codes(
             rng.integers(0, len(PRIORITIES), n_ord), PRIORITIES),
         "o_shippriority": np.zeros(n_ord, dtype=np.int32),
         "o_totalprice": np.round(rng.uniform(900.0, 500_000.0, n_ord), 2)
         .astype(np.float32),
+        "o_comment": pd.Categorical.from_codes(
+            _comment_codes(rng, n_ord, ("special", "requests")),
+            _COMMENT_POOL),
     })
 
     # lineitem: 1–7 lines per order (spec 4.2.3) ⇒ E[lines] = 4 ⇒ ≈ 6M·SF
@@ -161,9 +204,24 @@ def generate(scale: float, seed: int = 42) -> Dict[str, pd.DataFrame]:
             rng.integers(0, len(SHIP_MODES), n_li), SHIP_MODES),
     })
 
+    # spec 4.2.3: o_orderstatus aggregates the order's line statuses —
+    # F if every line is F, O if every line is O, else P (reduceat over the
+    # per-order line runs; lines_per ≥ 1 so no empty segments)
+    is_o = (l_shipdate > date_to_days("1995-06-17")).astype(np.int64)
+    starts = np.cumsum(lines_per) - lines_per
+    n_o = np.add.reduceat(is_o, starts)
+    status = np.where(n_o == 0, 0, np.where(n_o == lines_per, 1, 2))
+    orders["o_orderstatus"] = pd.Categorical.from_codes(
+        status.astype(np.int8), ["F", "O", "P"])
+
     supplier = pd.DataFrame({
         "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int32),
         "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int32),
+        "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_supp), 2)
+        .astype(np.float32),
+        "s_comment": pd.Categorical.from_codes(
+            _comment_codes(rng, n_supp, ("Customer", "Complaints")),
+            _COMMENT_POOL),
     })
 
     # part: names are two color words (Q9 filters '%green%'), types the
@@ -184,13 +242,17 @@ def generate(scale: float, seed: int = 42) -> Dict[str, pd.DataFrame]:
              for c in P_TYPE_S3]
     containers = [f"{a} {b}" for a in P_CONTAINER_1 for b in P_CONTAINER_2]
     brands = [f"Brand#{m}{n}" for m in range(1, 6) for n in range(1, 6)]
+    # spec 4.2.2: p_brand = Brand#MN where M is the manufacturer digit
+    brand_codes = rng.integers(0, len(brands), n_part)
     part = pd.DataFrame({
         "p_partkey": np.arange(1, n_part + 1, dtype=np.int32),
         "p_name": pd.Categorical.from_codes(lut[w1, w2], name_pool),
+        "p_mfgr": pd.Categorical.from_codes(
+            (brand_codes // 5).astype(np.int8),
+            [f"Manufacturer#{m}" for m in range(1, 6)]),
         "p_type": pd.Categorical.from_codes(
             rng.integers(0, len(types), n_part), types),
-        "p_brand": pd.Categorical.from_codes(
-            rng.integers(0, len(brands), n_part), brands),
+        "p_brand": pd.Categorical.from_codes(brand_codes, brands),
         "p_container": pd.Categorical.from_codes(
             rng.integers(0, len(containers), n_part), containers),
         "p_size": rng.integers(1, 51, n_part).astype(np.int32),

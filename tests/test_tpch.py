@@ -322,3 +322,279 @@ def test_datagen_shapes(data):
     for name, df in data.items():
         for c in df.columns:
             assert df[c].dtype != np.int64, (name, c)
+
+
+# ---------------------------------------------------------------------------
+# round-4 queries: Q2/Q7/Q8/Q11/Q13/Q15/Q16/Q17/Q20/Q21/Q22
+# ---------------------------------------------------------------------------
+
+def test_q2(dctx, data, dtables):
+    got = _frame(queries.q2(dctx, dtables))
+    p = data["part"]
+    p = p[(p["p_size"] == 15)
+          & p["p_type"].astype(str).str.endswith("BRASS")]
+    reg = data["region"]
+    reg = reg[reg["r_name"] == "EUROPE"]
+    n = data["nation"].merge(reg, left_on="n_regionkey",
+                             right_on="r_regionkey")
+    s = data["supplier"].merge(n, left_on="s_nationkey",
+                               right_on="n_nationkey")
+    m = data["partsupp"].merge(p, left_on="ps_partkey", right_on="p_partkey")
+    m = m.merge(s, left_on="ps_suppkey", right_on="s_suppkey")
+    mins = m.groupby("ps_partkey")["ps_supplycost"].min().reset_index() \
+        .rename(columns={"ps_supplycost": "min_cost"})
+    w = m.merge(mins, on="ps_partkey")
+    w = w[w["ps_supplycost"] == w["min_cost"]]
+    w = (w[["s_acctbal", "n_name", "p_partkey", "p_mfgr", "s_suppkey",
+            "ps_supplycost"]]
+         .sort_values(["s_acctbal", "n_name", "p_partkey"],
+                      ascending=[False, True, True]).head(100)
+         .reset_index(drop=True))
+    for c in ("n_name", "p_mfgr"):
+        w[c] = w[c].astype(str)
+    for c in ("p_partkey", "s_suppkey"):
+        got[c] = got[c].astype(np.int64)
+        w[c] = w[c].astype(np.int64)
+    _assert_rowset_equal(got, w, ["p_partkey", "s_suppkey"])
+
+
+def test_q7(dctx, data, dtables):
+    got = _frame(queries.q7(dctx, dtables))
+    nat = data["nation"]
+    k = {str(n): int(i) for i, n in zip(nat["n_nationkey"], nat["n_name"])}
+    k1, k2 = k["FRANCE"], k["GERMANY"]
+    d0, d1 = date_to_days("1995-01-01"), date_to_days("1996-12-31")
+    li = data["lineitem"]
+    li = li[(li["l_shipdate"] >= d0) & (li["l_shipdate"] <= d1)]
+    s = data["supplier"]
+    s = s[s["s_nationkey"].isin([k1, k2])]
+    c = data["customer"]
+    c = c[c["c_nationkey"].isin([k1, k2])]
+    m = li.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    m = m.merge(data["orders"], left_on="l_orderkey", right_on="o_orderkey")
+    m = m.merge(c, left_on="o_custkey", right_on="c_custkey")
+    m = m[m["s_nationkey"] != m["c_nationkey"]].copy()
+    from cylon_tpu.tpch.datagen import days_to_year
+    m["l_year"] = days_to_year(m["l_shipdate"].to_numpy())
+    m["revenue"] = _rev(m)
+    inv = {k1: "FRANCE", k2: "GERMANY"}
+    m["supp_nation"] = m["s_nationkey"].map(inv)
+    m["cust_nation"] = m["c_nationkey"].map(inv)
+    w = (m.groupby(["supp_nation", "cust_nation", "l_year"], observed=True)
+         ["revenue"].sum().reset_index()
+         .sort_values(["supp_nation", "cust_nation", "l_year"])
+         .reset_index(drop=True))
+    got["l_year"] = got["l_year"].astype(np.int64)
+    w["l_year"] = w["l_year"].astype(np.int64)
+    _assert_rowset_equal(got, w, ["supp_nation", "cust_nation", "l_year"])
+
+
+def test_q8(dctx, data, dtables):
+    got = _frame(queries.q8(dctx, dtables))
+    nat = data["nation"]
+    k = {str(n): int(i) for i, n in zip(nat["n_nationkey"], nat["n_name"])}
+    br = k["BRAZIL"]
+    reg = data["region"]
+    rk = int(reg[reg["r_name"] == "AMERICA"]["r_regionkey"].iloc[0])
+    amkeys = nat[nat["n_regionkey"] == rk]["n_nationkey"].tolist()
+    d0, d1 = date_to_days("1995-01-01"), date_to_days("1996-12-31")
+    p = data["part"]
+    p = p[p["p_type"] == "ECONOMY ANODIZED STEEL"]
+    m = data["lineitem"].merge(p[["p_partkey"]], left_on="l_partkey",
+                               right_on="p_partkey")
+    o = data["orders"]
+    o = o[(o["o_orderdate"] >= d0) & (o["o_orderdate"] <= d1)]
+    m = m.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    c = data["customer"]
+    c = c[c["c_nationkey"].isin(amkeys)]
+    m = m.merge(c, left_on="o_custkey", right_on="c_custkey")
+    m = m.merge(data["supplier"], left_on="l_suppkey",
+                right_on="s_suppkey").copy()
+    from cylon_tpu.tpch.datagen import days_to_year
+    m["o_year"] = days_to_year(m["o_orderdate"].to_numpy())
+    m["volume"] = _rev(m)
+    m["nation_vol"] = np.where(m["s_nationkey"] == br, m["volume"], 0.0)
+    g = m.groupby("o_year", observed=True)[["nation_vol", "volume"]].sum()
+    w = pd.DataFrame({"o_year": g.index.to_numpy(np.int64),
+                      "mkt_share": (g["nation_vol"]
+                                    / g["volume"]).to_numpy(np.float64)}) \
+        .sort_values("o_year").reset_index(drop=True)
+    got["o_year"] = got["o_year"].astype(np.int64)
+    _assert_rowset_equal(got, w, ["o_year"])
+
+
+def test_q11(dctx, data, dtables):
+    # fraction relaxed for the test scale (the spec's 0.0001/SF keeps ~a
+    # thousand parts at SF-1; at SF-0.002 it would keep none)
+    got = _frame(queries.q11(dctx, dtables, fraction_per_sf=0.000002))
+    nat = data["nation"]
+    k = {str(n): int(i) for i, n in zip(nat["n_nationkey"], nat["n_name"])}
+    s = data["supplier"]
+    s = s[s["s_nationkey"] == k["GERMANY"]]
+    sf = len(data["supplier"]) / 10_000.0
+    ps = data["partsupp"].merge(s, left_on="ps_suppkey", right_on="s_suppkey")
+    val = (ps["ps_supplycost"].astype(np.float64)
+           * ps["ps_availqty"].astype(np.float64))
+    tot = float(val.sum())
+    g = val.groupby(ps["ps_partkey"]).sum().reset_index(name="sum_value")
+    w = g[g["sum_value"] > tot * 0.000002 / sf] \
+        .sort_values("sum_value", ascending=False).reset_index(drop=True) \
+        .rename(columns={"index": "ps_partkey"})
+    assert len(w) > 0, "fraction too tight for the test scale"
+    got["ps_partkey"] = got["ps_partkey"].astype(np.int64)
+    w["ps_partkey"] = w["ps_partkey"].astype(np.int64)
+    _assert_rowset_equal(got, w[["ps_partkey", "sum_value"]], ["ps_partkey"])
+
+
+def test_q13(dctx, data, dtables):
+    got = _frame(queries.q13(dctx, dtables))
+    o = data["orders"]
+    o = o[~o["o_comment"].astype(str).str.contains("special.*requests",
+                                                   regex=True)]
+    m = data["customer"][["c_custkey"]].merge(
+        o[["o_orderkey", "o_custkey"]], left_on="c_custkey",
+        right_on="o_custkey", how="left")
+    per = m.groupby("c_custkey")["o_orderkey"].count().reset_index(
+        name="c_count")
+    w = per.groupby("c_count").size().reset_index(name="custdist") \
+        .sort_values(["custdist", "c_count"], ascending=[False, False]) \
+        .reset_index(drop=True)
+    assert (per["c_count"] == 0).any(), "zero-order customers must exist"
+    for c in ("c_count", "custdist"):
+        got[c] = got[c].astype(np.int64)
+        w[c] = w[c].astype(np.int64)
+    _assert_rowset_equal(got, w, ["c_count"])
+
+
+def test_q15(dctx, data, dtables):
+    got = _frame(queries.q15(dctx, dtables))
+    d0 = date_to_days("1996-01-01")
+    d1 = date_to_days("1996-04-01")
+    li = data["lineitem"]
+    li = li[(li["l_shipdate"] >= d0) & (li["l_shipdate"] < d1)].copy()
+    li["rev"] = _rev(li)
+    g = li.groupby("l_suppkey")["rev"].sum().reset_index(
+        name="total_revenue")
+    w = g[g["total_revenue"] >= g["total_revenue"].max() * (1 - 1e-9)] \
+        .sort_values("l_suppkey").reset_index(drop=True)
+    got["l_suppkey"] = got["l_suppkey"].astype(np.int64)
+    w["l_suppkey"] = w["l_suppkey"].astype(np.int64)
+    _assert_rowset_equal(got, w, ["l_suppkey"])
+
+
+def test_q16(dctx, data, dtables):
+    got = _frame(queries.q16(dctx, dtables))
+    s = data["supplier"]
+    bad = s[s["s_comment"].astype(str).str.contains("Customer.*Complaints",
+                                                    regex=True)]["s_suppkey"]
+    p = data["part"]
+    p = p[(p["p_brand"] != "Brand#45")
+          & ~p["p_type"].astype(str).str.startswith("MEDIUM POLISHED")
+          & p["p_size"].isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    ps = data["partsupp"]
+    ps = ps[~ps["ps_suppkey"].isin(bad)]
+    m = ps.merge(p, left_on="ps_partkey", right_on="p_partkey")
+    w = (m.groupby(["p_brand", "p_type", "p_size"], observed=True)
+         ["ps_suppkey"].nunique().reset_index(name="supplier_cnt")
+         .sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                      ascending=[False, True, True, True])
+         .reset_index(drop=True))
+    for c in ("p_brand", "p_type"):
+        w[c] = w[c].astype(str)
+    for c in ("p_size", "supplier_cnt"):
+        got[c] = got[c].astype(np.int64)
+        w[c] = w[c].astype(np.int64)
+    _assert_rowset_equal(got, w, ["p_brand", "p_type", "p_size"])
+
+
+def test_q17(dctx, data, dtables):
+    # spec params (Brand#23, MED BOX) select no parts at SF-0.002; use a
+    # wider container that does (the oracle applies the same params)
+    p = data["part"]
+    counts = p.groupby(["p_brand", "p_container"], observed=True).size()
+    (brand, container) = counts.idxmax()
+    got = _frame(queries.q17(dctx, dtables, brand=str(brand),
+                             container=str(container)))
+    pp = p[(p["p_brand"] == brand) & (p["p_container"] == container)]
+    li = data["lineitem"]
+    li = li[li["l_partkey"].isin(pp["p_partkey"])]
+    avg = li.groupby("l_partkey")["l_quantity"].mean().rename("avg_qty")
+    m = li.merge(avg, left_on="l_partkey", right_index=True)
+    sel = m[m["l_quantity"] < 0.2 * m["avg_qty"]]
+    want = float(sel["l_extendedprice"].astype(np.float64).sum()) / 7.0
+    assert got.shape == (1, 1)
+    np.testing.assert_allclose(float(got.iloc[0, 0]), want, rtol=1e-4)
+
+
+def test_q20(dctx, data, dtables):
+    got = _frame(queries.q20(dctx, dtables))
+    p = data["part"]
+    p = p[p["p_name"].astype(str).str.startswith("forest")]
+    d0 = date_to_days("1994-01-01")
+    li = data["lineitem"]
+    li = li[(li["l_shipdate"] >= d0) & (li["l_shipdate"] < d0 + 365)
+            & li["l_partkey"].isin(p["p_partkey"])]
+    qty = li.groupby(["l_partkey", "l_suppkey"])["l_quantity"].sum() \
+        .reset_index(name="sum_qty")
+    ps = data["partsupp"]
+    ps = ps[ps["ps_partkey"].isin(p["p_partkey"])]
+    m = ps.merge(qty, left_on=["ps_partkey", "ps_suppkey"],
+                 right_on=["l_partkey", "l_suppkey"])
+    m = m[m["ps_availqty"] > 0.5 * m["sum_qty"]]
+    nat = data["nation"]
+    k = {str(n): int(i) for i, n in zip(nat["n_nationkey"], nat["n_name"])}
+    s = data["supplier"]
+    s = s[(s["s_nationkey"] == k["CANADA"])
+          & s["s_suppkey"].isin(m["ps_suppkey"])]
+    w = s[["s_suppkey"]].sort_values("s_suppkey").reset_index(drop=True)
+    got["s_suppkey"] = got["s_suppkey"].astype(np.int64)
+    w["s_suppkey"] = w["s_suppkey"].astype(np.int64)
+    _assert_rowset_equal(got, w, ["s_suppkey"])
+
+
+def test_q21(dctx, data, dtables):
+    got = _frame(queries.q21(dctx, dtables))
+    o = data["orders"]
+    fkeys = o[o["o_orderstatus"] == "F"]["o_orderkey"]
+    li = data["lineitem"]
+    li = li[li["l_orderkey"].isin(fkeys)].copy()
+    li["late"] = (li["l_receiptdate"] > li["l_commitdate"]).astype(int)
+    per_os = li.groupby(["l_orderkey", "l_suppkey"])["late"].max() \
+        .reset_index(name="any_late")
+    per_o = per_os.groupby("l_orderkey").agg(
+        n_supp=("l_suppkey", "count"), n_late=("any_late", "sum")) \
+        .reset_index()
+    cand = per_o[(per_o["n_supp"] >= 2) & (per_o["n_late"] == 1)]
+    nat = data["nation"]
+    k = {str(n): int(i) for i, n in zip(nat["n_nationkey"], nat["n_name"])}
+    sa = data["supplier"]
+    sa = sa[sa["s_nationkey"] == k["SAUDI ARABIA"]]["s_suppkey"]
+    l1 = li[(li["late"] == 1) & li["l_suppkey"].isin(sa)
+            & li["l_orderkey"].isin(cand["l_orderkey"])]
+    w = l1.groupby("l_suppkey").size().reset_index(name="numwait") \
+        .sort_values(["numwait", "l_suppkey"], ascending=[False, True]) \
+        .head(100).reset_index(drop=True)
+    for c in ("l_suppkey", "numwait"):
+        got[c] = got[c].astype(np.int64)
+        w[c] = w[c].astype(np.int64)
+    _assert_rowset_equal(got, w, ["l_suppkey"])
+
+
+def test_q22(dctx, data, dtables):
+    got = _frame(queries.q22(dctx, dtables))
+    codes = (13, 31, 23, 29, 30, 18, 17)
+    c = data["customer"]
+    c = c[c["c_phone_cc"].isin(codes)]
+    pos = c[c["c_acctbal"] > 0.0]
+    avg = float(pos["c_acctbal"].astype(np.float64).mean())
+    rich = c[c["c_acctbal"] > avg]
+    noord = rich[~rich["c_custkey"].isin(data["orders"]["o_custkey"])]
+    assert len(noord) > 0, "Q22 cohort empty at test scale"
+    g = noord.groupby("c_phone_cc").agg(
+        numcust=("c_acctbal", "count"), totacctbal=("c_acctbal", "sum")) \
+        .reset_index().rename(columns={"c_phone_cc": "cntrycode"}) \
+        .sort_values("cntrycode").reset_index(drop=True)
+    for c2 in ("cntrycode", "numcust"):
+        got[c2] = got[c2].astype(np.int64)
+        g[c2] = g[c2].astype(np.int64)
+    _assert_rowset_equal(got, g, ["cntrycode"])
