@@ -90,6 +90,117 @@ def carry_unpack(carried, value_validities):
     return cols_s, valids_s
 
 
+def dense_group_structure(key: jax.Array, key_validity, row_valid,
+                          lo: int, hi: int):
+    """Direct-address grouping for a single integer key with a known dense
+    range [lo, hi] — NO sort.  Each row's group slot is ``key - lo``; a
+    scatter-add builds per-slot counts.  Replaces the sort+scan structure
+    when the key range is commensurate with the row count (TPC-H surrogate
+    keys: l_orderkey, c_custkey, …), turning the groupby's O(n log n) sort
+    into two O(n) scatter passes (docs/tpu_perf_notes.md: scatter ≈ 6
+    ns/row·pass; the sort path moves every carried column through lax.sort).
+
+    Slots: [0, R) real groups, R = null-key rows (one group, null == null
+    like the sort path), R+1 = dropped (padding / filtered rows — the
+    counts array has R+1 entries so slot R+1 falls off and ``mode='drop'``
+    discards it).  Returns (slot[n], counts[R+1], ngroups, overflow) where
+    ``overflow`` counts valid rows whose key lies OUTSIDE [lo, hi] — a
+    caller-contract violation that must fail loudly, never silently alias.
+    """
+    R = hi - lo + 1
+    n = key.shape[0]
+    valid = (jnp.ones(n, bool) if row_valid is None else row_valid)
+    if key_validity is not None:
+        nonnull = valid & key_validity
+        null_rows = valid & ~key_validity
+    else:
+        nonnull = valid
+        null_rows = None
+    in_range = (key >= lo) & (key <= hi)
+    overflow = jnp.sum(nonnull & ~in_range).astype(jnp.int32)
+    slot = jnp.where(nonnull & in_range, key.astype(jnp.int32) - lo,
+                     jnp.int32(R + 1))
+    if null_rows is not None:
+        slot = jnp.where(null_rows, jnp.int32(R), slot)
+    counts = jnp.zeros(R + 1, jnp.int32).at[slot].add(1, mode="drop")
+    ngroups = jnp.sum(counts > 0).astype(jnp.int32)
+    return slot, counts, ngroups, overflow
+
+
+def dense_groupby_aggregate(slot: jax.Array, counts: jax.Array,
+                            value_cols, value_validities,
+                            aggs: Tuple[str, ...], out_capacity: int,
+                            lo: int, key_dtype, has_null_slot: bool):
+    """Phase 2 of the dense path: per-agg scatter into the [R+1] slot
+    space, then compact the non-empty slots into ``out_capacity``.
+
+    The group key is RECONSTRUCTED from the slot id (lo + slot) — no key
+    gather at all.  Returns (key_data[C], key_validity[C] or None,
+    agg_arrays, agg_validities, ngroups), matching the sort path's
+    contract (entries past the group count are unspecified).
+    """
+    from ..dtypes import extreme_value
+    from .compact import compact_indices
+    R1 = counts.shape[0]
+    present = counts > 0
+    starts = compact_indices(present, out_capacity, fill=-1)  # slot per group
+    safe = jnp.clip(starts, 0, R1 - 1)
+    key_data = (lo + safe).astype(key_dtype)
+    key_valid = None
+    if has_null_slot:
+        key_valid = (starts >= 0) & (safe != R1 - 1)  # slot R ⇒ null key
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    outs, out_valids = [], []
+    cnt_cache: dict = {}
+
+    def slot_count(vmask_key, vmask):
+        if vmask_key not in cnt_cache:
+            c = jnp.zeros(R1, idt).at[slot].add(
+                vmask.astype(idt), mode="drop")
+            cnt_cache[vmask_key] = jnp.take(c, safe)
+        return cnt_cache[vmask_key]
+
+    for i, (col, validity, agg) in enumerate(
+            zip(value_cols, value_validities, aggs)):
+        vmask = (jnp.ones(col.shape[0], bool) if validity is None
+                 else validity)
+        vkey = id(validity)
+        cnt = None
+        if agg in (COUNT, MEAN, MIN, MAX):
+            cnt = slot_count(vkey, vmask)
+        if agg == COUNT:
+            outs.append(cnt)
+            out_valids.append(None)
+            continue
+        if agg in (SUM, MEAN):
+            acc_dt = (fdt if jnp.issubdtype(col.dtype, jnp.floating)
+                      else idt)
+            z = jnp.where(vmask, col, jnp.zeros((), col.dtype)).astype(acc_dt)
+            tot = jnp.take(jnp.zeros(R1, acc_dt).at[slot].add(
+                z, mode="drop"), safe)
+            if agg == SUM:
+                outs.append(tot.astype(col.dtype)
+                            if jnp.issubdtype(col.dtype, jnp.floating)
+                            else tot)
+                out_valids.append(None)
+            else:
+                outs.append(tot.astype(fdt)
+                            / jnp.maximum(cnt, 1).astype(fdt))
+                out_valids.append(cnt > 0)
+            continue
+        # MIN / MAX: scatter with the opposite-extreme sentinel init
+        sentinel = extreme_value(col.dtype, largest=(agg == MIN))
+        masked = jnp.where(vmask, col, sentinel)
+        init = jnp.full(R1, sentinel, col.dtype)
+        scat = (init.at[slot].min(masked, mode="drop") if agg == MIN
+                else init.at[slot].max(masked, mode="drop"))
+        outs.append(jnp.take(scat, safe))
+        out_valids.append(cnt > 0)
+    ngroups = jnp.sum(present).astype(jnp.int32)
+    return key_data, key_valid, tuple(outs), tuple(out_valids), ngroups
+
+
 _SEG_BLOCK = 128  # within-block scan width (log2 = 7 shift passes)
 
 

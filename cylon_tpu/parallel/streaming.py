@@ -130,8 +130,8 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
         reason = ("RIGHT/FULL_OUTER cannot stream (unmatched-right needs "
                   "all left chunks)"
                   if config.join_type.value in ("right", "full_outer")
-                  else f"chunks={chunks} does not divide cap={left.cap} "
-                  "into multiple slices")
+                  else f"chunks={chunks} <= 1 or left cap={left.cap} < "
+                  "chunks (no multi-slice split possible)")
         glog.vlog(1, "dist_join_streaming[%s]: falling back to one-shot "
                   "dist_join — %s", config.join_type.value, reason)
         return dist_join(left, right, config)

@@ -601,3 +601,62 @@ def test_dist_semi_join_empty_right(dctx, rng):
     assert dist_semi_join(lt, rt, "k", "k").to_table().num_rows == 0
     assert_same_rows(dist_anti_join(lt, rt, "k", "k").to_table().to_pandas(),
                      ldf)
+
+
+# ---------------------------------------------------------------------------
+# dense-key direct-address groupby (dense_key_range hint)
+# ---------------------------------------------------------------------------
+
+def test_dist_groupby_dense_matches_sort_path(dctx, rng):
+    df = pd.DataFrame({
+        "k": rng.integers(5, 95, 400),
+        "v": rng.normal(size=400),
+        "w": pd.array(np.where(rng.random(400) < 0.2, None,
+                               rng.integers(0, 9, 400).astype(float)),
+                      dtype="Float64"),
+    })
+    dt = dtable_from_pandas(dctx, df)
+    aggs = [("v", "sum"), ("v", "mean"), ("w", "count"), ("w", "min"),
+            ("v", "max")]
+    plain = dist_groupby(dt, ["k"], aggs).to_table().to_pandas()
+    dense = dist_groupby(dt, ["k"], aggs,
+                         dense_key_range=(0, 99)).to_table().to_pandas()
+    assert_same_rows(dense, plain)
+
+
+def test_dist_groupby_dense_null_keys_and_where(dctx, rng):
+    df = pd.DataFrame({
+        "k": pd.array(np.where(rng.random(200) < 0.15, None,
+                               rng.integers(0, 30, 200)), dtype="Int64"),
+        "v": rng.normal(size=200),
+    })
+    dt = dtable_from_pandas(dctx, df)
+    pred = lambda env: env["v"] > 0  # noqa: E731
+    plain = dist_groupby(dt, ["k"], [("v", "sum"), ("v", "count")],
+                         where=pred).to_table().to_pandas()
+    dense = dist_groupby(dt, ["k"], [("v", "sum"), ("v", "count")],
+                         where=pred,
+                         dense_key_range=(0, 29)).to_table().to_pandas()
+    assert_same_rows(dense, plain)
+
+
+def test_dist_groupby_dense_range_violation_raises(dctx, rng):
+    from cylon_tpu.status import CylonError
+    df = pd.DataFrame({"k": rng.integers(0, 100, 50),
+                       "v": rng.normal(size=50)})
+    dt = dtable_from_pandas(dctx, df)
+    with pytest.raises(CylonError, match="dense_key_range"):
+        dist_groupby(dt, ["k"], [("v", "sum")], dense_key_range=(0, 10))
+
+
+def test_dist_groupby_dense_hint_ignored_when_range_huge(dctx, rng):
+    """R > 4·cap falls back to the sort path silently (memory guard)."""
+    df = pd.DataFrame({"k": rng.integers(0, 50, 60),
+                       "v": rng.normal(size=60)})
+    dt = dtable_from_pandas(dctx, df)
+    out = dist_groupby(dt, ["k"], [("v", "sum")],
+                       dense_key_range=(0, 10_000_000)).to_table() \
+        .to_pandas()
+    w = df.groupby("k")["v"].sum().reset_index() \
+        .rename(columns={"v": "sum_v"})
+    assert_same_rows(out, w)
