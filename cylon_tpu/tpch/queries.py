@@ -342,19 +342,12 @@ def q4(ctx, t: Tables, date: str = "1993-07-01") -> Table:
                                    "l_receiptdate"]),
                      _pred_cols_lt("l_commitdate", "l_receiptdate"))
     li = dist_project(li, ["l_orderkey"])
-    # EXISTS ⇒ semi-join, evaluated small-side-first: join the filtered
-    # orders (~1/26 of a year) against the raw lineitem keys, THEN
-    # collapse to one row per order — grouping the join's ~matching-month
-    # output beats deduplicating the ~60%-selective lineitem filter first
-    # (a near-table-cardinality groupby, the Q18 cost shape)
-    m = _strip_prefixes(dist_join(orders, li,
-                                  _cfg("o_orderkey", "l_orderkey")))
-    # priority rides as a second group key (an order has exactly one), so
-    # the dictionary survives into the final per-priority rollup
-    per_order = dist_groupby(m, ["o_orderkey", "o_orderpriority"],
-                             [("o_orderkey", "count")])
-    g = dist_groupby(per_order, ["o_orderpriority"],
-                     [("o_orderkey", "count")])
+    # EXISTS ⇒ the semi-join primitive: one presence pass emits each
+    # filtered order at most once regardless of how many of its lines
+    # qualify (round 3 simulated this with inner join + two groupbys —
+    # the shape the primitive replaces)
+    m = dist_semi_join(orders, li, "o_orderkey", "l_orderkey")
+    g = dist_groupby(m, ["o_orderpriority"], [("o_orderkey", "count")])
     out = g.to_table()  # already exactly [o_orderpriority, count]
     from ..compute import sort_multi
     return sort_multi(out.rename_column("count_o_orderkey", "order_count"),
@@ -481,7 +474,10 @@ def _promo_rev(env):
 
 def q18(ctx, t: Tables, quantity: float = 300.0, limit: int = 100) -> Table:
     li = dist_project(t["lineitem"], ["l_orderkey", "l_quantity"])
-    per_order = dist_groupby(li, ["l_orderkey"], [("l_quantity", "sum")])
+    # l_orderkey densely covers [1, |orders|] by construction — the
+    # 15M-group aggregate runs direct-address (no sort)
+    per_order = dist_groupby(li, ["l_orderkey"], [("l_quantity", "sum")],
+                             dense_key_range=(1, _table_rows(t["orders"])))
     big = dist_select(per_order, _pred_gt("sum_l_quantity", quantity))
     orders = dist_project(t["orders"], ["o_orderkey", "o_custkey",
                                         "o_orderdate", "o_totalprice"])
@@ -855,7 +851,8 @@ def q13(ctx, t: Tables) -> Table:
     cust = dist_project(t["customer"], ["c_custkey"])
     m = _strip_prefixes(dist_join(
         cust, orders, _cfg("c_custkey", "o_custkey", JoinType.LEFT)))
-    per_c = dist_groupby(m, ["c_custkey"], [("o_orderkey", "count")])
+    per_c = dist_groupby(m, ["c_custkey"], [("o_orderkey", "count")],
+                         dense_key_range=(1, _table_rows(t["customer"])))
     g = dist_groupby(per_c, ["count_o_orderkey"], [("c_custkey", "count")])
     out = g.to_table().rename_column("count_o_orderkey", "c_count") \
         .rename_column("count_c_custkey", "custdist")
@@ -1005,7 +1002,8 @@ def q21(ctx, t: Tables, nation: str = "SAUDI ARABIA",
     per_os = dist_groupby(li, ["l_orderkey", "l_suppkey"],
                           [("late", "max")])
     per_o = dist_groupby(per_os, ["l_orderkey"],
-                         [("l_suppkey", "count"), ("max_late", "sum")])
+                         [("l_suppkey", "count"), ("max_late", "sum")],
+                         dense_key_range=(1, _table_rows(t["orders"])))
     cand = dist_select(per_o, _pred_q21_cand)
     supp_sa = dist_project(
         dist_select(dist_project(t["supplier"], ["s_suppkey",
