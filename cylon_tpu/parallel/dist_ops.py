@@ -161,6 +161,12 @@ def _shuffle_by_pids(dt: DTable, pid: jax.Array) -> DTable:
             leaves.append(c.validity)
             slots.append((i, True))
     new_leaves, newcounts, outcap = shuffle_leaves(dt.ctx, pid, leaves)
+    # structural exchange metric (static host-side sizes — no sync):
+    # total exchanged slot capacity across shards, summed over leaves
+    trace.count("shuffle.capacity_rows",
+                dt.ctx.get_world_size() * outcap)
+    trace.count("shuffle.capacity_cells",
+                dt.ctx.get_world_size() * outcap * len(leaves))
     data = {}
     validity = {}
     for leaf, (i, is_v) in zip(new_leaves, slots):
@@ -574,7 +580,8 @@ _GROUP_HINTS_MAX = 256
 
 def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
                  aggregations: Sequence[Tuple[Union[int, str], str]],
-                 where=None, dense_key_range=None) -> DTable:
+                 where=None, dense_key_range=None, pre_aggregate=None,
+                 _local_only: bool = False) -> DTable:
     """Distributed groupby-aggregate: shuffle on key hash (equal keys
     co-locate ⇒ each group lives wholly on one shard), then the local
     segment-reduction kernel per shard.  Aggs: sum/count/mean/min/max.
@@ -596,10 +603,19 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     integer, non-dictionary) group key densely covers [lo, hi] — TPC-H
     surrogate keys, row ids, enum codes.  The groupby then runs DIRECT-
     ADDRESS (two scatter passes, no sort — ops/groupby.py
-    dense_group_structure); measured ~4x faster at 60M rows / 15M groups
-    on a v5e.  A key outside the range fails loudly (never aliases); the
-    hint is ignored when the slot space would exceed 4x the shard
-    capacity (memory guard) or the key shape doesn't qualify.
+    dense_group_structure).  A key outside the range fails loudly (never
+    aliases); the hint is ignored when the slot space would exceed 4x the
+    shard capacity (memory guard) or the key shape doesn't qualify.
+
+    ``pre_aggregate`` (default: auto = on for world > 1): every supported
+    aggregation is decomposable, so each shard aggregates its OWN rows
+    first and only the per-shard group table crosses the wire — classic
+    two-level aggregation.  Exchange volume drops from O(rows) to
+    O(groups)/shard, and a hot key costs one partial row per shard
+    instead of landing every duplicate on one receiver (the skew-cliff
+    mitigation for grouped aggregation).  Pass ``False`` to force the
+    raw-row shuffle (e.g. keys known near-unique, where the partial pass
+    is pure overhead).
     """
     key_ids = _resolve_ids(dt, key_columns)
     val_ids = [dt.column_index(c) for c, _ in aggregations]
@@ -611,8 +627,14 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     for op in aggs:
         if op not in ops_groupby.AGG_OPS:
             raise CylonError(Status(Code.Invalid, f"unknown aggregation {op!r}"))
+    world = dt.ctx.get_world_size()
+    if pre_aggregate is None:
+        pre_aggregate = world > 1 and not _local_only
+    if world > 1 and pre_aggregate and not _local_only:
+        return _dist_groupby_preagg(dt, key_ids, aggregations, where,
+                                    dense_key_range)
     pmask = None if where is None else _predicate_mask(dt, where)
-    if dt.ctx.get_world_size() == 1:
+    if world == 1 or _local_only:
         sh = dt
     else:
         with trace.span("groupby.shuffle"):
@@ -725,6 +747,60 @@ def _dist_groupby_dense(dt: DTable, sh: DTable, kc: DColumn, key_id: int,
         cols.append(DColumn(f"{op}_{base.name}", DataType(t_out), arr,
                             validity))
     return DTable(dt.ctx, cols, used[0], counts_out)
+
+
+def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
+                         where, dense_key_range) -> DTable:
+    """Two-level aggregation tail of dist_groupby (``pre_aggregate``):
+    local per-shard groupby (no exchange) → shuffle the tiny partial-group
+    table → combining groupby (sum of sums, sum of counts, min of mins,
+    max of maxes; mean = Σsum/Σcount).  Column plumbing is positional —
+    partial column j sits at index K+j of the partial table."""
+    K = len(key_ids)
+    partial: List[Tuple[int, str]] = []
+    ppos: dict = {}
+
+    def _p(ci: int, op: str) -> int:
+        k = (ci, op)
+        if k not in ppos:
+            ppos[k] = len(partial)
+            partial.append((ci, op))
+        return ppos[k]
+
+    plan = []  # per final slot: (op, partial ref[, count ref for mean])
+    for cref, op in aggregations:
+        ci = dt.column_index(cref)
+        if op == "mean":
+            plan.append((op, _p(ci, "sum"), _p(ci, "count")))
+        elif op == "count":
+            plan.append((op, _p(ci, "count")))
+        else:
+            plan.append((op, _p(ci, op)))
+    part = dist_groupby(dt, key_ids, partial, where=where,
+                        dense_key_range=dense_key_range,
+                        pre_aggregate=False, _local_only=True)
+    comb_op = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+    comb = dist_groupby(part, list(range(K)),
+                        [(K + j, comb_op[op]) for j, (_, op)
+                         in enumerate(partial)],
+                        dense_key_range=dense_key_range,
+                        pre_aggregate=False)
+    from ..compute import _agg_output_type
+    fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    cols = list(comb.columns[:K])
+    for (cref, op), spec in zip(aggregations, plan):
+        base = dt.columns[dt.column_index(cref)]
+        t_out = _agg_output_type(base.dtype.type, op)
+        name = f"{op}_{base.name}"
+        if op == "mean":
+            s, c = comb.columns[K + spec[1]], comb.columns[K + spec[2]]
+            data = s.data.astype(fdt) / jnp.maximum(c.data, 1).astype(fdt)
+            cols.append(DColumn(name, DataType(t_out), data, c.data > 0))
+        else:
+            src = comb.columns[K + spec[1]]
+            cols.append(DColumn(name, DataType(t_out), src.data,
+                                src.validity))
+    return DTable(dt.ctx, cols, comb.cap, comb.counts)
 
 
 @functools.lru_cache(maxsize=None)
