@@ -159,6 +159,22 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
         per_recv = counts.sum(axis=0)
         outcap = ops_compact.next_bucket(
             max(int(per_recv.max(initial=0)), 1), minimum=8)
+        # Skew cliff: EVERY shard's receive block is sized to the HOTTEST
+        # receiver (XLA collectives are ragged-free — uniform shapes or
+        # nothing), so one hot key/range makes the global arrays ≈ P× the
+        # data.  Warn when the detour is real; mitigations are documented
+        # in docs/tpu_perf_notes.md (pre-aggregated groupby never routes
+        # raw hot rows; sample-sort splitters spread dense ranges).
+        mean_recv = max(float(per_recv.mean()), 1.0)
+        if Pn > 1 and outcap > 4 * mean_recv:
+            from .. import logging as glog
+            glog.warning(
+                "skewed exchange: hottest receiver gets %d rows "
+                "(%.1fx the %.0f mean); every shard's receive block is "
+                "bucketed to %d — peak memory ~%.1fx the data. "
+                "See docs/tpu_perf_notes.md 'hot-key skew'.",
+                int(per_recv.max(initial=0)), per_recv.max() / mean_recv,
+                mean_recv, outcap, outcap / mean_recv)
         return (block, outcap)
 
     with trace.span_sync("shuffle.exchange") as sp:

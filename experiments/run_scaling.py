@@ -57,8 +57,12 @@ total = rows * world
 krange = max(int(total * 0.99), 1)
 
 def make(n):
+    # four columns over TWO width classes (3x 4-byte + 1x 1-byte) so the
+    # width-classed packed exchange actually exercises multi-class packing
     return {{"k": rng.integers(0, krange, n).astype(np.int32),
-             "v0": rng.random(n, dtype=np.float32)}}
+             "v0": rng.random(n, dtype=np.float32),
+             "v1": rng.random(n, dtype=np.float32),
+             "flag": rng.integers(0, 2, n).astype(np.int8)}}
 
 left = DTable.from_table(ctx, Table.from_columns(ctx, make(total)))
 right = DTable.from_table(ctx, Table.from_columns(ctx, make(total)))
@@ -86,13 +90,17 @@ def run():
     return (time.perf_counter() - t0) * 1e3
 
 run()  # compile
-# each table's exchange launches one all_to_all per column leaf; the
-# world=1 path skips the shuffle entirely (no collectives at all)
+# each table's exchange launches ONE all_to_all per WIDTH CLASS (the
+# packed exchange) plus one for the count vector; the world=1 path skips
+# the shuffle entirely (no collectives at all)
+from cylon_tpu.ops import gather as ops_gather
+nclasses = len(list(ops_gather.pack_columns(
+    [c.data for c in left.columns])))
 print(json.dumps({{"times": [run() for _ in range(reps)],
                    "exchanged_rows": exchanged,
                    "exchanged_mb": round(exchanged * row_bytes / 1e6, 3),
                    "total_rows": 2 * total,
-                   "collectives": (2 * len(left.columns) if world > 1
+                   "collectives": (2 * (nclasses + 1) if world > 1
                                    else 0)}}))
 """
 
