@@ -226,6 +226,42 @@ def select(t: Table, predicate: Callable[[Dict[str, jax.Array]], jax.Array]) -> 
     return Table(t.ctx, _slice_columns(out, int(count)))
 
 
+def _split_by_pids(t: Table, pid: jax.Array, n: int) -> List[Table]:
+    """Rows → ``n`` tables by per-row partition id (shared tail of the
+    local partition ops).  One mask-compact per partition — a host loop is
+    fine at the compat layer (the distributed path exchanges in one
+    collective instead; parallel/shuffle.py)."""
+    outs = []
+    for p in range(n):
+        idx, count = ops_compact.mask_to_indices(pid == p, t.num_rows)
+        cols = _gather_columns(t, idx, fill_null=False)
+        outs.append(Table(t.ctx, _slice_columns(cols, int(count))))
+    return outs
+
+
+def hash_partition(t: Table, hash_columns: Sequence[Union[int, str]],
+                   no_of_partitions: int) -> List[Table]:
+    """Split a local table into ``n`` tables by murmur3 row hash of
+    ``hash_columns`` — the same partitioner the distributed shuffle uses
+    (ops/hash.py), so co-partitioned outputs join shard-for-shard.
+    reference: HashPartition (cpp/src/cylon/table_api.cpp:461-528; the
+    Java surface declares it at Table.java:156)."""
+    from .ops import hash as ops_hash
+    kcs = [t.column(c) for c in hash_columns]
+    cols = tuple(c.data for c in kcs)
+    valids = tuple(c.validity for c in kcs)
+    pid = ops_hash.partition_ids(ops_hash.row_hash(cols, valids),
+                                 no_of_partitions)
+    return _split_by_pids(t, pid, no_of_partitions)
+
+
+def round_robin_partition(t: Table, no_of_partitions: int) -> List[Table]:
+    """Split a local table into ``n`` similar-sized tables, row i →
+    partition i mod n (reference Java surface: Table.java:166)."""
+    pid = jnp.arange(t.num_rows, dtype=jnp.int32) % no_of_partitions
+    return _split_by_pids(t, pid, no_of_partitions)
+
+
 def merge(tables: Sequence[Table]) -> Table:
     """Concatenate tables with identical schemas (reference Merge,
     table_api.cpp:404-423)."""

@@ -36,6 +36,7 @@ def _run(script, *args):
     ("select_project_example.py", ()),
     ("groupby_sort_example.py", ()),
     ("cylon_simple_dataloader.py", ()),
+    ("cylon_mnist_example.py", ()),
 ])
 def test_example_runs(script, args):
     r = _run(script, *args)

@@ -381,3 +381,50 @@ def test_groupby_blocked_scan_spanning_groups(ctx):
                       ("count_w", "count_w")]:
         np.testing.assert_allclose(ours[col].astype(float),
                                    oracle[ocol].astype(float), rtol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# local partition ops (reference Java surface: hashPartition /
+# roundRobinPartition, Table.java:156-167)
+# ---------------------------------------------------------------------------
+
+def test_hash_partition_local(ctx, rng):
+    import pandas as pd
+    from cylon_tpu import compute
+    from cylon_tpu.table import Table
+    df = pd.DataFrame({"k": rng.integers(0, 50, 200),
+                       "v": rng.normal(size=200)})
+    parts = compute.hash_partition(Table.from_pandas(ctx, df), ["k"], 4)
+    assert len(parts) == 4
+    back = pd.concat([p.to_pandas() for p in parts])
+    assert_same_rows(back, df)
+    # equal keys land in exactly one partition
+    owners = {}
+    for i, p in enumerate(parts):
+        for k in p.to_pandas()["k"].unique():
+            assert owners.setdefault(k, i) == i
+
+
+def test_round_robin_partition_local(ctx, rng):
+    import pandas as pd
+    from cylon_tpu import compute
+    from cylon_tpu.table import Table
+    df = pd.DataFrame({"v": rng.normal(size=103)})
+    parts = compute.round_robin_partition(Table.from_pandas(ctx, df), 4)
+    sizes = [p.num_rows for p in parts]
+    assert sum(sizes) == 103
+    assert max(sizes) - min(sizes) <= 1  # similar-sized, per the contract
+    back = pd.concat([p.to_pandas() for p in parts])
+    assert_same_rows(back, df)
+
+
+def test_fileutils_compat(tmp_path):
+    import pytest as _pytest
+    from pycylon.util import FileUtils
+    assert FileUtils.path_exists(str(tmp_path))
+    (tmp_path / "a.csv").write_text("x\n1\n")
+    FileUtils.files_exist(str(tmp_path), ["a.csv"])
+    with _pytest.raises(ValueError):
+        FileUtils.files_exist(str(tmp_path), ["missing.csv"])
+    with _pytest.raises(ValueError):
+        FileUtils.path_exists(None)
