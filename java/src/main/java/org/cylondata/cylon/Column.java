@@ -1,0 +1,47 @@
+package org.cylondata.cylon;
+
+import java.util.List;
+
+/**
+ * One column of data, addressable on its own — mirrors the reference's
+ * {@code Column} handle (reference: java/src/main/java/org/cylondata/
+ * cylon/Column.java: id-addressed, with the table-position index attached
+ * once the column joins a {@link Table}).  Values live JVM-side until the
+ * column enters a table ({@code Table.fromColumns}) — the engine has no
+ * standalone column registry, so the handle carries its batch directly
+ * (documented deviation; the reference ships values through arrow
+ * vectors built in {@code ArrowTable}).
+ */
+public class Column<O> {
+
+  private final String name;
+  private final List<O> values;
+  private int columnIndex = -1;
+
+  public Column(String name, List<O> values) {
+    this.name = name;
+    this.values = values;
+  }
+
+  public String getName() {
+    return name;
+  }
+
+  public List<O> getValues() {
+    return values;
+  }
+
+  void setColumnIndex(int columnIndex) {
+    this.columnIndex = columnIndex;
+  }
+
+  /** Position in the owning table, −1 while detached (reference
+   *  contract: Column.java getColumnIndex). */
+  public int getColumnIndex() {
+    return columnIndex;
+  }
+
+  public int getRowCount() {
+    return values.size();
+  }
+}

@@ -1,0 +1,37 @@
+package org.cylondata.cylon;
+
+/**
+ * Column data types, mirroring the reference's enum of arrow minor types
+ * (reference: java/src/main/java/org/cylondata/cylon/DataTypes.java).
+ * The engine maps these onto its device dtypes (cylon_tpu/dtypes.py);
+ * types the device path cannot represent are accepted at the API surface
+ * and rejected at ingest with a typed Status, like the pycylon layer.
+ */
+public enum DataTypes {
+
+  BIGINT(0),
+  BIT(1),
+  DATEDAY(2),
+  DECIMAL(4),
+  FLOAT4(8),
+  FLOAT8(9),
+  INT(10),
+  NULL(15),
+  SMALLINT(16),
+  TINYINT(30),
+  UINT1(31),
+  UINT2(32),
+  UINT4(33),
+  UINT8(34),
+  VARCHAR(35);
+
+  private final int code;
+
+  DataTypes(int code) {
+    this.code = code;
+  }
+
+  public int getCode() {
+    return code;
+  }
+}

@@ -43,6 +43,7 @@ final class Json {
     return sb.append('}').toString();
   }
 
+  @SuppressWarnings("unchecked")
   private static void writeValue(StringBuilder sb, Object v) {
     if (v == null) {
       sb.append("null");
@@ -50,6 +51,19 @@ final class Json {
       writeString(sb, (String) v);
     } else if (v instanceof Boolean || v instanceof Number) {
       sb.append(v);
+    } else if (v instanceof java.util.List) {
+      sb.append('[');
+      boolean first = true;
+      for (Object e : (java.util.List<Object>) v) {
+        if (!first) {
+          sb.append(',');
+        }
+        first = false;
+        writeValue(sb, e);
+      }
+      sb.append(']');
+    } else if (v instanceof Map) {
+      sb.append(write((Map<String, Object>) v));
     } else {
       throw new CylonRuntimeException("unsupported JSON value: " + v);
     }

@@ -1,9 +1,15 @@
 package org.cylondata.cylon;
 
+import java.util.ArrayList;
 import java.util.List;
 import java.util.Map;
 
+import org.cylondata.cylon.arrow.ArrowTable;
 import org.cylondata.cylon.join.JoinConfig;
+import org.cylondata.cylon.ops.Filter;
+import org.cylondata.cylon.ops.Mapper;
+import org.cylondata.cylon.ops.Row;
+import org.cylondata.cylon.ops.Selector;
 
 /**
  * Id-addressed table handle, mirroring the reference's Java {@code Table}
@@ -31,6 +37,28 @@ public class Table {
     Map<String, Object> r = ctx.request(
         Json.map("op", "from_csv", "path", path));
     return new Table(ctx, (String) r.get("id"));
+  }
+
+  /** Build a table from JVM-side columns (reference: Table.fromColumns,
+   *  Table.java:64). */
+  public static Table fromColumns(CylonContext ctx, List<Column<?>> columns) {
+    List<Object> specs = new ArrayList<>();
+    for (int i = 0; i < columns.size(); i++) {
+      Column<?> c = columns.get(i);
+      specs.add(Json.map("name", c.getName(), "values", c.getValues()));
+    }
+    Map<String, Object> r = ctx.request(
+        Json.map("op", "table_from_columns", "columns", specs));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  /** Ingest a staged {@link ArrowTable} batch (reference:
+   *  Table.fromArrowTable, Table.java:42). */
+  public static Table fromArrowTable(CylonContext ctx, ArrowTable arrowTable) {
+    if (!arrowTable.isFinished()) {
+      arrowTable.finish();
+    }
+    return fromColumns(ctx, arrowTable.getColumns());
   }
 
   // -- relational ops (reference Table.java surface) ------------------------
@@ -88,6 +116,144 @@ public class Table {
     Map<String, Object> r = ctx.request(Json.map(
         "op", "sort", "id", id, "column", column));
     return new Table(ctx, (String) r.get("id"));
+  }
+
+  // -- row/cell lambdas (reference Table.java:145-226) ----------------------
+  //
+  // Selector/Filter/Mapper are JVM closures; a closure cannot cross the
+  // gateway, so these evaluate ON the JVM over rows fetched once
+  // (column_json) and ship the verdicts back as one batch — true source
+  // compatibility at O(rows) transfer.  selectExpr is the engine-side
+  // fast path (an expression string evaluated on device, no row fetch).
+
+  @SuppressWarnings("unchecked")
+  private List<List<Object>> fetchColumns() {
+    int nc = getColumnCount();
+    List<List<Object>> cols = new ArrayList<>();
+    for (int c = 0; c < nc; c++) {
+      cols.add((List<Object>) ctx.request(Json.map(
+          "op", "column_json", "id", id, "column", c)).get("value"));
+    }
+    return cols;
+  }
+
+  /** Keep rows the selector accepts (reference: Table.select,
+   *  Table.java:215). */
+  public Table select(Selector selector) {
+    List<List<Object>> cols = fetchColumns();
+    int n = cols.isEmpty() ? 0 : cols.get(0).size();
+    List<Object> mask = new ArrayList<>(n);
+    List<Object> row = new ArrayList<>(cols.size());
+    for (int i = 0; i < n; i++) {
+      row.clear();
+      for (List<Object> col : cols) {
+        row.add(col.get(i));
+      }
+      mask.add(selector.select(new Row(new ArrayList<>(row))));
+    }
+    Map<String, Object> r = ctx.request(Json.map(
+        "op", "select_mask", "id", id, "mask", mask));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  /** Engine-side select: expression over column names, evaluated on
+   *  device without fetching rows (this framework's scalable variant of
+   *  {@link #select(Selector)}). */
+  public Table selectExpr(String expression) {
+    Map<String, Object> r = ctx.request(Json.map(
+        "op", "select_expr", "id", id, "expr", expression));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  /** Keep rows whose {@code columnIndex} value passes the filter
+   *  (reference: Table.filter, Table.java:204). */
+  @SuppressWarnings("unchecked")
+  public <I> Table filter(int columnIndex, Filter<I> filterLogic) {
+    List<Object> col = (List<Object>) ctx.request(Json.map(
+        "op", "column_json", "id", id, "column", columnIndex)).get("value");
+    List<Object> mask = new ArrayList<>(col.size());
+    for (Object v : col) {
+      mask.add(filterLogic.filter((I) v));
+    }
+    Map<String, Object> r = ctx.request(Json.map(
+        "op", "select_mask", "id", id, "mask", mask));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  /** Transform one column cell-by-cell; returns the table with the
+   *  mapped column in place (reference: Table.mapColumn, Table.java:145
+   *  — the reference returns the detached Column; here the rebuilt table
+   *  is the useful handle, and {@link #getColumn} detaches it). */
+  @SuppressWarnings("unchecked")
+  public <I, O> Table mapColumn(int colIndex, String newName,
+                                Mapper<I, O> mapper) {
+    List<Object> col = (List<Object>) ctx.request(Json.map(
+        "op", "column_json", "id", id, "column", colIndex)).get("value");
+    List<Object> mapped = new ArrayList<>(col.size());
+    for (Object v : col) {
+      mapped.add(mapper.map((I) v));
+    }
+    Map<String, Object> r = ctx.request(Json.map(
+        "op", "replace_column", "id", id, "column", colIndex,
+        "values", mapped, "name", newName));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  /** Detach one column's values to the JVM. */
+  @SuppressWarnings("unchecked")
+  public <O> Column<O> getColumn(int colIndex) {
+    List<O> vals = (List<O>) ctx.request(Json.map(
+        "op", "column_json", "id", id, "column", colIndex)).get("value");
+    Column<O> c = new Column<>(getColumnNames().get(colIndex), vals);
+    return c;
+  }
+
+  // -- partitions / merge (reference Table.java:156-190) --------------------
+
+  /** Split by murmur3 hash of {@code hashColumns} into {@code n} tables
+   *  (reference: Table.hashPartition, Table.java:156). */
+  @SuppressWarnings("unchecked")
+  public List<Table> hashPartition(List<Integer> hashColumns,
+                                   int noOfPartitions) {
+    Map<String, Object> r = ctx.request(Json.map(
+        "op", "hash_partition", "id", id,
+        "columns", new ArrayList<Object>(hashColumns),
+        "n", noOfPartitions));
+    List<Table> out = new ArrayList<>();
+    for (String tid : (List<String>) r.get("ids")) {
+      out.add(new Table(ctx, tid));
+    }
+    return out;
+  }
+
+  /** Split into {@code n} similar-sized tables, row i → partition i mod n
+   *  (reference: Table.roundRobinPartition, Table.java:166). */
+  @SuppressWarnings("unchecked")
+  public List<Table> roundRobinPartition(int noOfPartitions) {
+    Map<String, Object> r = ctx.request(Json.map(
+        "op", "round_robin_partition", "id", id, "n", noOfPartitions));
+    List<Table> out = new ArrayList<>();
+    for (String tid : (List<String>) r.get("ids")) {
+      out.add(new Table(ctx, tid));
+    }
+    return out;
+  }
+
+  /** Concatenate same-schema tables (reference: Table.merge,
+   *  Table.java:176). */
+  public static Table merge(CylonContext ctx, Table... tables) {
+    List<Object> ids = new ArrayList<>();
+    for (Table t : tables) {
+      ids.add(t.getId());
+    }
+    Map<String, Object> r = ctx.request(Json.map("op", "merge", "ids", ids));
+    return new Table(ctx, (String) r.get("id"));
+  }
+
+  /** Release this handle's registry entry (reference: Table.clear,
+   *  Table.java:226). */
+  public void clear() {
+    free();
   }
 
   // -- shape / export -------------------------------------------------------

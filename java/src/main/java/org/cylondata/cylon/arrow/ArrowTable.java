@@ -1,0 +1,45 @@
+package org.cylondata.cylon.arrow;
+
+import java.util.ArrayList;
+import java.util.List;
+
+import org.cylondata.cylon.Column;
+
+/**
+ * Columnar staging buffer for building a {@link
+ * org.cylondata.cylon.Table} from JVM data — the builder surface of the
+ * reference's {@code arrow/ArrowTable} (reference: java/src/main/java/
+ * org/cylondata/cylon/arrow/ArrowTable.java:1-92, which assembles
+ * {@code org.apache.arrow} vectors and hands buffer addresses through
+ * JNI).  This image carries no arrow-java jars and the transport is the
+ * JSON gateway, so the builder stages plain value lists and the batch
+ * crosses as one {@code table_from_columns} request (documented
+ * deviation; the id-addressed contract downstream is identical).
+ */
+public class ArrowTable {
+
+  private final List<Column<?>> columns = new ArrayList<>();
+  private boolean finished = false;
+
+  public <T> ArrowTable addColumn(String name, List<T> values) {
+    if (finished) {
+      throw new IllegalStateException("ArrowTable already finished");
+    }
+    columns.add(new Column<>(name, values));
+    return this;
+  }
+
+  /** Seal the batch (reference: ArrowTable.finish() before handoff). */
+  public ArrowTable finish() {
+    finished = true;
+    return this;
+  }
+
+  public boolean isFinished() {
+    return finished;
+  }
+
+  public List<Column<?>> getColumns() {
+    return columns;
+  }
+}

@@ -1,0 +1,37 @@
+package org.cylondata.cylon.examples;
+
+import org.cylondata.cylon.CylonContext;
+import org.cylondata.cylon.Table;
+
+/**
+ * Row-lambda select — the reference's second Java example (reference:
+ * java/src/main/java/org/cylondata/cylon/examples/SelectExample.java:
+ * a {@code Selector} closure capturing a local).  The same lambda works
+ * here (it evaluates JVM-side over fetched rows); the engine-side
+ * {@code selectExpr} line below is this framework's scalable spelling.
+ */
+public final class SelectExample {
+
+  private SelectExample() {
+  }
+
+  public static void main(String[] args) {
+    String tablePath = args[0];
+
+    try (CylonContext ctx = CylonContext.init()) {
+      Table srcTable = Table.fromCSV(ctx, tablePath);
+
+      final long somethingOutside = 4;
+
+      // closure over a captured local, like the reference example
+      Table selected = srcTable.select(
+          (row) -> row.getInt64(0) == somethingOutside);
+      selected.print();
+
+      // engine-side equivalent: no row fetch, evaluated on device
+      Table same = srcTable.selectExpr("k == 4");
+      System.out.println("rows: " + selected.getRowCount()
+          + " == " + same.getRowCount());
+    }
+  }
+}

@@ -1,0 +1,10 @@
+package org.cylondata.cylon.ops;
+
+/**
+ * Single-column predicate for {@code Table.filter} — source-compatible
+ * with the reference interface (reference: ops/Filter.java).  Evaluated
+ * JVM-side like {@link Selector}.
+ */
+public interface Filter<I> {
+  boolean filter(I value);
+}

@@ -1,0 +1,10 @@
+package org.cylondata.cylon.ops;
+
+/**
+ * Cell transform for {@code Table.mapColumn} — source-compatible with the
+ * reference interface (reference: ops/Mapper.java).  Evaluated JVM-side;
+ * the mapped column travels back to the engine as one batch.
+ */
+public interface Mapper<I, O> {
+  O map(I cellValue);
+}

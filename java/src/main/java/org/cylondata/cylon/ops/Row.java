@@ -1,0 +1,51 @@
+package org.cylondata.cylon.ops;
+
+import java.util.List;
+
+/**
+ * One row of a table, handed to {@link Selector} lambdas.  Mirrors the
+ * reference's {@code ops/Row} accessor surface (reference:
+ * java/src/main/java/org/cylondata/cylon/ops/Row.java); values are the
+ * JSON-decoded cells fetched from the engine (nulls stay null).
+ */
+public class Row {
+
+  private final List<Object> values;
+
+  public Row(List<Object> values) {
+    this.values = values;
+  }
+
+  public int getColumnCount() {
+    return values.size();
+  }
+
+  public Object get(int column) {
+    return values.get(column);
+  }
+
+  public boolean isNull(int column) {
+    return values.get(column) == null;
+  }
+
+  public long getInt64(int column) {
+    return ((Number) values.get(column)).longValue();
+  }
+
+  public int getInt32(int column) {
+    return ((Number) values.get(column)).intValue();
+  }
+
+  public double getFloat64(int column) {
+    return ((Number) values.get(column)).doubleValue();
+  }
+
+  public float getFloat32(int column) {
+    return ((Number) values.get(column)).floatValue();
+  }
+
+  public String getString(int column) {
+    Object v = values.get(column);
+    return v == null ? null : v.toString();
+  }
+}

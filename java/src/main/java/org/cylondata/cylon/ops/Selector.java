@@ -1,0 +1,12 @@
+package org.cylondata.cylon.ops;
+
+/**
+ * Row predicate for {@code Table.select} — source-compatible with the
+ * reference interface (reference: ops/Selector.java).  The lambda runs on
+ * the JVM over rows fetched from the engine and the resulting row mask is
+ * shipped back (O(rows) transfer); for engine-side evaluation use
+ * {@code Table.selectExpr}.
+ */
+public interface Selector {
+  boolean select(Row row);
+}

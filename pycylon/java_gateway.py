@@ -107,6 +107,79 @@ class Gateway:
             finally:
                 sys.stdout = stdout
             return {"ok": True, "value": buf.getvalue()}
+        if op == "column_json":
+            t = self._get(req["id"]).backing
+            import pandas as pd
+            s = t.to_pandas().iloc[:, int(req["column"])]
+            vals = [None if pd.isna(v) else
+                    (v.item() if hasattr(v, "item") else v)
+                    for v in s]
+            return {"ok": True, "value": vals}
+        if op == "select_mask":
+            # Java-side Selector/Filter lambdas evaluate on the JVM and
+            # ship a row mask back — true source compat with the
+            # reference's row-lambda surface (Table.java:204-226), at
+            # O(rows) transfer; selectExpr is the engine-side fast path
+            import numpy as np
+            import jax.numpy as jnp
+            from cylon_tpu import compute
+            t = self._get(req["id"])
+            marr = jnp.asarray(np.asarray(req["mask"], dtype=bool))
+            out = compute.select(t.backing, lambda env: marr)
+            return {"ok": True, "id": self._put(self._Table(out))}
+        if op == "select_expr":
+            # expression fast path: a Python expression over the column-
+            # name env (the gateway is a local subprocess of the caller's
+            # own process tree — same trust domain as the lambda path)
+            t = self._get(req["id"])
+            expr = req["expr"]
+            import jax.numpy as jnp
+
+            def pred(env, _expr=expr):
+                return eval(_expr, {"jnp": jnp, "__builtins__": {}},
+                            dict(env.items()))
+
+            from cylon_tpu import compute
+            out = compute.select(t.backing, pred)
+            return {"ok": True, "id": self._put(self._Table(out))}
+        if op == "replace_column":
+            # mapColumn's return trip: new values for one column
+            import pandas as pd
+            t = self._get(req["id"])
+            df = t.backing.to_pandas()
+            df.isetitem(int(req["column"]), pd.Series(req["values"]))
+            if req.get("name"):
+                df = df.rename(columns={
+                    df.columns[int(req["column"])]: req["name"]})
+            from cylon_tpu.table import Table as _CT
+            out = _CT.from_pandas(self._ctx, df)
+            return {"ok": True, "id": self._put(self._Table(out))}
+        if op == "table_from_columns":
+            import pandas as pd
+            cols = {c["name"]: c["values"] for c in req["columns"]}
+            from cylon_tpu.table import Table as _CT
+            out = _CT.from_pandas(self._ctx, pd.DataFrame(cols))
+            return {"ok": True, "id": self._put(self._Table(out))}
+        if op == "hash_partition":
+            from cylon_tpu import compute
+            t = self._get(req["id"])
+            parts = compute.hash_partition(t.backing,
+                                           [int(c) for c in req["columns"]],
+                                           int(req["n"]))
+            return {"ok": True,
+                    "ids": [self._put(self._Table(p)) for p in parts]}
+        if op == "round_robin_partition":
+            from cylon_tpu import compute
+            t = self._get(req["id"])
+            parts = compute.round_robin_partition(t.backing,
+                                                  int(req["n"]))
+            return {"ok": True,
+                    "ids": [self._put(self._Table(p)) for p in parts]}
+        if op == "merge":
+            from cylon_tpu import compute
+            tabs = [self._get(i).backing for i in req["ids"]]
+            out = compute.merge(tabs)
+            return {"ok": True, "id": self._put(self._Table(out))}
         if op == "free":
             self._tables.pop(req["id"], None)
             return {"ok": True}

@@ -118,3 +118,52 @@ def test_java_sources_compile():
     r = subprocess.run([build_sh], capture_output=True, text=True,
                        timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_gateway_round4_surface_ops(gateway, tmp_path, rng):
+    """The ops backing the round-4 Java surface: select (mask + expr),
+    mapColumn (column_json + replace_column), fromColumns, partitions,
+    merge."""
+    df = pd.DataFrame({"k": rng.integers(0, 9, 40),
+                       "v": np.round(rng.random(40), 6)})
+    p = tmp_path / "t.csv"
+    df.to_csv(p, index=False)
+    tid = _rpc(gateway, op="from_csv", path=str(p))["id"]
+
+    # column_json: the JVM-side Row fetch
+    vals = _rpc(gateway, op="column_json", id=tid, column=0)["value"]
+    assert vals == df["k"].tolist()
+
+    # select via a JVM-computed row mask (the Selector lambda path)
+    mask = [bool(v == 3) for v in vals]
+    sid = _rpc(gateway, op="select_mask", id=tid, mask=mask)["id"]
+    assert (_rpc(gateway, op="rows", id=sid)["value"]
+            == int((df["k"] == 3).sum()))
+
+    # select via the engine-side expression fast path
+    eid = _rpc(gateway, op="select_expr", id=tid, expr="k > 4")["id"]
+    assert (_rpc(gateway, op="rows", id=eid)["value"]
+            == int((df["k"] > 4).sum()))
+
+    # mapColumn round trip: double column 0 and rename it
+    doubled = [v * 2 for v in vals]
+    mid = _rpc(gateway, op="replace_column", id=tid, column=0,
+               values=doubled, name="k2")["id"]
+    assert _rpc(gateway, op="column_names", id=mid)["value"][0] == "k2"
+    assert (_rpc(gateway, op="column_json", id=mid, column=0)["value"]
+            == doubled)
+
+    # fromColumns
+    fid = _rpc(gateway, op="table_from_columns",
+               columns=[{"name": "a", "values": [1, 2, 3]},
+                        {"name": "b", "values": [0.5, 1.5, 2.5]}])["id"]
+    assert _rpc(gateway, op="rows", id=fid)["value"] == 3
+
+    # partitions + merge round trip preserves the rows
+    hp = _rpc(gateway, op="hash_partition", id=tid, columns=[0], n=3)["ids"]
+    assert len(hp) == 3
+    rr = _rpc(gateway, op="round_robin_partition", id=tid, n=4)["ids"]
+    sizes = [_rpc(gateway, op="rows", id=i)["value"] for i in rr]
+    assert sum(sizes) == len(df) and max(sizes) - min(sizes) <= 1
+    mg = _rpc(gateway, op="merge", ids=hp)["id"]
+    assert _rpc(gateway, op="rows", id=mg)["value"] == len(df)
