@@ -1,10 +1,10 @@
-"""TPC-H queries composed from the distributed operator layer.
+"""All 22 TPC-H queries composed from the distributed operator layer.
 
 Each query takes a CylonContext plus ``{name: DTable}`` and returns a local
 result Table (aggregates are tiny, so the final gather is cheap).  Queries
-are built ONLY from the public dist ops — select → with_column → join →
-groupby → sort → head — the same composition a user of the framework would
-write; nothing here reaches into kernels.
+are built ONLY from the public dist ops — select → with_column → join /
+semi/anti-join → groupby → sort → head — the same composition a user of
+the framework would write; nothing here reaches into kernels.
 
 Predicates come from ``lru_cache``'d factories so re-running a query (bench
 repetitions) reuses the compiled select kernels instead of re-tracing.

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Per-phase TPC-H attribution with the amortized-dispatch protocol.
+
+Tunneled-TPU timing rules (docs/tpu_perf_notes.md): every hard sync costs
+~120 ms, so per-span syncs drown sub-100 ms phases.  Instead each query
+is split into CUMULATIVE STAGES (stage i = stages 0..i-1 plus one more
+pipeline step); a stage's cost is the difference of amortized wall times,
+where "amortized" = dispatch the stage K times under deferred capacity
+validation with ONE final sync, divide by K (the profile_join.py
+protocol, applied plan-level).
+
+    python experiments/profile_tpch.py q14 [sf]
+
+Prints one JSON line: {"query": ..., "sf": ..., "stages": {name: ms}}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _stages_q14(ctx, t):
+    from cylon_tpu.dtypes import Type
+    from cylon_tpu.parallel import (dist_aggregate, dist_join, dist_project,
+                                    dist_select, dist_with_column)
+    from cylon_tpu.tpch.datagen import date_to_days
+    from cylon_tpu.tpch import queries as q
+
+    d0, d1 = q._month_span("1995-09-01", 1)
+
+    def s_select():
+        li = dist_select(dist_project(t["lineitem"],
+                                      ["l_partkey", "l_shipdate",
+                                       "l_extendedprice", "l_discount"]),
+                         q._pred_range("l_shipdate", d0, d1))
+        return dist_project(li, ["l_partkey", "l_extendedprice",
+                                 "l_discount"])
+
+    def s_join():
+        li = s_select()
+        part = dist_project(t["part"], ["p_partkey", "p_type"])
+        return q._strip_prefixes(dist_join(li, part,
+                                           q._cfg("l_partkey", "p_partkey")))
+
+    def s_full():
+        return q.q14(ctx, t)
+
+    return [("select", s_select), ("join", s_join), ("aggregate", s_full)]
+
+
+def _stages_q12(ctx, t):
+    from cylon_tpu.parallel import dist_join, dist_project, dist_select
+    from cylon_tpu.tpch.datagen import date_to_days
+    from cylon_tpu.tpch import queries as q
+
+    d0 = date_to_days("1994-01-01")
+    mcodes = q._dict_codes(t["lineitem"], "l_shipmode", ("MAIL", "SHIP"))
+
+    def s_select():
+        li = dist_select(dist_project(t["lineitem"],
+                                      ["l_orderkey", "l_shipmode",
+                                       "l_shipdate", "l_commitdate",
+                                       "l_receiptdate"]),
+                         q._pred_q12(mcodes, d0, d0 + 365))
+        return dist_project(li, ["l_orderkey", "l_shipmode"])
+
+    def s_join():
+        li = s_select()
+        orders = dist_project(t["orders"], ["o_orderkey", "o_orderpriority"])
+        return q._strip_prefixes(dist_join(li, orders,
+                                           q._cfg("l_orderkey",
+                                                  "o_orderkey")))
+
+    def s_full():
+        return q.q12(ctx, t)
+
+    return [("select", s_select), ("join", s_join), ("groupby", s_full)]
+
+
+def _stages_q18(ctx, t):
+    from cylon_tpu.parallel import dist_groupby, dist_project, dist_select
+    from cylon_tpu.tpch import queries as q
+
+    def s_groupby():
+        li = dist_project(t["lineitem"], ["l_orderkey", "l_quantity"])
+        return dist_groupby(li, ["l_orderkey"], [("l_quantity", "sum")],
+                            dense_key_range=(1,
+                                             q._table_rows(t["orders"])))
+
+    def s_having():
+        return dist_select(s_groupby(), q._pred_gt("sum_l_quantity", 300.0))
+
+    def s_full():
+        return q.q18(ctx, t)
+
+    return [("groupby", s_groupby), ("having", s_having),
+            ("joins+sort", s_full)]
+
+
+STAGES = {"q12": _stages_q12, "q14": _stages_q14, "q18": _stages_q18}
+
+
+def main() -> int:
+    qname = sys.argv[1] if len(sys.argv) > 1 else "q14"
+    sf = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+    K = int(os.environ.get("PROFILE_K", "3"))
+
+    import jax
+
+    cache = os.path.join(REPO, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from cylon_tpu import CylonContext, trace
+    from cylon_tpu.ops import compact as ops_compact
+    from cylon_tpu.parallel import DTable
+    from cylon_tpu.tpch import generate
+
+    ctx = CylonContext({"backend": "tpu", "devices": jax.devices()})
+    data = generate(sf, seed=11)
+    t = {name: DTable.from_pandas(ctx, df) for name, df in data.items()}
+
+    def amortized(fn, k):
+        """k dispatches under deferred validation, one completion wait."""
+        t0 = time.perf_counter()
+        with ops_compact.deferred_region():
+            outs = [fn() for _ in range(k)]
+            ops_compact.flush_pending()
+        last = outs[-1]
+        leaves = ([c.data for c in last.columns]
+                  if hasattr(last, "columns") else last)
+        trace.hard_sync(leaves)
+        return time.perf_counter() - t0
+
+    stages = STAGES[qname](ctx, t)
+    results = {}
+    prev_ms = 0.0
+    for name, fn in stages:
+        amortized(fn, 1)  # compile + seed capacity hints
+        t1 = min(amortized(fn, 1) for _ in range(2))
+        tk = min(amortized(fn, K) for _ in range(2))
+        marginal = (tk - t1) / (K - 1) * 1e3 if K > 1 else t1 * 1e3
+        results[name] = round(marginal - prev_ms, 1)
+        results[f"cum_{name}"] = round(marginal, 1)
+        prev_ms = marginal
+    print(json.dumps({"query": qname, "sf": sf, "K": K,
+                      "stages": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
