@@ -564,6 +564,189 @@ def plan_indices(plan, how: str, capacity: int, l_count=None, r_count=None
     return mask_past_total(j, total, left_idx, right_idx)
 
 
+# ---------------------------------------------------------------------------
+# Carried-sort join: output columns ride the plan sorts
+# ---------------------------------------------------------------------------
+#
+# ``plan_indices`` + per-side ``take_many`` costs FOUR random passes at
+# phase 2: the decode gather (slot → sorted position), the rs read, and one
+# output gather per side by the materialized indices.  Riding the output
+# leaves through phase 1's sorts (extra lax.sort operands are ~free —
+# the groupby measurement, docs/tpu_perf_notes.md) leaves TWO:
+#
+#   probe outputs   read through the SAME wide gather that decodes the
+#                   slot (lo/cnt and the probe leaves share one packed
+#                   take by pos_c);
+#   build outputs   read directly at lo+within over the carried build
+#                   leaves — the rs indirection disappears.
+
+def sort_join_plan_carried(l_cols, l_valids, r_cols, r_valids,
+                           how: str = INNER, l_count=None, r_count=None,
+                           l_leaves=(), r_leaves=()):
+    """``sort_join_plan`` + output leaves riding the sorts.
+
+    ``l_leaves``/``r_leaves``: sequences of (data, validity) output
+    columns.  Returns ``(plan, probe_sorted, build_sorted)`` — the plan in
+    probe orientation (``how='right'`` swaps internally, exactly like
+    ``sort_join_plan``), probe leaves permuted into merged-sort order
+    ([n]), build leaves into build order ([n_build]).  Pair with
+    ``plan_gather_carried`` under the SAME ``how``.  Callers handle the
+    statically-empty sides via the index path (`_degenerate`).
+    """
+    if how == RIGHT:
+        return sort_join_plan_carried(r_cols, r_valids, l_cols, l_valids,
+                                      LEFT, r_count, l_count,
+                                      r_leaves, l_leaves)
+    n_l, n_r = l_cols[0].shape[0], r_cols[0].shape[0]
+    n = n_l + n_r
+    _, _, key_ops = _concat_key_parts(
+        l_cols, l_valids, r_cols, r_valids, l_count, r_count)
+    carry = []
+    for d, v in l_leaves:
+        carry.append(jnp.concatenate([d, jnp.zeros((n_r,), d.dtype)]))
+        if v is not None:
+            carry.append(jnp.concatenate([v, jnp.zeros((n_r,), bool)]))
+    sortedK, idxS, is_first, carried = sorted_key_structure(
+        key_ops, n, tuple(carry))
+    it = iter(carried)
+    probe_sorted = []
+    for d, v in l_leaves:
+        ds = next(it)
+        vs = next(it) if v is not None else None
+        probe_sorted.append((ds, vs))
+    padS = sortedK[0]
+    one = jnp.ones((1,), bool)
+    valid = ~padS
+    left_s = (idxS < n_l) & valid
+    right_s = (idxS >= n_l) & valid
+    maxi = jnp.iinfo(jnp.int32).max
+    last = jnp.concatenate([is_first[1:], one])
+
+    def seg_span(member):
+        m32 = member.astype(jnp.int32)
+        cm = jnp.cumsum(m32)
+        end = jax.lax.cummin(jnp.where(last, cm, maxi), reverse=True)
+        excl = jax.lax.cummax(jnp.where(is_first, cm - m32, 0))
+        return end - excl, excl, cm
+
+    cnt_p, lo_p, cr = seg_span(right_s)
+    # build order via the right-side-only stable sort (identical to the
+    # merged sort's right subsequence), carrying the build leaves
+    r_ops = tuple(op[n_l:] for op in key_ops)
+    rcarry = []
+    for d, v in r_leaves:
+        rcarry.append(d)
+        if v is not None:
+            rcarry.append(v)
+    rsorted = jax.lax.sort(
+        r_ops + (jnp.arange(n_r, dtype=jnp.int32),) + tuple(rcarry),
+        num_keys=len(r_ops) + 1)
+    rs = rsorted[len(r_ops)]
+    it = iter(rsorted[len(r_ops) + 1:])
+    build_sorted = []
+    for d, v in r_leaves:
+        ds = next(it)
+        vs = next(it) if v is not None else None
+        build_sorted.append((ds, vs))
+    if how == FULL_OUTER:
+        # um lives in build order: scatter the merged-space mask to the
+        # build slots (cr-1 = this build row's rank in build order)
+        rslot = jnp.where(right_s, cr - 1, jnp.int32(n_r))
+        l_in_seg, _, _ = seg_span(left_s)
+        um_sorted = right_s & (l_in_seg == 0)
+        um = jnp.zeros(n_r, bool).at[rslot].set(um_sorted, mode="drop")
+        plan = (idxS, lo_p, cnt_p, left_s, rs, um)
+    else:
+        plan = (idxS, lo_p, cnt_p, left_s, rs)
+    return plan, tuple(probe_sorted), tuple(build_sorted)
+
+
+def plan_gather_carried(plan, probe_sorted, build_sorted, how: str,
+                        capacity: int, l_count=None, r_count=None):
+    """Phase 2 over a carried plan: decode + output gathers fused.
+
+    Returns ``(left_outs, right_outs, count)`` in the ORIGINAL table
+    orientation (the ``how='right'`` swap is undone here); each out is a
+    (data, validity) tuple at ``capacity`` rows.  Unmatched rows of the
+    outer side carry nulls; rows past ``count`` are unspecified.
+    """
+    if how == RIGHT:
+        p_outs, b_outs, cnt = _gather_carried(
+            plan, probe_sorted, build_sorted, LEFT, capacity,
+            r_count, l_count)
+        return b_outs, p_outs, cnt
+    p_outs, b_outs, cnt = _gather_carried(
+        plan, probe_sorted, build_sorted, how, capacity, l_count, r_count)
+    return p_outs, b_outs, cnt
+
+
+def _gather_carried(plan, probe_sorted, build_sorted, how: str,
+                    capacity: int, l_count, r_count):
+    from .gather import take, take_many
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    idxS, lo_p, cnt_p, left_s, rs = plan[:5]
+    n = idxS.shape[0]
+    n_r = rs.shape[0]
+    inner = how == INNER
+    emit = _plan_emit(plan, how, idt)
+    offs_incl = jnp.cumsum(emit)
+    total_lpart = offs_incl[-1]
+    starts_p = (offs_incl - emit).astype(jnp.int32)
+    tgt = jnp.where(emit > 0, starts_p, jnp.int32(capacity))
+    scat = jnp.zeros(capacity, jnp.int32).at[tgt].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    pos_c = jax.lax.cummax(scat)
+    j = jnp.arange(capacity, dtype=idt)
+    chg = jnp.concatenate([jnp.ones((1,), bool), pos_c[1:] != pos_c[:-1]])
+    run_start = jax.lax.cummax(jnp.where(chg, j, 0))
+    within = j - run_start
+    # ONE wide gather by pos_c: the plan meta + every probe output leaf
+    meta = [(lo_p, None)] + ([] if inner else [(cnt_p, None)])
+    g = take_many(meta + list(probe_sorted), pos_c, fill_null=False)
+    lo_g = g[0][0]
+    p_outs = list(g[len(meta):])
+    r_pos = jnp.clip(lo_g + within.astype(jnp.int32), 0, max(n_r - 1, 0)) \
+        .astype(jnp.int32)
+    if inner:
+        b_outs = take_many(build_sorted, r_pos, fill_null=False)
+        total = jnp.sum(emit)
+    else:
+        cnt_g = g[1][0]
+        matched = within < cnt_g.astype(idt)
+        b_idx = jnp.where(matched, r_pos, jnp.int32(-1))
+        b_outs = take_many(build_sorted, b_idx, fill_null=True)
+        total = total_lpart
+    if how == FULL_OUTER:
+        um = plan[5]
+        from .compact import compact_indices
+        n_um = jnp.sum(um.astype(idt))
+        um_pos = compact_indices(um, n_r, fill=0)
+        k = jnp.clip(j - total_lpart, 0, max(n_r - 1, 0))
+        in_rpart = j >= total_lpart
+        tail_pos = jnp.take(um_pos, k)
+        tail_b = take_many(build_sorted, tail_pos, fill_null=False)
+        ones = jnp.ones(capacity, bool)
+        merged_b = []
+        for (bd, bv), (td, tv) in zip(b_outs, tail_b):
+            d = jnp.where(_b1(in_rpart, bd), td, bd)
+            v = jnp.where(in_rpart, tv if tv is not None else ones,
+                          bv if bv is not None else ones)
+            merged_b.append((d, v))
+        b_outs = merged_b
+        merged_p = []
+        for pd, pv in p_outs:
+            d = jnp.where(_b1(in_rpart, pd), jnp.zeros((), pd.dtype), pd)
+            v = (pv if pv is not None else ones) & ~in_rpart
+            merged_p.append((d, v))
+        p_outs = merged_p
+        total = total_lpart + n_um
+    return list(p_outs), list(b_outs), total.astype(jnp.int32)
+
+
+def _b1(mask, like):
+    return mask.reshape(mask.shape + (1,) * (like.ndim - 1))
+
+
 def _degenerate(l_key, r_key, how, capacity, idt, l_count=None, r_count=None):
     """One side statically empty: inner ⇒ ∅; outer ⇒ null-filled survivors."""
     n_l, n_r = l_key.shape[0], r_key.shape[0]

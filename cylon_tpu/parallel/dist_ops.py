@@ -198,33 +198,37 @@ def _join_phase1_fn(mesh, axis: str, how: str, alg: str):
     """Phase 1 per shard: the join "plan" + replicated output counts.
 
     ``hash``: dense ranks (the direct-address kernel's domain), plan =
-    (l_rank, r_rank).  ``sort``: the fused single-sort plan
-    (ops/join.py sort_join_plan) — one lax.sort instead of the
-    rank/re-sort pipeline.
+    (l_rank, r_rank).  ``sort``: the CARRIED fused single-sort plan
+    (ops/join.py sort_join_plan_carried) — output leaves ride the plan
+    sorts, so phase 2's output gathers fuse into the decode gathers
+    (two random passes instead of four).
     """
 
-    def kernel(l_cnt, r_cnt, lkeys, lvalids, rkeys, rvalids):
+    def kernel(l_cnt, r_cnt, lkeys, lvalids, rkeys, rvalids,
+               l_leaves, r_leaves):
         if alg == "hash":
             lr, rr = ops_join.dense_ranks(lkeys, lvalids, rkeys, rvalids,
                                           l_count=l_cnt[0], r_count=r_cnt[0])
-            plan = (lr, rr)
+            state = (lr, rr)
             cnt = ops_hashjoin.hash_join_count(
                 lr, rr, how, l_count=l_cnt[0], r_count=r_cnt[0])
         else:
-            plan = ops_join.sort_join_plan(lkeys, lvalids, rkeys, rvalids,
-                                           how, l_count=l_cnt[0],
-                                           r_count=r_cnt[0])
+            plan, psort, bsort = ops_join.sort_join_plan_carried(
+                lkeys, lvalids, rkeys, rvalids, how,
+                l_count=l_cnt[0], r_count=r_cnt[0],
+                l_leaves=l_leaves, r_leaves=r_leaves)
+            state = (plan, psort, bsort)
             cnt = ops_join.plan_total(plan, how, l_count=l_cnt[0],
                                       r_count=r_cnt[0])
         # counts replicated (all_gather of one int per shard) so any
         # controller process can device_get them under multi-host
-        return plan, jax.lax.all_gather(cnt.astype(jnp.int32), axis)
+        return state, jax.lax.all_gather(cnt.astype(jnp.int32), axis)
 
     spec = P(axis)
     # check_vma=False: the all_gathered counts are replicated, which
     # shard_map cannot statically infer
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(spec,) * 6,
+                             in_specs=(spec,) * 8,
                              out_specs=(spec, P()),
                              check_vma=False))
 
@@ -232,18 +236,21 @@ def _join_phase1_fn(mesh, axis: str, how: str, alg: str):
 @functools.lru_cache(maxsize=None)
 def _join_phase2_fn(mesh, axis: str, how: str, alg: str, capacity: int,
                     fill_left: bool, fill_right: bool):
-    def kernel(l_cnt, r_cnt, plan, l_leaves, r_leaves):
+    def kernel(l_cnt, r_cnt, state, l_leaves, r_leaves):
         if alg == "hash":
             li, ri, cnt = ops_hashjoin.hash_join_indices(
-                plan[0], plan[1], how, capacity,
+                state[0], state[1], how, capacity,
                 l_count=l_cnt[0], r_count=r_cnt[0])
+            louts = tuple(ops_gather.take_many(l_leaves, li,
+                                               fill_null=fill_left))
+            routs = tuple(ops_gather.take_many(r_leaves, ri,
+                                               fill_null=fill_right))
         else:
-            li, ri, cnt = ops_join.plan_indices(
-                plan, how, capacity, l_count=l_cnt[0], r_count=r_cnt[0])
-        louts = tuple(ops_gather.take_many(l_leaves, li,
-                                           fill_null=fill_left))
-        routs = tuple(ops_gather.take_many(r_leaves, ri,
-                                           fill_null=fill_right))
+            plan, psort, bsort = state
+            louts, routs, cnt = ops_join.plan_gather_carried(
+                plan, psort, bsort, how, capacity,
+                l_count=l_cnt[0], r_count=r_cnt[0])
+            louts, routs = tuple(louts), tuple(routs)
         return louts, routs, cnt[None]
 
     spec = P(axis)
@@ -344,16 +351,17 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_keys: Sequence[int],
     mesh, axis = ctx.mesh, ctx.axis
     lkcs = [lsh.columns[i] for i in li_keys]
     rkcs = [rsh.columns[i] for i in ri_keys]
-    with trace.span("join.count"):
-        plan, cnts = _join_phase1_fn(mesh, axis, how, alg)(
-            lsh.counts, rsh.counts,
-            tuple(c.data for c in lkcs), tuple(c.validity for c in lkcs),
-            tuple(c.data for c in rkcs), tuple(c.validity for c in rkcs))
-
     fill_left = how in ("right", "full_outer")
     fill_right = how in ("left", "full_outer")
     l_leaves = tuple((c.data, c.validity) for c in lsh.columns)
     r_leaves = tuple((c.data, c.validity) for c in rsh.columns)
+    with trace.span("join.count"):
+        plan, cnts = _join_phase1_fn(mesh, axis, how, alg)(
+            lsh.counts, rsh.counts,
+            tuple(c.data for c in lkcs), tuple(c.validity for c in lkcs),
+            tuple(c.data for c in rkcs), tuple(c.validity for c in rkcs),
+            l_leaves, r_leaves)
+
     hint_key = (mesh, lsh.cap, rsh.cap, how, alg)
 
     def dispatch(sizes):
