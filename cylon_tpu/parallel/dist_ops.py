@@ -849,8 +849,12 @@ def _scalar_agg_fn(mesh, axis: str, cap: int, aggs: Tuple[str, ...],
                 fill = extreme_value(d.dtype, largest=(op == "min"))
                 folded = jnp.where(m, d, fill)
                 local = folded.min() if op == "min" else folded.max()
-                outs.append(jax.lax.pmin(local, axis) if op == "min"
-                            else jax.lax.pmax(local, axis))
+                # all_gather + local fold instead of pmin/pmax: some XLA
+                # backends lower only SUM all-reduces (observed on the
+                # axon compile service); the gather of one scalar per
+                # shard costs the same wire bytes
+                g = jax.lax.all_gather(local, axis)
+                outs.append(g.min() if op == "min" else g.max())
             else:
                 raise ValueError(f"unknown aggregation {op!r}")
         return tuple(outs), tuple(nonempty)
