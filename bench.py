@@ -631,19 +631,26 @@ def main() -> None:
                 # scalar-aggregate ones), so materialize inside the clock
                 run_pipeline(lambda: qfn(ctx, dts)).to_pandas()
 
-            run_q()  # compile + seed hints
-            q_ts = []
-            for _ in range(2):
-                t0 = time.perf_counter()
-                run_q()
-                q_ts.append(time.perf_counter() - t0)
-            q_t = min(q_ts)
+            try:
+                run_q()  # compile + seed hints
+                q_ts = []
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    run_q()
+                    q_ts.append(time.perf_counter() - t0)
+                q_t = min(q_ts)
+            except Exception as e:  # one bad query must not kill the bench
+                print(f"tpch {qname} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:300]}", file=sys.stderr)
+                tpch_detail[f"tpch_{qname}_error"] = str(e)[:200]
+                continue
             q_pd = _pandas_tpch(qname, data, date_to_days, reps=pd_reps)
             ratios.append(q_pd / q_t)
             tpch_detail.update({
                 f"tpch_{qname}_ms": round(q_t * 1e3, 2),
                 f"tpch_{qname}_pandas_ms": round(q_pd * 1e3, 2),
                 f"tpch_{qname}_vs_pandas": round(q_pd / q_t, 3)})
+        tpch_detail["tpch_queries_ok"] = len(ratios)
         tpch_detail["tpch_geomean_vs_pandas"] = round(
             float(np.exp(np.mean(np.log(ratios)))), 3)
 
