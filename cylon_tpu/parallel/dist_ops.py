@@ -1088,15 +1088,21 @@ def _env(columns: Sequence[DColumn]) -> dict:
     return {c.name: c.data for c in columns}
 
 
-def _masked_predicate(names, predicate, base_mask, leaves):
+def _masked_predicate(names, predicate, base_mask, leaves, params=()):
     """The ONE definition of predicate evaluation semantics: the recording
     env (so nulls in exactly the columns the predicate read veto the row —
     SQL three-valued logic, waived per column via ``env.valid``), AND'ed
     with ``base_mask``.  Shared by dist_select and every filter-pushdown
-    path so the semantics cannot diverge."""
+    path so the semantics cannot diverge.
+
+    ``params`` are extra traced arguments handed to the predicate after
+    the env — DEVICE-RESIDENT comparands (e.g. a scalar aggregate feeding
+    a threshold).  They enter the jit as arguments, never as baked-in
+    constants, so a data-dependent threshold costs no host round trip and
+    downstream dispatch overlaps the upstream compute producing it."""
     env = _RecordingEnv({n: d for n, (d, _) in zip(names, leaves)},
                         {n: v for n, (_, v) in zip(names, leaves)})
-    mask = predicate(env) & base_mask
+    mask = predicate(env, *params) & base_mask
     for n, (_, v) in zip(names, leaves):
         if n in env.accessed - env.null_handled and v is not None:
             mask = mask & v
@@ -1170,32 +1176,40 @@ def _compact_survivors(dt: DTable, mask: jax.Array, cnts, hint_key,
     return DTable(dt.ctx, cols, used[0], counts)
 
 
-def dist_select(dt: DTable, predicate) -> DTable:
+def dist_select(dt: DTable, predicate, params=()) -> DTable:
     """Distributed row filter: ``predicate`` maps {column name: sharded data
     array} → bool mask; surviving rows compact into a size-class block
     bucketed to the max per-shard survivor count.  Purely local compute —
     the reference's Select is too (table_api.cpp:977-1005, per-row lambda →
     arrow Filter) — plus the tiny replicated count all_gather every
     two-phase op shares.
+
+    ``params``: device-resident extra predicate arguments (replicated
+    scalars/small arrays), passed ``predicate(env, *params)``.  A
+    threshold computed by ``dist_aggregate`` can feed a select WITHOUT a
+    host read — the dependency stays on device and the pipeline never
+    stalls on it (TPC-H Q11/Q15/Q22's correlated-scalar shape).
     """
     mesh, axis, cap = dt.ctx.mesh, dt.ctx.axis, dt.cap
     names = tuple(c.name for c in dt.columns)
-    key1 = ("selmask", mesh, axis, cap, names, predicate)
+    key1 = ("selmask", mesh, axis, cap, names, predicate, len(params))
     p1 = _select_cache.get(key1)
     if p1 is None:
-        def mask_kernel(cnt, leaves):
+        def mask_kernel(cnt, leaves, params):
             mask = _masked_predicate(names, predicate,
-                                     jnp.arange(cap) < cnt[0], leaves)
+                                     jnp.arange(cap) < cnt[0], leaves,
+                                     params)
             n = jnp.sum(mask).astype(jnp.int32)
             return mask, jax.lax.all_gather(n, axis)
 
         spec = P(axis)
-        # check_vma=False: the all_gathered counts are replicated
+        # check_vma=False: the all_gathered counts are replicated (and so
+        # are the params)
         p1 = _cache_put(key1, jax.jit(shard_map(
-            mask_kernel, mesh=mesh, in_specs=(spec, spec),
+            mask_kernel, mesh=mesh, in_specs=(spec, spec, P()),
             out_specs=(spec, P()), check_vma=False)))
     leaves = tuple((c.data, c.validity) for c in dt.columns)
-    mask, cnts = p1(dt.counts, leaves)
+    mask, cnts = p1(dt.counts, leaves, tuple(params))
     return _compact_survivors(dt, mask, cnts,
                               ("sel", mesh, cap, names, predicate),
                               "select.gather")

@@ -586,6 +586,24 @@ def _pred_ge(col: str, v):
 
 
 @functools.lru_cache(maxsize=None)
+def _pred_gt_param(col: str):
+    """col > (device-scalar param) — the correlated-threshold shape."""
+    return lambda env, v: env[col] > v
+
+
+@functools.lru_cache(maxsize=None)
+def _pred_ge_param(col: str):
+    return lambda env, v: env[col] >= v
+
+
+def _device_scalar(table: Table, col: str):
+    """A one-row aggregate column as a 0-d DEVICE array — feeds predicate
+    ``params`` without any host read (the whole point: the pipeline never
+    stalls on the scalar's value)."""
+    return table.column(col).data[0]
+
+
+@functools.lru_cache(maxsize=None)
 def _pred_range_incl(col: str, lo, hi):
     return lambda env: (env[col] >= lo) & (env[col] <= hi)
 
@@ -810,10 +828,10 @@ def q8(ctx, t: Tables, nation: str = "BRAZIL", region: str = "AMERICA",
 
 def q11(ctx, t: Tables, nation: str = "GERMANY",
         fraction_per_sf: float = 0.0001) -> Table:
-    """HAVING sum > FRACTION·total: total via the scalar-aggregate path
-    (one mid-query host read — a genuine data dependence), threshold
-    pushed into a select on the group table.  The spec's fraction is
-    0.0001/SF; SF is derived from the supplier cardinality (10k·SF)."""
+    """HAVING sum > FRACTION·total: total via the scalar-aggregate path,
+    consumed as a DEVICE-scalar predicate param (no host read — the
+    threshold is a data dependence the device resolves).  The spec's
+    fraction is 0.0001/SF; SF derives from the supplier cardinality."""
     gk = _nation_keys(t, [nation])[0]
     sf = max(_table_rows(t["supplier"]) / 10_000.0, 1e-9)
     supp = dist_project(
@@ -825,10 +843,13 @@ def q11(ctx, t: Tables, nation: str = "GERMANY",
                        "ps_availqty"])
     ps = _strip_prefixes(dist_join(ps, supp, _cfg("ps_suppkey", "s_suppkey")))
     ps = dist_with_column(ps, "value", _ps_value, Type.DOUBLE)
-    tot = float(dist_aggregate(ps, [("value", "sum")])
-                .to_pandas()["sum_value"].iloc[0])
+    # the HAVING threshold stays ON DEVICE (predicate param): no host
+    # read, and the groupby below dispatches without waiting for it
+    tot = _device_scalar(dist_aggregate(ps, [("value", "sum")]),
+                         "sum_value")
     g = dist_groupby(ps, ["ps_partkey"], [("value", "sum")])
-    g = dist_select(g, _pred_gt("sum_value", tot * fraction_per_sf / sf))
+    g = dist_select(g, _pred_gt_param("sum_value"),
+                    params=(tot * (fraction_per_sf / sf),))
     s = dist_sort(g, "sum_value", ascending=False)
     return s.to_table()
 
@@ -864,8 +885,9 @@ def q13(ctx, t: Tables) -> Table:
 
 def q15(ctx, t: Tables, date: str = "1996-01-01") -> Table:
     """The revenue view + MAX correlated filter: groupby-sum, scalar max
-    (one host read), equality select.  MAX picks an existing group sum
-    computed by the same kernel, so the float comparison is exact."""
+    as a device predicate param (no host read), equality select.  MAX
+    picks an existing group sum computed by the same kernel, so the
+    float comparison is exact."""
     d0, d1 = _month_span(date, 3)
     li = dist_select(dist_project(t["lineitem"],
                                   ["l_suppkey", "l_shipdate",
@@ -873,9 +895,9 @@ def q15(ctx, t: Tables, date: str = "1996-01-01") -> Table:
                      _pred_range("l_shipdate", d0, d1))
     li = dist_with_column(li, "rev", _revenue, Type.DOUBLE)
     revs = dist_groupby(li, ["l_suppkey"], [("rev", "sum")])
-    mx = float(dist_aggregate(revs, [("sum_rev", "max")])
-               .to_pandas()["max_sum_rev"].iloc[0])
-    top = dist_select(revs, _pred_ge("sum_rev", mx))
+    mx = _device_scalar(dist_aggregate(revs, [("sum_rev", "max")]),
+                        "max_sum_rev")
+    top = dist_select(revs, _pred_ge_param("sum_rev"), params=(mx,))
     out = top.to_table().rename_column("sum_rev", "total_revenue")
     from ..compute import sort_multi
     return sort_multi(out, ["l_suppkey"])
@@ -1024,15 +1046,16 @@ def q21(ctx, t: Tables, nation: str = "SAUDI ARABIA",
 def q22(ctx, t: Tables,
         codes: tuple = (13, 31, 23, 29, 30, 18, 17)) -> Table:
     """Country-code cohort above the positive-balance average with no
-    orders: scalar mean (one host read) + anti-join on custkey."""
+    orders: scalar mean as a device predicate param + anti-join on
+    custkey — the whole query is one unbroken device pipeline."""
     cust = dist_select(dist_project(t["customer"],
                                     ["c_custkey", "c_acctbal",
                                      "c_phone_cc"]),
                        _pred_isin("c_phone_cc", codes))
-    avg = float(dist_aggregate(cust, [("c_acctbal", "mean")],
-                               where=_pred_gt("c_acctbal", 0.0))
-                .to_pandas()["mean_c_acctbal"].iloc[0])
-    rich = dist_select(cust, _pred_gt("c_acctbal", avg))
+    avg = _device_scalar(dist_aggregate(cust, [("c_acctbal", "mean")],
+                                        where=_pred_gt("c_acctbal", 0.0)),
+                         "mean_c_acctbal")
+    rich = dist_select(cust, _pred_gt_param("c_acctbal"), params=(avg,))
     orders = dist_project(t["orders"], ["o_custkey"])
     noord = dist_anti_join(rich, orders, "c_custkey", "o_custkey")
     g = dist_groupby(noord, ["c_phone_cc"], [("c_acctbal", "count"),

@@ -728,3 +728,22 @@ def test_dist_groupby_preagg_shrinks_exchange(dctx, rng):
     # raw shuffle: the hot key routes n/2 rows to ONE shard -> capacity
     # bucketed to >= n/2 per shard; partial: <= 9 groups per shard
     assert cap_pre * 10 < cap_raw, (cap_pre, cap_raw)
+
+
+def test_dist_select_device_scalar_params(dctx, rng):
+    """Predicate params: a dist_aggregate scalar feeds a select WITHOUT
+    leaving the device, and re-running with different data reuses the
+    cached kernel but honors the NEW param value (no baked-in constant)."""
+    from cylon_tpu.parallel import dist_aggregate
+
+    pred = lambda env, v: env["x"] > v  # noqa: E731 — stable callable
+
+    def run(df):
+        dt = dtable_from_pandas(dctx, df)
+        avg = dist_aggregate(dt, [("x", "mean")]).column("mean_x").data[0]
+        out = dist_select(dt, pred, params=(avg,)).to_table().to_pandas()
+        want = df[df["x"] > df["x"].mean()]
+        assert_same_rows(out, want)
+
+    run(pd.DataFrame({"x": rng.normal(size=150)}))
+    run(pd.DataFrame({"x": rng.normal(size=150) + 100.0}))  # same shapes
