@@ -346,7 +346,8 @@ def q4(ctx, t: Tables, date: str = "1993-07-01") -> Table:
     # filtered order at most once regardless of how many of its lines
     # qualify (round 3 simulated this with inner join + two groupbys —
     # the shape the primitive replaces)
-    m = dist_semi_join(orders, li, "o_orderkey", "l_orderkey")
+    m = dist_semi_join(orders, li, "o_orderkey", "l_orderkey",
+                       dense_key_range=(1, _table_rows(t["orders"])))
     g = dist_groupby(m, ["o_orderpriority"], [("o_orderkey", "count")])
     out = g.to_table()  # already exactly [o_orderpriority, count]
     from ..compute import sort_multi
@@ -365,7 +366,10 @@ def q9(ctx, t: Tables, color: str = "green") -> Table:
     li = dist_project(t["lineitem"],
                       ["l_orderkey", "l_partkey", "l_suppkey", "l_quantity",
                        "l_extendedprice", "l_discount"])
-    lp = _strip_prefixes(dist_join(li, part, _cfg("l_partkey", "p_partkey")))
+    # p_partkey is unique and the only surviving part column, so the
+    # filter join IS a semi-join; the dense probe replaces the 62M sort
+    lp = dist_semi_join(li, part, "l_partkey", "p_partkey",
+                        dense_key_range=(1, _table_rows(t["part"])))
     ps = dist_project(t["partsupp"],
                       ["ps_partkey", "ps_suppkey", "ps_supplycost"])
     lps = _strip_prefixes(dist_join(
@@ -792,7 +796,8 @@ def q8(ctx, t: Tables, nation: str = "BRAZIL", region: str = "AMERICA",
     li = dist_project(t["lineitem"],
                       ["l_orderkey", "l_partkey", "l_suppkey",
                        "l_extendedprice", "l_discount"])
-    lp = _strip_prefixes(dist_join(li, part, _cfg("l_partkey", "p_partkey")))
+    lp = dist_semi_join(li, part, "l_partkey", "p_partkey",
+                        dense_key_range=(1, _table_rows(t["part"])))
     orders = dist_select(dist_project(t["orders"],
                                       ["o_orderkey", "o_custkey",
                                        "o_orderdate"]),
@@ -925,7 +930,8 @@ def q16(ctx, t: Tables, bad_brand: str = "Brand#45",
                                                 "p_type", "p_size"]),
                        _pred_q16(b45, btypes, sizes))
     ps = dist_project(t["partsupp"], ["ps_partkey", "ps_suppkey"])
-    ps = dist_anti_join(ps, badsup, "ps_suppkey", "s_suppkey")
+    ps = dist_anti_join(ps, badsup, "ps_suppkey", "s_suppkey",
+                        dense_key_range=(1, _table_rows(t["supplier"])))
     m = _strip_prefixes(dist_join(ps, part, _cfg("ps_partkey", "p_partkey")))
     per = dist_groupby(m, ["p_brand", "p_type", "p_size", "ps_suppkey"],
                        [("ps_suppkey", "count")])
@@ -953,7 +959,8 @@ def q17(ctx, t: Tables, brand: str = "Brand#23",
         ["p_partkey"])
     li = dist_project(t["lineitem"],
                       ["l_partkey", "l_quantity", "l_extendedprice"])
-    li = dist_semi_join(li, part, "l_partkey", "p_partkey")
+    li = dist_semi_join(li, part, "l_partkey", "p_partkey",
+                        dense_key_range=(1, _table_rows(t["part"])))
     avg = dist_groupby(li, ["l_partkey"], [("l_quantity", "mean")])
     avg = avg.rename(["apk", "avg_qty"])
     m = _strip_prefixes(dist_join(li, avg, _cfg("l_partkey", "apk")))
@@ -979,13 +986,15 @@ def q20(ctx, t: Tables, color: str = "forest", date: str = "1994-01-01",
                                   ["l_partkey", "l_suppkey", "l_shipdate",
                                    "l_quantity"]),
                      _pred_range("l_shipdate", d0, d0 + 365))
-    li = dist_semi_join(li, part, "l_partkey", "p_partkey")
+    li = dist_semi_join(li, part, "l_partkey", "p_partkey",
+                        dense_key_range=(1, _table_rows(t["part"])))
     qty = dist_groupby(li, ["l_partkey", "l_suppkey"],
                        [("l_quantity", "sum")])
     qty = qty.rename(["qpk", "qsk", "sum_qty"])
     ps = dist_project(t["partsupp"],
                       ["ps_partkey", "ps_suppkey", "ps_availqty"])
-    ps = dist_semi_join(ps, part, "ps_partkey", "p_partkey")
+    ps = dist_semi_join(ps, part, "ps_partkey", "p_partkey",
+                        dense_key_range=(1, _table_rows(t["part"])))
     # inner join ⇒ (part, supp) pairs with no shipped lines drop out — the
     # spec's NULL-subquery comparison excludes them too
     m = _strip_prefixes(dist_join(ps, qty, _cfg(("ps_partkey", "ps_suppkey"),
@@ -996,7 +1005,8 @@ def q20(ctx, t: Tables, color: str = "forest", date: str = "1994-01-01",
     supp = dist_select(dist_project(t["supplier"],
                                     ["s_suppkey", "s_nationkey"]),
                        _pred_eq("s_nationkey", ck))
-    out = dist_semi_join(supp, sup_ids, "s_suppkey", "ps_suppkey")
+    out = dist_semi_join(supp, sup_ids, "s_suppkey", "ps_suppkey",
+                         dense_key_range=(1, _table_rows(t["supplier"])))
     from ..compute import sort_multi
     return sort_multi(dist_project(out, ["s_suppkey"]).to_table(),
                       ["s_suppkey"])
@@ -1019,7 +1029,8 @@ def q21(ctx, t: Tables, nation: str = "SAUDI ARABIA",
     li = dist_project(t["lineitem"],
                       ["l_orderkey", "l_suppkey", "l_commitdate",
                        "l_receiptdate"])
-    li = dist_semi_join(li, orders_f, "l_orderkey", "o_orderkey")
+    li = dist_semi_join(li, orders_f, "l_orderkey", "o_orderkey",
+                        dense_key_range=(1, _table_rows(t["orders"])))
     li = dist_with_column(li, "late", _late_ind, Type.INT32)
     per_os = dist_groupby(li, ["l_orderkey", "l_suppkey"],
                           [("late", "max")])
@@ -1032,8 +1043,10 @@ def q21(ctx, t: Tables, nation: str = "SAUDI ARABIA",
                                                  "s_nationkey"]),
                     _pred_eq("s_nationkey", sk)), ["s_suppkey"])
     l1 = dist_select(li, _pred_eq("late", 1))
-    l1 = dist_semi_join(l1, supp_sa, "l_suppkey", "s_suppkey")
-    l1 = dist_semi_join(l1, cand, "l_orderkey", "l_orderkey")
+    l1 = dist_semi_join(l1, supp_sa, "l_suppkey", "s_suppkey",
+                        dense_key_range=(1, _table_rows(t["supplier"])))
+    l1 = dist_semi_join(l1, cand, "l_orderkey", "l_orderkey",
+                        dense_key_range=(1, _table_rows(t["orders"])))
     g = dist_groupby(l1, ["l_suppkey"], [("l_suppkey", "count")])
     out = g.to_table().rename_column("count_l_suppkey", "numwait")
     from ..compute import sort_multi
@@ -1057,7 +1070,8 @@ def q22(ctx, t: Tables,
                          "mean_c_acctbal")
     rich = dist_select(cust, _pred_gt_param("c_acctbal"), params=(avg,))
     orders = dist_project(t["orders"], ["o_custkey"])
-    noord = dist_anti_join(rich, orders, "c_custkey", "o_custkey")
+    noord = dist_anti_join(rich, orders, "c_custkey", "o_custkey",
+                           dense_key_range=(1, _table_rows(t["customer"])))
     g = dist_groupby(noord, ["c_phone_cc"], [("c_acctbal", "count"),
                                              ("c_acctbal", "sum")])
     out = g.to_table().rename_column("c_phone_cc", "cntrycode") \

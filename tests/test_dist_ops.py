@@ -747,3 +747,42 @@ def test_dist_select_device_scalar_params(dctx, rng):
 
     run(pd.DataFrame({"x": rng.normal(size=150)}))
     run(pd.DataFrame({"x": rng.normal(size=150) + 100.0}))  # same shapes
+
+
+def test_dist_semi_anti_dense_matches_sort_path(dctx, rng):
+    from cylon_tpu.parallel import dist_anti_join, dist_semi_join
+    ldf = pd.DataFrame({"k": rng.integers(0, 60, 200),
+                        "a": rng.normal(size=200)})
+    rdf = pd.DataFrame({"k": np.repeat(rng.integers(0, 60, 30), 5)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    for fn in (dist_semi_join, dist_anti_join):
+        plain = fn(lt, rt, "k", "k").to_table().to_pandas()
+        dense = fn(lt, rt, "k", "k",
+                   dense_key_range=(0, 59)).to_table().to_pandas()
+        assert_same_rows(dense, plain)
+
+
+def test_dist_semi_dense_null_keys(dctx):
+    from cylon_tpu.parallel import dist_anti_join, dist_semi_join
+    ldf = pd.DataFrame({"k": pd.array([1, None, 3, None, 5], dtype="Int64"),
+                        "a": np.arange(5, dtype=np.float64)})
+    r_with = pd.DataFrame({"k": pd.array([1, None], dtype="Int64")})
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, r_with)
+    semi = dist_semi_join(lt, rt, "k", "k",
+                          dense_key_range=(0, 9)).to_table().to_pandas()
+    assert_same_rows(semi, ldf[ldf["k"].isna() | (ldf["k"] == 1)])
+    anti = dist_anti_join(lt, rt, "k", "k",
+                          dense_key_range=(0, 9)).to_table().to_pandas()
+    assert_same_rows(anti, ldf[ldf["k"].isin([3, 5])])
+
+
+def test_dist_semi_dense_range_violation_raises(dctx, rng):
+    from cylon_tpu.status import CylonError
+    from cylon_tpu.parallel import dist_semi_join
+    ldf = pd.DataFrame({"k": rng.integers(0, 100, 50),
+                        "a": rng.normal(size=50)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 100, 20)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    with pytest.raises(CylonError, match="dense_key_range"):
+        dist_semi_join(lt, rt, "k", "k", dense_key_range=(0, 10))
