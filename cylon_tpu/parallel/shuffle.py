@@ -166,7 +166,9 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
         # in docs/tpu_perf_notes.md (pre-aggregated groupby never routes
         # raw hot rows; sample-sort splitters spread dense ranges).
         mean_recv = max(float(per_recv.mean()), 1.0)
-        if Pn > 1 and outcap > 4 * mean_recv:
+        # the 64k floor keeps toy tables (where count noise looks like
+        # skew) quiet; below that size the blowup is bytes, not a hazard
+        if Pn > 1 and outcap >= 65536 and outcap > 4 * mean_recv:
             from .. import logging as glog
             glog.warning(
                 "skewed exchange: hottest receiver gets %d rows "
