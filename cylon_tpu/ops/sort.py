@@ -67,6 +67,27 @@ def sort_indices_masked(col: jax.Array, validity: Optional[jax.Array],
     return jnp.lexsort((key, isnull, ispad))
 
 
+def lexsort_indices_masked(cols: Sequence[jax.Array],
+                           validities: Sequence[Optional[jax.Array]],
+                           count, ascending=True) -> jax.Array:
+    """Stable multi-key argsort of a padded block: rows [0, count) in
+    lexicographic order (per-key ASC/DESC, nulls last per key), padding
+    rows sorted to the tail — ``sort_indices_masked`` generalized to the
+    ORDER BY col1, col2, … shape the distributed multi-key sort needs."""
+    n = cols[0].shape[0]
+    ispad = jnp.arange(n) >= count
+    asc = ([ascending] * len(cols) if isinstance(ascending, bool)
+           else list(ascending))
+    flat = []
+    for i in reversed(range(len(cols))):
+        flat.append(cols[i] if asc[i] else _invert(cols[i]))
+        v = validities[i]
+        if v is not None:
+            flat.append(~v)
+    flat.append(ispad)
+    return jnp.lexsort(tuple(flat))
+
+
 def _invert(col: jax.Array) -> jax.Array:
     """Total order-reversing transform for descending sort.
 

@@ -1422,6 +1422,52 @@ def dist_head(dt: DTable, n: int) -> "Table":
 
 
 @functools.lru_cache(maxsize=None)
+def _local_sort_multi_fn(mesh, axis: str, cap: int, nkeys: int,
+                         ascending: Tuple[bool, ...]):
+    def kernel(cnt, key_leaves, leaves):
+        order = ops_sort.lexsort_indices_masked(
+            tuple(d for d, _ in key_leaves),
+            tuple(v for _, v in key_leaves), cnt[0], list(ascending))
+        return tuple(ops_gather.take_many(leaves, order, fill_null=False))
+
+    spec = P(axis)
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(spec,) * 3, out_specs=spec))
+
+
+def dist_sort_multi(dt: DTable, sort_columns: Sequence[Union[int, str]],
+                    ascending=True) -> DTable:
+    """Distributed multi-key ORDER BY: range-partition on the PRIMARY
+    column (equal primary values co-locate, so cross-shard lexicographic
+    order holds), then a per-shard masked lexsort over all keys.  One
+    shuffle regardless of key count — the scalable spelling of the
+    host-side ``compute.sort_multi`` tail every small query uses.
+    ``ascending``: one bool or a per-column sequence."""
+    key_ids = _resolve_ids(dt, sort_columns)
+    asc = ([ascending] * len(key_ids) if isinstance(ascending, bool)
+           else list(ascending))
+    if dt.ctx.get_world_size() == 1:
+        sh = dt
+    else:
+        with trace.span("sort.sample"):
+            splitters = _sample_splitters([(dt, key_ids[0])], asc[0])
+        with trace.span("sort.shuffle"):
+            sh = _shuffle_by_pids(
+                dt, _range_pids(dt, key_ids[0], splitters, asc[0]))
+    key_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
+                       for i in key_ids)
+    leaves = tuple((c.data, c.validity) for c in sh.columns)
+    with trace.span_sync("sort.local") as sp:
+        outs = _local_sort_multi_fn(dt.ctx.mesh, dt.ctx.axis, sh.cap,
+                                    len(key_ids), tuple(asc))(
+            sh.counts, key_leaves, leaves)
+        sp.sync(outs)
+    cols = [DColumn(c.name, c.dtype, d, v, c.dictionary, c.arrow_type)
+            for c, (d, v) in zip(sh.columns, outs)]
+    return DTable(dt.ctx, cols, sh.cap, sh.counts)
+
+
+@functools.lru_cache(maxsize=None)
 def _local_sort_fn(mesh, axis: str, cap: int, ascending: bool):
     def kernel(cnt, key_leaf, leaves):
         col, validity = key_leaf

@@ -28,7 +28,8 @@ from ..dtypes import Type
 from ..table import Table
 from ..parallel import (DTable, dist_aggregate, dist_anti_join, dist_groupby,
                         dist_head, dist_join, dist_project, dist_select,
-                        dist_semi_join, dist_sort, dist_with_column)
+                        dist_semi_join, dist_sort, dist_sort_multi,
+                        dist_with_column)
 from .datagen import date_to_days
 
 Tables = Dict[str, DTable]
@@ -731,11 +732,9 @@ def q2(ctx, t: Tables, size: int = 15, type_suffix: str = "BRASS",
                          ("mpk", "min_cost"))))
     best = dist_project(best, ["s_acctbal", "n_name", "p_partkey", "p_mfgr",
                                "s_suppkey", "ps_supplycost"])
-    out = best.to_table()  # qualifying parts only — small
-    from ..compute import sort_multi
-    out = sort_multi(out, ["s_acctbal", "n_name", "p_partkey"],
-                     ascending=[False, True, True])
-    return Table(ctx, [_slice_col(c, limit) for c in out.columns])
+    s = dist_sort_multi(best, ["s_acctbal", "n_name", "p_partkey"],
+                        ascending=[False, True, True])
+    return dist_head(s, limit)
 
 
 # -- Q7: volume shipping ------------------------------------------------------
@@ -880,10 +879,10 @@ def q13(ctx, t: Tables) -> Table:
     per_c = dist_groupby(m, ["c_custkey"], [("o_orderkey", "count")],
                          dense_key_range=(1, _table_rows(t["customer"])))
     g = dist_groupby(per_c, ["count_o_orderkey"], [("c_custkey", "count")])
-    out = g.to_table().rename_column("count_o_orderkey", "c_count") \
+    g = dist_sort_multi(g, ["count_c_custkey", "count_o_orderkey"],
+                        ascending=[False, False])
+    return g.to_table().rename_column("count_o_orderkey", "c_count") \
         .rename_column("count_c_custkey", "custdist")
-    from ..compute import sort_multi
-    return sort_multi(out, ["custdist", "c_count"], ascending=[False, False])
 
 
 # -- Q15: top supplier --------------------------------------------------------
@@ -937,10 +936,9 @@ def q16(ctx, t: Tables, bad_brand: str = "Brand#45",
                        [("ps_suppkey", "count")])
     g = dist_groupby(per, ["p_brand", "p_type", "p_size"],
                      [("ps_suppkey", "count")])
-    out = g.to_table().rename_column("count_ps_suppkey", "supplier_cnt")
-    from ..compute import sort_multi
-    return sort_multi(out, ["supplier_cnt", "p_brand", "p_type", "p_size"],
-                      ascending=[False, True, True, True])
+    g = dist_sort_multi(g, ["count_ps_suppkey", "p_brand", "p_type",
+                            "p_size"], ascending=[False, True, True, True])
+    return g.to_table().rename_column("count_ps_suppkey", "supplier_cnt")
 
 
 # -- Q17: small-quantity-order revenue ----------------------------------------
@@ -1048,10 +1046,9 @@ def q21(ctx, t: Tables, nation: str = "SAUDI ARABIA",
     l1 = dist_semi_join(l1, cand, "l_orderkey", "l_orderkey",
                         dense_key_range=(1, _table_rows(t["orders"])))
     g = dist_groupby(l1, ["l_suppkey"], [("l_suppkey", "count")])
-    out = g.to_table().rename_column("count_l_suppkey", "numwait")
-    from ..compute import sort_multi
-    out = sort_multi(out, ["numwait", "l_suppkey"], ascending=[False, True])
-    return Table(ctx, [_slice_col(c, limit) for c in out.columns])
+    g = dist_sort_multi(g, ["count_l_suppkey", "l_suppkey"],
+                        ascending=[False, True])
+    return dist_head(g, limit).rename_column("count_l_suppkey", "numwait")
 
 
 # -- Q22: global sales opportunity --------------------------------------------

@@ -786,3 +786,26 @@ def test_dist_semi_dense_range_violation_raises(dctx, rng):
     lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
     with pytest.raises(CylonError, match="dense_key_range"):
         dist_semi_join(lt, rt, "k", "k", dense_key_range=(0, 10))
+
+
+def test_dist_sort_multi_global_lex_order(dctx, rng):
+    from cylon_tpu.parallel import dist_sort_multi
+    df = pd.DataFrame({
+        "a": rng.integers(0, 12, 300),
+        "b": pd.array(np.where(rng.random(300) < 0.1, None,
+                               rng.integers(0, 5, 300)), dtype="Int64"),
+        "v": rng.normal(size=300),
+    })
+    dt = dtable_from_pandas(dctx, df, n_empty_shards=2)
+    out = dist_sort_multi(dt, ["a", "b"], ascending=[False, True]) \
+        .to_table().to_pandas()
+    want = df.sort_values(["a", "b"], ascending=[False, True],
+                          na_position="last", kind="stable") \
+        .reset_index(drop=True)
+    # global ORDER: the concatenated shards must equal the oracle order
+    # on the key columns (value column checked as a row multiset)
+    assert out["a"].tolist() == want["a"].tolist()
+    gb = out["b"].to_numpy(dtype=np.float64, na_value=np.nan)
+    wb = want["b"].to_numpy(dtype=np.float64, na_value=np.nan)
+    assert ((gb == wb) | (np.isnan(gb) & np.isnan(wb))).all()
+    assert_same_rows(out, df)
