@@ -91,7 +91,7 @@ def carry_unpack(carried, value_validities):
 
 
 def dense_group_structure(key: jax.Array, key_validity, row_valid,
-                          lo: int, hi: int):
+                          lo: int, hi: int, stride: int = 1):
     """Direct-address grouping for a single integer key with a known dense
     range [lo, hi] — NO sort.  Each row's group slot is ``key - lo``; a
     scatter-add builds per-slot counts.  Replaces the sort+scan structure
@@ -106,8 +106,14 @@ def dense_group_structure(key: jax.Array, key_validity, row_valid,
     discards it).  Returns (slot[n], counts[R+1], ngroups, overflow) where
     ``overflow`` counts valid rows whose key lies OUTSIDE [lo, hi] — a
     caller-contract violation that must fail loudly, never silently alias.
+
+    ``stride > 1`` is the MULTI-SHARD slot compression: rows were routed
+    by ``(key - lo) % stride``, so each shard sees one residue class and
+    ``(key - lo) // stride`` is injective on it — per-shard slot space
+    shrinks to ceil(R / stride).  The caller reconstructs keys as
+    ``lo + slot·stride + shard_index``.
     """
-    R = hi - lo + 1
+    R = -(-(hi - lo + 1) // stride)
     n = key.shape[0]
     valid = (jnp.ones(n, bool) if row_valid is None else row_valid)
     if key_validity is not None:
@@ -118,7 +124,9 @@ def dense_group_structure(key: jax.Array, key_validity, row_valid,
         null_rows = None
     in_range = (key >= lo) & (key <= hi)
     overflow = jnp.sum(nonnull & ~in_range).astype(jnp.int32)
-    slot = jnp.where(nonnull & in_range, key.astype(jnp.int32) - lo,
+    base = key.astype(jnp.int32) - lo
+    slot = jnp.where(nonnull & in_range,
+                     base // stride if stride > 1 else base,
                      jnp.int32(R + 1))
     if null_rows is not None:
         slot = jnp.where(null_rows, jnp.int32(R), slot)
@@ -130,14 +138,17 @@ def dense_group_structure(key: jax.Array, key_validity, row_valid,
 def dense_groupby_aggregate(slot: jax.Array, counts: jax.Array,
                             value_cols, value_validities,
                             aggs: Tuple[str, ...], out_capacity: int,
-                            lo: int, key_dtype, has_null_slot: bool):
+                            lo: int, key_dtype, has_null_slot: bool,
+                            stride: int = 1, phase=0):
     """Phase 2 of the dense path: per-agg scatter into the [R+1] slot
     space, then compact the non-empty slots into ``out_capacity``.
 
-    The group key is RECONSTRUCTED from the slot id (lo + slot) — no key
-    gather at all.  Returns (key_data[C], key_validity[C] or None,
-    agg_arrays, agg_validities, ngroups), matching the sort path's
-    contract (entries past the group count are unspecified).
+    The group key is RECONSTRUCTED from the slot id (lo + slot·stride +
+    phase; ``phase`` = this shard's residue class under the multi-shard
+    modulo routing, 0 single-shard) — no key gather at all.  Returns
+    (key_data[C], key_validity[C] or None, agg_arrays, agg_validities,
+    ngroups), matching the sort path's contract (entries past the group
+    count are unspecified).
     """
     from ..dtypes import extreme_value
     from .compact import compact_indices
@@ -145,7 +156,7 @@ def dense_groupby_aggregate(slot: jax.Array, counts: jax.Array,
     present = counts > 0
     starts = compact_indices(present, out_capacity, fill=-1)  # slot per group
     safe = jnp.clip(starts, 0, R1 - 1)
-    key_data = (lo + safe).astype(key_dtype)
+    key_data = (lo + safe * stride + phase).astype(key_dtype)
     key_valid = None
     if has_null_slot:
         key_valid = (starts >= 0) & (safe != R1 - 1)  # slot R ⇒ null key

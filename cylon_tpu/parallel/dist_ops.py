@@ -539,17 +539,19 @@ def _groupby_phase2_fn(mesh, axis: str, aggs: Tuple[str, ...], out_cap: int,
 
 @functools.lru_cache(maxsize=None)
 def _dense_phase1_fn(mesh, axis: str, cap: int, lo: int, hi: int,
-                     has_kvalid: bool, has_where: bool):
+                     has_kvalid: bool, has_where: bool, stride: int):
     """Dense-key phase 1: slot ids + slot counts + replicated
     [ngroups, overflow] per shard (overflow ⇒ the caller's range hint was
-    violated — fails loudly in the count protocol's post())."""
+    violated — fails loudly in the count protocol's post()).  ``stride`` =
+    world size under the modulo routing (per-shard slots = R/stride)."""
 
     def kernel(cnt, key_leaf, *maybe_mask):
         kd, kv = key_leaf
         row_valid = (maybe_mask[0] if has_where
                      else (jnp.arange(cap) < cnt[0]))
         slot, counts, ng, ov = ops_groupby.dense_group_structure(
-            kd, kv if has_kvalid else None, row_valid, lo, hi)
+            kd, kv if has_kvalid else None, row_valid, lo, hi,
+            stride=stride)
         return slot, counts, jax.lax.all_gather(
             jnp.stack([ng, ov]), axis)
 
@@ -563,14 +565,17 @@ def _dense_phase1_fn(mesh, axis: str, cap: int, lo: int, hi: int,
 @functools.lru_cache(maxsize=None)
 def _dense_phase2_fn(mesh, axis: str, aggs: Tuple[str, ...], out_cap: int,
                      lo: int, key_dtype_str: str, has_null_slot: bool,
-                     slot_map: Tuple[int, ...]):
+                     slot_map: Tuple[int, ...], stride: int):
     def kernel(slot, counts, val_leaves):
         import numpy as _np
         vcols = tuple(val_leaves[j][0] for j in slot_map)
         vvals = tuple(val_leaves[j][1] for j in slot_map)
+        phase = (jax.lax.axis_index(axis).astype(jnp.int32)
+                 if stride > 1 else 0)
         kd, kv, outs, ovals, ng = ops_groupby.dense_groupby_aggregate(
             slot, counts, vcols, vvals, aggs, out_cap, lo,
-            _np.dtype(key_dtype_str), has_null_slot)
+            _np.dtype(key_dtype_str), has_null_slot,
+            stride=stride, phase=phase)
         return ((kd, kv), outs, ovals, ng[None])
 
     spec = P(axis)
@@ -636,8 +641,25 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
         if op not in ops_groupby.AGG_OPS:
             raise CylonError(Status(Code.Invalid, f"unknown aggregation {op!r}"))
     world = dt.ctx.get_world_size()
+    # dense-key viability decides BOTH the partitioner (modulo routing at
+    # world > 1: per-shard slot space = R / world) and the pre-aggregation
+    # default (a key range wider than the shard capacity means near-unique
+    # keys per shard — the partial pass would be pure overhead)
+    dense = None
+    if dense_key_range is not None and len(key_ids) == 1:
+        kc0 = dt.columns[key_ids[0]]
+        lo, hi = int(dense_key_range[0]), int(dense_key_range[1])
+        stride = 1 if (world == 1 or _local_only) else world
+        if (jnp.issubdtype(kc0.data.dtype, jnp.integer)
+                and not is_dictionary_encoded(kc0.dtype.type)
+                and 0 < hi - lo + 1
+                and -(-(hi - lo + 1) // stride) <= 4 * dt.cap):
+            dense = (lo, hi, stride)
     if pre_aggregate is None:
-        pre_aggregate = world > 1 and not _local_only
+        near_unique = (dense_key_range is not None and len(key_ids) == 1
+                       and (int(dense_key_range[1])
+                            - int(dense_key_range[0]) + 1) > dt.cap)
+        pre_aggregate = world > 1 and not _local_only and not near_unique
     if world > 1 and pre_aggregate and not _local_only:
         return _dist_groupby_preagg(dt, key_ids, aggregations, where,
                                     dense_key_range)
@@ -646,7 +668,10 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
         sh = dt
     else:
         with trace.span("groupby.shuffle"):
-            pid = _hash_pids(dt, key_ids)
+            if dense is not None:
+                pid = _mod_pids(dt, key_ids[0], dense[0], world)
+            else:
+                pid = _hash_pids(dt, key_ids)
             if pmask is not None:
                 # filter pushdown: failing rows never enter the exchange
                 pid = jnp.where(pmask, pid, jnp.int32(dt.ctx.get_world_size()))
@@ -658,15 +683,10 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     val_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
                        for i in uniq_ids)
 
-    if dense_key_range is not None and len(key_ids) == 1:
-        kc = sh.columns[key_ids[0]]
-        lo, hi = int(dense_key_range[0]), int(dense_key_range[1])
-        if (jnp.issubdtype(kc.data.dtype, jnp.integer)
-                and not is_dictionary_encoded(kc.dtype.type)
-                and 0 < hi - lo + 1 <= 4 * sh.cap):
-            return _dist_groupby_dense(
-                dt, sh, kc, key_ids[0], val_leaves, uniq_ids, slot_map,
-                aggs, aggregations, lo, hi, pmask, where)
+    if dense is not None:
+        return _dist_groupby_dense(
+            dt, sh, sh.columns[key_ids[0]], key_ids[0], val_leaves,
+            uniq_ids, slot_map, aggs, aggregations, dense, pmask, where)
 
     with trace.span("groupby.count"):
         args = ((sh.counts, key_leaves, val_leaves)
@@ -709,26 +729,57 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     return DTable(dt.ctx, cols, out_cap, counts)
 
 
+def _mod_pids(dt: DTable, key_id: int, lo: int, nparts: int) -> jax.Array:
+    """Modulo partitioner for dense int keys: shard = (key − lo) mod P.
+    Equal keys co-locate (like the hash partitioner) AND each shard's key
+    set is one residue class, so the dense slot space compresses by P
+    ((key − lo) // P is injective per shard).  Nulls and out-of-range
+    keys route to shard 0 — overflow still fails loudly in phase 1."""
+    kc = dt.columns[key_id]
+    fn = _mod_pids_fn(dt.ctx.mesh, dt.ctx.axis, dt.cap, lo, nparts,
+                      kc.validity is not None)
+    return fn(dt.counts, kc.data, kc.validity)
+
+
+@functools.lru_cache(maxsize=None)
+def _mod_pids_fn(mesh, axis: str, cap: int, lo: int, nparts: int,
+                 has_kv: bool):
+    def kernel(cnt_blk, kd, kv):
+        mask = jnp.arange(cap) < cnt_blk[0]
+        base = kd.astype(jnp.int32) - lo
+        pid = jnp.where(base >= 0, base % nparts, 0)
+        if has_kv:
+            pid = jnp.where(kv, pid, 0)
+        return jnp.where(mask, pid, jnp.int32(nparts))
+
+    spec = P(axis)
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec))
+
+
 def _dist_groupby_dense(dt: DTable, sh: DTable, kc: DColumn, key_id: int,
                         val_leaves, uniq_ids, slot_map, aggs, aggregations,
-                        lo: int, hi: int, pmask, where) -> DTable:
+                        dense, pmask, where) -> DTable:
     """Direct-address tail of dist_groupby (dense_key_range hint)."""
+    lo, hi, stride = dense
     mesh, axis = dt.ctx.mesh, dt.ctx.axis
     with trace.span("groupby.count"):
         args = ((sh.counts, (kc.data, kc.validity))
                 + (() if pmask is None else (pmask,)))
         slot, counts, ngov = _dense_phase1_fn(
             mesh, axis, sh.cap, lo, hi, kc.validity is not None,
-            pmask is not None)(*args)
+            pmask is not None, stride)(*args)
 
-    hint_key = (mesh, sh.cap, aggs, ("dense", key_id, lo, hi), where)
+    hint_key = (mesh, sh.cap, aggs, ("dense", key_id, lo, hi, stride),
+                where)
     while len(_group_cap_hints) > _GROUP_HINTS_MAX:
         _group_cap_hints.pop(next(iter(_group_cap_hints)))
 
     def dispatch(sizes):
         return _dense_phase2_fn(mesh, axis, aggs, sizes[0], lo,
                                 str(kc.data.dtype),
-                                kc.validity is not None, slot_map)(
+                                kc.validity is not None, slot_map,
+                                stride)(
             slot, counts, val_leaves)
 
     def post(per_shard):
@@ -1221,7 +1272,7 @@ def dist_select(dt: DTable, predicate, params=()) -> DTable:
 @functools.lru_cache(maxsize=None)
 def _semi_mask_dense_fn(mesh, axis: str, cap_l: int, cap_r: int,
                         lo: int, hi: int, anti: bool,
-                        has_lv: bool, has_rv: bool):
+                        has_lv: bool, has_rv: bool, stride: int = 1):
     """Dense-key semi/anti probe: presence bits over the key range [lo,
     hi] (ONE scatter of the right keys) + ONE gather probe of the left
     keys — no sort at all.  The big⋈tiny filter-join shape (probe 60M
@@ -1229,8 +1280,9 @@ def _semi_mask_dense_fn(mesh, axis: str, cap_l: int, cap_r: int,
     sort to two O(n) passes.  Out-of-range keys on EITHER side fail
     loudly via the overflow counter (they could silently miss matches).
     Null == null like the sort kernel: a null left key matches iff the
-    right side has any null key."""
-    R = hi - lo + 1
+    right side has any null key.  ``stride`` = world size under modulo
+    routing (both sides see one residue class, slots compress by P)."""
+    R = -(-(hi - lo + 1) // stride)
 
     def kernel(l_cnt, r_cnt, lk, lv, rk, rv):
         rvalid = jnp.arange(cap_r) < r_cnt[0]
@@ -1241,11 +1293,15 @@ def _semi_mask_dense_fn(mesh, axis: str, cap_l: int, cap_r: int,
         l_in = (lk >= lo) & (lk <= hi)
         overflow = (jnp.sum(r_nonnull & ~r_in)
                     + jnp.sum(l_nonnull & ~l_in)).astype(jnp.int32)
-        slot = jnp.where(r_nonnull & r_in, rk.astype(jnp.int32) - lo,
-                         jnp.int32(R))
+        r_base = rk.astype(jnp.int32) - lo
+        l_base = lk.astype(jnp.int32) - lo
+        if stride > 1:
+            r_base = r_base // stride
+            l_base = l_base // stride
+        slot = jnp.where(r_nonnull & r_in, r_base, jnp.int32(R))
         present = jnp.zeros(R, bool).at[slot].set(True, mode="drop")
         hit = l_nonnull & l_in & jnp.take(
-            present, jnp.clip(lk.astype(jnp.int32) - lo, 0, R - 1))
+            present, jnp.clip(l_base, 0, R - 1))
         if has_lv or has_rv:
             r_has_null = (jnp.any(rvalid & ~rv) if has_rv
                           else jnp.zeros((), bool))
@@ -1299,34 +1355,48 @@ def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
     # rest before the exchange so non-key payload never crosses the wire
     right = dist_project(right, ri_keys)
     ri_keys = list(range(len(ri_keys)))
-    if left.ctx.get_world_size() > 1:
+    world = left.ctx.get_world_size()
+    # presence bits cost R/stride BYTES per shard — gate against the
+    # larger side's capacity (a 1.5M-key range is nothing next to a
+    # 15M-row probe side, even when the filtered LEFT block is small)
+    kc0 = left.columns[li_keys[0]]
+    stride = 1 if world == 1 else world
+    use_dense = (dense_key_range is not None and len(li_keys) == 1
+                 and jnp.issubdtype(kc0.data.dtype, jnp.integer)
+                 and not is_dictionary_encoded(kc0.dtype.type)
+                 and 0 < (int(dense_key_range[1])
+                          - int(dense_key_range[0]) + 1)
+                 and -(-(int(dense_key_range[1])
+                         - int(dense_key_range[0]) + 1) // stride)
+                 <= 4 * max(left.cap, right.cap))
+    if world > 1:
         with trace.span("semijoin.shuffle"):
-            left = _shuffle_by_pids(left, _hash_pids(left, li_keys))
-            right = _shuffle_by_pids(right, _hash_pids(right, ri_keys))
+            if use_dense:
+                lo0 = int(dense_key_range[0])
+                left = _shuffle_by_pids(
+                    left, _mod_pids(left, li_keys[0], lo0, world))
+                right = _shuffle_by_pids(
+                    right, _mod_pids(right, ri_keys[0], lo0, world))
+            else:
+                left = _shuffle_by_pids(left, _hash_pids(left, li_keys))
+                right = _shuffle_by_pids(right, _hash_pids(right, ri_keys))
     mesh, axis = left.ctx.mesh, left.ctx.axis
     lkcs = [left.columns[i] for i in li_keys]
     rkcs = [right.columns[i] for i in ri_keys]
     kc = lkcs[0]
-    # presence bits cost R BYTES per shard — gate against the larger
-    # side's capacity (a 1.5M-key range is nothing next to a 15M-row
-    # probe side, even when the filtered LEFT block is small)
-    use_dense = (dense_key_range is not None and len(li_keys) == 1
-                 and jnp.issubdtype(kc.data.dtype, jnp.integer)
-                 and not is_dictionary_encoded(kc.dtype.type)
-                 and 0 < (int(dense_key_range[1])
-                          - int(dense_key_range[0]) + 1)
-                 <= 4 * max(left.cap, right.cap))
     if use_dense:
         lo, hi = int(dense_key_range[0]), int(dense_key_range[1])
         rc = rkcs[0]
         with trace.span("semijoin.mask"):
             mask, cnts = _semi_mask_dense_fn(
                 mesh, axis, left.cap, right.cap, lo, hi, anti,
-                kc.validity is not None, rc.validity is not None)(
+                kc.validity is not None, rc.validity is not None,
+                stride)(
                 left.counts, right.counts, kc.data, kc.validity,
                 rc.data, rc.validity)
 
-        hint_key = ("semid", mesh, left.cap, right.cap, lo, hi, anti)
+        hint_key = ("semid", mesh, left.cap, right.cap, lo, hi, anti,
+                    stride)
 
         def post(per_shard):
             per_shard = per_shard.reshape(-1, 2)
