@@ -417,6 +417,18 @@ def _pandas_tpch(qname: str, data, date_to_days, reps: int = 2) -> float:
     return min(ts)
 
 
+def _progress(msg: str) -> None:
+    """Timestamped stage marker on stderr (stdout carries only the JSON
+    line).  The run crosses a tunneled TPU backend where a single wedged
+    RPC can stall for an hour with no CPU activity — stage markers make a
+    hang attributable to a specific section from the log alone.  Silence
+    with CYLON_BENCH_QUIET=1."""
+    if os.environ.get("CYLON_BENCH_QUIET", "0") not in ("", "0"):
+        return
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache: the benchmark's wall time is
     dominated by fresh-process compiles; a warm cache cuts re-runs to
@@ -455,6 +467,7 @@ def main() -> None:
     pipe_k = int(os.environ.get("CYLON_BENCH_PIPELINE_K", "4"))
     total = rows * world
 
+    _progress(f"start: platform={platform} world={world} rows={total}")
     ctx = CylonContext({"backend": "tpu", "devices": devs})
     rng = np.random.default_rng(3)
     krange = max(int(total * 0.99), 1)
@@ -508,6 +521,7 @@ def main() -> None:
     out_rows = 0
     w_ts = []
     for alg in (JoinAlgorithm.SORT, JoinAlgorithm.HASH):
+        _progress(f"join bench: algorithm={alg.value}")
         cfg = JoinConfig.InnerJoin(0, 0, algorithm=alg)
         _, _, warm = run_join(cfg)  # compile + first caches
         out_rows = warm.num_rows
@@ -533,6 +547,7 @@ def main() -> None:
         _trace.hard_sync([c.data for c in outs[-1].columns])
         return time.perf_counter() - t0
 
+    _progress(f"pipelined join bench (K={pipe_k})")
     run_pipe(1)  # warm the deferred-mode dispatch path
     if pipe_k > 1:
         # best-of per arm, then one difference: pairing a fast K-run with
@@ -568,11 +583,13 @@ def main() -> None:
             ctx, pid, [c.data for c in left.columns])
         _trace.hard_sync(leaves)
         return time.perf_counter() - t0
+    _progress("shuffle microbench")
     run_shuffle()
     s_t = min(run_shuffle() for _ in range(reps))
 
     # baseline: single-core pandas hash join on identical data, measured
     # the same way as the framework side (one warmup, min over `reps`)
+    _progress("pandas + pyarrow join baselines")
     ldf, rdf = pd.DataFrame(ldata), pd.DataFrame(rdata)
     base_rows = len(ldf.merge(rdf, on="k", how="inner"))  # warmup
     p_ts = []
@@ -608,7 +625,9 @@ def main() -> None:
         from cylon_tpu.parallel import run_pipeline
         from cylon_tpu.tpch import generate, queries
         from cylon_tpu.tpch.datagen import date_to_days
+        _progress(f"TPC-H datagen sf={sf}")
         data = generate(sf, seed=11)
+        _progress("TPC-H ingest to device")
         with warnings.catch_warnings(record=True) as _tpch_warns:
             warnings.simplefilter("always")
             dts = {name: DTable.from_pandas(ctx, df)
@@ -623,6 +642,7 @@ def main() -> None:
         tpch_detail = {"tpch_sf": sf, "tpch_key_dtype": "int32"}
         ratios = []
         for qname in sorted(queries.QUERIES):
+            _progress(f"TPC-H {qname}: compile+run")
             qfn = queries.QUERIES[qname]
 
             def run_q():
@@ -644,6 +664,7 @@ def main() -> None:
                       f"{str(e)[:300]}", file=sys.stderr)
                 tpch_detail[f"tpch_{qname}_error"] = str(e)[:200]
                 continue
+            _progress(f"TPC-H {qname}: {q_t * 1e3:.0f} ms; pandas oracle")
             q_pd = _pandas_tpch(qname, data, date_to_days, reps=pd_reps)
             ratios.append(q_pd / q_t)
             tpch_detail.update({
