@@ -194,14 +194,20 @@ def shuffle_table(dt: DTable, key_columns: Sequence[Union[int, str]]
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _join_phase1_fn(mesh, axis: str, how: str, alg: str):
+def _join_phase1_fn(mesh, axis: str, how: str, alg: str, carried: bool):
     """Phase 1 per shard: the join "plan" + replicated output counts.
 
     ``hash``: dense ranks (the direct-address kernel's domain), plan =
-    (l_rank, r_rank).  ``sort``: the CARRIED fused single-sort plan
-    (ops/join.py sort_join_plan_carried) — output leaves ride the plan
-    sorts, so phase 2's output gathers fuse into the decode gathers
-    (two random passes instead of four).
+    (l_rank, r_rank).  ``sort``: the fused single-sort plan; with
+    ``carried`` the output leaves additionally ride the plan sorts
+    (ops/join.py sort_join_plan_carried) so phase 2's output gathers fuse
+    into the decode gathers.  Measured on a v5e at 4M+4M rows the carried
+    variant wins ONLY when each side carries a single no-validity column
+    (154 vs 212 ms) — every extra carried array rides the 8M merged sort,
+    the build-order sort AND a widened run-heavy decode gather, and by two
+    arrays per side the plain plan + per-side packed takes is ~20% faster
+    (181 vs 216 ms at 2, 208 vs 237 at 3).  ``carried`` encodes that
+    crossover (chosen by the caller from the leaf counts).
     """
 
     def kernel(l_cnt, r_cnt, lkeys, lvalids, rkeys, rvalids,
@@ -213,11 +219,17 @@ def _join_phase1_fn(mesh, axis: str, how: str, alg: str):
             cnt = ops_hashjoin.hash_join_count(
                 lr, rr, how, l_count=l_cnt[0], r_count=r_cnt[0])
         else:
-            plan, psort, bsort = ops_join.sort_join_plan_carried(
-                lkeys, lvalids, rkeys, rvalids, how,
-                l_count=l_cnt[0], r_count=r_cnt[0],
-                l_leaves=l_leaves, r_leaves=r_leaves)
-            state = (plan, psort, bsort)
+            if carried:
+                plan, psort, bsort = ops_join.sort_join_plan_carried(
+                    lkeys, lvalids, rkeys, rvalids, how,
+                    l_count=l_cnt[0], r_count=r_cnt[0],
+                    l_leaves=l_leaves, r_leaves=r_leaves)
+                state = (plan, psort, bsort)
+            else:
+                plan = ops_join.sort_join_plan(
+                    lkeys, lvalids, rkeys, rvalids, how,
+                    l_count=l_cnt[0], r_count=r_cnt[0])
+                state = (plan,)
             cnt = ops_join.plan_total(plan, how, l_count=l_cnt[0],
                                       r_count=r_cnt[0])
         # counts replicated (all_gather of one int per shard) so any
@@ -235,22 +247,26 @@ def _join_phase1_fn(mesh, axis: str, how: str, alg: str):
 
 @functools.lru_cache(maxsize=None)
 def _join_phase2_fn(mesh, axis: str, how: str, alg: str, capacity: int,
-                    fill_left: bool, fill_right: bool):
+                    fill_left: bool, fill_right: bool, carried: bool):
     def kernel(l_cnt, r_cnt, state, l_leaves, r_leaves):
-        if alg == "hash":
-            li, ri, cnt = ops_hashjoin.hash_join_indices(
-                state[0], state[1], how, capacity,
-                l_count=l_cnt[0], r_count=r_cnt[0])
-            louts = tuple(ops_gather.take_many(l_leaves, li,
-                                               fill_null=fill_left))
-            routs = tuple(ops_gather.take_many(r_leaves, ri,
-                                               fill_null=fill_right))
-        else:
+        if carried:
             plan, psort, bsort = state
             louts, routs, cnt = ops_join.plan_gather_carried(
                 plan, psort, bsort, how, capacity,
                 l_count=l_cnt[0], r_count=r_cnt[0])
-            louts, routs = tuple(louts), tuple(routs)
+            return tuple(louts), tuple(routs), cnt[None]
+        if alg == "hash":
+            li, ri, cnt = ops_hashjoin.hash_join_indices(
+                state[0], state[1], how, capacity,
+                l_count=l_cnt[0], r_count=r_cnt[0])
+        else:
+            (plan,) = state
+            li, ri, cnt = ops_join.plan_indices(
+                plan, how, capacity, l_count=l_cnt[0], r_count=r_cnt[0])
+        louts = tuple(ops_gather.take_many(l_leaves, li,
+                                           fill_null=fill_left))
+        routs = tuple(ops_gather.take_many(r_leaves, ri,
+                                           fill_null=fill_right))
         return louts, routs, cnt[None]
 
     spec = P(axis)
@@ -355,8 +371,14 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_keys: Sequence[int],
     fill_right = how in ("left", "full_outer")
     l_leaves = tuple((c.data, c.validity) for c in lsh.columns)
     r_leaves = tuple((c.data, c.validity) for c in rsh.columns)
+    # measured crossover (see _join_phase1_fn): riding output leaves
+    # through the plan sorts only pays when each side carries ONE array
+    def _carry_width(leaves):
+        return sum(1 + (v is not None) for _, v in leaves)
+    carried = (alg == "sort" and _carry_width(l_leaves) <= 1
+               and _carry_width(r_leaves) <= 1)
     with trace.span("join.count"):
-        plan, cnts = _join_phase1_fn(mesh, axis, how, alg)(
+        plan, cnts = _join_phase1_fn(mesh, axis, how, alg, carried)(
             lsh.counts, rsh.counts,
             tuple(c.data for c in lkcs), tuple(c.validity for c in lkcs),
             tuple(c.data for c in rkcs), tuple(c.validity for c in rkcs),
@@ -366,7 +388,7 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_keys: Sequence[int],
 
     def dispatch(sizes):
         return _join_phase2_fn(mesh, axis, how, alg, sizes[0],
-                               fill_left, fill_right)(
+                               fill_left, fill_right, carried)(
             lsh.counts, rsh.counts, plan, l_leaves, r_leaves)
 
     def post(per_shard):
