@@ -274,7 +274,8 @@ def _join_phase2_fn(mesh, axis: str, how: str, alg: str, capacity: int,
                              in_specs=(spec,) * 5, out_specs=(spec,) * 3))
 
 
-def dist_join(left: DTable, right: DTable, config: JoinConfig) -> DTable:
+def dist_join(left: DTable, right: DTable, config: JoinConfig,
+              dense_key_range=None) -> DTable:
     """Distributed equi-join: co-partition both sides on the key, then a
     masked local join per shard.  Output columns are ``lt-…``/``rt-…`` like
     the local join (reference join_utils.cpp:23-95).
@@ -286,13 +287,210 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig) -> DTable:
       SORT  sampled-splitter range partition (distributed sample-sort) +
             local sort-merge join — shards are ordered by key ranges, so
             the join output is additionally globally key-ordered.
+
+    ``dense_key_range=(lo, hi)``: caller hint that the RIGHT side's single
+    join key is **unique, non-null and within [lo, hi]** — the FK → PK
+    shape (fact table joining a base/dimension table on its primary key).
+    Eligible joins (INNER/LEFT, single non-dictionary int key, slot space
+    within budget) then skip both plan sorts and the run-length expansion
+    entirely: one scatter builds a key→row map, one gather probes it
+    (the direct-address idiom of the dense groupby/semi-join paths).  A
+    LEFT join additionally keeps the probe side zero-copy — N:1 joins
+    with referential integrity (every probe key present, the TPC-H
+    fact→dimension joins) should prefer LEFT for that reason; with no
+    unmatched probe rows the result equals INNER plus all-valid right
+    columns.  Hint violations (duplicate / null / out-of-range right
+    keys) fail loudly — they would silently drop matches.  world > 1
+    co-partitions by the MODULO router, compressing the per-shard slot
+    space to R/P exactly like the dense groupby.  NOTE: the fast path
+    partitions by key residue, NOT by key range — the SORT algorithm's
+    global key-ordering guarantee does not apply to a dense-hinted join
+    (order an output that needs it with dist_sort, as the TPC-H plans
+    do).
     """
+    if dense_key_range is not None:
+        out = _try_fk_join(left, right, config, dense_key_range)
+        if out is not None:
+            return out
     left, right, li_keys, ri_keys, alg, splitters = _join_prologue(
         left, right, config)
     lsh = _copartition(left, li_keys, alg, splitters)
     rsh = _copartition(right, ri_keys, alg, splitters)
     return _join_copartitioned(lsh, rsh, li_keys, ri_keys,
                                config.join_type.value, alg)
+
+
+@functools.lru_cache(maxsize=None)
+def _fk_probe_fn(mesh, axis: str, cap_l: int, cap_r: int, lo: int, hi: int,
+                 stride: int, has_lv: bool, has_rv: bool):
+    """Dense-unique-key join probe: ONE scatter of the right rows into a
+    key→row-index map over [lo, hi], ONE gather of the probe keys — the
+    N:1 join plan with no sort at all.  Returns the per-probe-row build
+    index (−1 = unmatched), the matched mask, and the replicated
+    validation vector [matched, right_oob, right_dups, right_nulls] per
+    shard (the last three are hint-contract violations: each silently
+    loses matches, so callers raise on any non-zero).  ``stride`` = world
+    size under modulo routing (one residue class per shard, slot space
+    R/P)."""
+    R = -(-(hi - lo + 1) // stride)
+
+    def kernel(l_cnt, r_cnt, lk, lv, rk, rv):
+        lvalid = jnp.arange(cap_l) < l_cnt[0]
+        rvalid = jnp.arange(cap_r) < r_cnt[0]
+        r_nonnull = rvalid & rv if has_rv else rvalid
+        l_nonnull = lvalid & lv if has_lv else lvalid
+        r_in = (rk >= lo) & (rk <= hi)
+        l_in = (lk >= lo) & (lk <= hi)
+        # subtract in the key dtype BEFORE narrowing: an int64 key past
+        # 2^31 would wrap under astype(int32) and alias a valid slot
+        # (in-range keys yield a base < R, which int32 always holds)
+        r_base = (rk - lo).astype(jnp.int32)
+        l_base = (lk - lo).astype(jnp.int32)
+        if stride > 1:
+            r_base = r_base // stride
+            l_base = l_base // stride
+        r_ok = r_nonnull & r_in
+        slot = jnp.where(r_ok, r_base, jnp.int32(R))
+        amap = jnp.full(R, -1, jnp.int32).at[slot].set(
+            jnp.arange(cap_r, dtype=jnp.int32), mode="drop")
+        oob = jnp.sum(r_nonnull & ~r_in).astype(jnp.int32)
+        dups = (jnp.sum(r_ok) - jnp.sum(amap >= 0)).astype(jnp.int32)
+        rnull = (jnp.sum(rvalid & ~rv).astype(jnp.int32) if has_rv
+                 else jnp.zeros((), jnp.int32))
+        m = jnp.take(amap, jnp.clip(l_base, 0, R - 1))
+        matched = l_nonnull & l_in & (m >= 0)
+        ri = jnp.where(matched, m, jnp.int32(-1))
+        n = jnp.sum(matched).astype(jnp.int32)
+        return matched, ri, jax.lax.all_gather(
+            jnp.stack([n, oob, dups, rnull]), axis)
+
+    spec = P(axis)
+    # check_vma=False: the all_gathered counts are replicated
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
+                             out_specs=(spec, spec, P()), check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _fk_rgather_fn(mesh, axis: str, nleaves: int, fill: bool):
+    """Gather the build-side output columns at the per-output build index
+    (−1 ⇒ null when ``fill``)."""
+
+    def kernel(ri, r_leaves):
+        return tuple(ops_gather.take_many(r_leaves, ri, fill_null=fill))
+
+    spec = P(axis)
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec),
+                             out_specs=spec))
+
+
+def _fk_violations(per_shard):
+    per_shard = per_shard.reshape(-1, 4)
+    oob, dups, rnull = (int(per_shard[:, 1].sum()),
+                        int(per_shard[:, 2].sum()),
+                        int(per_shard[:, 3].sum()))
+    if oob or dups or rnull:
+        raise CylonError(Status(Code.Invalid,
+            "dist_join dense_key_range contract violated on the right "
+            f"side: {oob} keys out of range, {dups} duplicate keys, "
+            f"{rnull} null keys (the hint promises unique non-null keys "
+            "within the range)"))
+    return per_shard
+
+
+def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
+                 dense_key_range) -> "DTable | None":
+    """Run the dense-unique-right-key join if eligible, else None (the
+    general path handles every shape; the hint is advisory for dispatch
+    but its CONTRACT — unique/non-null/in-range right keys — is enforced)."""
+    how = config.join_type.value
+    li_keys = _join_keys(left, config.left_column_idx)
+    ri_keys = _join_keys(right, config.right_column_idx)
+    if (how not in ("inner", "left")
+            or len(li_keys) != 1 or len(ri_keys) != 1):
+        return None
+    lkc = left.columns[li_keys[0]]
+    rkc = right.columns[ri_keys[0]]
+    if (lkc.dtype.type != rkc.dtype.type
+            or not jnp.issubdtype(lkc.data.dtype, jnp.integer)
+            or is_dictionary_encoded(lkc.dtype.type)):
+        return None
+    lo, hi = int(dense_key_range[0]), int(dense_key_range[1])
+    world = left.ctx.get_world_size()
+    stride = 1 if world == 1 else world
+    if hi < lo:
+        return None
+    R = -(-(hi - lo + 1) // stride)
+    if R > 4 * max(left.cap, right.cap):
+        return None  # same slot-space budget as the dense semi-join
+    if world > 1:
+        with trace.span("join.shuffle"):
+            left = _shuffle_by_pids(
+                left, _mod_pids(left, li_keys[0], lo, world))
+            right = _shuffle_by_pids(
+                right, _mod_pids(right, ri_keys[0], lo, world))
+        lkc = left.columns[li_keys[0]]
+        rkc = right.columns[ri_keys[0]]
+    ctx = left.ctx
+    mesh, axis = ctx.mesh, ctx.axis
+    with trace.span("join.count"):
+        matched, ri, cnts = _fk_probe_fn(
+            mesh, axis, left.cap, right.cap, lo, hi, stride,
+            lkc.validity is not None, rkc.validity is not None)(
+            left.counts, right.counts, lkc.data, lkc.validity,
+            rkc.data, rkc.validity)
+    r_leaves = tuple((c.data, c.validity) for c in right.columns)
+
+    from ..dtypes import Type
+    if how == "left":
+        # probe side zero-copy: every valid left row emits exactly once,
+        # in place — no compaction, no count read (capacity is static)
+        hint_key = ("fkleft", mesh, left.cap, right.cap, lo, hi, stride)
+        _capacity_hints.setdefault(hint_key, ((1,), 0))
+
+        def dispatch(sizes):
+            with trace.span("join.gather"):
+                return _fk_rgather_fn(mesh, axis, len(r_leaves), True)(
+                    ri, r_leaves)
+
+        def post(per_shard):
+            _fk_violations(per_shard)
+            return (1,)
+
+        routs, _, _ = ops_compact.optimistic_dispatch(
+            _capacity_hints, hint_key, dispatch, cnts, post)
+        cols = [DColumn("lt-" + c.name, c.dtype, c.data, c.validity,
+                        c.dictionary, c.arrow_type) for c in left.columns]
+        cols += [DColumn("rt-" + c.name, c.dtype, d, v, c.dictionary,
+                         c.arrow_type)
+                 for c, (d, v) in zip(right.columns, routs)]
+        return DTable(ctx, cols, left.cap, left.counts)
+
+    # INNER: compact the matched probe rows (the shared row-filter tail),
+    # carrying the build index as a rider column, then gather the build
+    # outputs at the compacted capacity
+    aug_cols = [DColumn("lt-" + c.name, c.dtype, c.data, c.validity,
+                        c.dictionary, c.arrow_type) for c in left.columns]
+    aug_cols.append(DColumn("__fk_ri", DataType(Type.INT32), ri, None))
+    aug = DTable(ctx, aug_cols, left.cap, left.counts)
+
+    def post(per_shard):
+        per_shard = _fk_violations(per_shard)
+        return (ops_compact.next_bucket(
+            max(int(per_shard[:, 0].max(initial=0)), 1), minimum=8),)
+
+    hint_key = ("fkinner", mesh, left.cap, right.cap, lo, hi, stride,
+                len(aug_cols))
+    out = _compact_survivors(aug, matched, cnts, hint_key, "join.gather",
+                             post=post)
+    ri_c = out.columns[-1].data
+    with trace.span("join.gather"):
+        routs = _fk_rgather_fn(mesh, axis, len(r_leaves), False)(
+            ri_c, r_leaves)
+    cols = list(out.columns[:-1])
+    cols += [DColumn("rt-" + c.name, c.dtype, d, v, c.dictionary,
+                     c.arrow_type)
+             for c, (d, v) in zip(right.columns, routs)]
+    return DTable(ctx, cols, out.cap, out.counts)
 
 
 def _join_keys(dt: DTable, spec) -> List[int]:
@@ -1315,8 +1513,10 @@ def _semi_mask_dense_fn(mesh, axis: str, cap_l: int, cap_r: int,
         l_in = (lk >= lo) & (lk <= hi)
         overflow = (jnp.sum(r_nonnull & ~r_in)
                     + jnp.sum(l_nonnull & ~l_in)).astype(jnp.int32)
-        r_base = rk.astype(jnp.int32) - lo
-        l_base = lk.astype(jnp.int32) - lo
+        # subtract in the key dtype BEFORE narrowing (int64 keys past 2^31
+        # would wrap under astype(int32) and alias a valid slot)
+        r_base = (rk - lo).astype(jnp.int32)
+        l_base = (lk - lo).astype(jnp.int32)
         if stride > 1:
             r_base = r_base // stride
             l_base = l_base // stride

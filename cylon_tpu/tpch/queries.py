@@ -199,8 +199,13 @@ def q3(ctx, t: Tables, segment: str = "BUILDING",
                                    "l_extendedprice", "l_discount"]),
                      _pred_gt("l_shipdate", day))
 
-    co = _strip_prefixes(dist_join(cust, orders, _cfg("c_custkey", "o_custkey")))
-    col = _strip_prefixes(dist_join(co, li, _cfg("o_orderkey", "l_orderkey")))
+    # FK → PK orientation: probe the fact side against the unique-key side
+    # (direct-address join, no sort)
+    co = _strip_prefixes(dist_join(orders, cust,
+                                   _cfg("o_custkey", "c_custkey"),
+                                   dense_key_range=_pk1(t, "customer")))
+    col = _strip_prefixes(dist_join(li, co, _cfg("l_orderkey", "o_orderkey"),
+                                    dense_key_range=_pk1(t, "orders")))
     col = dist_with_column(col, "volume", _revenue, Type.DOUBLE)
     g = dist_groupby(col, ["l_orderkey", "o_orderdate", "o_shippriority"],
                      [("volume", "sum")])
@@ -225,22 +230,27 @@ def q5(ctx, t: Tables, region: str = "ASIA",
         reg, _cfg("n_regionkey", "r_regionkey")))
     sn = _strip_prefixes(dist_join(
         dist_project(t["supplier"], ["s_suppkey", "s_nationkey"]), nr,
-        _cfg("s_nationkey", "n_nationkey")))
+        _cfg("s_nationkey", "n_nationkey"),
+        dense_key_range=_pk0(t, "nation")))
     sn = dist_project(sn, ["s_suppkey", "s_nationkey", "n_name"])
     orders = dist_project(
         dist_select(dist_project(t["orders"],
                                  ["o_orderkey", "o_custkey", "o_orderdate"]),
                     _pred_range("o_orderdate", d0, d0 + 365)),
         ["o_orderkey", "o_custkey"])
+    # FK → PK orientation throughout (see _pk1): the fact side probes
     co = _strip_prefixes(dist_join(
-        dist_project(t["customer"], ["c_custkey", "c_nationkey"]), orders,
-        _cfg("c_custkey", "o_custkey")))
+        orders, dist_project(t["customer"], ["c_custkey", "c_nationkey"]),
+        _cfg("o_custkey", "c_custkey", JoinType.LEFT),
+        dense_key_range=_pk1(t, "customer")))
     li = dist_project(t["lineitem"], ["l_orderkey", "l_suppkey",
                                       "l_extendedprice", "l_discount"])
-    col = _strip_prefixes(dist_join(co, li,
-                                    _cfg("o_orderkey", "l_orderkey")))
+    col = _strip_prefixes(dist_join(li, co,
+                                    _cfg("l_orderkey", "o_orderkey"),
+                                    dense_key_range=_pk1(t, "orders")))
     # join on suppkey, THEN enforce the spec's c_nationkey = s_nationkey
-    full = _strip_prefixes(dist_join(col, sn, _cfg("l_suppkey", "s_suppkey")))
+    full = _strip_prefixes(dist_join(col, sn, _cfg("l_suppkey", "s_suppkey"),
+                                     dense_key_range=_pk1(t, "supplier")))
     full = dist_select(full, _pred_cols_eq("c_nationkey", "s_nationkey"))
     full = dist_with_column(full, "volume", _revenue, Type.DOUBLE)
     g = dist_groupby(full, ["n_name"], [("volume", "sum")])
@@ -282,12 +292,18 @@ def q10(ctx, t: Tables, date: str = "1993-10-01", limit: int = 20) -> Table:
         ["l_orderkey", "l_extendedprice", "l_discount"])
     cust = dist_project(t["customer"], ["c_custkey", "c_nationkey",
                                         "c_acctbal"])
-    co = _strip_prefixes(dist_join(cust, orders,
-                                   _cfg("c_custkey", "o_custkey")))
-    col = _strip_prefixes(dist_join(co, li, _cfg("o_orderkey", "l_orderkey")))
+    # FK → PK orientation (see _pk1): facts probe, unique keys build
+    co = _strip_prefixes(dist_join(orders, cust,
+                                   _cfg("o_custkey", "c_custkey",
+                                        JoinType.LEFT),
+                                   dense_key_range=_pk1(t, "customer")))
+    col = _strip_prefixes(dist_join(li, co, _cfg("l_orderkey", "o_orderkey"),
+                                    dense_key_range=_pk1(t, "orders")))
     nat = dist_project(t["nation"], ["n_nationkey", "n_name"])
     full = _strip_prefixes(dist_join(col, nat,
-                                     _cfg("c_nationkey", "n_nationkey")))
+                                     _cfg("c_nationkey", "n_nationkey",
+                                          JoinType.LEFT),
+                                     dense_key_range=_pk0(t, "nation")))
     full = dist_with_column(full, "volume", _revenue, Type.DOUBLE)
     g = dist_groupby(full, ["c_custkey", "n_name", "c_acctbal"],
                      [("volume", "sum")])
@@ -379,11 +395,17 @@ def q9(ctx, t: Tables, color: str = "green") -> Table:
     sn = _strip_prefixes(dist_join(
         dist_project(t["supplier"], ["s_suppkey", "s_nationkey"]),
         dist_project(t["nation"], ["n_nationkey", "n_name"]),
-        _cfg("s_nationkey", "n_nationkey")))
-    lsn = _strip_prefixes(dist_join(lps, sn, _cfg("l_suppkey", "s_suppkey")))
+        _cfg("s_nationkey", "n_nationkey", JoinType.LEFT),
+        dense_key_range=_pk0(t, "nation")))
+    lsn = _strip_prefixes(dist_join(lps, sn,
+                                    _cfg("l_suppkey", "s_suppkey",
+                                         JoinType.LEFT),
+                                    dense_key_range=_pk1(t, "supplier")))
     orders = dist_project(t["orders"], ["o_orderkey", "o_orderdate"])
     full = _strip_prefixes(dist_join(lsn, orders,
-                                     _cfg("l_orderkey", "o_orderkey")))
+                                     _cfg("l_orderkey", "o_orderkey",
+                                          JoinType.LEFT),
+                                     dense_key_range=_pk1(t, "orders")))
     full = dist_with_column(full, "o_year", _year_col, Type.INT32)
     full = dist_with_column(full, "amount", _q9_amount, Type.DOUBLE)
     g = dist_groupby(full, ["n_name", "o_year"], [("amount", "sum")])
@@ -410,7 +432,9 @@ def q12(ctx, t: Tables, modes=("MAIL", "SHIP"),
     li = dist_project(li, ["l_orderkey", "l_shipmode"])
     orders = dist_project(t["orders"], ["o_orderkey", "o_orderpriority"])
     m = _strip_prefixes(dist_join(li, orders,
-                                  _cfg("l_orderkey", "o_orderkey")))
+                                  _cfg("l_orderkey", "o_orderkey",
+                                       JoinType.LEFT),
+                                  dense_key_range=_pk1(t, "orders")))
     hi = _dict_codes(t["orders"], "o_orderpriority", ("1-URGENT", "2-HIGH"))
     m = dist_with_column(m, "high_line", _indicator_isin("o_orderpriority",
                                                          hi), Type.INT32)
@@ -456,7 +480,10 @@ def q14(ctx, t: Tables, date: str = "1995-09-01") -> Table:
     promo = _dict_codes_where(t["part"], "p_type",
                               lambda s: s.startswith("PROMO"))
     part = dist_project(t["part"], ["p_partkey", "p_type"])
-    m = _strip_prefixes(dist_join(li, part, _cfg("l_partkey", "p_partkey")))
+    m = _strip_prefixes(dist_join(li, part,
+                                  _cfg("l_partkey", "p_partkey",
+                                       JoinType.LEFT),
+                                  dense_key_range=_pk1(t, "part")))
     m = dist_with_column(m, "rev", _revenue, Type.DOUBLE)
     m = dist_with_column(m, "promo_ind", _indicator_isin("p_type", promo),
                          Type.INT32)
@@ -487,9 +514,14 @@ def q18(ctx, t: Tables, quantity: float = 300.0, limit: int = 100) -> Table:
     orders = dist_project(t["orders"], ["o_orderkey", "o_custkey",
                                         "o_orderdate", "o_totalprice"])
     m = _strip_prefixes(dist_join(big, orders,
-                                  _cfg("l_orderkey", "o_orderkey")))
+                                  _cfg("l_orderkey", "o_orderkey",
+                                       JoinType.LEFT),
+                                  dense_key_range=_pk1(t, "orders")))
     cust = dist_project(t["customer"], ["c_custkey"])
-    m = _strip_prefixes(dist_join(m, cust, _cfg("o_custkey", "c_custkey")))
+    m = _strip_prefixes(dist_join(m, cust,
+                                  _cfg("o_custkey", "c_custkey",
+                                       JoinType.LEFT),
+                                  dense_key_range=_pk1(t, "customer")))
     m = dist_project(m, ["c_custkey", "o_orderkey", "o_orderdate",
                          "o_totalprice", "sum_l_quantity"])
     out = m.to_table()  # ≤ a few thousand rows survive the HAVING
@@ -575,6 +607,22 @@ def _region_nation_keys(t: Tables, region: str) -> tuple:
     rk = int(rdf[rdf["r_name"].astype(str) == region]["r_regionkey"].iloc[0])
     return tuple(int(k) for k in
                  ndf[ndf["n_regionkey"] == rk]["n_nationkey"])
+
+
+def _pk1(t: Tables, table: str):
+    """``dense_key_range`` for a 1-based base-table primary key
+    (c_custkey / o_orderkey / s_suppkey / p_partkey are 1..N by the spec's
+    dense-key construction — datagen.py).  Join legs probing a base (or
+    base-filtered) table pass this so dist_join runs the direct-address
+    FK → PK path; LEFT is used instead of INNER where the build side is
+    the FULL base table (referential integrity ⇒ identical result, and
+    the probe side stays zero-copy)."""
+    return (1, _table_rows(t[table]))
+
+
+def _pk0(t: Tables, table: str):
+    """Like ``_pk1`` for 0-based keys (n_nationkey, r_regionkey)."""
+    return (0, _table_rows(t[table]) - 1)
 
 
 def _table_rows(dt: DTable) -> int:
@@ -710,7 +758,8 @@ def q2(ctx, t: Tables, size: int = 15, type_suffix: str = "BRASS",
     sn = _strip_prefixes(dist_join(
         dist_project(t["supplier"], ["s_suppkey", "s_nationkey",
                                      "s_acctbal"]),
-        nr, _cfg("s_nationkey", "n_nationkey")))
+        nr, _cfg("s_nationkey", "n_nationkey"),
+        dense_key_range=_pk0(t, "nation")))
     sn = dist_project(sn, ["s_suppkey", "s_acctbal", "n_name"])
     tcodes = _dict_codes_where(t["part"], "p_type",
                                lambda s: s.endswith(type_suffix))
@@ -721,8 +770,10 @@ def q2(ctx, t: Tables, size: int = 15, type_suffix: str = "BRASS",
         ["p_partkey", "p_mfgr"])
     ps = dist_project(t["partsupp"],
                       ["ps_partkey", "ps_suppkey", "ps_supplycost"])
-    ps = _strip_prefixes(dist_join(ps, part, _cfg("ps_partkey", "p_partkey")))
-    full = _strip_prefixes(dist_join(ps, sn, _cfg("ps_suppkey", "s_suppkey")))
+    ps = _strip_prefixes(dist_join(ps, part, _cfg("ps_partkey", "p_partkey"),
+                                   dense_key_range=_pk1(t, "part")))
+    full = _strip_prefixes(dist_join(ps, sn, _cfg("ps_suppkey", "s_suppkey"),
+                                     dense_key_range=_pk1(t, "supplier")))
     mins = dist_groupby(full, ["ps_partkey"], [("ps_supplycost", "min")])
     mins = mins.rename(["mpk", "min_cost"])
     # MIN picks an existing value of the same column (no arithmetic), so
@@ -756,12 +807,16 @@ def q7(ctx, t: Tables, nation1: str = "FRANCE",
     cust = dist_select(dist_project(t["customer"],
                                     ["c_custkey", "c_nationkey"]),
                        _pred_isin("c_nationkey", (k1, k2)))
-    ls = _strip_prefixes(dist_join(li, supp, _cfg("l_suppkey", "s_suppkey")))
+    ls = _strip_prefixes(dist_join(li, supp, _cfg("l_suppkey", "s_suppkey"),
+                                   dense_key_range=_pk1(t, "supplier")))
     orders = dist_project(t["orders"], ["o_orderkey", "o_custkey"])
     lso = _strip_prefixes(dist_join(ls, orders,
-                                    _cfg("l_orderkey", "o_orderkey")))
+                                    _cfg("l_orderkey", "o_orderkey",
+                                         JoinType.LEFT),
+                                    dense_key_range=_pk1(t, "orders")))
     full = _strip_prefixes(dist_join(lso, cust,
-                                     _cfg("o_custkey", "c_custkey")))
+                                     _cfg("o_custkey", "c_custkey"),
+                                     dense_key_range=_pk1(t, "customer")))
     # both nationkeys ∈ {k1, k2}: inequality ⇔ the spec's (n1,n2)|(n2,n1)
     full = dist_select(full, _pred_cols_ne("s_nationkey", "c_nationkey"))
     full = dist_with_column(full, "l_year", _year_of("l_shipdate"),
@@ -802,15 +857,19 @@ def q8(ctx, t: Tables, nation: str = "BRAZIL", region: str = "AMERICA",
                                        "o_orderdate"]),
                          _pred_range_incl("o_orderdate", d0, d1))
     lpo = _strip_prefixes(dist_join(lp, orders,
-                                    _cfg("l_orderkey", "o_orderkey")))
+                                    _cfg("l_orderkey", "o_orderkey"),
+                                    dense_key_range=_pk1(t, "orders")))
     cust = dist_select(dist_project(t["customer"],
                                     ["c_custkey", "c_nationkey"]),
                        _pred_isin("c_nationkey", rkeys))
     lpoc = _strip_prefixes(dist_join(lpo, cust,
-                                     _cfg("o_custkey", "c_custkey")))
+                                     _cfg("o_custkey", "c_custkey"),
+                                     dense_key_range=_pk1(t, "customer")))
     supp = dist_project(t["supplier"], ["s_suppkey", "s_nationkey"])
     full = _strip_prefixes(dist_join(lpoc, supp,
-                                     _cfg("l_suppkey", "s_suppkey")))
+                                     _cfg("l_suppkey", "s_suppkey",
+                                          JoinType.LEFT),
+                                     dense_key_range=_pk1(t, "supplier")))
     full = dist_with_column(full, "o_year", _year_col, Type.INT32)
     full = dist_with_column(full, "volume", _revenue, Type.DOUBLE)
     full = dist_with_column(full, "nation_vol",
@@ -822,8 +881,11 @@ def q8(ctx, t: Tables, nation: str = "BRAZIL", region: str = "AMERICA",
     import pandas as pd
     out = pd.DataFrame({
         "o_year": out["o_year"].astype(np.int32),
+        # explicit f32: the device stores f32 (x64 off) and an implicit
+        # f64→f32 ingest narrowing warns
         "mkt_share": (out["sum_nation_vol"].astype(np.float64)
-                      / out["sum_volume"].astype(np.float64)),
+                      / out["sum_volume"].astype(np.float64))
+        .astype(np.float32),
     }).sort_values("o_year").reset_index(drop=True)
     return Table.from_pandas(ctx, out)
 
@@ -845,7 +907,8 @@ def q11(ctx, t: Tables, nation: str = "GERMANY",
     ps = dist_project(t["partsupp"],
                       ["ps_partkey", "ps_suppkey", "ps_supplycost",
                        "ps_availqty"])
-    ps = _strip_prefixes(dist_join(ps, supp, _cfg("ps_suppkey", "s_suppkey")))
+    ps = _strip_prefixes(dist_join(ps, supp, _cfg("ps_suppkey", "s_suppkey"),
+                                   dense_key_range=_pk1(t, "supplier")))
     ps = dist_with_column(ps, "value", _ps_value, Type.DOUBLE)
     # the HAVING threshold stays ON DEVICE (predicate param): no host
     # read, and the groupby below dispatches without waiting for it
@@ -931,7 +994,8 @@ def q16(ctx, t: Tables, bad_brand: str = "Brand#45",
     ps = dist_project(t["partsupp"], ["ps_partkey", "ps_suppkey"])
     ps = dist_anti_join(ps, badsup, "ps_suppkey", "s_suppkey",
                         dense_key_range=(1, _table_rows(t["supplier"])))
-    m = _strip_prefixes(dist_join(ps, part, _cfg("ps_partkey", "p_partkey")))
+    m = _strip_prefixes(dist_join(ps, part, _cfg("ps_partkey", "p_partkey"),
+                                  dense_key_range=_pk1(t, "part")))
     per = dist_groupby(m, ["p_brand", "p_type", "p_size", "ps_suppkey"],
                        [("ps_suppkey", "count")])
     g = dist_groupby(per, ["p_brand", "p_type", "p_size"],
@@ -961,7 +1025,9 @@ def q17(ctx, t: Tables, brand: str = "Brand#23",
                         dense_key_range=(1, _table_rows(t["part"])))
     avg = dist_groupby(li, ["l_partkey"], [("l_quantity", "mean")])
     avg = avg.rename(["apk", "avg_qty"])
-    m = _strip_prefixes(dist_join(li, avg, _cfg("l_partkey", "apk")))
+    m = _strip_prefixes(dist_join(li, avg,
+                                  _cfg("l_partkey", "apk", JoinType.LEFT),
+                                  dense_key_range=_pk1(t, "part")))
     sel = dist_select(m, _pred_cols_lt_scaled("l_quantity", 0.2, "avg_qty"))
     out = dist_aggregate(sel, [("l_extendedprice", "sum")]).to_pandas()
     import pandas as pd

@@ -129,6 +129,117 @@ def test_dist_join_sample_sort_globally_ordered(dctx, rng):
         prev_max = part["lt-k"].max()
 
 
+def _fk_dfs(rng, n_l=200, n_r=60, key_range=(1, 80)):
+    """FK → PK shape: right keys unique within [lo, hi], probe keys span
+    the range (some unmatched when n_r < range size)."""
+    lo, hi = key_range
+    rk = rng.permutation(np.arange(lo, hi + 1))[:n_r].astype(np.int64)
+    lk = rng.integers(lo, hi + 1, n_l).astype(np.int64)
+    ldf = pd.DataFrame({"k": lk, "a": rng.normal(size=n_l)})
+    rdf = pd.DataFrame({"k": rk, "b": rng.normal(size=n_r),
+                        "c": rng.integers(0, 9, n_r)})
+    return ldf, rdf
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_dist_join_dense_unique_right_vs_oracle(dctx, rng, how):
+    ldf, rdf = _fk_dfs(rng)
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf, n_empty_shards=2)
+    cfg = JoinConfig(JoinType(how), JoinAlgorithm.SORT, 0, 0)
+    ours = dist_join(lt, rt, cfg, dense_key_range=(1, 80)) \
+        .to_table().to_pandas()
+    assert_same_rows(ours, oracle_join(ldf, rdf, "k", "k", how))
+    # and identical row multiset to the general path
+    general = dist_join(lt, rt, cfg).to_table().to_pandas()
+    assert_same_rows(ours, general)
+
+
+def test_dist_join_dense_left_null_probe_keys(dctx, rng):
+    """Null probe keys never match a (non-null-keyed) right side; LEFT
+    emits them null-filled, INNER drops them."""
+    ldf = pd.DataFrame({"k": pd.array([1, None, 3, None, 2, 9], dtype="Int64"),
+                        "a": np.arange(6, dtype=np.float64)})
+    rdf = pd.DataFrame({"k": pd.array([1, 2, 3], dtype="Int64"),
+                        "b": [10., 20., 30.]})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    for how in ("inner", "left"):
+        cfg = JoinConfig(JoinType(how), JoinAlgorithm.SORT, 0, 0)
+        ours = dist_join(lt, rt, cfg, dense_key_range=(1, 9)) \
+            .to_table().to_pandas()
+        assert_same_rows(ours, oracle_join(ldf, rdf, "k", "k", how))
+
+
+def test_dist_join_dense_hint_violations_raise(dctx, rng):
+    from cylon_tpu.status import CylonError
+    ldf = pd.DataFrame({"k": np.array([1, 2, 3], dtype=np.int64),
+                        "a": [1., 2., 3.]})
+    lt = dtable_from_pandas(dctx, ldf)
+    cfg = JoinConfig.InnerJoin(0, 0)
+    # duplicate right keys
+    rdup = dtable_from_pandas(dctx, pd.DataFrame(
+        {"k": np.array([2, 2, 3], dtype=np.int64), "b": [1., 2., 3.]}))
+    with pytest.raises(CylonError, match="duplicate"):
+        dist_join(lt, rdup, cfg, dense_key_range=(1, 9)).to_table()
+    # out-of-range right keys
+    roob = dtable_from_pandas(dctx, pd.DataFrame(
+        {"k": np.array([2, 40], dtype=np.int64), "b": [1., 2.]}))
+    with pytest.raises(CylonError, match="out of range"):
+        dist_join(lt, roob, cfg, dense_key_range=(1, 9)).to_table()
+    # null right keys
+    rnull = dtable_from_pandas(dctx, pd.DataFrame(
+        {"k": pd.array([2, None], dtype="Int64"), "b": [1., 2.]}))
+    with pytest.raises(CylonError, match="null"):
+        dist_join(lt, rnull, cfg, dense_key_range=(1, 9)).to_table()
+
+
+def test_dist_join_dense_ineligible_falls_back(dctx, rng):
+    """FULL_OUTER and string keys are ineligible — the hint must be
+    silently ignored and the general path produce the oracle result."""
+    ldf, rdf = _fk_dfs(rng, n_l=50, n_r=20, key_range=(1, 30))
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    cfg = JoinConfig(JoinType.FULL_OUTER, JoinAlgorithm.SORT, 0, 0)
+    ours = dist_join(lt, rt, cfg, dense_key_range=(1, 30)) \
+        .to_table().to_pandas()
+    assert_same_rows(ours, oracle_join(ldf, rdf, "k", "k", "full_outer"))
+    sdf_l = pd.DataFrame({"k": ["a", "b", "c", "a"], "v": np.arange(4)})
+    sdf_r = pd.DataFrame({"k": ["b", "a"], "w": [1., 2.]})
+    ours = dist_join(dtable_from_pandas(dctx, sdf_l),
+                     dtable_from_pandas(dctx, sdf_r),
+                     JoinConfig.InnerJoin(0, 0), dense_key_range=(1, 30)) \
+        .to_table().to_pandas()
+    assert_same_rows(ours, oracle_join(sdf_l, sdf_r, "k", "k", "inner"))
+
+
+def test_dist_join_dense_keys_past_int32(dctx, rng):
+    """int64 keys straddling 2^31: the slot base must be computed in the
+    key dtype before any int32 narrowing (a wrapped base would alias a
+    valid slot and silently mis-join)."""
+    base = 2**31 - 50
+    rk = np.arange(base, base + 101, dtype=np.int64)
+    ldf = pd.DataFrame({"k": rng.choice(rk, 40).astype(np.int64),
+                        "a": rng.normal(size=40)})
+    rdf = pd.DataFrame({"k": rk, "b": rng.normal(size=101)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    ours = dist_join(lt, rt, JoinConfig.InnerJoin(0, 0),
+                     dense_key_range=(base, base + 100)) \
+        .to_table().to_pandas()
+    assert_same_rows(ours, oracle_join(ldf, rdf, "k", "k", "inner"))
+
+
+def test_dist_join_dense_empty_right(dctx, rng):
+    ldf = pd.DataFrame({"k": np.array([1, 2, 3], dtype=np.int64),
+                        "a": [1., 2., 3.]})
+    rdf = pd.DataFrame({"k": np.array([], dtype=np.int64),
+                        "b": np.array([], dtype=np.float64)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    assert dist_join(lt, rt, JoinConfig.InnerJoin(0, 0),
+                     dense_key_range=(1, 9)).to_table().num_rows == 0
+    out = dist_join(lt, rt, JoinConfig.LeftJoin(0, 0),
+                    dense_key_range=(1, 9)).to_table().to_pandas()
+    assert_same_rows(out, oracle_join(ldf, rdf, "k", "k", "left"))
+
+
 def test_dist_join_extreme_keys_and_nulls(dctx):
     M = np.iinfo(np.int64).max
     ldf = pd.DataFrame({"k": pd.array([M, None, 5, M, None, 3, 2, 1],
