@@ -24,8 +24,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..config import JoinAlgorithm, JoinConfig, JoinType
-from ..dtypes import Type
-from ..table import Table
+from ..dtypes import DataType, Type
+from ..table import Column, Table
 from ..parallel import (DTable, dist_aggregate, dist_anti_join, dist_groupby,
                         dist_head, dist_join, dist_project, dist_select,
                         dist_semi_join, dist_sort, dist_sort_multi,
@@ -488,13 +488,17 @@ def q14(ctx, t: Tables, date: str = "1995-09-01") -> Table:
     m = dist_with_column(m, "promo_ind", _indicator_isin("p_type", promo),
                          Type.INT32)
     m = dist_with_column(m, "promo_rev", _promo_rev, Type.DOUBLE)
-    out = dist_aggregate(m, [("promo_rev", "sum"),
-                             ("rev", "sum")]).to_pandas()
-    import pandas as pd
-    pr = float(out["sum_promo_rev"].iloc[0])
-    rv = float(out["sum_rev"].iloc[0])
-    return Table.from_pandas(ctx, pd.DataFrame(
-        {"promo_revenue": np.float32([100.0 * pr / rv if rv else 0.0])}))
+    # the ratio stays ON DEVICE: a mid-query .to_pandas() would cost a
+    # full sync round trip (~110 ms on the tunneled harness) just to do
+    # two-scalar arithmetic the device does for free; the lazy result
+    # table exports once, with the pipeline's batched flush (the Q6
+    # pattern)
+    agg = dist_aggregate(m, [("promo_rev", "sum"), ("rev", "sum")])
+    pr = agg.column("sum_promo_rev").data
+    rv = agg.column("sum_rev").data
+    val = jnp.where(rv != 0.0, 100.0 * pr / jnp.where(rv != 0.0, rv, 1.0),
+                    0.0)
+    return _scalar_table(ctx, "promo_revenue", val)
 
 
 def _promo_rev(env):
@@ -565,10 +569,8 @@ def q19(ctx, t: Tables) -> Table:
                                  (1.0, 10.0, 20.0), (11.0, 20.0, 30.0),
                                  (5, 10, 15)))
     m = dist_with_column(m, "rev", _revenue, Type.DOUBLE)
-    out = dist_aggregate(m, [("rev", "sum")]).to_pandas()
-    import pandas as pd
-    return Table.from_pandas(ctx, pd.DataFrame(
-        {"revenue": np.float32([float(out["sum_rev"].iloc[0])])}))
+    agg = dist_aggregate(m, [("rev", "sum")])
+    return _scalar_table(ctx, "revenue", agg.column("sum_rev").data)
 
 
 # ---------------------------------------------------------------------------
@@ -607,6 +609,15 @@ def _region_nation_keys(t: Tables, region: str) -> tuple:
     rk = int(rdf[rdf["r_name"].astype(str) == region]["r_regionkey"].iloc[0])
     return tuple(int(k) for k in
                  ndf[ndf["n_regionkey"] == rk]["n_nationkey"])
+
+
+def _scalar_table(ctx, name: str, val) -> Table:
+    """One-row FLOAT result table over a device scalar — the tail of every
+    scalar-answer query (Q14/Q17/Q19).  Keeping the value on device means
+    no mid-query host read; the table exports once with the pipeline's
+    batched flush (the Q6 pattern)."""
+    return Table(ctx, [Column(name, DataType(Type.FLOAT),
+                              val.astype(jnp.float32))])
 
 
 def _pk1(t: Tables, table: str):
@@ -1029,11 +1040,9 @@ def q17(ctx, t: Tables, brand: str = "Brand#23",
                                   _cfg("l_partkey", "apk", JoinType.LEFT),
                                   dense_key_range=_pk1(t, "part")))
     sel = dist_select(m, _pred_cols_lt_scaled("l_quantity", 0.2, "avg_qty"))
-    out = dist_aggregate(sel, [("l_extendedprice", "sum")]).to_pandas()
-    import pandas as pd
-    return Table.from_pandas(ctx, pd.DataFrame(
-        {"avg_yearly": np.float32(
-            [float(out["sum_l_extendedprice"].iloc[0]) / 7.0])}))
+    agg = dist_aggregate(sel, [("l_extendedprice", "sum")])
+    return _scalar_table(ctx, "avg_yearly",
+                         agg.column("sum_l_extendedprice").data / 7.0)
 
 
 # -- Q20: potential part promotion --------------------------------------------

@@ -45,7 +45,9 @@ def _stages_q14(ctx, t):
         li = s_select()
         part = dist_project(t["part"], ["p_partkey", "p_type"])
         return q._strip_prefixes(dist_join(li, part,
-                                           q._cfg("l_partkey", "p_partkey")))
+                                           q._cfg("l_partkey", "p_partkey",
+                                                  q.JoinType.LEFT),
+                                           dense_key_range=q._pk1(t, "part")))
 
     def s_full():
         return q.q14(ctx, t)
@@ -73,8 +75,10 @@ def _stages_q12(ctx, t):
         li = s_select()
         orders = dist_project(t["orders"], ["o_orderkey", "o_orderpriority"])
         return q._strip_prefixes(dist_join(li, orders,
-                                           q._cfg("l_orderkey",
-                                                  "o_orderkey")))
+                                           q._cfg("l_orderkey", "o_orderkey",
+                                                  q.JoinType.LEFT),
+                                           dense_key_range=q._pk1(t,
+                                                                  "orders")))
 
     def s_full():
         return q.q12(ctx, t)
