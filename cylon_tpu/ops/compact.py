@@ -218,14 +218,17 @@ def flush_pending_with(extra):
     # Entries queue in dispatch order, so everything after the first
     # undersized dispatch computed on truncated inputs — its counts are
     # poisoned (a zero-filled exchange can explode a downstream join
-    # count toward cap²) and must not feed the size hints.  The failing
-    # entry itself is trustworthy: its count came from inputs that
-    # validated.
+    # count toward cap², and a contract-validating post would raise a
+    # spurious hard error on the garbage) — skip their posts entirely;
+    # the replay re-dispatches and re-validates them on sound inputs.
+    # The failing entry itself is trustworthy: its count came from
+    # inputs that validated.
     trusted = _deferred.ok
     for (hints, key, hint, _, post), v in zip(batch, values):
+        if not trusted:
+            continue
         need = tuple(post(np.asarray(v)))
-        if trusted:
-            update_size_hint(hints, key, need)
+        update_size_hint(hints, key, need)
         if any(n > h for n, h in zip(need, hint)):
             _deferred.ok = False
             trusted = False

@@ -115,3 +115,44 @@ def test_pipeline_hint_miss_after_poisoned_dispatch(dctx, rng):
 
     got = run_pipeline(query)
     assert got == expect
+
+
+def test_contract_post_not_called_on_poisoned_counts(dctx, rng):
+    """An undersized upstream dispatch poisons every downstream queued
+    count; a contract-validating post (the dense FK join's duplicate/
+    range check) must NOT run on that garbage — it would raise a hard
+    CylonError instead of letting run_pipeline replay (the q9 SF-0.5
+    regression)."""
+    ldf, left = _mk(dctx, rng, 3000, 4000)
+    rdf, right = _mk(dctx, rng, 2000, 4000)
+    # pk large enough that its modulo shuffle truncates under the
+    # sabotaged (8, 8) exchange hint — truncation + clipped unpack gathers
+    # is what manufactures duplicate right keys
+    pk = pd.DataFrame({"k": np.arange(0, 4000, dtype=np.int32),
+                       "c": rng.random(4000).astype(np.float32)})
+    pkt = DTable.from_table(dctx, Table.from_pandas(dctx, pk))
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+
+    def query():
+        j = dist_join(left, right, cfg)
+        # LEFT: the zero-copy path's validation-only hint is seeded
+        # unconditionally (setdefault), so its contract check QUEUES even
+        # when upstream caps changed — exactly q9's failing shape
+        fk = dist_join(j.rename(["k", "v1", "k2", "v2"]), pkt,
+                       JoinConfig.LeftJoin(0, 0), dense_key_range=(0, 3999))
+        return fk.to_table().num_rows
+
+    expect = query()  # sync seeding of all hints
+    # sabotage the EXCHANGE hints: with the send block too small but the
+    # receive capacity roomy, the unpack's fill-0 compaction indices
+    # replicate row 0 over the phantom tail (newcount counts rows the
+    # truncated block never carried) — duplicate right keys, the exact
+    # garbage that made the FK join's queued contract check raise
+    assert any(k[0] == "fkleft" for k in dops._capacity_hints), \
+        "expected a seeded fkleft hint"
+    from cylon_tpu.parallel import shuffle as shmod
+    assert shmod._block_hints, "expected seeded shuffle hints"
+    for key in list(shmod._block_hints):
+        shmod._block_hints[key] = ((8, 256), 0)
+    got = run_pipeline(query)
+    assert got == expect
