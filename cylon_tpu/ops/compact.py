@@ -195,8 +195,10 @@ def flush_pending() -> bool:
     """Resolve every queued validation with one batched host read.
 
     Returns True when all hinted dispatches since the last flush were
-    correctly sized (accumulated into the region-level flag).  Always
-    updates size hints, so a failed region's replay dispatches correctly.
+    correctly sized (accumulated into the region-level flag).  Hints are
+    updated for the trusted prefix only — entries queued after the first
+    undersized dispatch carry poisoned counts, so their posts are skipped
+    entirely and the replay re-validates them on sound inputs.
     """
     ok, _ = flush_pending_with(())
     return ok

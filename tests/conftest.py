@@ -25,21 +25,38 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compile cache (same dir the bench uses): the suite's wall
 # time is dominated by CPU XLA compiles — a warm cache cuts a cold ~14 min
-# run to a few minutes (VERDICT r2 weak #5).
+# run to a few minutes (VERDICT r2 weak #5).  CYLON_TEST_NO_COMPILE_CACHE=1
+# disables it (diagnostic switch: the cache's native (de)serialization is
+# the one component outside this repo's control).
 _cache = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".jax_cache")
-try:
-    os.makedirs(_cache, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", _cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-except Exception:
-    pass  # cache is an optimization; never fail the suite over it
+if os.environ.get("CYLON_TEST_NO_COMPILE_CACHE", "0") in ("", "0"):
+    try:
+        os.makedirs(_cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # cache is an optimization; never fail the suite over it
 # env JAX_ENABLE_X64 is read at first jax import, which the environment's
 # sitecustomize performs before conftest runs — set it via the config API.
 jax.config.update("jax_enable_x64", True)
 
 CPU_DEVICES = jax.devices("cpu")
 jax.config.update("jax_default_device", CPU_DEVICES[0])
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _bound_jit_memory():
+    """Free compiled executables at module boundaries.
+
+    The suite compiles many hundreds of XLA:CPU programs in one process;
+    past a threshold the accumulated JIT state segfaults jaxlib natively
+    (observed in three different sites — compiler, cache serialize, cache
+    deserialize — always after ~290 tests).  Dropping the executable
+    caches per module bounds resident JIT memory; the persistent on-disk
+    cache makes any cross-module recompile a cheap reload."""
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture(scope="session")
