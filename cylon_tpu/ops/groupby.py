@@ -124,7 +124,10 @@ def dense_group_structure(key: jax.Array, key_validity, row_valid,
         null_rows = None
     in_range = (key >= lo) & (key <= hi)
     overflow = jnp.sum(nonnull & ~in_range).astype(jnp.int32)
-    base = key.astype(jnp.int32) - lo
+    # subtract in the key dtype BEFORE narrowing: an int64 key past 2^31
+    # would wrap under astype(int32) and alias a valid slot (in-range keys
+    # always yield a base < R, which int32 holds)
+    base = (key - lo).astype(jnp.int32)
     slot = jnp.where(nonnull & in_range,
                      base // stride if stride > 1 else base,
                      jnp.int32(R + 1))
@@ -156,7 +159,11 @@ def dense_groupby_aggregate(slot: jax.Array, counts: jax.Array,
     present = counts > 0
     starts = compact_indices(present, out_capacity, fill=-1)  # slot per group
     safe = jnp.clip(starts, 0, R1 - 1)
-    key_data = (lo + safe * stride + phase).astype(key_dtype)
+    # reconstruct in the key dtype (not int32-then-cast): lo past 2^31
+    # must not wrap — mirror of the subtract-before-narrow rule in
+    # dense_group_structure
+    key_data = (jnp.asarray(lo, key_dtype) + safe.astype(key_dtype) * stride
+                + phase)
     key_valid = None
     if has_null_slot:
         key_valid = (starts >= 0) & (safe != R1 - 1)  # slot R ⇒ null key

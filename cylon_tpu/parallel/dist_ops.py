@@ -966,7 +966,11 @@ def _mod_pids_fn(mesh, axis: str, cap: int, lo: int, nparts: int,
                  has_kv: bool):
     def kernel(cnt_blk, kd, kv):
         mask = jnp.arange(cap) < cnt_blk[0]
-        base = kd.astype(jnp.int32) - lo
+        # subtract in the key dtype BEFORE narrowing (the rule the dense
+        # probes document): an int64 key past 2^31 would wrap under
+        # astype(int32) and alias a residue class; in-range keys always
+        # yield a base int32 holds
+        base = (kd - lo).astype(jnp.int32)
         pid = jnp.where(base >= 0, base % nparts, 0)
         if has_kv:
             pid = jnp.where(kv, pid, 0)

@@ -1036,6 +1036,11 @@ def q17(ctx, t: Tables, brand: str = "Brand#23",
                         dense_key_range=(1, _table_rows(t["part"])))
     avg = dist_groupby(li, ["l_partkey"], [("l_quantity", "mean")])
     avg = avg.rename(["apk", "avg_qty"])
+    # NOTE: at realistic scales this hint does NOT fire — R = |part| far
+    # exceeds the 4x-cap slot budget of the brand/container-filtered
+    # inputs, so _try_fk_join declines and the leg runs the general sort
+    # path (both sides are tiny post-filter, so that is fine); the hint
+    # only engages at the small test scales where the budget holds
     m = _strip_prefixes(dist_join(li, avg,
                                   _cfg("l_partkey", "apk", JoinType.LEFT),
                                   dense_key_range=_pk1(t, "part")))

@@ -189,7 +189,7 @@ def test_dist_join_dense_hint_violations_raise(dctx, rng):
     # null right keys
     rnull = dtable_from_pandas(dctx, pd.DataFrame(
         {"k": pd.array([2, None], dtype="Int64"), "b": [1., 2.]}))
-    with pytest.raises(CylonError, match="null"):
+    with pytest.raises(CylonError, match="null keys"):
         dist_join(lt, rnull, cfg, dense_key_range=(1, 9)).to_table()
 
 
@@ -749,6 +749,22 @@ def test_dist_groupby_dense_null_keys_and_where(dctx, rng):
                          where=pred,
                          dense_key_range=(0, 29)).to_table().to_pandas()
     assert_same_rows(dense, plain)
+
+
+def test_dist_groupby_dense_keys_past_int32(dctx, rng):
+    """int64 group keys straddling 2^31: slot base and key reconstruction
+    must both run in the key dtype (narrow-before-subtract would alias
+    slots; int32 reconstruction would wrap the emitted keys)."""
+    base = 2**31 - 20
+    keys = rng.integers(base, base + 41, 300).astype(np.int64)
+    df = pd.DataFrame({"k": keys, "v": rng.normal(size=300)})
+    dt = dtable_from_pandas(dctx, df)
+    out = dist_groupby(dt, ["k"], [("v", "sum"), ("v", "count")],
+                       dense_key_range=(base, base + 40)) \
+        .to_table().to_pandas()
+    w = df.groupby("k")["v"].agg(["sum", "count"]).reset_index()
+    w.columns = ["k", "sum_v", "count_v"]
+    assert_same_rows(out, w)
 
 
 def test_dist_groupby_dense_range_violation_raises(dctx, rng):
