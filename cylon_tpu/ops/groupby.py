@@ -142,7 +142,8 @@ def dense_groupby_aggregate(slot: jax.Array, counts: jax.Array,
                             value_cols, value_validities,
                             aggs: Tuple[str, ...], out_capacity: int,
                             lo: int, key_dtype, has_null_slot: bool,
-                            stride: int = 1, phase=0):
+                            stride: int = 1, phase=0,
+                            emit_empty: bool = False, hi: int = None):
     """Phase 2 of the dense path: per-agg scatter into the [R+1] slot
     space, then compact the non-empty slots into ``out_capacity``.
 
@@ -152,12 +153,43 @@ def dense_groupby_aggregate(slot: jax.Array, counts: jax.Array,
     (key_data[C], key_validity[C] or None, agg_arrays, agg_validities,
     ngroups), matching the sort path's contract (entries past the group
     count are unspecified).
+
+    ``emit_empty=True`` emits EVERY in-range key as a group, including
+    keys with zero matching rows (count 0 / sum 0 / null min-max-mean) —
+    the direct-address answer to "LEFT join a key universe just to keep
+    the zero groups" (TPC-H Q13's zero-order customers).  The null-key
+    group still appears only when null keys exist.
     """
     from ..dtypes import extreme_value
     from .compact import compact_indices
     R1 = counts.shape[0]
-    present = counts > 0
-    starts = compact_indices(present, out_capacity, fill=-1)  # slot per group
+    nreal = R1 - 1          # slots [0, nreal) = real keys; nreal = nulls
+    if emit_empty:
+        idx = jnp.arange(out_capacity, dtype=jnp.int32)
+        null_present = (counts[nreal] > 0) if has_null_slot \
+            else jnp.zeros((), bool)
+        # residues near the top of an uneven range have one fewer slot —
+        # an emitted key must stay ≤ hi (the caller's range ceiling).
+        # Widest available int: int32 with x64 off (same key-width limit
+        # the rest of the device path documents)
+        kdt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        key_of = jnp.asarray(lo, kdt) + idx.astype(kdt) * stride + phase
+        real = (idx < nreal) & (key_of <= (hi if hi is not None
+                                           else lo + nreal * stride - 1))
+        # ``real`` is a PREFIX of idx (key_of is monotone in idx), but on
+        # a shard whose residue class is one key short it ends at m =
+        # nreal − 1, not nreal — the null group must sit at position m
+        # (first free row), not at nreal, or consumers reading rows
+        # [0, ngroups) would see a garbage row and lose the null group
+        m = jnp.sum(real).astype(jnp.int32)
+        starts = jnp.where(real, idx,
+                           jnp.where((idx == m) & null_present,
+                                     jnp.int32(nreal), jnp.int32(-1)))
+        ngroups = m + null_present.astype(jnp.int32)
+    else:
+        present = counts > 0
+        starts = compact_indices(present, out_capacity, fill=-1)
+        ngroups = jnp.sum(present).astype(jnp.int32)
     safe = jnp.clip(starts, 0, R1 - 1)
     # reconstruct in the key dtype (not int32-then-cast): lo past 2^31
     # must not wrap — mirror of the subtract-before-narrow rule in
@@ -215,7 +247,6 @@ def dense_groupby_aggregate(slot: jax.Array, counts: jax.Array,
                 else init.at[slot].max(masked, mode="drop"))
         outs.append(jnp.take(scat, safe))
         out_valids.append(cnt > 0)
-    ngroups = jnp.sum(present).astype(jnp.int32)
     return key_data, key_valid, tuple(outs), tuple(out_valids), ngroups
 
 

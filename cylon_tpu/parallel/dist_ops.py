@@ -71,6 +71,13 @@ def _resolve_ids(dt: DTable, cols: Sequence[Union[int, str]]) -> List[int]:
     return [dt.column_index(c) for c in cols]
 
 
+def _cleared(dt: DTable) -> DTable:
+    """A handle on the same blocks with the pending mask dropped — used by
+    callers that have already folded the mask into their partition ids
+    (the shuffle then must NOT collapse it a second time)."""
+    return DTable(dt.ctx, dt.columns, dt.cap, dt.counts)
+
+
 @functools.lru_cache(maxsize=None)
 def _hash_pids_fn(mesh, axis: str, cap: int, nparts: int, use_pallas: bool):
     def kernel(cnt_blk, cols, valids):
@@ -140,8 +147,10 @@ def _unify_dtable_dicts(a: DTable, b: DTable,
         changed = True
     if not changed:
         return a, b
-    return (DTable(a.ctx, acols, a.cap, a.counts),
-            DTable(b.ctx, bcols, b.cap, b.counts))
+    return (DTable(a.ctx, acols, a.cap, a.counts, a.pending_mask,
+                   a.pending_cnts),
+            DTable(b.ctx, bcols, b.cap, b.counts, b.pending_mask,
+                   b.pending_cnts))
 
 
 # ---------------------------------------------------------------------------
@@ -150,6 +159,14 @@ def _unify_dtable_dicts(a: DTable, b: DTable,
 
 def _shuffle_by_pids(dt: DTable, pid: jax.Array) -> DTable:
     """Exchange rows to their target shards; rebuild the DTable."""
+    if dt.pending_mask is not None:
+        # ``pid`` was computed against THESE blocks — a deferred select
+        # must have been folded into it (dropped-partition routing, via a
+        # _cleared handle) or collapsed before the pid computation; seeing
+        # one here is a caller bug, and collapsing now would desync shapes
+        raise CylonError(Status(Code.ExecutionError,
+            "internal: shuffle of a mask-carrying DTable (fold the "
+            "pending mask into the partition ids or collapse first)"))
     if dt.ctx.get_world_size() == 1:
         return dt  # one shard: every row is already home; no collective
     leaves: List[jax.Array] = []
@@ -177,6 +194,17 @@ def _shuffle_by_pids(dt: DTable, pid: jax.Array) -> DTable:
     return DTable(dt.ctx, cols, outcap, newcounts)
 
 
+def _shuffle_masked(dt: DTable, pid: jax.Array) -> DTable:
+    """Shuffle with any deferred-select mask folded into the routing:
+    masked-out rows go to the dropped partition and never cross the wire
+    (the same pushdown dist_groupby's ``where`` rides)."""
+    if dt.pending_mask is not None:
+        pid = jnp.where(dt.pending_mask, pid,
+                        jnp.int32(dt.ctx.get_world_size()))
+        dt = _cleared(dt)
+    return _shuffle_by_pids(dt, pid)
+
+
 def shuffle_table(dt: DTable, key_columns: Sequence[Union[int, str]]
                   ) -> DTable:
     """Hash-repartition rows so equal keys co-locate on one shard.
@@ -185,6 +213,7 @@ def shuffle_table(dt: DTable, key_columns: Sequence[Union[int, str]]
     ArrowAllToAll + concat collapsed into partition-ids + one two-phase
     all_to_all exchange.
     """
+    dt._collapse_pending()
     key_ids = _resolve_ids(dt, key_columns)
     return _shuffle_by_pids(dt, _hash_pids(dt, key_ids))
 
@@ -322,7 +351,8 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig,
 
 @functools.lru_cache(maxsize=None)
 def _fk_probe_fn(mesh, axis: str, cap_l: int, cap_r: int, lo: int, hi: int,
-                 stride: int, has_lv: bool, has_rv: bool):
+                 stride: int, has_lv: bool, has_rv: bool,
+                 has_lmask: bool = False):
     """Dense-unique-key join probe: ONE scatter of the right rows into a
     key→row-index map over [lo, hi], ONE gather of the probe keys — the
     N:1 join plan with no sort at all.  Returns the per-probe-row build
@@ -334,8 +364,10 @@ def _fk_probe_fn(mesh, axis: str, cap_l: int, cap_r: int, lo: int, hi: int,
     R/P)."""
     R = -(-(hi - lo + 1) // stride)
 
-    def kernel(l_cnt, r_cnt, lk, lv, rk, rv):
+    def kernel(l_cnt, r_cnt, lk, lv, rk, rv, *maybe_lmask):
         lvalid = jnp.arange(cap_l) < l_cnt[0]
+        if has_lmask:  # deferred-select fusion: filter rides the probe
+            lvalid = lvalid & maybe_lmask[0]
         rvalid = jnp.arange(cap_r) < r_cnt[0]
         r_nonnull = rvalid & rv if has_rv else rvalid
         l_nonnull = lvalid & lv if has_lv else lvalid
@@ -365,8 +397,9 @@ def _fk_probe_fn(mesh, axis: str, cap_l: int, cap_r: int, lo: int, hi: int,
             jnp.stack([n, oob, dups, rnull]), axis)
 
     spec = P(axis)
+    nargs = 6 + int(has_lmask)
     # check_vma=False: the all_gathered counts are replicated
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * nargs,
                              out_specs=(spec, spec, P()), check_vma=False))
 
 
@@ -422,9 +455,14 @@ def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
     R = -(-(hi - lo + 1) // stride)
     if R > 4 * max(left.cap, right.cap):
         return None  # same slot-space budget as the dense semi-join
+    # a deferred select on the BUILD side would change which keys exist —
+    # compact it (build sides are dimension-sized); the PROBE side's mask
+    # fuses: INNER folds it into `matched` (one shared compaction), LEFT
+    # keeps the zero-copy probe and passes the mask through to the output
+    right._collapse_pending()
     if world > 1:
         with trace.span("join.shuffle"):
-            left = _shuffle_by_pids(
+            left = _shuffle_masked(
                 left, _mod_pids(left, li_keys[0], lo, world))
             right = _shuffle_by_pids(
                 right, _mod_pids(right, ri_keys[0], lo, world))
@@ -432,12 +470,14 @@ def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
         rkc = right.columns[ri_keys[0]]
     ctx = left.ctx
     mesh, axis = ctx.mesh, ctx.axis
+    has_lm = how == "inner" and left.pending_mask is not None
+    lm_args = (left.pending_mask,) if has_lm else ()
     with trace.span("join.count"):
         matched, ri, cnts = _fk_probe_fn(
             mesh, axis, left.cap, right.cap, lo, hi, stride,
-            lkc.validity is not None, rkc.validity is not None)(
+            lkc.validity is not None, rkc.validity is not None, has_lm)(
             left.counts, right.counts, lkc.data, lkc.validity,
-            rkc.data, rkc.validity)
+            rkc.data, rkc.validity, *lm_args)
     r_leaves = tuple((c.data, c.validity) for c in right.columns)
 
     from ..dtypes import Type
@@ -463,7 +503,10 @@ def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
         cols += [DColumn("rt-" + c.name, c.dtype, d, v, c.dictionary,
                          c.arrow_type)
                  for c, (d, v) in zip(right.columns, routs)]
-        return DTable(ctx, cols, left.cap, left.counts)
+        # a deferred select on the probe side stays deferred: the attach
+        # is zero-copy, so the mask keeps describing the output's rows
+        return DTable(ctx, cols, left.cap, left.counts,
+                      left.pending_mask, left.pending_cnts)
 
     # INNER: compact the matched probe rows (the shared row-filter tail),
     # carrying the build index as a rider column, then gather the build
@@ -479,7 +522,7 @@ def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
             max(int(per_shard[:, 0].max(initial=0)), 1), minimum=8),)
 
     hint_key = ("fkinner", mesh, left.cap, right.cap, lo, hi, stride,
-                len(aug_cols))
+                len(aug_cols), has_lm)
     out = _compact_survivors(aug, matched, cnts, hint_key, "join.gather",
                              post=post)
     ri_c = out.columns[-1].data
@@ -506,6 +549,11 @@ def _join_keys(dt: DTable, spec) -> List[int]:
 def _join_prologue(left: DTable, right: DTable, config: JoinConfig):
     """Shared setup for the one-shot and streaming joins: key resolution,
     type check, dictionary unification, algorithm + sort splitters."""
+    # the general join's plan sorts want compacted inputs (a deferred
+    # select's padding would ride every sort operand); only the dense
+    # paths consume a pending mask in place
+    left._collapse_pending()
+    right._collapse_pending()
     li_keys = _join_keys(left, config.left_column_idx)
     ri_keys = _join_keys(right, config.right_column_idx)
     if len(li_keys) != len(ri_keys):
@@ -647,6 +695,8 @@ def _setop_fn(mesh, axis: str, op: str, cap_a: int, cap_b: int,
 
 
 def _dist_set_op(a: DTable, b: DTable, op: str) -> DTable:
+    a._collapse_pending()
+    b._collapse_pending()
     a.verify_same_schema(b)
     a, b = _unify_dtable_dicts(a, b, range(a.num_columns),
                                range(b.num_columns))
@@ -785,7 +835,8 @@ def _dense_phase1_fn(mesh, axis: str, cap: int, lo: int, hi: int,
 @functools.lru_cache(maxsize=None)
 def _dense_phase2_fn(mesh, axis: str, aggs: Tuple[str, ...], out_cap: int,
                      lo: int, key_dtype_str: str, has_null_slot: bool,
-                     slot_map: Tuple[int, ...], stride: int):
+                     slot_map: Tuple[int, ...], stride: int,
+                     emit_empty: bool = False, hi: int = None):
     def kernel(slot, counts, val_leaves):
         import numpy as _np
         vcols = tuple(val_leaves[j][0] for j in slot_map)
@@ -795,7 +846,7 @@ def _dense_phase2_fn(mesh, axis: str, aggs: Tuple[str, ...], out_cap: int,
         kd, kv, outs, ovals, ng = ops_groupby.dense_groupby_aggregate(
             slot, counts, vcols, vvals, aggs, out_cap, lo,
             _np.dtype(key_dtype_str), has_null_slot,
-            stride=stride, phase=phase)
+            stride=stride, phase=phase, emit_empty=emit_empty, hi=hi)
         return ((kd, kv), outs, ovals, ng[None])
 
     spec = P(axis)
@@ -814,6 +865,7 @@ _GROUP_HINTS_MAX = 256
 def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
                  aggregations: Sequence[Tuple[Union[int, str], str]],
                  where=None, dense_key_range=None, pre_aggregate=None,
+                 emit_empty: bool = False,
                  _local_only: bool = False) -> DTable:
     """Distributed groupby-aggregate: shuffle on key hash (equal keys
     co-locate ⇒ each group lives wholly on one shard), then the local
@@ -839,6 +891,13 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     dense_group_structure).  A key outside the range fails loudly (never
     aliases); the hint is ignored when the slot space would exceed 4x the
     shard capacity (memory guard) or the key shape doesn't qualify.
+
+    ``emit_empty=True`` (requires an engaged ``dense_key_range``) emits
+    EVERY key in [lo, hi] as a group, zero-count keys included (count 0,
+    sum 0, null min/max/mean) — the direct-address replacement for "LEFT
+    join the key universe to keep its zero groups" (TPC-H Q13's
+    zero-order customers).  Raises when the dense path cannot engage:
+    the caller's plan depends on the zeros actually appearing.
 
     ``pre_aggregate`` (default: auto = on for world > 1): every supported
     aggregation is decomposable, so each shard aggregates its OWN rows
@@ -875,6 +934,11 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
                 and 0 < hi - lo + 1
                 and -(-(hi - lo + 1) // stride) <= 4 * dt.cap):
             dense = (lo, hi, stride)
+    if emit_empty and dense is None:
+        raise CylonError(Status(Code.Invalid,
+            "emit_empty requires an engaged dense_key_range (integer "
+            "non-dictionary single key, slot space within 4x capacity) — "
+            "the zero-count groups only exist on the direct-address path"))
     if pre_aggregate is None:
         near_unique = (dense_key_range is not None and len(key_ids) == 1
                        and (int(dense_key_range[1])
@@ -882,8 +946,8 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
         pre_aggregate = world > 1 and not _local_only and not near_unique
     if world > 1 and pre_aggregate and not _local_only:
         return _dist_groupby_preagg(dt, key_ids, aggregations, where,
-                                    dense_key_range)
-    pmask = None if where is None else _predicate_mask(dt, where)
+                                    dense_key_range, emit_empty)
+    pmask = _effective_mask(dt, where)
     if world == 1 or _local_only:
         sh = dt
     else:
@@ -896,7 +960,7 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
                 # filter pushdown: failing rows never enter the exchange
                 pid = jnp.where(pmask, pid, jnp.int32(dt.ctx.get_world_size()))
                 pmask = None  # rows arrive pre-filtered
-            sh = _shuffle_by_pids(dt, pid)
+            sh = _shuffle_by_pids(_cleared(dt), pid)
     mesh, axis = dt.ctx.mesh, dt.ctx.axis
     key_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
                        for i in key_ids)
@@ -906,7 +970,8 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     if dense is not None:
         return _dist_groupby_dense(
             dt, sh, sh.columns[key_ids[0]], key_ids[0], val_leaves,
-            uniq_ids, slot_map, aggs, aggregations, dense, pmask, where)
+            uniq_ids, slot_map, aggs, aggregations, dense, pmask, where,
+            emit_empty)
 
     with trace.span("groupby.count"):
         args = ((sh.counts, key_leaves, val_leaves)
@@ -918,7 +983,8 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     # belong in the hint key — two different groupbys sharing one hint
     # would mis-hint each other into redundant redispatches/replays
     # (predicates are identity-hashable, same as _select_cache's key)
-    hint_key = (mesh, sh.cap, aggs, tuple(key_ids), where)
+    hint_key = (mesh, sh.cap, aggs, tuple(key_ids), where,
+                pmask is not None)
     while len(_group_cap_hints) > _GROUP_HINTS_MAX:
         _group_cap_hints.pop(next(iter(_group_cap_hints)))
 
@@ -983,7 +1049,8 @@ def _mod_pids_fn(mesh, axis: str, cap: int, lo: int, nparts: int,
 
 def _dist_groupby_dense(dt: DTable, sh: DTable, kc: DColumn, key_id: int,
                         val_leaves, uniq_ids, slot_map, aggs, aggregations,
-                        dense, pmask, where) -> DTable:
+                        dense, pmask, where,
+                        emit_empty: bool = False) -> DTable:
     """Direct-address tail of dist_groupby (dense_key_range hint)."""
     lo, hi, stride = dense
     mesh, axis = dt.ctx.mesh, dt.ctx.axis
@@ -995,15 +1062,22 @@ def _dist_groupby_dense(dt: DTable, sh: DTable, kc: DColumn, key_id: int,
             pmask is not None, stride)(*args)
 
     hint_key = (mesh, sh.cap, aggs, ("dense", key_id, lo, hi, stride),
-                where)
+                where, pmask is not None, emit_empty)
     while len(_group_cap_hints) > _GROUP_HINTS_MAX:
         _group_cap_hints.pop(next(iter(_group_cap_hints)))
+    if emit_empty:
+        # group count is R/stride (+1 null) by construction — the first
+        # dispatch can be sized exactly, no optimistic miss possible
+        R_shard = -(-(hi - lo + 1) // stride)
+        _group_cap_hints.setdefault(
+            hint_key, ((ops_compact.next_bucket(R_shard + 1, minimum=8),),
+                       0))
 
     def dispatch(sizes):
         return _dense_phase2_fn(mesh, axis, aggs, sizes[0], lo,
                                 str(kc.data.dtype),
                                 kc.validity is not None, slot_map,
-                                stride)(
+                                stride, emit_empty, hi)(
             slot, counts, val_leaves)
 
     def post(per_shard):
@@ -1033,7 +1107,8 @@ def _dist_groupby_dense(dt: DTable, sh: DTable, kc: DColumn, key_id: int,
 
 
 def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
-                         where, dense_key_range) -> DTable:
+                         where, dense_key_range,
+                         emit_empty: bool = False) -> DTable:
     """Two-level aggregation tail of dist_groupby (``pre_aggregate``):
     local per-shard groupby (no exchange) → shuffle the tiny partial-group
     table → combining groupby (sum of sums, sum of counts, min of mins,
@@ -1059,9 +1134,13 @@ def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
             plan.append((op, _p(ci, "count")))
         else:
             plan.append((op, _p(ci, op)))
+    # emit_empty rides the LOCAL pass only: with every shard emitting the
+    # full key range, every key reaches the combine as ≥1 partial row, so
+    # the zero groups survive it without a second emit-empty pass
     part = dist_groupby(dt, key_ids, partial, where=where,
                         dense_key_range=dense_key_range,
-                        pre_aggregate=False, _local_only=True)
+                        pre_aggregate=False, _local_only=True,
+                        emit_empty=emit_empty)
     comb_op = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
     comb = dist_groupby(part, list(range(K)),
                         [(K + j, comb_op[op]) for j, (_, op)
@@ -1154,7 +1233,7 @@ def dist_aggregate(dt: DTable,
     """
     val_ids = [dt.column_index(c) for c, _ in aggregations]
     aggs = tuple(op for _, op in aggregations)
-    pmask = None if where is None else _predicate_mask(dt, where)
+    pmask = _effective_mask(dt, where)
     val_leaves = tuple((dt.columns[i].data, dt.columns[i].validity)
                        for i in val_ids)
     args = (dt.counts, val_leaves) + (() if pmask is None else (pmask,))
@@ -1385,9 +1464,10 @@ def _masked_predicate(names, predicate, base_mask, leaves, params=()):
 
 
 def _predicate_mask(dt: DTable, predicate) -> jax.Array:
-    """Row mask [P*cap] for ``predicate``, AND'ed with the valid-row mask.
-    Pure elementwise — XLA propagates the mesh sharding; used by the
-    filter-pushdown paths (dist_groupby ``where``)."""
+    """Row mask [P*cap] for ``predicate``, AND'ed with the valid-row mask
+    (and any deferred-select mask the table carries).  Pure elementwise —
+    XLA propagates the mesh sharding; used by the filter-pushdown paths
+    (dist_groupby ``where``)."""
     names = tuple(c.name for c in dt.columns)
     key = ("pmask", dt.cap, names, predicate)
     fn = _select_cache.get(key)
@@ -1397,7 +1477,17 @@ def _predicate_mask(dt: DTable, predicate) -> jax.Array:
 
         fn = _cache_put(key, jax.jit(kernel))
     leaves = tuple((c.data, c.validity) for c in dt.columns)
-    return fn(_row_mask(dt), leaves)
+    base = _row_mask(dt) if dt.pending_mask is None else dt.pending_mask
+    return fn(base, leaves)
+
+
+def _effective_mask(dt: DTable, where) -> "jax.Array | None":
+    """The fused row filter a mask-aware consumer should apply: the
+    ``where`` predicate (if any) AND the table's deferred-select mask (if
+    any); None when neither exists (the cheap no-ballast path)."""
+    if where is not None:
+        return _predicate_mask(dt, where)  # folds pending itself
+    return dt.pending_mask
 
 
 # Last bucketed output capacity per select signature (optimistic dispatch,
@@ -1454,7 +1544,8 @@ def _compact_survivors(dt: DTable, mask: jax.Array, cnts, hint_key,
     return DTable(dt.ctx, cols, used[0], counts)
 
 
-def dist_select(dt: DTable, predicate, params=()) -> DTable:
+def dist_select(dt: DTable, predicate, params=(), compact: bool = True
+                ) -> DTable:
     """Distributed row filter: ``predicate`` maps {column name: sharded data
     array} → bool mask; surviving rows compact into a size-class block
     bucketed to the max per-shard survivor count.  Purely local compute —
@@ -1467,15 +1558,27 @@ def dist_select(dt: DTable, predicate, params=()) -> DTable:
     threshold computed by ``dist_aggregate`` can feed a select WITHOUT a
     host read — the dependency stays on device and the pipeline never
     stalls on it (TPC-H Q11/Q15/Q22's correlated-scalar shape).
+
+    ``compact=False`` defers the compaction: the result carries the row
+    mask (``DTable.pending_mask``) and keeps the input blocks.  Consumers
+    that fold row masks — groupby/aggregate, the dense semi/anti and
+    FK-join probes, further selects — then skip the standalone ~6 ns/row
+    compaction scatter entirely; any other consumer compacts on first
+    touch.  Rule of thumb (docs/tpu_perf_notes.md): defer when the
+    SURVIVOR fraction is large (the compaction's output gathers dominate)
+    or the consumer is mask-aware end-to-end; compact when the filter is
+    highly selective and the consumer re-traverses the block per pass.
     """
     mesh, axis, cap = dt.ctx.mesh, dt.ctx.axis, dt.cap
     names = tuple(c.name for c in dt.columns)
-    key1 = ("selmask", mesh, axis, cap, names, predicate, len(params))
+    has_pm = dt.pending_mask is not None
+    key1 = ("selmask", mesh, axis, cap, names, predicate, len(params),
+            has_pm)
     p1 = _select_cache.get(key1)
     if p1 is None:
-        def mask_kernel(cnt, leaves, params):
-            mask = _masked_predicate(names, predicate,
-                                     jnp.arange(cap) < cnt[0], leaves,
+        def mask_kernel(cnt, leaves, params, *maybe_pm):
+            base = maybe_pm[0] if has_pm else (jnp.arange(cap) < cnt[0])
+            mask = _masked_predicate(names, predicate, base, leaves,
                                      params)
             n = jnp.sum(mask).astype(jnp.int32)
             return mask, jax.lax.all_gather(n, axis)
@@ -1484,10 +1587,16 @@ def dist_select(dt: DTable, predicate, params=()) -> DTable:
         # check_vma=False: the all_gathered counts are replicated (and so
         # are the params)
         p1 = _cache_put(key1, jax.jit(shard_map(
-            mask_kernel, mesh=mesh, in_specs=(spec, spec, P()),
+            mask_kernel, mesh=mesh,
+            in_specs=(spec, spec, P()) + ((spec,) if has_pm else ()),
             out_specs=(spec, P()), check_vma=False)))
     leaves = tuple((c.data, c.validity) for c in dt.columns)
-    mask, cnts = p1(dt.counts, leaves, tuple(params))
+    args = (dt.counts, leaves, tuple(params)) + (
+        (dt.pending_mask,) if has_pm else ())
+    mask, cnts = p1(*args)
+    if not compact:
+        return DTable(dt.ctx, dt.columns, dt.cap, dt.counts,
+                      pending_mask=mask, pending_cnts=cnts)
     return _compact_survivors(dt, mask, cnts,
                               ("sel", mesh, cap, names, predicate),
                               "select.gather")
@@ -1496,7 +1605,8 @@ def dist_select(dt: DTable, predicate, params=()) -> DTable:
 @functools.lru_cache(maxsize=None)
 def _semi_mask_dense_fn(mesh, axis: str, cap_l: int, cap_r: int,
                         lo: int, hi: int, anti: bool,
-                        has_lv: bool, has_rv: bool, stride: int = 1):
+                        has_lv: bool, has_rv: bool, stride: int = 1,
+                        has_lmask: bool = False, has_rmask: bool = False):
     """Dense-key semi/anti probe: presence bits over the key range [lo,
     hi] (ONE scatter of the right keys) + ONE gather probe of the left
     keys — no sort at all.  The big⋈tiny filter-join shape (probe 60M
@@ -1508,9 +1618,17 @@ def _semi_mask_dense_fn(mesh, axis: str, cap_l: int, cap_r: int,
     routing (both sides see one residue class, slots compress by P)."""
     R = -(-(hi - lo + 1) // stride)
 
-    def kernel(l_cnt, r_cnt, lk, lv, rk, rv):
+    def kernel(l_cnt, r_cnt, lk, lv, rk, rv, *masks):
         rvalid = jnp.arange(cap_r) < r_cnt[0]
         lvalid = jnp.arange(cap_l) < l_cnt[0]
+        # deferred-select masks fold straight into row validity: the
+        # "table" each side presents is its filtered rows
+        mi = 0
+        if has_lmask:
+            lvalid = lvalid & masks[mi]
+            mi += 1
+        if has_rmask:
+            rvalid = rvalid & masks[mi]
         r_nonnull = rvalid & rv if has_rv else rvalid
         l_nonnull = lvalid & lv if has_lv else lvalid
         r_in = (rk >= lo) & (rk <= hi)
@@ -1538,8 +1656,9 @@ def _semi_mask_dense_fn(mesh, axis: str, cap_l: int, cap_r: int,
         return keep, jax.lax.all_gather(jnp.stack([n, overflow]), axis)
 
     spec = P(axis)
+    nargs = 6 + int(has_lmask) + int(has_rmask)
     # check_vma=False: the all_gathered counts are replicated
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * nargs,
                              out_specs=(spec, P()), check_vma=False))
 
 
@@ -1596,16 +1715,23 @@ def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
                          - int(dense_key_range[0]) + 1) // stride)
                  <= 4 * max(left.cap, right.cap))
     if world > 1:
+        # deferred-select masks fold into the routing: masked rows go to
+        # the dropped partition, so the kernels below see cleared tables
         with trace.span("semijoin.shuffle"):
             if use_dense:
                 lo0 = int(dense_key_range[0])
-                left = _shuffle_by_pids(
+                left = _shuffle_masked(
                     left, _mod_pids(left, li_keys[0], lo0, world))
-                right = _shuffle_by_pids(
+                right = _shuffle_masked(
                     right, _mod_pids(right, ri_keys[0], lo0, world))
             else:
-                left = _shuffle_by_pids(left, _hash_pids(left, li_keys))
-                right = _shuffle_by_pids(right, _hash_pids(right, ri_keys))
+                left = _shuffle_masked(left, _hash_pids(left, li_keys))
+                right = _shuffle_masked(right, _hash_pids(right, ri_keys))
+    if not use_dense:
+        # the sort-path presence kernel has no mask operand — compact any
+        # deferred select first (world > 1 already folded it above)
+        left._collapse_pending()
+        right._collapse_pending()
     mesh, axis = left.ctx.mesh, left.ctx.axis
     lkcs = [left.columns[i] for i in li_keys]
     rkcs = [right.columns[i] for i in ri_keys]
@@ -1613,16 +1739,20 @@ def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
     if use_dense:
         lo, hi = int(dense_key_range[0]), int(dense_key_range[1])
         rc = rkcs[0]
+        has_lm = left.pending_mask is not None
+        has_rm = right.pending_mask is not None
+        mask_args = (() if not has_lm else (left.pending_mask,)) + \
+            (() if not has_rm else (right.pending_mask,))
         with trace.span("semijoin.mask"):
             mask, cnts = _semi_mask_dense_fn(
                 mesh, axis, left.cap, right.cap, lo, hi, anti,
                 kc.validity is not None, rc.validity is not None,
-                stride)(
+                stride, has_lm, has_rm)(
                 left.counts, right.counts, kc.data, kc.validity,
-                rc.data, rc.validity)
+                rc.data, rc.validity, *mask_args)
 
         hint_key = ("semid", mesh, left.cap, right.cap, lo, hi, anti,
-                    stride)
+                    stride, has_lm, has_rm)
 
         def post(per_shard):
             per_shard = per_shard.reshape(-1, 2)
@@ -1677,9 +1807,11 @@ def dist_anti_join(left: DTable, right: DTable, left_on, right_on,
 
 def dist_project(dt: DTable, columns: Sequence[Union[int, str]]) -> DTable:
     """Column subset — zero-copy, like the local Project
-    (reference table_api.cpp:1007-1029)."""
+    (reference table_api.cpp:1007-1029).  A deferred-select mask rides
+    along (projection commutes with row filtering)."""
     ids = _resolve_ids(dt, columns)
-    return DTable(dt.ctx, [dt.columns[i] for i in ids], dt.cap, dt.counts)
+    return DTable(dt.ctx, [dt.columns[i] for i in ids], dt.cap, dt.counts,
+                  dt.pending_mask, dt.pending_cnts)
 
 
 def dist_with_column(dt: DTable, name: str, fn, out_type,
@@ -1707,7 +1839,10 @@ def dist_with_column(dt: DTable, name: str, fn, out_type,
         if v is not None:
             validity = v if validity is None else (validity & v)
     cols = list(dt.columns) + [DColumn(name, _DT(out_type), out, validity)]
-    return DTable(dt.ctx, cols, dt.cap, dt.counts)
+    # a deferred-select mask rides along: the derived column computes
+    # garbage on masked-out rows, which stay masked
+    return DTable(dt.ctx, cols, dt.cap, dt.counts, dt.pending_mask,
+                  dt.pending_cnts)
 
 
 def dist_head(dt: DTable, n: int) -> "Table":
@@ -1739,6 +1874,7 @@ def dist_sort_multi(dt: DTable, sort_columns: Sequence[Union[int, str]],
     shuffle regardless of key count — the scalable spelling of the
     host-side ``compute.sort_multi`` tail every small query uses.
     ``ascending``: one bool or a per-column sequence."""
+    dt._collapse_pending()
     key_ids = _resolve_ids(dt, sort_columns)
     asc = ([ascending] * len(key_ids) if isinstance(ascending, bool)
            else list(ascending))
@@ -1782,6 +1918,7 @@ def dist_sort(dt: DTable, sort_column: Union[int, str],
     requested order, and rows within a shard are sorted (nulls last
     globally), so concatenating shards in mesh order is the sorted table.
     """
+    dt._collapse_pending()
     key_i = dt.column_index(sort_column)
     if dt.ctx.get_world_size() == 1:
         sh = dt  # one shard: a local sort is already globally ordered

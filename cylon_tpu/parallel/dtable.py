@@ -58,15 +58,45 @@ class DColumn:
 
 
 class DTable:
-    """Mesh-partitioned table: padded per-shard blocks + valid counts."""
+    """Mesh-partitioned table: padded per-shard blocks + valid counts.
+
+    ``pending_mask`` (set only by ``dist_select(compact=False)``) is a
+    deferred row filter: a [P*cap] bool mask, already AND'ed with the
+    valid-row mask, that has NOT been compacted yet.  Consumers that can
+    fold a row mask into their own kernels (groupby/aggregate ``where``,
+    the dense semi/FK probes, further selects) read it and skip the
+    standalone compaction scatter (~6 ns/row — the dominant cost of a
+    wide select, docs/tpu_perf_notes.md); every other op first calls
+    ``_collapse_pending()``, which compacts in place, so correctness
+    never depends on a consumer knowing about the mask."""
 
     def __init__(self, ctx: CylonContext, columns: List[DColumn], cap: int,
-                 counts: jax.Array):
+                 counts: jax.Array, pending_mask: Optional[jax.Array] = None,
+                 pending_cnts: Optional[jax.Array] = None):
         self.ctx = ctx
         self.columns = columns
         self.cap = int(cap)
         self.counts = counts               # [P] int32, sharded P('p')
+        self.pending_mask = pending_mask   # [P*cap] bool or None
+        self.pending_cnts = pending_cnts   # replicated [P] survivor counts
         self._counts_host: Optional[np.ndarray] = None
+
+    def _collapse_pending(self) -> None:
+        """Materialize a deferred select IN PLACE (identity-preserving:
+        the handle keeps working for callers that captured it)."""
+        if self.pending_mask is None:
+            return
+        from . import dist_ops  # runtime import; no cycle at module load
+        mask, cnts = self.pending_mask, self.pending_cnts
+        self.pending_mask = self.pending_cnts = None
+        out = dist_ops._compact_survivors(
+            self, mask, cnts,
+            ("pmat", self.ctx.mesh, self.cap, self.num_columns),
+            "select.gather")
+        self.columns = out.columns
+        self.cap = out.cap
+        self.counts = out.counts
+        self._counts_host = None
 
     # -- shape ---------------------------------------------------------------
 
@@ -83,6 +113,7 @@ class DTable:
         return [c.name for c in self.columns]
 
     def counts_host(self) -> np.ndarray:
+        self._collapse_pending()
         if self._counts_host is None:
             # resolve queued optimistic-capacity validations before trusting
             # any host-visible row counts; inside a failed deferred attempt
@@ -266,6 +297,7 @@ class DTable:
         ``P * cap`` — a groupby result with 4 valid rows in a multi-million
         capacity block transfers 4 rows, not the padded block.
         """
+        self._collapse_pending()
         ops_compact.flush_pending()  # payload must be validation-clean
         ops_compact._abort_if_poisoned()
         # int32 gather indices unless x64 is on: jnp.asarray would silently
@@ -320,6 +352,7 @@ class DTable:
         rows) — the fused kernel's replicated [n] block would cost
         O(P·n) memory.
         """
+        self._collapse_pending()
         n_eff = min(int(n), self.nparts * self.cap)
         if n_eff <= 0:
             return self._export([0] * self.nparts)
@@ -373,7 +406,8 @@ class DTable:
     def rename(self, names: Sequence[str]) -> "DTable":
         return DTable(self.ctx, [replace(c, name=n)
                                  for c, n in zip(self.columns, names)],
-                      self.cap, self.counts)
+                      self.cap, self.counts, self.pending_mask,
+                      self.pending_cnts)
 
     def __repr__(self) -> str:
         cols = ", ".join(f"{c.name}:{c.dtype.type.name}" for c in self.columns)
@@ -447,6 +481,9 @@ def _head_fn(mesh, axis: str, cap: int, n: int, has_v):
 _ARENA_CAP = 256 << 20
 _arena = None
 _arena_lock = threading.Lock()
+# diagnostic switch (bench.py's ingest A/B): False forces the numpy
+# fallback path even on real H2D targets
+ARENA_ENABLED = True
 
 
 class StagedIngest:
@@ -472,7 +509,7 @@ class StagedIngest:
         self._ctx = ctx
         self._owns_arena = False
         platform = ctx.mesh.devices.flat[0].platform
-        if platform != "cpu" or force_arena:
+        if (platform != "cpu" or force_arena) and ARENA_ENABLED:
             # exclusive ownership: a second concurrent ingest must not
             # reset the arena under the first one's in-flight transfers
             if _arena_lock.acquire(blocking=False):

@@ -1,0 +1,636 @@
+"""ON-DEVICE TPC-H generation with an exact numpy host mirror.
+
+The tables ``datagen.generate`` builds are *synthetic*: every value is a
+pure function of (seed, row index).  Pushing 3 GB of generated numpy
+arrays through a tunneled H2D link (measured ~3.4 MB/s on the axon
+harness — 15 minutes for SF-10) is therefore pure waste: the same values
+can be computed directly in HBM.  This module implements the generator as
+a counter-based PRNG (murmur3-finalizer avalanche over uint32, the same
+primitive ops numpy and XLA both define bit-exactly) written ONCE against
+an array-module parameter, so
+
+  * ``generate_device(ctx, sf)`` runs it under ``jit`` with mesh
+    out-shardings — SF-10 materializes on a v5e chip in seconds, nothing
+    crosses the tunnel but the dispatch;
+  * ``generate_mirror(sf)`` runs the identical formulas in numpy for the
+    host-side contenders (the pandas oracles time against the *same*
+    values the device holds — integer columns bit-identical, floats equal
+    up to backend FMA/rounding ULPs).
+
+Distribution shapes (cardinalities, key formulas, date windows, enum
+pools, the o_custkey mod-3 gap, the partsupp supplier formula, comment
+LIKE-pattern planting) match ``datagen.generate``; dictionary pools are
+constructed pre-sorted so codes are drawn directly in sorted-dictionary
+space (the encode invariant ``table.py`` establishes at ingest).
+
+reference: the closest analogue is the reference's CSV generator feeding
+per-rank files (cpp/src/experiments/generate_files.py:20-52); generating
+in place of ingesting is the TPU-native answer to its mmap-speed local
+reads (io/arrow_io.cpp:25-50).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import numpy as np
+
+from .datagen import (DAYS_TOTAL, NATIONS, P_CONTAINER_1, P_CONTAINER_2,
+                      P_NAME_WORDS, P_TYPE_S1, P_TYPE_S2, P_TYPE_S3,
+                      PRIORITIES, REGIONS, RETURN_FLAGS, SEGMENTS,
+                      SHIP_MODES, SUPPLIERS_PER_PART, _COMMENT_WORDS,
+                      date_to_days)
+
+# ---------------------------------------------------------------------------
+# counter-based PRNG (identical in numpy and jax.numpy)
+# ---------------------------------------------------------------------------
+
+_M1, _M2, _GOLD = 0x85EBCA6B, 0xC2B2AE35, 0x9E3779B9
+
+# bump when any formula/pool changes: keys the bench's persisted oracle
+# timings (bench.py tpch_oracle_times.json) to the data they measured
+DATA_VERSION = 1
+
+
+def _mix(x, xp):
+    """murmur3 finalizer: uint32 → uint32 full-avalanche bijection.
+    Same constants as ops/hash.py's vendored murmur3 tail (public-domain
+    Appleby constants — they are the algorithm)."""
+    x = (x ^ (x >> xp.uint32(16))) * xp.uint32(_M1)
+    x = (x ^ (x >> xp.uint32(13))) * xp.uint32(_M2)
+    return x ^ (x >> xp.uint32(16))
+
+
+def _salt(seed: int, tag: int) -> int:
+    """Per-draw-site salt, derived host-side (pure-python ints: numpy
+    scalars would warn on the intended uint32 wraparound)."""
+    h = (seed * _GOLD + tag) & 0xFFFFFFFF
+    h ^= h >> 16
+    h = (h * _M1) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * _M2) & 0xFFFFFFFF
+    return h ^ (h >> 16)
+
+
+def _u32(xp, salt: int, i):
+    """The raw stream: hash of (salt, index).  ``i`` is any int32 array."""
+    return _mix(i.astype(xp.uint32) + xp.uint32(salt), xp)
+
+
+def _randint(xp, salt: int, i, n: int):
+    """Uniform int32 in [0, n) (modulo bias ≤ n/2^32 — immaterial here)."""
+    return (_u32(xp, salt, i) % xp.uint32(n)).astype(xp.int32)
+
+
+def _rand01(xp, salt: int, i):
+    """Uniform float32 in [0, 1): the top 24 hash bits scaled."""
+    return ((_u32(xp, salt, i) >> xp.uint32(8)).astype(xp.float32)
+            * xp.float32(1.0 / (1 << 24)))
+
+
+def _uniform(xp, salt: int, i, lo: float, hi: float):
+    return (_rand01(xp, salt, i) * xp.float32(hi - lo)
+            + xp.float32(lo)).astype(xp.float32)
+
+
+def _round2(xp, x):
+    """Two-decimal rounding, spelled identically both sides."""
+    return (xp.round(x * xp.float32(100.0)) / xp.float32(100.0)) \
+        .astype(xp.float32)
+
+
+# draw-site tags: ONE per random column/decision, shared by both backends
+class _T:
+    LINES = 1
+    ODATE = 2
+    OCUST = 3
+    OPRIO = 4
+    OPRICE = 5
+    OCA = 6
+    OCB = 7
+    OCC = 8
+    OCHIT = 9
+    LPART = 10
+    LSUPI = 11
+    LSHIP = 12
+    LCOMMIT = 13
+    LRECEIPT = 14
+    LQTY = 15
+    LPRICE = 16
+    LDISC = 17
+    LTAX = 18
+    LRFLAG = 19
+    LMODE = 20
+    CNAT = 21
+    CBAL = 22
+    CSEG = 23
+    SNAT = 24
+    SBAL = 25
+    SCA = 26
+    SCB = 27
+    SCC = 28
+    SCHIT = 29
+    PNAME = 30
+    PTYPE = 31
+    PBRAND = 32
+    PCONT = 33
+    PSIZE = 34
+    PPRICE = 35
+    PSCOST = 36
+    PSQTY = 37
+    BENCH_K = 60  # join-microbench columns (bench.py)
+    BENCH_V = 61
+
+
+# ---------------------------------------------------------------------------
+# dictionary pools, constructed PRE-SORTED (codes are drawn in sorted space)
+# ---------------------------------------------------------------------------
+
+_WORDS = sorted(_COMMENT_WORDS)
+_W = len(_WORDS)
+# "a b c" over a sorted word list, (a,b,c)-major, IS lexically sorted:
+# the separating space sorts below every word character, so prefix words
+# ("hot" vs "hotpink") order the same way the phrases do
+COMMENT_POOL = [f"{a} {b} {c}" for a in _WORDS for b in _WORDS
+                for c in _WORDS]
+NAME_POOL = sorted({f"{a} {b}" for a in P_NAME_WORDS for b in P_NAME_WORDS})
+TYPE_POOL = [f"{a} {b} {c}" for a in sorted(P_TYPE_S1)
+             for b in sorted(P_TYPE_S2) for c in sorted(P_TYPE_S3)]
+CONTAINER_POOL = [f"{a} {b}" for a in sorted(P_CONTAINER_1)
+                  for b in sorted(P_CONTAINER_2)]
+BRAND_POOL = [f"Brand#{m}{n}" for m in range(1, 6) for n in range(1, 6)]
+MFGR_POOL = [f"Manufacturer#{m}" for m in range(1, 6)]
+STATUS_POOL = ["F", "O", "P"]
+
+_LINESTATUS_CUTOFF = date_to_days("1995-06-17")
+
+
+def _comment_code(xp, seed: int, tags, i):
+    """Random 3-word comment codes, ~2% planted with (word_a … word_c) in
+    order — the LIKE-pattern cohort Q13/Q16 scan for (datagen.py's
+    _comment_codes, re-derived in sorted-word space)."""
+    ta, tb, tc, thit, wa, wc = tags
+    a = _randint(xp, _salt(seed, ta), i, _W)
+    b = _randint(xp, _salt(seed, tb), i, _W)
+    c = _randint(xp, _salt(seed, tc), i, _W)
+    hit = _randint(xp, _salt(seed, thit), i, 50) == 0
+    a = xp.where(hit, xp.int32(_WORDS.index(wa)), a)
+    c = xp.where(hit, xp.int32(_WORDS.index(wc)), c)
+    return a * xp.int32(_W * _W) + b * xp.int32(_W) + c
+
+
+def _scale_counts(scale: float):
+    n_cust = max(int(150_000 * scale), 1)
+    n_ord = max(int(1_500_000 * scale), 1)
+    n_supp = max(int(10_000 * scale), SUPPLIERS_PER_PART)
+    n_part = max(int(200_000 * scale), 1)
+    return n_cust, n_ord, n_supp, n_part
+
+
+def _lines_per(xp, seed: int, o_idx, o_valid):
+    """1–7 lines per order (E=4 ⇒ lineitem ≈ 6M·SF); 0 on padding rows so
+    the device cumsum stays exact in padded space."""
+    lp = 1 + _randint(xp, _salt(seed, _T.LINES), o_idx, 7)
+    return xp.where(o_valid, lp, xp.int32(0)) if o_valid is not None else lp
+
+
+def _part_supp_key(xp, partkey, i, n_supp: int):
+    """The spec's supplier-of-part formula (datagen.part_supp_key)."""
+    step = max(n_supp // SUPPLIERS_PER_PART, 1)
+    return (((partkey - 1) + i * xp.int32(step)) % xp.int32(n_supp)
+            + 1).astype(xp.int32)
+
+
+# ---------------------------------------------------------------------------
+# shared column formulas (xp ∈ {numpy, jax.numpy})
+# ---------------------------------------------------------------------------
+
+def _orders_cols(xp, seed: int, o_idx, starts, lines_per, n_cust: int):
+    """All orders columns from the order index (+ the per-order line-start
+    positions, so o_orderstatus can fold its lines' statuses with a
+    7-step bounded loop instead of a segment reduction)."""
+    n_valid_cust = max(n_cust - n_cust // 3, 1)
+    ci = _randint(xp, _salt(seed, _T.OCUST), o_idx, n_valid_cust)
+    odate = _randint(xp, _salt(seed, _T.ODATE), o_idx, DAYS_TOTAL)
+    # o_orderstatus: F iff every line F, O iff every line O, else P.
+    # lines_per ≤ 7, so a static 7-iteration fold over the order's line
+    # indices is exact (and identical in numpy and XLA)
+    s_ship = _salt(seed, _T.LSHIP)
+    n_o = xp.zeros(o_idx.shape[0], xp.int32)
+    for j in range(7):
+        gi = starts + xp.int32(j)
+        ship = odate + 1 + _randint(xp, s_ship, gi, 121)
+        is_o = (ship > _LINESTATUS_CUTOFF) & (xp.int32(j) < lines_per)
+        n_o = n_o + is_o.astype(xp.int32)
+    status = xp.where(n_o == 0, xp.int32(0),
+                      xp.where(n_o == lines_per, xp.int32(1), xp.int32(2)))
+    return {
+        "o_orderkey": (o_idx + 1).astype(xp.int32),
+        "o_custkey": (3 * (ci // 2) + 1 + ci % 2).astype(xp.int32),
+        "o_orderdate": odate,
+        "o_orderpriority": _randint(xp, _salt(seed, _T.OPRIO), o_idx,
+                                    len(PRIORITIES)),
+        "o_shippriority": xp.zeros(o_idx.shape[0], xp.int32),
+        "o_totalprice": _round2(xp, _uniform(xp, _salt(seed, _T.OPRICE),
+                                             o_idx, 900.0, 500_000.0)),
+        "o_comment": _comment_code(xp, seed,
+                                   (_T.OCA, _T.OCB, _T.OCC, _T.OCHIT,
+                                    "special", "requests"), o_idx),
+        "o_orderstatus": status,
+    }
+
+
+def _lineitem_cols(xp, seed: int, li_idx, order_idx, n_part: int,
+                   n_supp: int):
+    """All lineitem columns from (line index, owning-order index)."""
+    odate = _randint(xp, _salt(seed, _T.ODATE), order_idx, DAYS_TOTAL)
+    ship = odate + 1 + _randint(xp, _salt(seed, _T.LSHIP), li_idx, 121)
+    commit = odate + 30 + _randint(xp, _salt(seed, _T.LCOMMIT), li_idx, 61)
+    receipt = ship + 1 + _randint(xp, _salt(seed, _T.LRECEIPT), li_idx, 30)
+    partkey = 1 + _randint(xp, _salt(seed, _T.LPART), li_idx, n_part)
+    suppkey = _part_supp_key(
+        xp, partkey, _randint(xp, _salt(seed, _T.LSUPI), li_idx,
+                              SUPPLIERS_PER_PART), n_supp)
+    return {
+        "l_orderkey": (order_idx + 1).astype(xp.int32),
+        "l_partkey": partkey,
+        "l_suppkey": suppkey,
+        "l_quantity": (1 + _randint(xp, _salt(seed, _T.LQTY), li_idx, 50))
+        .astype(xp.float32),
+        "l_extendedprice": _round2(xp, _uniform(
+            xp, _salt(seed, _T.LPRICE), li_idx, 900.0, 105_000.0)),
+        "l_discount": (_randint(xp, _salt(seed, _T.LDISC), li_idx, 11)
+                       .astype(xp.float32) / xp.float32(100.0)),
+        "l_tax": (_randint(xp, _salt(seed, _T.LTAX), li_idx, 9)
+                  .astype(xp.float32) / xp.float32(100.0)),
+        "l_returnflag": _randint(xp, _salt(seed, _T.LRFLAG), li_idx,
+                                 len(RETURN_FLAGS)),
+        "l_linestatus": (ship > _LINESTATUS_CUTOFF).astype(xp.int32),
+        "l_shipdate": ship,
+        "l_commitdate": commit,
+        "l_receiptdate": receipt,
+        "l_shipmode": _randint(xp, _salt(seed, _T.LMODE), li_idx,
+                               len(SHIP_MODES)),
+    }
+
+
+def _customer_cols(xp, seed: int, c_idx):
+    nat = _randint(xp, _salt(seed, _T.CNAT), c_idx, 25)
+    return {
+        "c_custkey": (c_idx + 1).astype(xp.int32),
+        "c_nationkey": nat,
+        "c_acctbal": _round2(xp, _uniform(xp, _salt(seed, _T.CBAL), c_idx,
+                                          -999.99, 9999.99)),
+        "c_mktsegment": _randint(xp, _salt(seed, _T.CSEG), c_idx,
+                                 len(SEGMENTS)),
+        "c_phone_cc": (nat + 10).astype(xp.int32),
+    }
+
+
+def _supplier_cols(xp, seed: int, s_idx):
+    return {
+        "s_suppkey": (s_idx + 1).astype(xp.int32),
+        "s_nationkey": _randint(xp, _salt(seed, _T.SNAT), s_idx, 25),
+        "s_acctbal": _round2(xp, _uniform(xp, _salt(seed, _T.SBAL), s_idx,
+                                          -999.99, 9999.99)),
+        "s_comment": _comment_code(xp, seed,
+                                   (_T.SCA, _T.SCB, _T.SCC, _T.SCHIT,
+                                    "Customer", "Complaints"), s_idx),
+    }
+
+
+def _part_cols(xp, seed: int, p_idx):
+    brand = _randint(xp, _salt(seed, _T.PBRAND), p_idx, len(BRAND_POOL))
+    return {
+        "p_partkey": (p_idx + 1).astype(xp.int32),
+        "p_name": _randint(xp, _salt(seed, _T.PNAME), p_idx,
+                           len(NAME_POOL)),
+        "p_mfgr": brand // 5,
+        "p_type": _randint(xp, _salt(seed, _T.PTYPE), p_idx,
+                           len(TYPE_POOL)),
+        "p_brand": brand,
+        "p_container": _randint(xp, _salt(seed, _T.PCONT), p_idx,
+                                len(CONTAINER_POOL)),
+        "p_size": 1 + _randint(xp, _salt(seed, _T.PSIZE), p_idx, 50),
+        "p_retailprice": _round2(xp, _uniform(
+            xp, _salt(seed, _T.PPRICE), p_idx, 900.0, 2000.0)),
+    }
+
+
+def _partsupp_cols(xp, seed: int, ps_idx, n_supp: int):
+    partkey = (ps_idx // SUPPLIERS_PER_PART + 1).astype(xp.int32)
+    i = (ps_idx % SUPPLIERS_PER_PART).astype(xp.int32)
+    return {
+        "ps_partkey": partkey,
+        "ps_suppkey": _part_supp_key(xp, partkey, i, n_supp),
+        "ps_supplycost": _round2(xp, _uniform(
+            xp, _salt(seed, _T.PSCOST), ps_idx, 1.0, 1000.0)),
+        "ps_availqty": 1 + _randint(xp, _salt(seed, _T.PSQTY), ps_idx,
+                                    9999),
+    }
+
+
+def bench_join_cols(xp, seed: int, idx, krange: int):
+    """The join-microbench side (bench.py): 1%-duplicate int32 keys + 3
+    float payloads — the reference scaling protocol's column shape
+    (cpp/src/experiments/generate_files.py:30,49)."""
+    out = {"k": _randint(xp, _salt(seed, _T.BENCH_K), idx, krange)}
+    for j in range(3):
+        out[f"v{j}"] = _rand01(xp, _salt(seed, _T.BENCH_V + j), idx)
+    return out
+
+
+# canonical column order per table (jit returns dict pytrees key-sorted,
+# so the device side must re-impose the schema order the mirror emits)
+_COLUMN_ORDER = {
+    "lineitem": ["l_orderkey", "l_partkey", "l_suppkey", "l_quantity",
+                 "l_extendedprice", "l_discount", "l_tax", "l_returnflag",
+                 "l_linestatus", "l_shipdate", "l_commitdate",
+                 "l_receiptdate", "l_shipmode"],
+    "orders": ["o_orderkey", "o_custkey", "o_orderdate", "o_orderpriority",
+               "o_shippriority", "o_totalprice", "o_comment",
+               "o_orderstatus"],
+    "customer": ["c_custkey", "c_nationkey", "c_acctbal", "c_mktsegment",
+                 "c_phone_cc"],
+    "supplier": ["s_suppkey", "s_nationkey", "s_acctbal", "s_comment"],
+    "part": ["p_partkey", "p_name", "p_mfgr", "p_type", "p_brand",
+             "p_container", "p_size", "p_retailprice"],
+    "partsupp": ["ps_partkey", "ps_suppkey", "ps_supplycost",
+                 "ps_availqty"],
+}
+
+# per-column dictionary pools (None ⇒ plain numeric column)
+_DICTS = {
+    "o_orderpriority": PRIORITIES, "o_comment": COMMENT_POOL,
+    "o_orderstatus": STATUS_POOL,
+    "l_returnflag": RETURN_FLAGS, "l_linestatus": ["F", "O"],
+    "l_shipmode": SHIP_MODES,
+    "c_mktsegment": SEGMENTS,
+    "s_comment": COMMENT_POOL,
+    "p_name": NAME_POOL, "p_mfgr": MFGR_POOL, "p_type": TYPE_POOL,
+    "p_brand": BRAND_POOL, "p_container": CONTAINER_POOL,
+}
+_FLOAT_COLS = {"o_totalprice", "l_quantity", "l_extendedprice",
+               "l_discount", "l_tax", "c_acctbal", "s_acctbal",
+               "p_retailprice", "ps_supplycost"}
+
+
+# ---------------------------------------------------------------------------
+# host mirror (numpy → pandas; the contender side times against this)
+# ---------------------------------------------------------------------------
+
+def _mirror_df(cols: Dict[str, np.ndarray], which: str):
+    import pandas as pd
+    out = {}
+    for name in _COLUMN_ORDER[which]:
+        v = cols[name]
+        pool = _DICTS.get(name)
+        if pool is not None:
+            out[name] = pd.Categorical.from_codes(v, pool)
+        else:
+            out[name] = v
+    return pd.DataFrame(out)
+
+
+def _nation_region():
+    import pandas as pd
+    nation = pd.DataFrame({
+        "n_nationkey": np.arange(25, dtype=np.int32),
+        "n_name": pd.Categorical([n for n, _ in NATIONS]),
+        "n_regionkey": np.array([r for _, r in NATIONS], dtype=np.int32),
+    })
+    region = pd.DataFrame({
+        "r_regionkey": np.arange(5, dtype=np.int32),
+        "r_name": pd.Categorical(REGIONS),
+    })
+    return nation, region
+
+
+def generate_mirror(scale: float, seed: int = 42,
+                    tables=None) -> Dict[str, "pd.DataFrame"]:
+    """The numpy twin of ``generate_device`` — same formulas, same values.
+    ``tables`` optionally restricts which tables to build (the oracle
+    phase may only need a subset)."""
+    n_cust, n_ord, n_supp, n_part = _scale_counts(scale)
+    want = set(tables) if tables is not None else None
+
+    def _want(name):
+        return want is None or name in want
+
+    out: Dict[str, object] = {}
+    o_idx = np.arange(n_ord, dtype=np.int32)
+    lines_per = _lines_per(np, seed, o_idx, None)
+    ends = np.cumsum(lines_per, dtype=np.int64)
+    n_li = int(ends[-1]) if n_ord else 0
+    starts = (ends - lines_per).astype(np.int32)
+    if _want("orders"):
+        out["orders"] = _mirror_df(_orders_cols(np, seed, o_idx, starts,
+                                                lines_per, n_cust),
+                                   "orders")
+    if _want("lineitem"):
+        order_idx = np.repeat(o_idx, lines_per)
+        li_idx = np.arange(n_li, dtype=np.int32)
+        out["lineitem"] = _mirror_df(_lineitem_cols(np, seed, li_idx,
+                                                    order_idx, n_part,
+                                                    n_supp), "lineitem")
+    if _want("customer"):
+        out["customer"] = _mirror_df(_customer_cols(
+            np, seed, np.arange(n_cust, dtype=np.int32)), "customer")
+    if _want("supplier"):
+        out["supplier"] = _mirror_df(_supplier_cols(
+            np, seed, np.arange(n_supp, dtype=np.int32)), "supplier")
+    if _want("part"):
+        out["part"] = _mirror_df(_part_cols(
+            np, seed, np.arange(n_part, dtype=np.int32)), "part")
+    if _want("partsupp"):
+        out["partsupp"] = _mirror_df(_partsupp_cols(
+            np, seed, np.arange(n_part * SUPPLIERS_PER_PART,
+                                dtype=np.int32), n_supp), "partsupp")
+    nation, region = _nation_region()
+    if _want("nation"):
+        out["nation"] = nation
+    if _want("region"):
+        out["region"] = region
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device side
+# ---------------------------------------------------------------------------
+
+def _sizes_offs(n: int, Pn: int):
+    """The ONE definition of the per-shard block split (matches
+    DTable.from_table's layout; every builder below derives from it)."""
+    base, rem = divmod(n, Pn)
+    sizes = np.array([base + (1 if i < rem else 0) for i in range(Pn)],
+                     np.int32)
+    offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    return sizes, offs
+
+
+def _block_layout(ctx, n: int):
+    """Block distribution over the mesh: per-shard sizes/offsets + bucketed
+    capacity (mirrors DTable.from_table's layout exactly)."""
+    from ..ops import compact as ops_compact
+    Pn = ctx.get_world_size()
+    sizes, offs = _sizes_offs(n, Pn)
+    cap = ops_compact.next_bucket(max(int(sizes.max(initial=0)), 1),
+                                  minimum=8)
+    return Pn, sizes, offs, cap
+
+
+def _global_index(jnp, Pn: int, cap: int, sizes, offs):
+    """Padded-block position → (global row id, valid flag)."""
+    p = jnp.arange(Pn * cap, dtype=jnp.int32)
+    shard = p // jnp.int32(cap)
+    local = p - shard * jnp.int32(cap)
+    g = jnp.asarray(offs[:-1], jnp.int32)[shard] + local
+    valid = local < jnp.asarray(sizes, jnp.int32)[shard]
+    return g, valid
+
+
+def _zero_invalid(jnp, cols: Dict[str, object], valid):
+    return {k: jnp.where(valid, v, jnp.zeros((), v.dtype))
+            for k, v in cols.items()}
+
+
+@functools.lru_cache(maxsize=None)
+def _elementwise_table_fn(mesh, axis: str, which: str, seed: int, n: int,
+                          cap: int, extra: tuple):
+    """jit builder for the tables that are pure functions of the row id
+    (customer / supplier / part / partsupp)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    Pn = mesh.devices.size
+    sizes, offs = _sizes_offs(n, Pn)
+
+    def fn():
+        g, valid = _global_index(jnp, Pn, cap, sizes, offs)
+        if which == "customer":
+            cols = _customer_cols(jnp, seed, g)
+        elif which == "supplier":
+            cols = _supplier_cols(jnp, seed, g)
+        elif which == "part":
+            cols = _part_cols(jnp, seed, g)
+        else:
+            cols = _partsupp_cols(jnp, seed, g, extra[0])
+        return _zero_invalid(jnp, cols, valid)
+
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, P(axis)))
+
+
+@functools.lru_cache(maxsize=None)
+def _orders_lineitem_fn(mesh, axis: str, seed: int, n_ord: int, n_li: int,
+                        cap_o: int, cap_li: int, n_cust: int, n_part: int,
+                        n_supp: int):
+    """One jit producing BOTH orders and lineitem blocks: the line→order
+    ownership (cumsum over per-order line counts + one marker scatter)
+    is computed once and shared."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    Pn = mesh.devices.size
+    sizes_o, offs_o = _sizes_offs(n_ord, Pn)
+    sizes_l, offs_l = _sizes_offs(n_li, Pn)
+
+    def fn():
+        # --- global (compact) order-line structure -----------------------
+        o_idx_c = jnp.arange(n_ord, dtype=jnp.int32)
+        lp_c = _lines_per(jnp, seed, o_idx_c, None)
+        ends_c = jnp.cumsum(lp_c).astype(jnp.int32)
+        starts_c = ends_c - lp_c
+
+        # --- orders block ------------------------------------------------
+        g_o, valid_o = _global_index(jnp, Pn, cap_o, sizes_o, offs_o)
+        lp_b = jnp.where(valid_o, jnp.take(lp_c, jnp.minimum(
+            g_o, jnp.int32(max(n_ord - 1, 0)))), 0)
+        st_b = jnp.take(starts_c, jnp.minimum(
+            g_o, jnp.int32(max(n_ord - 1, 0))))
+        ocols = _zero_invalid(
+            jnp, _orders_cols(jnp, seed, g_o, st_b, lp_b, n_cust), valid_o)
+
+        # --- lineitem block ----------------------------------------------
+        # owning order per line: marker at each order's first line + scan.
+        # Computed in COMPACT space (length n_li), then placed into the
+        # padded block (world=1: a plain pad; world>1: a block gather).
+        marker = jnp.zeros(max(n_li, 1), jnp.int32).at[starts_c].add(
+            1, mode="drop")
+        order_idx_c = jnp.cumsum(marker) - 1
+        if Pn == 1:
+            pad = cap_li - n_li
+            order_idx_b = jnp.pad(order_idx_c[:n_li], (0, pad))
+            li_b = jnp.pad(jnp.arange(n_li, dtype=jnp.int32), (0, pad))
+            valid_l = jnp.arange(cap_li) < n_li
+        else:
+            g_l, valid_l = _global_index(jnp, Pn, cap_li, sizes_l, offs_l)
+            safe = jnp.minimum(g_l, jnp.int32(max(n_li - 1, 0)))
+            order_idx_b = jnp.take(order_idx_c, safe)
+            li_b = safe
+        lcols = _zero_invalid(
+            jnp, _lineitem_cols(jnp, seed, li_b, order_idx_b, n_part,
+                                n_supp), valid_l)
+        return ocols, lcols
+
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.jit(fn, out_shardings=sharding)
+
+
+def _dtable_from_blocks(ctx, cols: Dict[str, object], n: int,
+                        which: str) -> "DTable":
+    from ..dtypes import DataType, Type
+    from ..parallel.dtable import DColumn, DTable
+    import jax
+    Pn, sizes, offs, cap = _block_layout(ctx, n)
+    dcols: List[DColumn] = []
+    for name in _COLUMN_ORDER[which]:
+        data = cols[name]
+        pool = _DICTS.get(name)
+        if pool is not None:
+            dcols.append(DColumn(name, DataType(Type.STRING), data,
+                                 dictionary=np.asarray(pool)))
+        elif name in _FLOAT_COLS:
+            dcols.append(DColumn(name, DataType(Type.FLOAT), data))
+        else:
+            dcols.append(DColumn(name, DataType(Type.INT32), data))
+    counts = jax.device_put(sizes, ctx.sharding())
+    return DTable(ctx, dcols, cap, counts)
+
+
+def generate_device(ctx, scale: float, seed: int = 42
+                    ) -> Dict[str, "DTable"]:
+    """All eight TPC-H tables as DTables, the big six generated IN HBM
+    (nation/region are 25/5 rows — host ingest is the cheaper dispatch)."""
+    from ..parallel.dtable import DTable
+    n_cust, n_ord, n_supp, n_part = _scale_counts(scale)
+    # n_li comes from the host replica of the same counter stream (cheap:
+    # one hash pass over n_ord) — jit needs it static
+    lp = _lines_per(np, seed, np.arange(n_ord, dtype=np.int32), None)
+    n_li = int(lp.sum())
+    mesh, axis = ctx.mesh, ctx.axis
+    _, _, _, cap_o = _block_layout(ctx, n_ord)
+    _, _, _, cap_li = _block_layout(ctx, n_li)
+    ocols, lcols = _orders_lineitem_fn(mesh, axis, seed, n_ord, n_li,
+                                       cap_o, cap_li, n_cust, n_part,
+                                       n_supp)()
+    out = {
+        "orders": _dtable_from_blocks(ctx, ocols, n_ord, "orders"),
+        "lineitem": _dtable_from_blocks(ctx, lcols, n_li, "lineitem"),
+    }
+    for which, n, extra in (("customer", n_cust, ()),
+                            ("supplier", n_supp, ()),
+                            ("part", n_part, ()),
+                            ("partsupp", n_part * SUPPLIERS_PER_PART,
+                             (n_supp,))):
+        _, _, _, cap = _block_layout(ctx, n)
+        cols = _elementwise_table_fn(mesh, axis, which, seed, n, cap,
+                                     extra)()
+        out[which] = _dtable_from_blocks(ctx, cols, n, which)
+    nation, region = _nation_region()
+    out["nation"] = DTable.from_pandas(ctx, nation)
+    out["region"] = DTable.from_pandas(ctx, region)
+    return out

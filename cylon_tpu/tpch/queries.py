@@ -190,14 +190,17 @@ def q3(ctx, t: Tables, segment: str = "BUILDING",
     cust = dist_select(dist_project(t["customer"],
                                     ["c_custkey", "c_mktsegment"]),
                        _pred_eq("c_mktsegment", seg))
+    # ~50% survivors on both sides: defer the selects — their masks fold
+    # into the dense FK probes below (one shared compaction per join,
+    # no standalone ~6 ns/row compaction scatter over 15M/60M rows)
     orders = dist_select(dist_project(t["orders"],
                                       ["o_orderkey", "o_custkey",
                                        "o_orderdate", "o_shippriority"]),
-                         _pred_lt("o_orderdate", day))
+                         _pred_lt("o_orderdate", day), compact=False)
     li = dist_select(dist_project(t["lineitem"],
                                   ["l_orderkey", "l_shipdate",
                                    "l_extendedprice", "l_discount"]),
-                     _pred_gt("l_shipdate", day))
+                     _pred_gt("l_shipdate", day), compact=False)
 
     # FK → PK orientation: probe the fact side against the unique-key side
     # (direct-address join, no sort)
@@ -251,7 +254,8 @@ def q5(ctx, t: Tables, region: str = "ASIA",
     # join on suppkey, THEN enforce the spec's c_nationkey = s_nationkey
     full = _strip_prefixes(dist_join(col, sn, _cfg("l_suppkey", "s_suppkey"),
                                      dense_key_range=_pk1(t, "supplier")))
-    full = dist_select(full, _pred_cols_eq("c_nationkey", "s_nationkey"))
+    full = dist_select(full, _pred_cols_eq("c_nationkey", "s_nationkey"),
+                       compact=False)  # mask rides into the groupby
     full = dist_with_column(full, "volume", _revenue, Type.DOUBLE)
     g = dist_groupby(full, ["n_name"], [("volume", "sum")])
     s = dist_sort(g, "sum_volume", ascending=False)
@@ -284,11 +288,12 @@ def q10(ctx, t: Tables, date: str = "1993-10-01", limit: int = 20) -> Table:
                                  ["o_orderkey", "o_custkey", "o_orderdate"]),
                     _pred_range("o_orderdate", d0, d0 + 92)),
         ["o_orderkey", "o_custkey"])
+    # ~33% survivors: deferred — the mask folds into the col probe
     li = dist_project(
         dist_select(dist_project(t["lineitem"],
                                  ["l_orderkey", "l_returnflag",
                                   "l_extendedprice", "l_discount"]),
-                    _pred_eq("l_returnflag", r_code)),
+                    _pred_eq("l_returnflag", r_code), compact=False),
         ["l_orderkey", "l_extendedprice", "l_discount"])
     cust = dist_project(t["customer"], ["c_custkey", "c_nationkey",
                                         "c_acctbal"])
@@ -354,10 +359,13 @@ def q4(ctx, t: Tables, date: str = "1993-07-01") -> Table:
                                        "o_orderdate"]),
                          _pred_q4(d0, d0 + 92))
     orders = dist_project(orders, ["o_orderkey", "o_orderpriority"])
+    # ~50% survivors: the deferred mask rides into the semi-join's
+    # presence-bit scatter (no 30M-row compaction of a 1-column table)
     li = dist_select(dist_project(t["lineitem"],
                                   ["l_orderkey", "l_commitdate",
                                    "l_receiptdate"]),
-                     _pred_cols_lt("l_commitdate", "l_receiptdate"))
+                     _pred_cols_lt("l_commitdate", "l_receiptdate"),
+                     compact=False)
     li = dist_project(li, ["l_orderkey"])
     # EXISTS ⇒ the semi-join primitive: one presence pass emits each
     # filtered order at most once regardless of how many of its lines
@@ -560,14 +568,19 @@ def q19(ctx, t: Tables) -> Table:
     )
     part = dist_select(part, _pred_isin("p_brand", brands))
     modes = _dict_codes(t["lineitem"], "l_shipmode", ("AIR", "REG AIR"))
+    # ~28% survivors: deferred into the dense FK probe (p_partkey is the
+    # part PK — unique/non-null/in-range holds for the FILTERED part too,
+    # unmatched probes simply drop under INNER)
     li = dist_select(dist_project(t["lineitem"],
                                   ["l_partkey", "l_quantity", "l_shipmode",
                                    "l_extendedprice", "l_discount"]),
-                     _pred_isin("l_shipmode", modes))
-    m = _strip_prefixes(dist_join(li, part, _cfg("l_partkey", "p_partkey")))
+                     _pred_isin("l_shipmode", modes), compact=False)
+    m = _strip_prefixes(dist_join(li, part, _cfg("l_partkey", "p_partkey"),
+                                  dense_key_range=_pk1(t, "part")))
     m = dist_select(m, _pred_q19(brands, containers,
                                  (1.0, 10.0, 20.0), (11.0, 20.0, 30.0),
-                                 (5, 10, 15)))
+                                 (5, 10, 15)),
+                    compact=False)  # mask rides into the aggregate
     m = dist_with_column(m, "rev", _revenue, Type.DOUBLE)
     agg = dist_aggregate(m, [("rev", "sum")])
     return _scalar_table(ctx, "revenue", agg.column("sum_rev").data)
@@ -808,10 +821,13 @@ def q7(ctx, t: Tables, nation1: str = "FRANCE",
     spec collapse to isin predicates + a host name map on the 4-row result."""
     k1, k2 = _nation_keys(t, [nation1, nation2])
     d0, d1 = date_to_days("1995-01-01"), date_to_days("1996-12-31")
+    # ~30% survivors: deferred — the mask folds into the ls probe's
+    # matched set (single compaction at the join output)
     li = dist_select(dist_project(t["lineitem"],
                                   ["l_orderkey", "l_suppkey", "l_shipdate",
                                    "l_extendedprice", "l_discount"]),
-                     _pred_range_incl("l_shipdate", d0, d1))
+                     _pred_range_incl("l_shipdate", d0, d1),
+                     compact=False)
     supp = dist_select(dist_project(t["supplier"],
                                     ["s_suppkey", "s_nationkey"]),
                        _pred_isin("s_nationkey", (k1, k2)))
@@ -829,7 +845,8 @@ def q7(ctx, t: Tables, nation1: str = "FRANCE",
                                      _cfg("o_custkey", "c_custkey"),
                                      dense_key_range=_pk1(t, "customer")))
     # both nationkeys ∈ {k1, k2}: inequality ⇔ the spec's (n1,n2)|(n2,n1)
-    full = dist_select(full, _pred_cols_ne("s_nationkey", "c_nationkey"))
+    full = dist_select(full, _pred_cols_ne("s_nationkey", "c_nationkey"),
+                       compact=False)  # mask rides into the groupby
     full = dist_with_column(full, "l_year", _year_of("l_shipdate"),
                             Type.INT32)
     full = dist_with_column(full, "volume", _revenue, Type.DOUBLE)
@@ -935,28 +952,29 @@ def q11(ctx, t: Tables, nation: str = "GERMANY",
 # -- Q13: customer distribution -----------------------------------------------
 
 def q13(ctx, t: Tables) -> Table:
-    """Orders-per-customer histogram INCLUDING zero-order customers:
-    LEFT join + count-valid (unmatched rows carry a null o_orderkey, which
-    count skips — the zero groups come out naturally)."""
+    """Orders-per-customer histogram INCLUDING zero-order customers.
+    The spec's LEFT join exists only to keep the zero groups — the dense
+    groupby's ``emit_empty`` produces them directly (every c_custkey in
+    [1, |customer|] is a group, zero-count keys included), eliminating
+    the 15M-row general sort join; the comment-filter select stays
+    deferred (its mask rides the groupby, no compaction)."""
     import re
     bad = _dict_codes_where(t["orders"], "o_comment",
                             lambda s: re.search("special.*requests", s)
                             is not None)
-    orders = dist_project(
-        dist_select(dist_project(t["orders"],
-                                 ["o_orderkey", "o_custkey", "o_comment"]),
-                    _pred_notin("o_comment", bad)),
-        ["o_orderkey", "o_custkey"])
-    cust = dist_project(t["customer"], ["c_custkey"])
-    m = _strip_prefixes(dist_join(
-        cust, orders, _cfg("c_custkey", "o_custkey", JoinType.LEFT)))
-    per_c = dist_groupby(m, ["c_custkey"], [("o_orderkey", "count")],
-                         dense_key_range=(1, _table_rows(t["customer"])))
-    g = dist_groupby(per_c, ["count_o_orderkey"], [("c_custkey", "count")])
-    g = dist_sort_multi(g, ["count_c_custkey", "count_o_orderkey"],
+    orders = dist_select(dist_project(t["orders"],
+                                      ["o_custkey", "o_comment"]),
+                         _pred_notin("o_comment", bad), compact=False)
+    per_c = dist_groupby(orders, ["o_custkey"],
+                         [("o_custkey", "count")],
+                         dense_key_range=(1, _table_rows(t["customer"])),
+                         emit_empty=True)
+    g = dist_groupby(per_c, ["count_o_custkey"],
+                     [("count_o_custkey", "count")])
+    g = dist_sort_multi(g, ["count_count_o_custkey", "count_o_custkey"],
                         ascending=[False, False])
-    return g.to_table().rename_column("count_o_orderkey", "c_count") \
-        .rename_column("count_c_custkey", "custdist")
+    return g.to_table().rename_column("count_o_custkey", "c_count") \
+        .rename_column("count_count_o_custkey", "custdist")
 
 
 # -- Q15: top supplier --------------------------------------------------------
@@ -970,7 +988,7 @@ def q15(ctx, t: Tables, date: str = "1996-01-01") -> Table:
     li = dist_select(dist_project(t["lineitem"],
                                   ["l_suppkey", "l_shipdate",
                                    "l_extendedprice", "l_discount"]),
-                     _pred_range("l_shipdate", d0, d1))
+                     _pred_range("l_shipdate", d0, d1), compact=False)
     li = dist_with_column(li, "rev", _revenue, Type.DOUBLE)
     revs = dist_groupby(li, ["l_suppkey"], [("rev", "sum")])
     mx = _device_scalar(dist_aggregate(revs, [("sum_rev", "max")]),
@@ -1044,7 +1062,8 @@ def q17(ctx, t: Tables, brand: str = "Brand#23",
     m = _strip_prefixes(dist_join(li, avg,
                                   _cfg("l_partkey", "apk", JoinType.LEFT),
                                   dense_key_range=_pk1(t, "part")))
-    sel = dist_select(m, _pred_cols_lt_scaled("l_quantity", 0.2, "avg_qty"))
+    sel = dist_select(m, _pred_cols_lt_scaled("l_quantity", 0.2, "avg_qty"),
+                      compact=False)  # mask rides into the aggregate
     agg = dist_aggregate(sel, [("l_extendedprice", "sum")])
     return _scalar_table(ctx, "avg_yearly",
                          agg.column("sum_l_extendedprice").data / 7.0)
@@ -1063,7 +1082,8 @@ def q20(ctx, t: Tables, color: str = "forest", date: str = "1994-01-01",
     li = dist_select(dist_project(t["lineitem"],
                                   ["l_partkey", "l_suppkey", "l_shipdate",
                                    "l_quantity"]),
-                     _pred_range("l_shipdate", d0, d0 + 365))
+                     _pred_range("l_shipdate", d0, d0 + 365),
+                     compact=False)  # mask rides into the semi probe
     li = dist_semi_join(li, part, "l_partkey", "p_partkey",
                         dense_key_range=(1, _table_rows(t["part"])))
     qty = dist_groupby(li, ["l_partkey", "l_suppkey"],
@@ -1077,7 +1097,8 @@ def q20(ctx, t: Tables, color: str = "forest", date: str = "1994-01-01",
     # spec's NULL-subquery comparison excludes them too
     m = _strip_prefixes(dist_join(ps, qty, _cfg(("ps_partkey", "ps_suppkey"),
                                                 ("qpk", "qsk"))))
-    m = dist_select(m, _pred_cols_gt_scaled("ps_availqty", 0.5, "sum_qty"))
+    m = dist_select(m, _pred_cols_gt_scaled("ps_availqty", 0.5, "sum_qty"),
+                    compact=False)  # mask rides into the groupby
     sup_ids = dist_groupby(m, ["ps_suppkey"], [("ps_suppkey", "count")])
     ck = _nation_keys(t, [nation])[0]
     supp = dist_select(dist_project(t["supplier"],
@@ -1103,7 +1124,8 @@ def q21(ctx, t: Tables, nation: str = "SAUDI ARABIA",
     orders_f = dist_project(
         dist_select(dist_project(t["orders"], ["o_orderkey",
                                                "o_orderstatus"]),
-                    _pred_eq("o_orderstatus", fcode)), ["o_orderkey"])
+                    _pred_eq("o_orderstatus", fcode), compact=False),
+        ["o_orderkey"])  # ~49% survivors: mask rides the presence scatter
     li = dist_project(t["lineitem"],
                       ["l_orderkey", "l_suppkey", "l_commitdate",
                        "l_receiptdate"])
@@ -1115,12 +1137,12 @@ def q21(ctx, t: Tables, nation: str = "SAUDI ARABIA",
     per_o = dist_groupby(per_os, ["l_orderkey"],
                          [("l_suppkey", "count"), ("max_late", "sum")],
                          dense_key_range=(1, _table_rows(t["orders"])))
-    cand = dist_select(per_o, _pred_q21_cand)
+    cand = dist_select(per_o, _pred_q21_cand, compact=False)
     supp_sa = dist_project(
         dist_select(dist_project(t["supplier"], ["s_suppkey",
                                                  "s_nationkey"]),
                     _pred_eq("s_nationkey", sk)), ["s_suppkey"])
-    l1 = dist_select(li, _pred_eq("late", 1))
+    l1 = dist_select(li, _pred_eq("late", 1), compact=False)
     l1 = dist_semi_join(l1, supp_sa, "l_suppkey", "s_suppkey",
                         dense_key_range=(1, _table_rows(t["supplier"])))
     l1 = dist_semi_join(l1, cand, "l_orderkey", "l_orderkey",
@@ -1145,7 +1167,8 @@ def q22(ctx, t: Tables,
     avg = _device_scalar(dist_aggregate(cust, [("c_acctbal", "mean")],
                                         where=_pred_gt("c_acctbal", 0.0)),
                          "mean_c_acctbal")
-    rich = dist_select(cust, _pred_gt_param("c_acctbal"), params=(avg,))
+    rich = dist_select(cust, _pred_gt_param("c_acctbal"), params=(avg,),
+                       compact=False)  # mask rides into the anti probe
     orders = dist_project(t["orders"], ["o_custkey"])
     noord = dist_anti_join(rich, orders, "c_custkey", "o_custkey",
                            dense_key_range=(1, _table_rows(t["customer"])))

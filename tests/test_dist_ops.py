@@ -767,6 +767,61 @@ def test_dist_groupby_dense_keys_past_int32(dctx, rng):
     assert_same_rows(out, w)
 
 
+def test_dist_groupby_dense_emit_empty(dctx, rng):
+    """emit_empty: every key in [lo, hi] appears, zero-count included
+    (count 0 / sum 0 / null min) — the LEFT-join-the-universe replacement."""
+    df = pd.DataFrame({"k": rng.choice([2, 3, 5, 7, 11, 13], 300)
+                       .astype(np.int64),
+                       "v": rng.normal(size=300)})
+    dt = dtable_from_pandas(dctx, df)
+    out = dist_groupby(dt, ["k"], [("v", "count"), ("v", "sum"),
+                                   ("v", "min")],
+                       dense_key_range=(1, 15), emit_empty=True) \
+        .to_table().to_pandas()
+    assert len(out) == 15 and set(out["k"]) == set(range(1, 16))
+    w = df.groupby("k")["v"].agg(["count", "sum", "min"])
+    for _, row in out.iterrows():
+        k = int(row["k"])
+        if k in w.index:
+            assert row["count_v"] == w.loc[k, "count"]
+            np.testing.assert_allclose(row["sum_v"], w.loc[k, "sum"],
+                                       rtol=1e-5)
+        else:
+            assert row["count_v"] == 0 and row["sum_v"] == 0
+            assert pd.isna(row["min_v"])
+
+
+def test_dist_groupby_dense_emit_empty_nullable_uneven(dctx, rng):
+    """Nullable key + a range shorter than shards·slots: the null group
+    must land in the compact prefix (not past ngroups) and short residue
+    classes must not emit garbage rows."""
+    df = pd.DataFrame({
+        "k": pd.array([1, 3, 3, None, 5, None, 2, 1], dtype="Int64"),
+        "v": rng.normal(size=8),
+    })
+    dt = dtable_from_pandas(dctx, df)
+    out = dist_groupby(dt, ["k"], [("v", "count"), ("v", "sum")],
+                       dense_key_range=(1, 5), emit_empty=True,
+                       pre_aggregate=False) \
+        .to_table().to_pandas()
+    # 5 real keys + 1 null group, each exactly once
+    assert len(out) == 6
+    keys = out["k"].to_numpy()
+    assert pd.isna(keys).sum() == 1
+    assert set(int(k) for k in keys[~pd.isna(keys)]) == {1, 2, 3, 4, 5}
+    by = {(-1 if pd.isna(k) else int(k)): int(c)
+          for k, c in zip(out["k"], out["count_v"])}
+    assert by == {1: 2, 2: 1, 3: 2, 4: 0, 5: 1, -1: 2}
+
+
+def test_dist_groupby_emit_empty_needs_dense(dctx, rng):
+    from cylon_tpu.status import CylonError
+    df = pd.DataFrame({"k": rng.integers(0, 5, 20), "v": rng.normal(size=20)})
+    dt = dtable_from_pandas(dctx, df)
+    with pytest.raises(CylonError, match="emit_empty"):
+        dist_groupby(dt, ["k"], [("v", "sum")], emit_empty=True)
+
+
 def test_dist_groupby_dense_range_violation_raises(dctx, rng):
     from cylon_tpu.status import CylonError
     df = pd.DataFrame({"k": rng.integers(0, 100, 50),

@@ -1,0 +1,242 @@
+"""Deferred-select (mask-carrying DTable) fusion: every consumer must
+produce exactly what compact-first produces.
+
+``dist_select(..., compact=False)`` skips the compaction scatter and
+hands the row mask downstream; these tests pin the contract that this is
+a pure performance choice — results are identical whether the mask is
+folded (groupby/aggregate/dense probes/FK join/select chains) or
+collapsed on first touch (sorts, set ops, the general join, export).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import JoinConfig, JoinType, JoinAlgorithm
+from cylon_tpu.parallel import (DTable, dist_aggregate, dist_anti_join,
+                                dist_groupby, dist_join, dist_select,
+                                dist_semi_join, dist_sort, dist_union,
+                                dist_with_column, run_pipeline)
+
+
+def _dt(dctx, df):
+    return DTable.from_pandas(dctx, df)
+
+
+def _frame(rng, n=600):
+    return pd.DataFrame({
+        "k": rng.integers(1, 60, n).astype(np.int64),
+        "v": rng.normal(size=n),
+        "w": pd.array(np.where(rng.random(n) < 0.15, None,
+                               rng.integers(0, 9, n).astype(float)),
+                      dtype="Float64"),
+    })
+
+
+PRED = staticmethod(lambda env: env["v"] > 0.0)
+
+
+def pred(env):
+    return env["v"] > 0.0
+
+
+def pred2(env):
+    return env["k"] % 2 == 0
+
+
+def same(a, b):
+    def norm(df):
+        out = df.copy()
+        for c in out.columns:
+            if str(out[c].dtype) in ("Float64", "Int64"):
+                out[c] = out[c].astype("float64")  # NA → nan
+        return out
+    a, b = norm(a), norm(b)
+    ka = a.sort_values(list(a.columns)).reset_index(drop=True)
+    kb = b[list(a.columns)].sort_values(list(a.columns)) \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(ka, kb, check_dtype=False)
+
+
+def test_deferred_select_collapses_on_export(dctx, rng):
+    df = _frame(rng)
+    dt = _dt(dctx, df)
+    got = dist_select(dt, pred, compact=False).to_table().to_pandas()
+    want = dist_select(_dt(dctx, df), pred).to_table().to_pandas()
+    same(got, want)
+    assert len(got) == (df["v"] > 0).sum()
+
+
+def test_deferred_select_chain_folds(dctx, rng):
+    df = _frame(rng)
+    a = dist_select(_dt(dctx, df), pred, compact=False)
+    b = dist_select(a, pred2, compact=False)
+    assert b.pending_mask is not None
+    got = b.to_table().to_pandas()
+    want = df[(df["v"] > 0) & (df["k"] % 2 == 0)]
+    same(got, want)
+
+
+def test_deferred_into_groupby_sort_and_dense(dctx, rng):
+    df = _frame(rng)
+    aggs = [("v", "sum"), ("v", "count"), ("w", "min")]
+    want = dist_groupby(
+        dist_select(_dt(dctx, df), pred), ["k"], aggs) \
+        .to_table().to_pandas()
+    for dense in (None, (1, 59)):
+        d = dist_select(_dt(dctx, df), pred, compact=False)
+        got = dist_groupby(d, ["k"], aggs, dense_key_range=dense) \
+            .to_table().to_pandas()
+        same(got, want)
+
+
+def test_deferred_into_groupby_with_where(dctx, rng):
+    df = _frame(rng)
+    d = dist_select(_dt(dctx, df), pred, compact=False)
+    got = dist_groupby(d, ["k"], [("v", "sum")], where=pred2) \
+        .to_table().to_pandas()
+    want = dist_groupby(dist_select(_dt(dctx, df), pred), ["k"],
+                        [("v", "sum")], where=pred2).to_table().to_pandas()
+    same(got, want)
+
+
+def test_deferred_into_scalar_aggregate(dctx, rng):
+    df = _frame(rng)
+    d = dist_select(_dt(dctx, df), pred, compact=False)
+    got = dist_aggregate(d, [("v", "sum"), ("v", "count")]).to_pandas()
+    w = df[df["v"] > 0]
+    assert got["count_v"].iloc[0] == len(w)
+    np.testing.assert_allclose(got["sum_v"].iloc[0], w["v"].sum(),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("anti", [False, True])
+@pytest.mark.parametrize("dense", [None, (1, 59)])
+def test_deferred_into_semi_anti_both_sides(dctx, rng, anti, dense):
+    df = _frame(rng)
+    rk = pd.DataFrame({"k": rng.integers(1, 60, 40).astype(np.int64),
+                       "x": rng.normal(size=40)})
+    op = dist_anti_join if anti else dist_semi_join
+    want = op(dist_select(_dt(dctx, df), pred),
+              dist_select(_dt(dctx, rk), pred2), "k", "k",
+              dense_key_range=dense).to_table().to_pandas()
+    got = op(dist_select(_dt(dctx, df), pred, compact=False),
+             dist_select(_dt(dctx, rk), pred2, compact=False), "k", "k",
+             dense_key_range=dense).to_table().to_pandas()
+    same(got, want)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_deferred_into_fk_join(dctx, rng, how):
+    """world > 1: the deferred mask folds into the modulo-routed shuffle
+    (masked rows never cross the wire)."""
+    df = _frame(rng)
+    pk = pd.DataFrame({"k": np.arange(1, 60, dtype=np.int64),
+                       "c": rng.normal(size=59)})
+    cfg = JoinConfig(JoinType(how), JoinAlgorithm.SORT, 0, 0)
+    want = dist_join(dist_select(_dt(dctx, df), pred), _dt(dctx, pk),
+                     cfg, dense_key_range=(1, 59)).to_table().to_pandas()
+    d = dist_select(_dt(dctx, df), pred, compact=False)
+    out = dist_join(d, _dt(dctx, pk), cfg, dense_key_range=(1, 59))
+    got = out.to_table().to_pandas()
+    same(got, want)
+
+
+@pytest.fixture(scope="module")
+def dctx1():
+    """Single-device context: the regime where the FK-LEFT attach keeps
+    the probe zero-copy and the deferred mask rides the output."""
+    import jax
+    from cylon_tpu import CylonContext
+    return CylonContext({"backend": "tpu",
+                         "devices": jax.devices("cpu")[:1]})
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_deferred_into_fk_join_world1(dctx1, rng, how):
+    df = _frame(rng)
+    pk = pd.DataFrame({"k": np.arange(1, 60, dtype=np.int64),
+                       "c": rng.normal(size=59)})
+    cfg = JoinConfig(JoinType(how), JoinAlgorithm.SORT, 0, 0)
+    want = dist_join(dist_select(_dt(dctx1, df), pred), _dt(dctx1, pk),
+                     cfg, dense_key_range=(1, 59)).to_table().to_pandas()
+    d = dist_select(_dt(dctx1, df), pred, compact=False)
+    out = dist_join(d, _dt(dctx1, pk), cfg, dense_key_range=(1, 59))
+    if how == "left":
+        # zero-copy attach: the filter must STILL be deferred on the output
+        assert out.pending_mask is not None
+    got = out.to_table().to_pandas()
+    same(got, want)
+
+
+def test_deferred_fk_left_then_groupby_no_compaction(dctx1, rng):
+    """The full fused pipeline (single chip): select (deferred) → FK-LEFT
+    attach (mask rides) → groupby consuming the mask — zero compactions,
+    numbers must match pandas."""
+    df = _frame(rng)
+    pk = pd.DataFrame({"k": np.arange(1, 60, dtype=np.int64),
+                       "c": rng.normal(size=59)})
+    d = dist_select(_dt(dctx1, df), pred, compact=False)
+    j = dist_join(d, _dt(dctx1, pk),
+                  JoinConfig(JoinType.LEFT, JoinAlgorithm.SORT, 0, 0),
+                  dense_key_range=(1, 59))
+    assert j.pending_mask is not None
+    g = dist_groupby(j, ["rt-c"], [("lt-v", "sum")])
+    got = g.to_table().to_pandas()
+    w = df[df["v"] > 0].merge(pk, on="k", how="left")
+    want = w.groupby("c")["v"].sum().reset_index()
+    want.columns = ["rt-c", "sum_lt-v"]
+    same(got, want)
+
+
+def test_deferred_into_general_join_materializes(dctx, rng):
+    df = _frame(rng)
+    rk = pd.DataFrame({"k": rng.integers(1, 60, 80).astype(np.int64),
+                       "x": rng.normal(size=80)})
+    cfg = JoinConfig.InnerJoin(0, 0)
+    d = dist_select(_dt(dctx, df), pred, compact=False)
+    got = dist_join(d, _dt(dctx, rk), cfg).to_table().to_pandas()
+    want = dist_join(dist_select(_dt(dctx, df), pred), _dt(dctx, rk),
+                     cfg).to_table().to_pandas()
+    same(got, want)
+
+
+def test_deferred_into_sort_and_union_materialize(dctx, rng):
+    df = _frame(rng)[["k", "v"]]
+    d = dist_select(_dt(dctx, df), pred, compact=False)
+    s = dist_sort(d, "k").to_table().to_pandas()
+    w = df[df["v"] > 0]
+    assert (s["k"].to_numpy() == np.sort(w["k"].to_numpy())).all()
+    d2 = dist_select(_dt(dctx, df), pred, compact=False)
+    u = dist_union(d2, _dt(dctx, w)).to_table()
+    assert u.num_rows == len(w.drop_duplicates())
+
+
+def test_deferred_with_column_rides(dctx, rng):
+    from cylon_tpu.dtypes import Type
+    df = _frame(rng)
+    d = dist_select(_dt(dctx, df), pred, compact=False)
+    d = dist_with_column(d, "v2", lambda env: env["v"] * 2.0, Type.DOUBLE)
+    assert d.pending_mask is not None
+    got = dist_aggregate(d, [("v2", "sum")]).to_pandas()
+    np.testing.assert_allclose(got["sum_v2"].iloc[0],
+                               2.0 * df[df["v"] > 0]["v"].sum(), rtol=1e-5)
+
+
+def test_deferred_inside_run_pipeline(dctx, rng):
+    """Deferred masks + the deferred-validation replay protocol."""
+    df = _frame(rng)
+    pk = pd.DataFrame({"k": np.arange(1, 60, dtype=np.int64),
+                       "c": rng.normal(size=59)})
+    dt, pkt = _dt(dctx, df), _dt(dctx, pk)
+
+    def plan():
+        d = dist_select(dt, pred, compact=False)
+        j = dist_join(d, pkt,
+                      JoinConfig(JoinType.LEFT, JoinAlgorithm.SORT, 0, 0),
+                      dense_key_range=(1, 59))
+        return dist_groupby(j, ["rt-c"], [("lt-v", "sum")]).to_table()
+    got = run_pipeline(plan).to_pandas()
+    w = df[df["v"] > 0].merge(pk, on="k", how="left")
+    want = w.groupby("c")["v"].sum().reset_index()
+    want.columns = ["rt-c", "sum_lt-v"]
+    same(got, want)
