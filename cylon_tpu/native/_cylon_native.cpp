@@ -129,6 +129,63 @@ PyObject* py_murmur3_32_bytes(PyObject*, PyObject* args) {
 }
 
 // ---------------------------------------------------------------------------
+// hash64 string encode: object array of str/bytes -> two uint32 hash lanes
+// (murmur3_32 under two seeds = the 64-bit key identity the device joins
+// and shuffles on; payload strings stay host-side — SURVEY.md §7 hard
+// part 2's hash64 + host-payload strategy)
+// ---------------------------------------------------------------------------
+
+PyObject* py_hash64_strings(PyObject*, PyObject* args) {
+  PyObject* in_obj;
+  unsigned int seed0 = 0x9747B28CU, seed1 = 0x85EBCA6BU;
+  if (!PyArg_ParseTuple(args, "O|II", &in_obj, &seed0, &seed1))
+    return nullptr;
+  PyArrayObject* in = reinterpret_cast<PyArrayObject*>(PyArray_FROM_OTF(
+      in_obj, NPY_OBJECT, NPY_ARRAY_IN_ARRAY));
+  if (!in) return nullptr;
+  npy_intp n = PyArray_SIZE(in);
+  PyArrayObject* h0 = reinterpret_cast<PyArrayObject*>(
+      PyArray_SimpleNew(1, &n, NPY_UINT32));
+  PyArrayObject* h1 = reinterpret_cast<PyArrayObject*>(
+      PyArray_SimpleNew(1, &n, NPY_UINT32));
+  if (!h0 || !h1) { Py_XDECREF(h0); Py_XDECREF(h1); Py_DECREF(in);
+    return nullptr; }
+  PyObject** src = static_cast<PyObject**>(PyArray_DATA(in));
+  uint32_t* d0 = static_cast<uint32_t*>(PyArray_DATA(h0));
+  uint32_t* d1 = static_cast<uint32_t*>(PyArray_DATA(h1));
+  for (npy_intp i = 0; i < n; i++) {
+    PyObject* o = src[i];
+    const char* buf = nullptr;
+    Py_ssize_t len = 0;
+    if (o == Py_None) {
+      d0[i] = 0; d1[i] = 0;  // caller masks nulls via validity
+      continue;
+    }
+    if (PyUnicode_Check(o)) {
+      buf = PyUnicode_AsUTF8AndSize(o, &len);
+      if (!buf) { Py_DECREF(h0); Py_DECREF(h1); Py_DECREF(in);
+        return nullptr; }
+    } else if (PyBytes_Check(o)) {
+      buf = PyBytes_AS_STRING(o);
+      len = PyBytes_GET_SIZE(o);
+    } else {
+      PyErr_SetString(PyExc_TypeError,
+                      "hash64_strings: elements must be str/bytes/None");
+      Py_DECREF(h0); Py_DECREF(h1); Py_DECREF(in);
+      return nullptr;
+    }
+    d0[i] = murmur3_32(buf, static_cast<size_t>(len), seed0);
+    d1[i] = murmur3_32(buf, static_cast<size_t>(len), seed1);
+  }
+  Py_DECREF(in);
+  PyObject* tup = PyTuple_Pack(2, reinterpret_cast<PyObject*>(h0),
+                               reinterpret_cast<PyObject*>(h1));
+  Py_DECREF(h0);
+  Py_DECREF(h1);
+  return tup;
+}
+
+// ---------------------------------------------------------------------------
 // dictionary encode: object array of str -> (int32 codes, sorted uniques)
 // ---------------------------------------------------------------------------
 
@@ -305,6 +362,8 @@ PyMethodDef module_methods[] = {
      "murmur3_32_u32(uint32 array, seed=0) -> uint32 array"},
     {"murmur3_32_u64", py_murmur3_32_u64, METH_VARARGS,
      "murmur3_32_u64(uint64 array, seed=0) -> uint32 array"},
+    {"hash64_strings", py_hash64_strings, METH_VARARGS,
+     "hash64_strings(object array[, seed0, seed1]) -> (uint32, uint32)"},
     {"murmur3_32_bytes", py_murmur3_32_bytes, METH_VARARGS,
      "murmur3_32_bytes(bytes, seed=0) -> int"},
     {"dictionary_encode", py_dictionary_encode, METH_VARARGS,

@@ -45,6 +45,69 @@ def dictionary_encode(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return codes.astype(np.int32), dictionary
 
 
+_H64_SEED0, _H64_SEED1 = 0x9747B28C, 0x85EBCA6B
+
+
+def murmur3_32_bytes(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3_x86_32 of a byte string (matches the C++ murmur3_32;
+    pure-python fallback mirrors it bit for bit)."""
+    if _ext is not None:
+        return int(_ext.murmur3_32_bytes(data, np.uint32(seed)))
+    M = 0xFFFFFFFF
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & M
+    n = len(data)
+    for i in range(0, n - n % 4, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & M
+        k = ((k << 15) | (k >> 17)) & M
+        k = (k * c2) & M
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & M
+        h = (h * 5 + 0xE6546B64) & M
+    tail = data[n - n % 4:]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & M
+        k = ((k << 15) | (k >> 17)) & M
+        k = (k * c2) & M
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & M
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & M
+    return h ^ (h >> 16)
+
+
+def hash64_strings(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """values (1-D object array of str/bytes/None) → two uint32 murmur3
+    lanes under independent seeds — the 64-bit key identity the device
+    joins/shuffles on (SURVEY §7 hash64 + host-payload strategy).  None
+    hashes to (0, 0); callers mask nulls via validity.  Native C++ when
+    built; per-element murmur3_32_bytes fallback otherwise."""
+    values = np.asarray(values, dtype=object)
+    # getattr guard: a stale .so built before this entry existed must
+    # degrade to the bit-identical fallback, not AttributeError
+    fn = getattr(_ext, "hash64_strings", None) if _ext is not None else None
+    if fn is not None:
+        return fn(values, _H64_SEED0, _H64_SEED1)
+    h0 = np.zeros(len(values), np.uint32)
+    h1 = np.zeros(len(values), np.uint32)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        b = v.encode() if isinstance(v, str) else v
+        h0[i] = murmur3_32_bytes(b, _H64_SEED0)
+        h1[i] = murmur3_32_bytes(b, _H64_SEED1)
+    return h0, h1
+
+
 # ---------------------------------------------------------------------------
 # murmur3 (host reference implementation; device version is ops/hash.py)
 # ---------------------------------------------------------------------------

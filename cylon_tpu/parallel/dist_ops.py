@@ -184,6 +184,11 @@ def _shuffle_by_pids(dt: DTable, pid: jax.Array) -> DTable:
                 dt.ctx.get_world_size() * outcap)
     trace.count("shuffle.capacity_cells",
                 dt.ctx.get_world_size() * outcap * len(leaves))
+    # peak SINGLE exchange block (the sum above over-states transients
+    # for staged plans like the streaming join, whose chunks free their
+    # blocks before the next one allocates)
+    trace.count_max("shuffle.capacity_cells_max",
+                    dt.ctx.get_world_size() * outcap * len(leaves))
     data = {}
     validity = {}
     for leaf, (i, is_v) in zip(new_leaves, slots):

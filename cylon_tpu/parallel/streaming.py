@@ -140,6 +140,10 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
         left, right, config)
     rsh = _copartition(right, ri_key, alg, splitters)  # once, resident
 
+    def _cells(dt: DTable) -> int:
+        per_row = sum(1 + (c.validity is not None) for c in dt.columns)
+        return dt.ctx.get_world_size() * dt.cap * per_row
+
     w = ops_compact.next_bucket(math.ceil(left.cap / chunks), minimum=8)
     parts: List[DTable] = []
     how = config.join_type.value
@@ -148,6 +152,12 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
             hi = min(lo + w, left.cap)
             chunk = _slice_rows(left, lo, hi)
             csh = _copartition(chunk, li_key, alg, splitters)
+            # the live exchange transient of the staged plan is the
+            # RESIDENT right co-partition PLUS the in-flight chunk block
+            # — peak-of-single-block would under-report it by up to 2x
+            # (experiments/sf100_plan.py projects from this counter)
+            trace.count_max("shuffle.capacity_cells_live_peak",
+                            _cells(rsh) + _cells(csh))
             parts.append(_join_copartitioned(csh, rsh, li_key, ri_key,
                                              how, alg))
     return _concat_compact(parts)

@@ -382,7 +382,8 @@ def q4(ctx, t: Tables, date: str = "1993-07-01") -> Table:
 
 # -- Q9: product type profit measure ------------------------------------------
 
-def q9(ctx, t: Tables, color: str = "green") -> Table:
+def q9(ctx, t: Tables, color: str = "green",
+       streaming_chunks: int = 0) -> Table:
     codes = _dict_codes_where(t["part"], "p_name", lambda s: color in s)
     part = dist_project(dist_select(dist_project(t["part"],
                                                  ["p_partkey", "p_name"]),
@@ -397,9 +398,21 @@ def q9(ctx, t: Tables, color: str = "green") -> Table:
                         dense_key_range=(1, _table_rows(t["part"])))
     ps = dist_project(t["partsupp"],
                       ["ps_partkey", "ps_suppkey", "ps_supplycost"])
-    lps = _strip_prefixes(dist_join(
-        lp, ps, _cfg(("l_partkey", "l_suppkey"),
-                     ("ps_partkey", "ps_suppkey"))))
+    # the ONE lineitem-scale composite-key join the dense FK path cannot
+    # take — SF-100+'s widest transient.  ``streaming_chunks > 0`` stages
+    # the probe side through dist_join_streaming: partsupp co-partitions
+    # once (resident), lineitem chunks exchange one at a time, so the
+    # live exchange footprint drops from three fact-scale co-partitions
+    # at once to resident-partsupp + one chunk in flight
+    # (experiments/sf100_plan.py records both; BASELINE.md derives the
+    # per-chip ceiling from it)
+    cfg9 = _cfg(("l_partkey", "l_suppkey"), ("ps_partkey", "ps_suppkey"))
+    if streaming_chunks > 0:
+        from ..parallel.streaming import dist_join_streaming
+        lps = _strip_prefixes(dist_join_streaming(
+            lp, ps, cfg9, chunks=streaming_chunks))
+    else:
+        lps = _strip_prefixes(dist_join(lp, ps, cfg9))
     sn = _strip_prefixes(dist_join(
         dist_project(t["supplier"], ["s_suppkey", "s_nationkey"]),
         dist_project(t["nation"], ["n_nationkey", "n_name"]),

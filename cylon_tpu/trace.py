@@ -161,6 +161,16 @@ def count(name: str, n: int = 1) -> None:
     c[name] = c.get(name, 0) + int(n)
 
 
+def count_max(name: str, n: int) -> None:
+    """Record the MAX a named quantity reaches (peak single-exchange
+    block size, etc. — where the transient footprint is the max, not the
+    sum)."""
+    if not _enabled:
+        return
+    c = _counters()
+    c[name] = max(c.get(name, 0), int(n))
+
+
 def reset() -> None:
     _state.spans = []
     _state.counters = {}

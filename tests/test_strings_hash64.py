@@ -1,0 +1,113 @@
+"""Hash64 string keys (cylon_tpu.strings): high-cardinality string joins
+without dictionaries — encode, join on the lane pair, resolve payloads."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import JoinConfig
+from cylon_tpu import strings as cstr
+from cylon_tpu.parallel import DTable, dist_groupby, dist_join
+
+
+def _rand_strings(rng, n, n_distinct):
+    pool = np.array([f"user-{i:08x}-{i * 2654435761 % 97:02d}"
+                     for i in range(n_distinct)], dtype=object)
+    return pool[rng.integers(0, n_distinct, n)]
+
+
+def test_encode_resolve_roundtrip(rng):
+    df = pd.DataFrame({"k": _rand_strings(rng, 500, 200),
+                       "v": rng.normal(size=500)})
+    enc, store = cstr.encode_frame(df)
+    assert list(enc.columns) == ["k#h0", "k#h1", "v"]
+    assert enc["k#h0"].dtype == np.int32
+    back = store.resolve_frame(enc)
+    np.testing.assert_array_equal(back["k"].to_numpy(), df["k"].to_numpy())
+
+
+def test_hash64_join_matches_pandas(dctx, rng):
+    """The headline path: join two frames on a string key via the lane
+    pair — result must equal pandas, and NO dictionary may exist on the
+    key columns (the np.unique/unify path is provably bypassed)."""
+    ldf = pd.DataFrame({"k": _rand_strings(rng, 800, 300),
+                       "a": rng.normal(size=800)})
+    rdf = pd.DataFrame({"k": np.array(sorted(set(ldf["k"]))[:250],
+                                      dtype=object),
+                        "b": rng.normal(size=250)})
+    store = cstr.StringStore()
+    lenc, _ = cstr.encode_frame(ldf, ["k"], store)
+    renc, _ = cstr.encode_frame(rdf, ["k"], store)
+    lt = DTable.from_pandas(dctx, lenc)
+    rt = DTable.from_pandas(dctx, renc)
+    for c in lt.columns + rt.columns:
+        assert c.dictionary is None  # nothing dictionary-encoded anywhere
+    cfg = JoinConfig.InnerJoin(("k#h0", "k#h1"), ("k#h0", "k#h1"))
+    out = dist_join(lt, rt, cfg).to_table().to_pandas()
+    got = store.resolve_frame(
+        out.rename(columns={"lt-k#h0": "k#h0", "lt-k#h1": "k#h1"})
+        [["k#h0", "k#h1", "lt-a", "rt-b"]])
+    exp = ldf.merge(rdf, on="k", how="inner")
+    key = lambda d, cols: d.sort_values(cols).reset_index(drop=True)  # noqa
+    pd.testing.assert_frame_equal(
+        key(got.rename(columns={"lt-a": "a", "rt-b": "b"}),
+            ["k", "a", "b"])[["k", "a", "b"]],
+        key(exp, ["k", "a", "b"]), check_dtype=False)
+
+
+def test_hash64_groupby_on_lanes(dctx, rng):
+    df = pd.DataFrame({"k": _rand_strings(rng, 600, 40),
+                       "v": rng.normal(size=600)})
+    enc, store = cstr.encode_frame(df, ["k"])
+    dt = DTable.from_pandas(dctx, enc)
+    g = dist_groupby(dt, ["k#h0", "k#h1"], [("v", "sum"), ("v", "count")])
+    got = store.resolve_frame(g.to_table().to_pandas())
+    exp = df.groupby("k")["v"].agg(["sum", "count"]).reset_index()
+    got = got.sort_values("k").reset_index(drop=True)
+    exp = exp.sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(got["k"].to_numpy(), exp["k"].to_numpy())
+    np.testing.assert_allclose(got["sum_v"].to_numpy(),
+                               exp["sum"].to_numpy(), rtol=1e-5)
+    np.testing.assert_array_equal(got["count_v"].to_numpy(),
+                                  exp["count"].to_numpy())
+
+
+def test_collision_detected_at_ingest():
+    """The within-column detection the collision policy promises: two
+    different strings forced onto one 64-bit hash must raise."""
+    from cylon_tpu.status import CylonError
+    store = cstr.StringStore()
+    h0 = np.array([7, 7], dtype=np.int32)
+    h1 = np.array([9, 9], dtype=np.int32)
+    store.register("k", np.array(["a", "a"], dtype=object), h0, h1)  # ok
+    with pytest.raises(CylonError, match="collision"):
+        store.register("k", np.array(["b"], dtype=object),
+                       h0[:1], h1[:1])
+
+
+def test_null_keys_masked(dctx, rng):
+    """None hashes to (0,0); a validity-style treatment is the caller's
+    choice — here we check resolve returns None for unknown pairs."""
+    store = cstr.StringStore()
+    enc, _ = cstr.encode_frame(
+        pd.DataFrame({"k": np.array(["x", None, "y"], dtype=object)}),
+        ["k"], store)
+    back = store.resolve("k", enc["k#h0"].to_numpy()[1:2],
+                         enc["k#h1"].to_numpy()[1:2])
+    assert back[0] is None
+
+
+def test_native_and_fallback_agree(rng):
+    from cylon_tpu.native import runtime as nat
+    if not nat.have_native():
+        pytest.skip("native extension not built")
+    vals = np.array(["alpha", "beta", "γδε", b"raw", None], dtype=object)
+    n0, n1 = nat.hash64_strings(vals)
+    # force the fallback path
+    ext = nat._ext
+    try:
+        nat._ext = None
+        f0, f1 = nat.hash64_strings(vals)
+    finally:
+        nat._ext = ext
+    np.testing.assert_array_equal(n0, f0)
+    np.testing.assert_array_equal(n1, f1)

@@ -598,3 +598,11 @@ def test_q22(dctx, data, dtables):
         got[c2] = got[c2].astype(np.int64)
         g[c2] = g[c2].astype(np.int64)
     _assert_rowset_equal(got, g, ["cntrycode"])
+
+
+def test_q9_streaming_matches_oneshot(dctx, data, dtables):
+    """The staged (chunked) Q9 plan — SF-200's transient mitigation —
+    must produce exactly the one-shot plan's rows."""
+    base = _frame(queries.q9(dctx, dtables))
+    stream = _frame(queries.q9(dctx, dtables, streaming_chunks=4))
+    _assert_rowset_equal(stream, base, ["n_name", "o_year"])
