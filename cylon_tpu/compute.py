@@ -50,8 +50,14 @@ def _gather_columns(tb: Table, indices: jax.Array, fill_null: bool,
 
 
 def _slice_columns(cols: List[Column], count: int) -> List[Column]:
+    # slicing preserves a prefix, so the host caches slice along (and
+    # stale full-length caches must never survive a shape change)
     return [replace(c, data=c.data[:count],
-                    validity=None if c.validity is None else c.validity[:count])
+                    validity=None if c.validity is None else c.validity[:count],
+                    host_data=None if c.host_data is None
+                    else c.host_data[:count],
+                    host_validity=None if c.host_validity is None
+                    else c.host_validity[:count])
             for c in cols]
 
 
@@ -100,10 +106,10 @@ def join(left: Table, right: Table, config: JoinConfig) -> Table:
     """Local equi-join; output columns renamed ``lt-…`` / ``rt-…``
     (reference: join/join_utils.cpp:23-95 build_final_table).
 
-    ``algorithm='hash'`` runs the bucket-probe hash kernel
-    (ops/hashjoin.py); ``'sort'`` the argsort/searchsorted kernel
-    (ops/join.py) — mirroring the reference's SORT/HASH split
-    (join/join.cpp:247 do_hash_join vs :51 do_sorted_join).
+    Both algorithms run the sort-plan kernel (ops/join.py) by default —
+    see JoinConfig's docstring and ``dist_ops.HASH_LOCAL_KERNEL`` for the
+    measured retirement of the separate hash local kernel
+    (ops/hashjoin.py, re-enabled by flipping the switch).
     """
     return join_on(left, right, [config.left_column_idx],
                    [config.right_column_idx], config.join_type.value,
@@ -122,7 +128,9 @@ def join_on(left: Table, right: Table,
     dense-rank keying handles any number of key columns directly.
     """
     left, right, lk, rk = _join_key_ranks(left, right, left_on, right_on)
-    if algorithm == JoinAlgorithm.HASH:
+    from .parallel import dist_ops as _dist_ops  # shared retirement switch
+    if (algorithm == JoinAlgorithm.HASH
+            and _dist_ops.HASH_LOCAL_KERNEL != "sort"):
         total = int(ops_hashjoin.hash_join_count(lk, rk, how))
         cap = ops_compact.next_bucket(total)
         li, ri, cnt = ops_hashjoin.hash_join_indices(lk, rk, how, cap)
@@ -291,7 +299,8 @@ def groupby(t: Table, key_columns: Sequence[Union[int, str]],
         for c, op in aggregations:
             base = t.column(c)
             acols.append(Column(f"{op}_{base.name}", base.dtype, base.data[:0]))
-        return Table(t.ctx, [replace(k, data=k.data[:0], validity=None)
+        return Table(t.ctx, [replace(k, data=k.data[:0], validity=None,
+                                     host_data=None, host_validity=None)
                              for k in kcols] + acols)
     kcols = [t.column(c) for c in key_columns]
     vcols = [t.column(c) for c, _ in aggregations]

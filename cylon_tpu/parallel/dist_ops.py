@@ -610,12 +610,27 @@ def _copartition(dt: DTable, key_is: Sequence[int], alg: str,
 # size-class caps + join kind.
 _capacity_hints: dict = {}
 
+# Local kernel behind JoinAlgorithm.HASH.  Measured on the v5e
+# (experiments/ab_join_kernels.json): the dense-ranks direct-address
+# kernel costs 170.5 ms vs the fused single-sort plan's 138.6 at the
+# 4M+4M bench shape (it pays dense_ranks' lexsort AND the probe passes),
+# and a true no-sort open-addressing table loses 16x even at its
+# best-case unique-build shape — random probe passes at ~6 ns/row cannot
+# beat ~2 ns/row sorts.  The algorithm choice therefore governs the
+# DISTRIBUTED strategy only (murmur hash partitioning vs range
+# partitioning — the reference's split, where the shuffle varies and the
+# local kernel is shared, arrow_hash_kernels.hpp vs join.cpp); both run
+# the sort-plan local kernel.  Flip to "rank" to time the retired kernel.
+HASH_LOCAL_KERNEL = "sort"
+
 
 def _join_copartitioned(lsh: DTable, rsh: DTable, li_keys: Sequence[int],
                         ri_keys: Sequence[int], how: str, alg: str) -> DTable:
     """Masked local join of already co-partitioned sides (dist_join's tail)."""
     ctx = lsh.ctx
     mesh, axis = ctx.mesh, ctx.axis
+    if alg == "hash" and HASH_LOCAL_KERNEL == "sort":
+        alg = "sort"  # retired local kernel; see HASH_LOCAL_KERNEL
     lkcs = [lsh.columns[i] for i in li_keys]
     rkcs = [rsh.columns[i] for i in ri_keys]
     fill_left = how in ("right", "full_outer")

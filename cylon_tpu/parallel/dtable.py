@@ -117,9 +117,9 @@ class DTable:
         if self._counts_host is None:
             # resolve queued optimistic-capacity validations before trusting
             # any host-visible row counts; inside a failed deferred attempt
-            # abort for replay instead of materializing poisoned counts
-            ops_compact.flush_pending()
-            ops_compact._abort_if_poisoned()
+            # abort for replay instead of materializing poisoned counts.
+            # The counts ride the SAME batched device_get as the flush —
+            # one tunnel round trip, not two (round-trip census r5)
             c = self.counts
             if not c.is_fully_addressable:
                 # multi-controller: this process only holds its own shards;
@@ -127,7 +127,10 @@ class DTable:
                 # full count vector (reference: every MPI rank knows the
                 # exchange header counts, mpi_channel.cpp's 8-int header)
                 c = _replicate_counts_fn(self.ctx.mesh, self.ctx.axis)(c)
-            self._counts_host = np.asarray(jax.device_get(c))
+            ok, vals = ops_compact.flush_pending_with((c,))
+            if not ok:
+                ops_compact._abort_if_poisoned()
+            self._counts_host = np.asarray(vals[0])
         return self._counts_host
 
     @property
@@ -324,14 +327,19 @@ class DTable:
         cols: List[Column] = []
         hi = 0
         for c in self.columns:
-            data = jnp.asarray(hosts[hi])
+            hd = np.asarray(hosts[hi])
+            data = jnp.asarray(hd)
             hi += 1
-            validity = None
+            validity, hv = None, None
             if c.validity is not None:
-                validity = jnp.asarray(hosts[hi])
+                hv = np.asarray(hosts[hi])
+                validity = jnp.asarray(hv)
                 hi += 1
+            # the host copies ride along: to_arrow then transfers nothing
             cols.append(Column(c.name, c.dtype, data, validity,
-                               dictionary=c.dictionary, arrow_type=c.arrow_type))
+                               dictionary=c.dictionary,
+                               arrow_type=c.arrow_type,
+                               host_data=hd, host_validity=hv))
         return Table(self.ctx, cols)
 
     def to_table(self) -> Table:
@@ -386,15 +394,18 @@ class DTable:
         cols: List[Column] = []
         hi = 1
         for c in self.columns:
-            data = jnp.asarray(np.asarray(vals[hi])[:take])
+            hd = np.asarray(vals[hi])[:take]
+            data = jnp.asarray(hd)
             hi += 1
-            validity = None
+            validity, hv = None, None
             if c.validity is not None:
-                validity = jnp.asarray(np.asarray(vals[hi])[:take])
+                hv = np.asarray(vals[hi])[:take]
+                validity = jnp.asarray(hv)
                 hi += 1
             cols.append(Column(c.name, c.dtype, data, validity,
                                dictionary=c.dictionary,
-                               arrow_type=c.arrow_type))
+                               arrow_type=c.arrow_type,
+                               host_data=hd, host_validity=hv))
         return Table(self.ctx, cols)
 
     def partition(self, i: int) -> Table:

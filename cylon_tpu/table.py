@@ -45,6 +45,13 @@ class Column:
     validity: Optional[jax.Array] = None  # [n] bool device array; None = all valid
     dictionary: Optional[np.ndarray] = None  # host payload for STRING/BINARY
     arrow_type: Any = None               # original pyarrow type for round-trip
+    # host copies of data/validity when the producer already paid the
+    # transfer (DTable export): to_arrow reads these instead of pulling
+    # the re-uploaded device arrays back — on a tunneled TPU every pull
+    # is a ~100 ms round trip, and the per-column pulls were the single
+    # largest hidden cost of small-query exports (round-trip census r5)
+    host_data: Optional[np.ndarray] = None
+    host_validity: Optional[np.ndarray] = None
 
     def __post_init__(self):
         if is_dictionary_encoded(self.dtype.type) and self.dictionary is None:
@@ -59,7 +66,9 @@ class Column:
 
     def with_data(self, data, validity="__same__") -> "Column":
         v = self.validity if validity == "__same__" else validity
-        return replace(self, data=data, validity=v)
+        # new device contents ⇒ the export-time host caches are stale
+        return replace(self, data=data, validity=v,
+                       host_data=None, host_validity=None)
 
 
 def _combine(chunked):
@@ -255,8 +264,13 @@ class Table:
                 host_columns_from_arrow(atable):
             data = jnp.asarray(npv)
             val = jnp.asarray(mask) if mask is not None else None
+            # ingest already has the host values — cache them so an
+            # export of this table pulls nothing back through the tunnel
             cols.append(Column(name, DataType(t), data, val,
-                               dictionary=dictionary, arrow_type=ftype))
+                               dictionary=dictionary, arrow_type=ftype,
+                               host_data=np.asarray(npv),
+                               host_validity=(None if mask is None
+                                              else np.asarray(mask))))
         return Table(ctx, cols)
 
     @staticmethod
@@ -290,14 +304,37 @@ class Table:
     # -- export --------------------------------------------------------------
 
     def to_arrow(self):
-        """Device→host; decode dictionaries; reattach nulls."""
+        """Device→host; decode dictionaries; reattach nulls.
+
+        All columns missing a host cache transfer in ONE batched
+        ``device_get`` (per-column pulls would pay one tunnel round trip
+        each); columns exported from a DTable carry their host copies
+        already and transfer nothing."""
         import pyarrow as pa
 
+        pulls, slots = [], []
+        for i, c in enumerate(self.columns):
+            if c.host_data is None:
+                pulls.append(c.data)
+                slots.append((i, False))
+            if c.validity is not None and c.host_validity is None:
+                pulls.append(c.validity)
+                slots.append((i, True))
+        pulled = jax.device_get(pulls) if pulls else []
+        got = {}
+        for (i, is_v), v in zip(slots, pulled):
+            got[(i, is_v)] = np.asarray(v)
+
         arrays, names = [], []
-        for c in self.columns:
-            host = np.asarray(jax.device_get(c.data))
-            mask = (None if c.validity is None
-                    else ~np.asarray(jax.device_get(c.validity), dtype=bool))
+        for i, c in enumerate(self.columns):
+            host = (c.host_data if c.host_data is not None
+                    else got[(i, False)])
+            if c.validity is None:
+                mask = None
+            else:
+                hv = (c.host_validity if c.host_validity is not None
+                      else got[(i, True)])
+                mask = ~np.asarray(hv, dtype=bool)
             if is_dictionary_encoded(c.dtype.type):
                 vals = (c.dictionary[np.clip(host, 0, max(len(c.dictionary) - 1, 0))]
                         if len(c.dictionary)
@@ -398,9 +435,9 @@ def unify_dictionaries(a: Column, b: Column) -> Tuple[Column, Column]:
     map_a = jnp.asarray(np.searchsorted(merged, a.dictionary).astype(np.int32))
     map_b = jnp.asarray(np.searchsorted(merged, b.dictionary).astype(np.int32))
     new_a = replace(a, data=(map_a[a.data] if len(a.dictionary) else a.data),
-                    dictionary=merged)
+                    dictionary=merged, host_data=None, host_validity=None)
     new_b = replace(b, data=(map_b[b.data] if len(b.dictionary) else b.data),
-                    dictionary=merged)
+                    dictionary=merged, host_data=None, host_validity=None)
     return new_a, new_b
 
 

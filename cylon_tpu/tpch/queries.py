@@ -549,19 +549,13 @@ def q18(ctx, t: Tables, quantity: float = 300.0, limit: int = 100) -> Table:
                                   dense_key_range=_pk1(t, "customer")))
     m = dist_project(m, ["c_custkey", "o_orderkey", "o_orderdate",
                          "o_totalprice", "sum_l_quantity"])
-    out = m.to_table()  # ≤ a few thousand rows survive the HAVING
-    from ..compute import sort_multi
-    out = sort_multi(out, ["o_totalprice", "o_orderdate"],
-                     ascending=[False, True])
-    return Table(ctx, [_slice_col(c, limit) for c in out.columns])
+    # distributed ORDER BY + fused LIMIT gather: ONE host round trip for
+    # the whole result (the head() fused path), vs export-then-host-sort
+    s = dist_sort_multi(m, ["o_totalprice", "o_orderdate"],
+                        ascending=[False, True])
+    return dist_head(s, limit)
 
 
-def _slice_col(c, n: int):
-    import dataclasses
-    take = min(n, c.data.shape[0])
-    return dataclasses.replace(
-        c, data=c.data[:take],
-        validity=None if c.validity is None else c.validity[:take])
 
 
 # -- Q19: discounted revenue (disjunctive brand/container/quantity) -----------
