@@ -213,8 +213,51 @@ def sort(t: Table, sort_column: Union[int, str], ascending: bool = True) -> Tabl
 def sort_multi(t: Table, sort_columns: Sequence[Union[int, str]],
                ascending=True) -> Table:
     """Stable multi-key local sort; ``ascending`` is one bool or a
-    per-column sequence (ORDER BY mixed ASC/DESC)."""
+    per-column sequence (ORDER BY mixed ASC/DESC).
+
+    When every column carries its host copy (a table just exported from
+    a DTable, the ORDER-BY-then-return tail of most queries), the sort
+    runs HOST-side on those copies: the result needs no device gather
+    and — with the host caches riding along — exports with zero further
+    tunnel round trips.  Semantics mirror ops/sort.lexsort_indices
+    exactly (stable, per-key ASC/DESC, nulls last per key)."""
     cols = [t.column(c) for c in sort_columns]
+    if all(c.host_data is not None
+           and (c.validity is None or c.host_validity is not None)
+           for c in t.columns):
+        asc = ([ascending] * len(cols) if isinstance(ascending, bool)
+               else list(ascending))
+        flat = []
+        for i, c in reversed(list(enumerate(cols))):
+            k = np.asarray(c.host_data)
+            if not asc[i]:
+                # order-inverting transform — EXACT host mirror of
+                # ops/sort._invert (negation would wrap INT64_MIN and
+                # uint64 values past 2^63):
+                if k.dtype.kind == "i" or k.dtype == np.bool_:
+                    k = ~k
+                elif k.dtype.kind == "u":
+                    k = np.iinfo(k.dtype).max - k
+                else:
+                    k = -k.astype(np.float64)
+            flat.append(k)
+            if c.validity is not None:  # null flag outranks its key value
+                flat.append(~np.asarray(c.host_validity, bool))
+        order = np.lexsort(tuple(flat))
+        out = []
+        # jnp.asarray below is an ASYNC device put (no completion round
+        # trip) — it keeps Column.data's always-device invariant; an
+        # export-only consumer reads host_data and never waits on it
+        for c in t.columns:
+            hd = np.asarray(c.host_data)[order]
+            hv = (None if c.validity is None
+                  else np.asarray(c.host_validity, bool)[order])
+            out.append(Column(c.name, c.dtype, jnp.asarray(hd),
+                              None if hv is None else jnp.asarray(hv),
+                              dictionary=c.dictionary,
+                              arrow_type=c.arrow_type,
+                              host_data=hd, host_validity=hv))
+        return Table(t.ctx, out)
     order = ops_sort.lexsort_indices([c.data for c in cols],
                                      [c.validity for c in cols], ascending)
     return Table(t.ctx, _gather_columns(t, order, fill_null=False))

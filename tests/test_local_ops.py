@@ -428,3 +428,36 @@ def test_fileutils_compat(tmp_path):
         FileUtils.files_exist(str(tmp_path), ["missing.csv"])
     with _pytest.raises(ValueError):
         FileUtils.path_exists(None)
+
+
+def test_sort_multi_host_path_matches_device(ctx, rng):
+    """The host-side ORDER BY fast path (all columns cached) must order
+    exactly like the device lexsort, including DESC keys and nulls."""
+    import dataclasses
+    import pandas as pd
+    from cylon_tpu import Table
+    from cylon_tpu.compute import sort_multi
+
+    df = pd.DataFrame({
+        "a": rng.integers(-50, 50, 200).astype(np.int32),
+        "b": pd.array(np.where(rng.random(200) < 0.25, None,
+                               rng.normal(size=200)), dtype="Float64"),
+        "c": rng.random(200).astype(np.float32),
+    })
+    t = Table.from_pandas(ctx, df)
+    assert all(c.host_data is not None for c in t.columns)
+    host = sort_multi(t, ["a", "b"], ascending=[False, True]).to_pandas()
+    # strip the caches -> the device path runs
+    t_dev = Table(ctx, [dataclasses.replace(c, host_data=None,
+                                            host_validity=None)
+                        for c in t.columns])
+    dev = sort_multi(t_dev, ["a", "b"],
+                     ascending=[False, True]).to_pandas()
+    pd.testing.assert_frame_equal(host, dev, check_dtype=False)
+    # int64 extremes DESC: negation would wrap INT64_MIN — the host
+    # transform must mirror _invert's ~k, not -k
+    df2 = pd.DataFrame({"a": np.array([-2**63, 0, 5, 2**63 - 1],
+                                      dtype=np.int64)})
+    t2 = Table.from_pandas(ctx, df2)
+    got = sort_multi(t2, ["a"], ascending=False).to_pandas()
+    assert got["a"].tolist() == [2**63 - 1, 5, 0, -2**63]
