@@ -37,6 +37,7 @@ def _run(script, *args):
     ("groupby_sort_example.py", ()),
     ("cylon_simple_dataloader.py", ()),
     ("cylon_mnist_example.py", ()),
+    ("strings_hash64_example.py", ()),
 ])
 def test_example_runs(script, args):
     r = _run(script, *args)
