@@ -40,6 +40,13 @@ from ..table import Column, Table
 # path, whose transfer scales with rows taken instead of O(P·n) memory
 _HEAD_FUSED_MAX = 4096
 
+# to_table probes via the fused head kernel only while the padded block
+# holds at most this many CELLS (rows × data/validity arrays): the
+# probe's scatters traverse the whole block per array (~6 ns/cell), so
+# past this point its cost exceeds the one tunnel round trip (~100 ms)
+# it can save
+_TO_TABLE_PROBE_MAX_CELLS = 16 << 20
+
 
 @dataclass
 class DColumn:
@@ -343,8 +350,67 @@ class DTable:
         return Table(self.ctx, cols)
 
     def to_table(self) -> Table:
-        """Gather all shards to one local Table (drops padding)."""
+        """Gather all shards to one local Table (drops padding).
+
+        Small-result fast path: when the padded block is modest, the
+        fused head kernel probes the first ``_HEAD_FUSED_MAX`` rows with
+        the COUNT VECTOR riding the same batched flush — a result that
+        fits comes back in ONE tunnel round trip (most aggregate tails);
+        a bigger result falls through to the counts-based export having
+        already paid for its counts (2 trips total, same as before).
+        """
+        n_arrays = sum(1 + (c.validity is not None) for c in self.columns)
+        if (self.pending_mask is None and self.columns
+                and self.nparts * self.cap * n_arrays
+                <= _TO_TABLE_PROBE_MAX_CELLS):
+            n = min(_HEAD_FUSED_MAX, self.nparts * self.cap)
+            leaves = tuple((c.data, c.validity) for c in self.columns)
+            outs, got = _head_fn(self.ctx.mesh, self.ctx.axis, self.cap, n,
+                                 tuple(c.validity is not None
+                                       for c in self.columns))(
+                self.counts, leaves)
+            cnt_dev = self.counts
+            if not cnt_dev.is_fully_addressable:
+                cnt_dev = _replicate_counts_fn(self.ctx.mesh,
+                                               self.ctx.axis)(cnt_dev)
+            flat: List[Any] = [got, cnt_dev]
+            for d, v in outs:
+                flat.append(d)
+                if v is not None:
+                    flat.append(v)
+            ok, vals = ops_compact.flush_pending_with(flat)
+            if not ok:
+                ops_compact._abort_if_poisoned()
+            take = int(np.asarray(vals[0]))
+            cnts = np.asarray(vals[1])
+            self._counts_host = cnts  # paid for either way — cache it
+            if take >= int(cnts.sum()):  # the probe holds the whole table
+                return Table(self.ctx,
+                             self._columns_from_host(vals, 2, take))
+            return self._export([int(c) for c in cnts])
         return self._export([int(c) for c in self.counts_host()])
+
+    def _columns_from_host(self, vals, start: int, take: int
+                           ) -> List[Column]:
+        """Unflatten a batched host read (data, then validity where
+        nullable, per column) into local Columns carrying their host
+        copies — the shared tail of ``head`` and the ``to_table``
+        probe."""
+        cols: List[Column] = []
+        hi = start
+        for c in self.columns:
+            hd = np.asarray(vals[hi])[:take]
+            hi += 1
+            hv = None
+            if c.validity is not None:
+                hv = np.asarray(vals[hi])[:take]
+                hi += 1
+            cols.append(Column(
+                c.name, c.dtype, jnp.asarray(hd),
+                None if hv is None else jnp.asarray(hv),
+                dictionary=c.dictionary, arrow_type=c.arrow_type,
+                host_data=hd, host_validity=hv))
+        return cols
 
     def head(self, n: int) -> Table:
         """First ``n`` global rows (shard-major order) as a local Table.
@@ -391,22 +457,7 @@ class DTable:
             # than hand truncated garbage to the caller
             ops_compact._abort_if_poisoned()
         take = int(np.asarray(vals[0]))
-        cols: List[Column] = []
-        hi = 1
-        for c in self.columns:
-            hd = np.asarray(vals[hi])[:take]
-            data = jnp.asarray(hd)
-            hi += 1
-            validity, hv = None, None
-            if c.validity is not None:
-                hv = np.asarray(vals[hi])[:take]
-                validity = jnp.asarray(hv)
-                hi += 1
-            cols.append(Column(c.name, c.dtype, data, validity,
-                               dictionary=c.dictionary,
-                               arrow_type=c.arrow_type,
-                               host_data=hd, host_validity=hv))
-        return Table(self.ctx, cols)
+        return Table(self.ctx, self._columns_from_host(vals, 1, take))
 
     def partition(self, i: int) -> Table:
         """Shard *i*'s rows as a local Table (a rank's-eye view)."""

@@ -991,3 +991,17 @@ def test_dist_sort_multi_global_lex_order(dctx, rng):
     wb = want["b"].to_numpy(dtype=np.float64, na_value=np.nan)
     assert ((gb == wb) | (np.isnan(gb) & np.isnan(wb))).all()
     assert_same_rows(out, df)
+
+
+def test_to_table_probe_boundaries(dctx, rng):
+    """to_table's single-round-trip probe: results below, at, and above
+    the fused-head window must all come back complete."""
+    from cylon_tpu.parallel.dtable import _HEAD_FUSED_MAX
+
+    for n in (5, _HEAD_FUSED_MAX, _HEAD_FUSED_MAX + 37):
+        df = pd.DataFrame({"k": np.arange(n, dtype=np.int64),
+                           "v": rng.normal(size=n)})
+        dt = dtable_from_pandas(dctx, df)
+        out = dt.to_table().to_pandas()
+        assert len(out) == n
+        assert set(out["k"]) == set(range(n))
