@@ -240,3 +240,51 @@ def test_deferred_inside_run_pipeline(dctx, rng):
     want = w.groupby("c")["v"].sum().reset_index()
     want.columns = ["rt-c", "sum_lt-v"]
     same(got, want)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_deferred_eager_equivalence_fuzz(dctx, seed):
+    """Randomized op chains: the same plan with every select DEFERRED
+    must equal the plan with every select EAGER — across joins (dense
+    and general), semi/anti joins, groupby, sort and export, on the
+    8-device mesh."""
+    rng = np.random.default_rng(100 + seed)
+    n = int(rng.integers(200, 900))
+    df = pd.DataFrame({
+        "k": rng.integers(1, 40, n).astype(np.int64),
+        "v": rng.normal(size=n),
+        "w": pd.array(np.where(rng.random(n) < 0.2, None,
+                               rng.integers(0, 7, n).astype(float)),
+                      dtype="Float64"),
+    })
+    pk = pd.DataFrame({"k": np.arange(1, 40, dtype=np.int64),
+                       "c": rng.normal(size=39)})
+    rk = pd.DataFrame({"k": rng.integers(1, 40, 50).astype(np.int64),
+                       "x": rng.normal(size=50)})
+
+    preds = [pred, pred2, lambda env: env["v"] < 0.5]
+    steps = rng.integers(0, len(preds), size=2)
+    post_preds = [lambda env: env["lt-v"] > -0.5,
+                  lambda env: env["lt-k"] % 3 != 0]
+
+    def plan(compact):
+        d = _dt(dctx, df)
+        d = dist_select(d, preds[steps[0]], compact=compact)
+        d = dist_select(d, preds[steps[1]], compact=compact)
+        how = ["inner", "left"][seed % 2]
+        cfg = JoinConfig(JoinType(how), JoinAlgorithm.SORT, 0, 0)
+        if seed % 2 == 0:
+            d = dist_join(d, _dt(dctx, pk), cfg, dense_key_range=(1, 39))
+        else:
+            d = dist_join(d, _dt(dctx, rk), cfg)
+        d = dist_select(d, post_preds[seed % 2], compact=compact)
+        op = [dist_semi_join, dist_anti_join][seed % 2]
+        if seed % 3 != 2:
+            d = op(d, _dt(dctx, rk), "lt-k", "k",
+                   dense_key_range=(1, 39) if seed % 4 < 2 else None)
+        g = dist_groupby(d, ["lt-k"], [("lt-v", "sum"), ("lt-v", "count")])
+        return dist_sort(g, "lt-k").to_table().to_pandas()
+
+    eager = plan(True)
+    deferred = plan(False)
+    same(deferred, eager)
