@@ -467,6 +467,31 @@ _ORACLE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 _ORACLE_REPS = 5
 
 
+def _env_fingerprint() -> str:
+    """Short digest of the machine + library versions the pandas oracle
+    ran under.  Folded into the oracle cache key so `tpch_*_vs_pandas`
+    ratios never score framework times against oracle timings measured
+    on a DIFFERENT machine (or different pandas/numpy) — a cache file
+    travelling with the repo would otherwise silently poison every
+    ratio."""
+    import hashlib
+    import platform as _pf
+
+    import numpy as _np
+    import pandas as _pd
+    cpu = _pf.processor() or _pf.machine()
+    try:  # the model name is the discriminating field on linux hosts
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    cpu = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    sig = f"{cpu}|{_pf.machine()}|pd{_pd.__version__}|np{_np.__version__}"
+    return hashlib.sha1(sig.encode()).hexdigest()[:10]
+
+
 def _oracle_cache_load() -> dict:
     try:
         with open(_ORACLE_CACHE) as f:
@@ -872,28 +897,41 @@ def main() -> None:
                 run_pipeline(lambda: qfn(ctx, dts)).to_pandas()
 
             try:
+                # counter-only tracing: tally which join path each query
+                # takes (broadcast vs shuffle) WITHOUT span syncs — the
+                # timed dispatch stays fully async
+                _trace.enable_counters()
                 run_q()  # compile + seed hints
                 q_ts = []
                 for _ in range(2):
+                    _trace.reset()  # counters from exactly the last rep
                     t0 = time.perf_counter()
                     run_q()
                     q_ts.append(time.perf_counter() - t0)
                 q_t = min(q_ts)
+                q_counters = _trace.counters()
             except Exception as e:  # one bad query must not kill the bench
                 print(f"tpch {qname} FAILED: {type(e).__name__}: "
                       f"{str(e)[:300]}", file=sys.stderr)
                 em.detail[f"tpch_{qname}_error"] = str(e)[:200]
                 em.emit(f"tpch_{qname}")
                 continue
+            finally:
+                _trace.disable_counters()
+                _trace.reset()
             q_ms[qname] = q_t
             em.detail[f"tpch_{qname}_ms"] = round(q_t * 1e3, 2)
+            em.detail[f"tpch_{qname}_join_broadcast_hits"] = \
+                q_counters.get("join.broadcast", 0)
+            em.detail[f"tpch_{qname}_join_shuffle_hits"] = \
+                q_counters.get("join.shuffle", 0)
             _progress(f"TPC-H {qname}: {q_t * 1e3:.0f} ms")
             em.emit(f"tpch_{qname}")
 
         # oracle phase: top up the persisted per-query pandas timings to
         # _ORACLE_REPS, then score ratios from the cached median + spread
         cache = _oracle_cache_load()
-        ckey = f"sf{sf}_seed11_v{dd.DATA_VERSION}"
+        ckey = f"sf{sf}_seed11_v{dd.DATA_VERSION}_env{_env_fingerprint()}"
         entry = cache.setdefault(ckey, {})
         need = [q for q in q_ms
                 if len(entry.get(q, [])) < _ORACLE_REPS]

@@ -1,0 +1,29 @@
+"""Version compatibility for the jax APIs this engine leans on.
+
+The engine targets the modern surface (top-level ``jax.shard_map`` with
+its ``check_vma`` flag), but deployment containers routinely pin older
+jax where ``shard_map`` lives in ``jax.experimental.shard_map`` and the
+replication-check flag is named ``check_rep``.  Every module imports
+``shard_map`` from here so the whole engine degrades together; the
+wrapper keeps the ONE calling convention used throughout the codebase
+(keyword mesh/in_specs/out_specs, optional ``check_vma``).
+"""
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level, replication flag named check_vma
+    from jax import shard_map as _shard_map
+    _VMA_KW = "check_vma"
+except ImportError:  # older jax: experimental namespace, check_rep flag
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _VMA_KW = "check_rep"
+
+try:  # newer jax exposes the x64 context manager at top level
+    from jax import enable_x64
+except ImportError:
+    from jax.experimental import enable_x64  # noqa: F401
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    kw = {_VMA_KW: check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -342,8 +342,7 @@ def groupby(t: Table, key_columns: Sequence[Union[int, str]],
         for c, op in aggregations:
             base = t.column(c)
             acols.append(Column(f"{op}_{base.name}", base.dtype, base.data[:0]))
-        return Table(t.ctx, [replace(k, data=k.data[:0], validity=None,
-                                     host_data=None, host_validity=None)
+        return Table(t.ctx, [k.with_data(k.data[:0], validity=None)
                              for k in kcols] + acols)
     kcols = [t.column(c) for c in key_columns]
     vcols = [t.column(c) for c, _ in aggregations]

@@ -7,6 +7,31 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
+from typing import Optional
+
+# Global row-count threshold below which a distributed join/semi/anti
+# replicates the small side to every shard (one all_gather) instead of
+# hash/range-shuffling BOTH sides — the dimension-table join shape
+# (docs/tpu_perf_notes.md "broadcast vs shuffle joins").  The replicated
+# copy costs P × rows per column, so the knob bounds per-shard memory;
+# per-call override via ``JoinConfig.broadcast_threshold`` (0 disables).
+DEFAULT_BROADCAST_JOIN_THRESHOLD = 1 << 17
+
+_broadcast_join_threshold = DEFAULT_BROADCAST_JOIN_THRESHOLD
+
+
+def broadcast_join_threshold() -> int:
+    """The session-wide small-side row threshold for broadcast joins."""
+    return _broadcast_join_threshold
+
+
+def set_broadcast_join_threshold(n: int) -> int:
+    """Set the session-wide broadcast threshold; returns the previous
+    value (callers restore it in a finally — test/bench A/B idiom)."""
+    global _broadcast_join_threshold
+    prev = _broadcast_join_threshold
+    _broadcast_join_threshold = int(n)
+    return prev
 
 
 class JoinType(enum.Enum):
@@ -56,6 +81,12 @@ class JoinConfig:
     algorithm: JoinAlgorithm = JoinAlgorithm.SORT
     left_column_idx: object = 0
     right_column_idx: object = 0
+    # per-call broadcast-join override: None → the session-wide
+    # ``broadcast_join_threshold()``; 0 → never broadcast this join;
+    # any other int → use it as the small-side row threshold.  Only the
+    # DISTRIBUTED strategy changes (replicate-small vs shuffle-both);
+    # the local kernel and result rows are identical either way.
+    broadcast_threshold: Optional[int] = None
 
     @staticmethod
     def InnerJoin(left_column_idx: int = 0, right_column_idx: int = 0,

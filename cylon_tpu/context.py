@@ -153,7 +153,7 @@ class CylonContext:
 
         reference: net/mpi/mpi_communicator.cpp (Barrier)
         """
-        from jax import shard_map
+        from ._jax_compat import shard_map
         import jax.numpy as jnp
 
         if not self._distributed or len(self._devices) == 1:

@@ -200,6 +200,12 @@ def from_arrow_type(at) -> Type:
         # arrow dictionary arrays (e.g. pandas Categorical) land on the
         # framework's native dictionary-encoded representation
         return from_arrow_type(at.value_type)
+    if pa.types.is_null(at):
+        # the typeless column (pandas infers pa.null() for empty or
+        # all-None object columns, with pyarrow-version-dependent
+        # eagerness): ingest as an all-null string column — every row
+        # carries a validity=False, so no value is ever fabricated
+        return Type.STRING
     raise NotImplementedError(f"unsupported arrow type {at!r}")
 
 

@@ -9,6 +9,7 @@ rendezvous/AllToAll protocol collapses into a two-phase static-shape
 ``lax.all_to_all`` under ``shard_map`` (SURVEY.md §2.4).
 """
 from ..ops.compact import run_pipeline
+from .broadcast import replicate_table
 from .dtable import DColumn, DTable
 from .shuffle import shuffle_leaves
 from .dist_ops import (dist_aggregate, dist_anti_join, dist_groupby,
@@ -20,6 +21,7 @@ from .streaming import dist_join_streaming
 
 __all__ = [
     "DColumn", "DTable", "shuffle_leaves", "shuffle_table",
+    "replicate_table",
     "dist_join", "dist_join_streaming", "dist_semi_join", "dist_anti_join",
     "dist_union", "dist_intersect",
     "dist_subtract", "dist_groupby", "dist_aggregate", "dist_sort",

@@ -1,0 +1,206 @@
+"""Replicated small-side blocks: the broadcast half of join algorithm
+selection.
+
+The shuffle engine (shuffle.py) moves BOTH sides of every distributed
+join so matching keys co-locate — the reference's one bulk pattern
+(table_api.cpp:299-352).  When one side is dimension-table sized that
+symmetry is pure waste: the fact side pays a two-phase exchange to meet
+a few thousand rows that would fit replicated on every shard.  This
+module implements the standard remedy (algorithm selection between
+shuffle and broadcast joins, arXiv:2212.13732 §hybrid; replicated
+operand layouts are cheap on ICI meshes, arXiv:2112.01075): one
+``all_gather`` of the small side's column leaves into a REPLICATED
+block per shard, after which the existing local kernels run per shard
+against the *unmoved* large side — no partition pass, no all_to_all, no
+receive-side compaction on the hot path.
+
+Mechanics:
+
+  * ``replicate_table`` gathers every leaf of a (collapsed) DTable and
+    compacts the per-shard padding away into a block bucketed by
+    ``ops/compact.next_bucket`` — repeated small-side sizes reuse one
+    compiled gather program.  The result is an ordinary DTable whose
+    every shard holds ALL rows (``counts[i] = total`` for the join
+    probe form, or ``[total, 0, …]`` for the single-owner form the
+    groupby combine uses), so the existing shard_map kernels consume it
+    unchanged.
+  * a module-level **replica cache** (the optimistic-dispatch-hint
+    idiom of ``shuffle._block_hints``) keyed by the identity of the
+    small side's device arrays: a dimension table joined N times per
+    query — nation/region/supplier in TPC-H q7/q8/q9 — is gathered
+    once and reused across joins AND across bench repetitions (the
+    base-table arrays persist; each query run re-projects them).
+    Entries pin their source arrays (identity keys must not be reused
+    by the allocator), so the cache is bounded FIFO.
+  * ``rows_if_small`` is the planner predicate: it answers "is this
+    side provably ≤ threshold rows?" WITHOUT ever blocking on a host
+    read — from ingest-cached counts when available, else from the
+    static capacity bound ``P * cap`` (rows never exceed capacity).
+    Algorithm selection therefore costs zero round trips and is
+    deterministic across controllers (multi-host) and across deferred
+    replays (ops/compact.run_pipeline).
+
+Path selection is observable: callers bump ``trace.count("join.broadcast")``
+/ ``trace.count("join.shuffle")``, and the gather itself records a
+``join.broadcast_gather`` span + counter (cache hits record
+``join.broadcast_replica_hit`` instead), so bench artifacts show which
+path each query took.  See docs/tpu_perf_notes.md "broadcast vs shuffle
+joins" for threshold semantics and the planner matrix.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from .._jax_compat import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .. import trace
+from ..config import broadcast_join_threshold
+from ..ops import compact as ops_compact
+from .dtable import DColumn, DTable
+
+# counts layouts for the replicated DTable
+ALL = "all"    # counts[i] = total on every shard — the join-probe form
+HEAD = "head"  # counts = [total, 0, …] — one shard owns the rows (the
+#                groupby combine form: every shard holds the data, only
+#                shard 0's copy is "valid", so nothing is double-counted)
+
+
+def _bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
+    return mask.reshape(mask.shape + (1,) * (like.ndim - mask.ndim))
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_fn(mesh, axis: str, cap: int, outcap: int, head_only: bool):
+    """Per shard: all_gather every leaf, drop the per-shard padding, and
+    pack the survivors into a [outcap] block — identical on every shard.
+
+    One collective per leaf (dimension tables are narrow; the
+    width-classed packing of shuffle.py would save little here) plus the
+    one-int count gather.  Output specs are P(axis): each shard's block
+    IS the full gathered table, which is exactly what lets the existing
+    per-shard join kernels run against it unchanged."""
+
+    def kernel(cnt_blk, leaves):
+        gcnts = jax.lax.all_gather(cnt_blk, axis, tiled=True)      # [P]
+        valid = (jnp.arange(cap)[None, :] < gcnts[:, None]).reshape(-1)
+        idx = ops_compact.compact_indices(valid, outcap, fill=0)
+        total = jnp.sum(gcnts).astype(jnp.int32)
+        keep = jnp.arange(outcap, dtype=jnp.int32) < total
+        outs = []
+        for leaf in leaves:
+            as_bool = leaf.dtype == jnp.bool_
+            x = leaf.astype(jnp.uint8) if as_bool else leaf
+            g = jax.lax.all_gather(x, axis, tiled=True)            # [P*cap]
+            c = jnp.take(g, idx, axis=0)
+            c = jnp.where(_bcast(keep, c), c, jnp.zeros((), c.dtype))
+            outs.append(c.astype(jnp.bool_) if as_bool else c)
+        if head_only:
+            me = jax.lax.axis_index(axis)
+            cnt_out = jnp.where(me == 0, total, jnp.int32(0))
+        else:
+            cnt_out = total
+        return tuple(outs), cnt_out[None]
+
+    spec = P(axis)
+    # check_vma=False: the all_gathered intermediates are replicated,
+    # which shard_map cannot statically infer (same note as shuffle.py)
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec),
+                             out_specs=(spec, spec), check_vma=False))
+
+
+def rows_if_small(dt: DTable, threshold: Optional[int]) -> Optional[int]:
+    """Global-row upper bound if ``dt`` provably holds ≤ ``threshold``
+    rows, else None — WITHOUT a host sync (the planner contract above).
+
+    ``threshold`` None resolves to the session-wide knob
+    (config.broadcast_join_threshold); ≤ 0 disables.  A deferred-select
+    mask only removes rows, so the capacity bound stays valid for
+    mask-carrying tables (the caller collapses before replicating).
+    """
+    if threshold is None:
+        threshold = broadcast_join_threshold()
+    if threshold <= 0:
+        return None
+    ch = dt._counts_host
+    if ch is not None and dt.pending_mask is None:
+        n = int(ch.sum())
+        return n if n <= threshold else None
+    bound = dt.nparts * dt.cap
+    return bound if bound <= threshold else None
+
+
+# Replicated blocks by small-side array identity (see module docstring);
+# an entry holds strong refs to its source arrays, so ids stay unique
+# while cached.  Bounded FIFO like dist_ops._group_cap_hints.
+_replica_cache: dict = {}
+_REPLICA_CACHE_MAX = 64
+
+
+def clear_replica_cache() -> None:
+    """Drop every cached replica (frees the pinned source arrays)."""
+    _replica_cache.clear()
+
+
+def _cache_key(dt: DTable, mode: str) -> Tuple:
+    # names and dictionary identity belong in the key: metadata-only
+    # derivations share the device arrays (DTable.rename; the
+    # empty-dictionary branch of dictionary unification swaps the
+    # dictionary while keeping the codes) and must not hit a replica
+    # built under the old metadata.  The cached entry pins dt.columns,
+    # which pins the dictionaries, so the ids stay unique while cached.
+    return (dt.ctx.mesh, mode, dt.cap,
+            tuple((c.name, id(c.data), id(c.validity), id(c.dictionary))
+                  for c in dt.columns))
+
+
+def replicate_table(dt: DTable, mode: str = ALL,
+                    span_name: str = "join.broadcast_gather",
+                    cache: bool = True) -> DTable:
+    """Gather ``dt``'s rows into a replicated DTable (every shard holds
+    all rows).  ``dt`` must carry no pending mask (callers collapse
+    first — the gather reads only counts-valid rows).  Schema,
+    dictionaries and column order are preserved, so the result drops
+    into any shard_map kernel in the small side's place.  Pass
+    ``cache=False`` for one-shot intermediates (the groupby combine) —
+    caching them would only pin dead arrays."""
+    assert dt.pending_mask is None, "collapse the pending mask first"
+    key = _cache_key(dt, mode) if cache else None
+    if cache:
+        hit = _replica_cache.get(key)
+        if hit is not None:
+            trace.count("join.broadcast_replica_hit")
+            return hit[1]
+    ch = dt._counts_host
+    total_bound = int(ch.sum()) if ch is not None else dt.nparts * dt.cap
+    outcap = ops_compact.next_bucket(max(total_bound, 1), minimum=8)
+    leaves = []
+    slots = []  # (column index, is_validity)
+    for i, c in enumerate(dt.columns):
+        leaves.append(c.data)
+        slots.append((i, False))
+        if c.validity is not None:
+            leaves.append(c.validity)
+            slots.append((i, True))
+    with trace.span_sync(span_name) as sp:
+        trace.count(span_name)  # counter mirrors the span name
+        outs, counts = _gather_fn(dt.ctx.mesh, dt.ctx.axis, dt.cap,
+                                  outcap, mode == HEAD)(
+            dt.counts, tuple(leaves))
+        sp.sync(outs)
+    data, validity = {}, {}
+    for leaf, (i, is_v) in zip(outs, slots):
+        (validity if is_v else data)[i] = leaf
+    cols = [DColumn(c.name, c.dtype, data[i], validity.get(i),
+                    c.dictionary, c.arrow_type)
+            for i, c in enumerate(dt.columns)]
+    rep = DTable(dt.ctx, cols, outcap, counts)
+    if cache:
+        while len(_replica_cache) >= _REPLICA_CACHE_MAX:
+            _replica_cache.pop(next(iter(_replica_cache)))
+        # pin the source columns: their ids ARE the key
+        _replica_cache[key] = (dt.columns, rep)
+    return rep

@@ -27,7 +27,7 @@ from typing import List, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import trace
@@ -42,6 +42,7 @@ from ..ops import join as ops_join
 from ..ops import setops as ops_setops
 from ..ops import sort as ops_sort
 from ..status import Code, CylonError, Status
+from . import broadcast
 from .dtable import DColumn, DTable
 from .shuffle import shuffle_leaves
 
@@ -322,6 +323,18 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig,
             local sort-merge join — shards are ordered by key ranges, so
             the join output is additionally globally key-ordered.
 
+    Before either shuffle strategy runs, the planner considers a
+    BROADCAST join (broadcast.py): when one side's global row count is
+    provably under ``config.broadcast_threshold`` (None → the session
+    knob ``config.broadcast_join_threshold()``, 0 → disabled), that
+    side is all_gathered once into a replicated block — replica-cached
+    across repeated joins of the same table — and the local kernel runs
+    per shard against the UNMOVED other side; neither side is
+    shuffled.  INNER may replicate either side, LEFT only the right;
+    RIGHT/FULL always shuffle (a replicated side's unmatched rows would
+    be emitted once per shard).  Like the dense fast path below, a
+    broadcast join does not carry SORT's global key-ordering guarantee.
+
     ``dense_key_range=(lo, hi)``: caller hint that the RIGHT side's single
     join key is **unique, non-null and within [lo, hi]** — the FK → PK
     shape (fact table joining a base/dimension table on its primary key).
@@ -346,8 +359,13 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig,
         out = _try_fk_join(left, right, config, dense_key_range)
         if out is not None:
             return out
+    out = _try_broadcast_join(left, right, config)
+    if out is not None:
+        return out
     left, right, li_keys, ri_keys, alg, splitters = _join_prologue(
         left, right, config)
+    if left.ctx.get_world_size() > 1:
+        trace.count("join.shuffle")
     lsh = _copartition(left, li_keys, alg, splitters)
     rsh = _copartition(right, ri_keys, alg, splitters)
     return _join_copartitioned(lsh, rsh, li_keys, ri_keys,
@@ -454,11 +472,20 @@ def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
         return None
     lo, hi = int(dense_key_range[0]), int(dense_key_range[1])
     world = left.ctx.get_world_size()
-    stride = 1 if world == 1 else world
     if hi < lo:
         return None
+    # small BUILD side ⇒ replicate it instead of co-partitioning: the
+    # probe (fact) side then never moves at all — the broadcast FK join.
+    # stride stays 1 (every shard builds the full key→row map from its
+    # replicated copy), so the slot budget is checked against the
+    # replicated block's capacity bound.
+    r_rows = (broadcast.rows_if_small(right, config.broadcast_threshold)
+              if world > 1 else None)
+    stride = 1 if (world == 1 or r_rows is not None) else world
     R = -(-(hi - lo + 1) // stride)
-    if R > 4 * max(left.cap, right.cap):
+    bcap_bound = (ops_compact.next_bucket(max(r_rows, 1), minimum=8)
+                  if r_rows is not None else right.cap)
+    if R > 4 * max(left.cap, bcap_bound):
         return None  # same slot-space budget as the dense semi-join
     # a deferred select on the BUILD side would change which keys exist —
     # compact it (build sides are dimension-sized); the PROBE side's mask
@@ -466,12 +493,17 @@ def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
     # keeps the zero-copy probe and passes the mask through to the output
     right._collapse_pending()
     if world > 1:
-        with trace.span("join.shuffle"):
-            left = _shuffle_masked(
-                left, _mod_pids(left, li_keys[0], lo, world))
-            right = _shuffle_by_pids(
-                right, _mod_pids(right, ri_keys[0], lo, world))
-        lkc = left.columns[li_keys[0]]
+        if r_rows is not None:
+            trace.count("join.broadcast")
+            right = broadcast.replicate_table(right)
+        else:
+            trace.count("join.shuffle")
+            with trace.span("join.shuffle"):
+                left = _shuffle_masked(
+                    left, _mod_pids(left, li_keys[0], lo, world))
+                right = _shuffle_by_pids(
+                    right, _mod_pids(right, ri_keys[0], lo, world))
+            lkc = left.columns[li_keys[0]]
         rkc = right.columns[ri_keys[0]]
     ctx = left.ctx
     mesh, axis = ctx.mesh, ctx.axis
@@ -551,9 +583,10 @@ def _join_keys(dt: DTable, spec) -> List[int]:
     return [dt.column_index(spec)]
 
 
-def _join_prologue(left: DTable, right: DTable, config: JoinConfig):
-    """Shared setup for the one-shot and streaming joins: key resolution,
-    type check, dictionary unification, algorithm + sort splitters."""
+def _join_setup(left: DTable, right: DTable, config: JoinConfig):
+    """Key resolution + type check + dictionary unification — the setup
+    every distributed-join strategy (shuffle, streaming, broadcast)
+    shares."""
     # the general join's plan sorts want compacted inputs (a deferred
     # select's padding would ride every sort operand); only the dense
     # paths consume a pending mask in place
@@ -571,6 +604,54 @@ def _join_prologue(left: DTable, right: DTable, config: JoinConfig):
             raise CylonError(Status(Code.TypeError,
                 f"join key type mismatch {lt_k.name} vs {rt_k.name}"))
     left, right = _unify_dtable_dicts(left, right, li_keys, ri_keys)
+    return left, right, li_keys, ri_keys
+
+
+def _try_broadcast_join(left: DTable, right: DTable, config: JoinConfig
+                        ) -> "DTable | None":
+    """Replicated-small-side join if eligible, else None (the shuffle
+    path handles every shape).
+
+    Eligibility = a side whose global row count is provably under the
+    broadcast threshold (config knob / ``JoinConfig.broadcast_threshold``)
+    AND whose unmatched rows need no emission: INNER can replicate
+    either side, LEFT only the right side; RIGHT/FULL stay on the
+    shuffle path (a replicated side's unmatched rows would be emitted
+    once per shard — docs/tpu_perf_notes.md "broadcast vs shuffle
+    joins").  The small side is all_gathered once into a replicated
+    block (replica-cached across repeated joins) and the existing local
+    kernel runs per shard against the UNMOVED large side, whose rows
+    never cross the wire.  NOTE: like the dense FK fast path, a
+    broadcast join does not carry the SORT algorithm's global
+    key-ordering guarantee — the output stays in the large side's
+    shard layout.
+    """
+    how = config.join_type.value
+    if how not in ("inner", "left"):
+        return None
+    world = left.ctx.get_world_size()
+    if world == 1:
+        return None  # co-partitioning is already a no-op
+    thr = config.broadcast_threshold
+    r_rows = broadcast.rows_if_small(right, thr)
+    l_rows = (broadcast.rows_if_small(left, thr)
+              if how == "inner" else None)
+    if r_rows is None and l_rows is None:
+        return None
+    left, right, li_keys, ri_keys = _join_setup(left, right, config)
+    trace.count("join.broadcast")
+    if r_rows is not None and (l_rows is None or r_rows <= l_rows):
+        rrep = broadcast.replicate_table(right)
+        return _join_copartitioned(left, rrep, li_keys, ri_keys, how,
+                                   "sort")
+    lrep = broadcast.replicate_table(left)
+    return _join_copartitioned(lrep, right, li_keys, ri_keys, how, "sort")
+
+
+def _join_prologue(left: DTable, right: DTable, config: JoinConfig):
+    """Shared setup for the one-shot and streaming joins: key resolution,
+    type check, dictionary unification, algorithm + sort splitters."""
+    left, right, li_keys, ri_keys = _join_setup(left, right, config)
     alg = "sort" if config.algorithm == JoinAlgorithm.SORT else "hash"
     if alg == "hash" or left.ctx.get_world_size() == 1:
         splitters = None
@@ -1162,11 +1243,25 @@ def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
                         pre_aggregate=False, _local_only=True,
                         emit_empty=emit_empty)
     comb_op = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
-    comb = dist_groupby(part, list(range(K)),
-                        [(K + j, comb_op[op]) for j, (_, op)
-                         in enumerate(partial)],
-                        dense_key_range=dense_key_range,
-                        pre_aggregate=False)
+    comb_aggs = [(K + j, comb_op[op]) for j, (_, op) in enumerate(partial)]
+    if broadcast.rows_if_small(part, None) is not None:
+        # small partial table: replace the combine SHUFFLE with one
+        # all_gather — every shard receives all partial rows, shard 0
+        # alone owns them (HEAD counts), and the local combining groupby
+        # produces the full result there.  One collective instead of
+        # partition + two-phase exchange; the result lands on one shard,
+        # which is where a few-group aggregate ends up anyway.
+        trace.count("groupby.broadcast_combine")
+        part_rep = broadcast.replicate_table(
+            part, mode=broadcast.HEAD,
+            span_name="groupby.broadcast_gather", cache=False)
+        comb = dist_groupby(part_rep, list(range(K)), comb_aggs,
+                            dense_key_range=dense_key_range,
+                            pre_aggregate=False, _local_only=True)
+    else:
+        comb = dist_groupby(part, list(range(K)), comb_aggs,
+                            dense_key_range=dense_key_range,
+                            pre_aggregate=False)
     from ..compute import _agg_output_type
     fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     cols = list(comb.columns[:K])
@@ -1703,7 +1798,8 @@ def _semi_mask_fn(mesh, axis: str, cap_l: int, cap_r: int, anti: bool):
 
 
 def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
-                       anti: bool, dense_key_range=None) -> DTable:
+                       anti: bool, dense_key_range=None,
+                       broadcast_threshold=None) -> DTable:
     li_keys = _join_keys(left, left_on)
     ri_keys = _join_keys(right, right_on)
     if len(li_keys) != len(ri_keys):
@@ -1721,11 +1817,22 @@ def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
     right = dist_project(right, ri_keys)
     ri_keys = list(range(len(ri_keys)))
     world = left.ctx.get_world_size()
+    # small build side ⇒ replicate its keys to every shard and probe the
+    # UNMOVED left block locally — the big⋈tiny filter-join shape with
+    # no exchange on either side (semi/anti emit left rows only, so a
+    # replicated right is always sound)
+    use_bcast = False
+    if world > 1 and broadcast.rows_if_small(
+            right, broadcast_threshold) is not None:
+        use_bcast = True
+        trace.count("join.broadcast")
+        right._collapse_pending()
+        right = broadcast.replicate_table(right)
     # presence bits cost R/stride BYTES per shard — gate against the
     # larger side's capacity (a 1.5M-key range is nothing next to a
     # 15M-row probe side, even when the filtered LEFT block is small)
     kc0 = left.columns[li_keys[0]]
-    stride = 1 if world == 1 else world
+    stride = 1 if (world == 1 or use_bcast) else world
     use_dense = (dense_key_range is not None and len(li_keys) == 1
                  and jnp.issubdtype(kc0.data.dtype, jnp.integer)
                  and not is_dictionary_encoded(kc0.dtype.type)
@@ -1734,7 +1841,8 @@ def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
                  and -(-(int(dense_key_range[1])
                          - int(dense_key_range[0]) + 1) // stride)
                  <= 4 * max(left.cap, right.cap))
-    if world > 1:
+    if world > 1 and not use_bcast:
+        trace.count("join.shuffle")
         # deferred-select masks fold into the routing: masked rows go to
         # the dropped partition, so the kernels below see cleared tables
         with trace.span("semijoin.shuffle"):
@@ -1795,7 +1903,7 @@ def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
 
 
 def dist_semi_join(left: DTable, right: DTable, left_on, right_on,
-                   dense_key_range=None) -> DTable:
+                   dense_key_range=None, broadcast_threshold=None) -> DTable:
     """Distributed LEFT SEMI join: the rows of ``left`` whose key has at
     least one match in ``right`` — each such row emitted ONCE regardless of
     match multiplicity (SQL EXISTS / IN).  Output schema = left's schema.
@@ -1810,19 +1918,27 @@ def dist_semi_join(left: DTable, right: DTable, left_on, right_on,
     ``dense_key_range=(lo, hi)``: single-int-key hint (same contract as
     ``dist_groupby``'s) switching the probe to presence bits over the
     range — one scatter + one gather instead of the merged sort.
+
+    ``broadcast_threshold``: per-call override of the broadcast small-
+    side row threshold (None → the session knob, 0 → never broadcast);
+    below it the right side's keys replicate to every shard and the
+    probe runs against the UNMOVED left block — no exchange at all.
     """
     return _dist_semi_or_anti(left, right, left_on, right_on, anti=False,
-                              dense_key_range=dense_key_range)
+                              dense_key_range=dense_key_range,
+                              broadcast_threshold=broadcast_threshold)
 
 
 def dist_anti_join(left: DTable, right: DTable, left_on, right_on,
-                   dense_key_range=None) -> DTable:
+                   dense_key_range=None, broadcast_threshold=None) -> DTable:
     """Distributed LEFT ANTI join: the rows of ``left`` whose key has NO
     match in ``right`` (SQL NOT EXISTS).  Complement of ``dist_semi_join``
     over the valid left rows: a null left key equals a null right key, so
-    with any null right key present, null-keyed left rows are dropped."""
+    with any null right key present, null-keyed left rows are dropped.
+    ``broadcast_threshold`` as in ``dist_semi_join``."""
     return _dist_semi_or_anti(left, right, left_on, right_on, anti=True,
-                              dense_key_range=dense_key_range)
+                              dense_key_range=dense_key_range,
+                              broadcast_threshold=broadcast_threshold)
 
 
 def dist_project(dt: DTable, columns: Sequence[Union[int, str]]) -> DTable:
@@ -1830,8 +1946,13 @@ def dist_project(dt: DTable, columns: Sequence[Union[int, str]]) -> DTable:
     (reference table_api.cpp:1007-1029).  A deferred-select mask rides
     along (projection commutes with row filtering)."""
     ids = _resolve_ids(dt, columns)
-    return DTable(dt.ctx, [dt.columns[i] for i in ids], dt.cap, dt.counts,
-                  dt.pending_mask, dt.pending_cnts)
+    out = DTable(dt.ctx, [dt.columns[i] for i in ids], dt.cap, dt.counts,
+                 dt.pending_mask, dt.pending_cnts)
+    # projection never changes row counts — keep the host copy so the
+    # broadcast planner's sync-free threshold check stays exact for
+    # projected base tables (the semi/anti path projects to keys first)
+    out._counts_host = dt._counts_host
+    return out
 
 
 def dist_with_column(dt: DTable, name: str, fn, out_type,

@@ -206,7 +206,11 @@ class DTable:
         finally:
             staged.finish()
         counts = jax.device_put(sizes, ctx.sharding())
-        return DTable(ctx, cols, cap, counts)
+        out = DTable(ctx, cols, cap, counts)
+        # ingest knows the per-shard sizes statically — pre-cache them so
+        # planners (broadcast-join threshold) never pay a host read here
+        out._counts_host = sizes.copy()
+        return out
 
     @staticmethod
     def from_arrow(ctx: CylonContext, atable, cap: Optional[int] = None
@@ -237,7 +241,9 @@ class DTable:
         finally:
             staged.finish()
         counts = jax.device_put(sizes, ctx.sharding())
-        return DTable(ctx, cols, cap, counts)
+        out = DTable(ctx, cols, cap, counts)
+        out._counts_host = sizes.copy()  # statically known at ingest
+        return out
 
     @staticmethod
     def from_pandas(ctx: CylonContext, df, cap: Optional[int] = None
@@ -295,7 +301,9 @@ class DTable:
             cols.append(DColumn(c0.name, c0.dtype, data, validity,
                                 dictionary, c0.arrow_type))
         counts = jax.device_put(sizes, ctx.sharding())
-        return DTable(ctx, cols, cap, counts)
+        out = DTable(ctx, cols, cap, counts)
+        out._counts_host = sizes.copy()  # statically known at ingest
+        return out
 
     # -- export --------------------------------------------------------------
 
@@ -466,10 +474,12 @@ class DTable:
                              for j in range(self.nparts)])
 
     def rename(self, names: Sequence[str]) -> "DTable":
-        return DTable(self.ctx, [replace(c, name=n)
-                                 for c, n in zip(self.columns, names)],
-                      self.cap, self.counts, self.pending_mask,
-                      self.pending_cnts)
+        out = DTable(self.ctx, [replace(c, name=n)
+                                for c, n in zip(self.columns, names)],
+                     self.cap, self.counts, self.pending_mask,
+                     self.pending_cnts)
+        out._counts_host = self._counts_host  # same rows, same counts
+        return out
 
     def __repr__(self) -> str:
         cols = ", ".join(f"{c.name}:{c.dtype.type.name}" for c in self.columns)
@@ -486,7 +496,7 @@ def _export_take(a: jax.Array, idx: jax.Array) -> jax.Array:
 @_functools.lru_cache(maxsize=None)
 def _replicate_counts_fn(mesh, axis: str):
     """[P]-sharded counts → replicated copy every controller can read."""
-    from jax import shard_map
+    from .._jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def kernel(cnt_blk):
@@ -503,7 +513,7 @@ def _head_fn(mesh, axis: str, cap: int, n: int, has_v):
     """Per shard: scatter my first ``take`` rows into a replicated [n]
     block at my global shard-major offset; shards write disjoint slots, so
     a psum combines them.  Returns ((data, validity), …) + rows-taken."""
-    from jax import shard_map
+    from .._jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def kernel(cnt_blk, leaves):

@@ -33,7 +33,7 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import trace
@@ -164,7 +164,10 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
         # nothing), so one hot key/range makes the global arrays ≈ P× the
         # data.  Warn when the detour is real; mitigations are documented
         # in docs/tpu_perf_notes.md (pre-aggregated groupby never routes
-        # raw hot rows; sample-sort splitters spread dense ranges).
+        # raw hot rows; sample-sort splitters spread dense ranges; and
+        # when the skewed exchange is a join moving a small side, the
+        # broadcast join skips this shuffle entirely — see broadcast.py
+        # and docs/tpu_perf_notes.md "broadcast vs shuffle joins").
         mean_recv = max(float(per_recv.mean()), 1.0)
         # the 64k floor keeps toy tables (where count noise looks like
         # skew) quiet; below that size the blowup is bytes, not a hazard

@@ -30,7 +30,7 @@ from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import trace

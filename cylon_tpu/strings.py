@@ -63,6 +63,21 @@ def _u64_keys(h0: np.ndarray, h1: np.ndarray) -> np.ndarray:
     return (u0 << np.uint64(32)) | u1
 
 
+def _lane_np(series) -> Tuple[np.ndarray, np.ndarray]:
+    """A pandas lane column → (int32 lane array, null mask).
+
+    Lanes round-trip through several dtypes: plain int32 (no nulls at
+    encode), nullable Int32 (``None`` keys), or float64-with-NaN (an
+    exported arrow int32-with-nulls column).  Nulls decode as lane 0 +
+    mask — the caller substitutes ``None`` after payload lookup."""
+    import pandas as pd
+    nulls = np.asarray(pd.isna(series), bool)
+    filled = series.fillna(0) if nulls.any() else series
+    # float64 holds every int32 exactly, so the astype chain is lossless
+    lanes = np.asarray(filled.to_numpy(), dtype=np.int64).astype(np.int32)
+    return lanes, nulls
+
+
 class StringStore:
     """Host-side payloads for hash64-encoded columns.
 
@@ -142,7 +157,9 @@ class StringStore:
     def resolve_frame(self, df, columns: Optional[Iterable[str]] = None):
         """Pandas frame with ``{col}#h0/#h1`` lane pairs → same frame with
         the pairs replaced by the decoded string column.  ``lt-``/``rt-``
-        join prefixes on the lane names are understood."""
+        join prefixes on the lane names are understood.  Null lanes (the
+        nullable encoding of ``None`` keys, or null-filled LEFT-join
+        misses) decode to ``None``."""
         out = df.copy()
         want = set(columns) if columns is not None else None
         for name in list(out.columns):
@@ -159,8 +176,13 @@ class StringStore:
                 continue
             if store_key not in self._maps:
                 continue
-            vals = self.resolve(store_key, out[name].to_numpy(),
-                                out[other].to_numpy())
+            h0, null0 = _lane_np(out[name])
+            h1, null1 = _lane_np(out[other])
+            vals = self.resolve(store_key, h0, h1)
+            nulls = null0 | null1
+            if nulls.any():
+                vals = vals.copy()
+                vals[nulls] = None
             out[base] = vals
             out = out.drop(columns=[name, other])
         return out
@@ -175,6 +197,15 @@ def encode_frame(df, columns: Optional[Iterable[str]] = None,
     ingests through the ordinary numeric path (``DTable.from_pandas``) —
     no dictionary is built, so ingest cost is one murmur3 pass instead of
     a full-column ``np.unique`` sort.
+
+    ``None`` entries emit NULLABLE lane columns (pandas Int32 with a
+    mask), so DTable ingest marks those rows null and the data plane
+    applies the engine's SQL-null key semantics — matching the
+    dictionary-string path.  (Without the mask a ``None`` encoded as the
+    valid lane pair (0, 0): null keys silently inner-joined/grouped with
+    each other AND with any real string hashing to exactly (0, 0).)
+    Columns without ``None`` keep plain int32 lanes — no validity
+    ballast on the common path.
     """
     import pandas as pd
     store = store if store is not None else StringStore()
@@ -192,8 +223,15 @@ def encode_frame(df, columns: Optional[Iterable[str]] = None,
         vals = df[name].to_numpy(dtype=object, na_value=None)
         h0, h1 = hash_lanes(vals)
         store.register(name, vals, h0, h1)
-        out[name + H0] = h0
-        out[name + H1] = h1
+        nulls = np.fromiter((v is None for v in vals), bool, len(vals))
+        if nulls.any():
+            out[name + H0] = pd.arrays.IntegerArray(
+                np.asarray(h0, np.int32), mask=nulls.copy())
+            out[name + H1] = pd.arrays.IntegerArray(
+                np.asarray(h1, np.int32), mask=nulls.copy())
+        else:
+            out[name + H0] = h0
+            out[name + H1] = h1
     return pd.DataFrame(out), store
 
 

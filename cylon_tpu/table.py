@@ -32,6 +32,11 @@ from .dtypes import (DataType, Type, device_dtype, from_arrow_type,
 from .status import Code, CylonError, Status
 
 
+# "keep the current value" marker for Column.with_data — compared by
+# identity because the real operands are arrays
+_SAME = object()
+
+
 @dataclass
 class Column:
     """One column: logical type + device data (+ validity, + host dictionary).
@@ -64,10 +69,18 @@ class Column:
     def has_nulls(self) -> bool:
         return self.validity is not None
 
-    def with_data(self, data, validity="__same__") -> "Column":
-        v = self.validity if validity == "__same__" else validity
+    def with_data(self, data, validity=_SAME, dictionary=_SAME) -> "Column":
+        """THE way to derive a column with new contents: every
+        data/validity/dictionary-changing site goes through here so the
+        export-time host caches can never survive a device-side change
+        (``to_arrow`` would silently export the stale host copy
+        otherwise — the invariant is also assert-checked at export)."""
+        # identity sentinel, not ==: validity/dictionary operands are
+        # arrays, whose == against a marker is elementwise
+        v = self.validity if validity is _SAME else validity
+        d = self.dictionary if dictionary is _SAME else dictionary
         # new device contents ⇒ the export-time host caches are stale
-        return replace(self, data=data, validity=v,
+        return replace(self, data=data, validity=v, dictionary=d,
                        host_data=None, host_validity=None)
 
 
@@ -314,6 +327,20 @@ class Table:
 
         pulls, slots = [], []
         for i, c in enumerate(self.columns):
+            # host-cache staleness guard: a cache may only coexist with
+            # the device array it was copied from (every contents change
+            # must route through Column.with_data, which drops it).  A
+            # length mismatch is the cheap observable of a violation.
+            assert c.host_data is None \
+                or c.host_data.shape[0] == c.length, \
+                f"stale host_data cache on column {c.name!r} " \
+                f"({c.host_data.shape[0]} host vs {c.length} device " \
+                "rows) — derive columns via Column.with_data"
+            assert c.host_validity is None or (
+                c.validity is not None
+                and c.host_validity.shape[0] == c.length), \
+                f"stale host_validity cache on column {c.name!r} — " \
+                "derive columns via Column.with_data"
             if c.host_data is None:
                 pulls.append(c.data)
                 slots.append((i, False))
@@ -434,10 +461,10 @@ def unify_dictionaries(a: Column, b: Column) -> Tuple[Column, Column]:
     merged = np.unique(np.concatenate([a.dictionary, b.dictionary]))
     map_a = jnp.asarray(np.searchsorted(merged, a.dictionary).astype(np.int32))
     map_b = jnp.asarray(np.searchsorted(merged, b.dictionary).astype(np.int32))
-    new_a = replace(a, data=(map_a[a.data] if len(a.dictionary) else a.data),
-                    dictionary=merged, host_data=None, host_validity=None)
-    new_b = replace(b, data=(map_b[b.data] if len(b.dictionary) else b.data),
-                    dictionary=merged, host_data=None, host_validity=None)
+    new_a = a.with_data(map_a[a.data] if len(a.dictionary) else a.data,
+                        dictionary=merged)
+    new_b = b.with_data(map_b[b.data] if len(b.dictionary) else b.data,
+                        dictionary=merged)
     return new_a, new_b
 
 

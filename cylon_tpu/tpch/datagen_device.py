@@ -598,7 +598,9 @@ def _dtable_from_blocks(ctx, cols: Dict[str, object], n: int,
         else:
             dcols.append(DColumn(name, DataType(Type.INT32), data))
     counts = jax.device_put(sizes, ctx.sharding())
-    return DTable(ctx, dcols, cap, counts)
+    out = DTable(ctx, dcols, cap, counts)
+    out._counts_host = np.asarray(sizes).copy()  # statically known layout
+    return out
 
 
 def generate_device(ctx, scale: float, seed: int = 42

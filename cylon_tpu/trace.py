@@ -34,6 +34,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
     "enable", "disable", "enabled", "span", "count", "reset",
+    "enable_counters", "disable_counters", "counters_enabled",
     "get_spans", "phase_totals", "counters", "report", "bench_line",
     "profile", "hard_sync",
 ]
@@ -102,6 +103,28 @@ def enabled() -> bool:
     return _enabled
 
 
+# Counter-only mode: counters tally but spans stay disabled — no device
+# syncs, so dispatch remains fully async.  The bench uses this to record
+# which path a query took (join.broadcast vs join.shuffle) WITHOUT the
+# span syncs distorting the very timings it is scoring.
+_counters_enabled = False
+
+
+def enable_counters() -> None:
+    """Record counters without span timing (and without span syncs)."""
+    global _counters_enabled
+    _counters_enabled = True
+
+
+def disable_counters() -> None:
+    global _counters_enabled
+    _counters_enabled = False
+
+
+def counters_enabled() -> bool:
+    return _enabled or _counters_enabled
+
+
 @contextlib.contextmanager
 def span(name: str, sync=None) -> Iterator[None]:
     """Record wall-clock of the enclosed block under ``name``.
@@ -155,7 +178,7 @@ def span_sync(name: str) -> Iterator[_SyncSpan]:
 def count(name: str, n: int = 1) -> None:
     """Bump a named counter (reference: the eq_calls/hash_calls tallies in
     table_api.cpp:636-662)."""
-    if not _enabled:
+    if not (_enabled or _counters_enabled):
         return
     c = _counters()
     c[name] = c.get(name, 0) + int(n)
@@ -165,7 +188,7 @@ def count_max(name: str, n: int) -> None:
     """Record the MAX a named quantity reaches (peak single-exchange
     block size, etc. — where the transient footprint is the max, not the
     sum)."""
-    if not _enabled:
+    if not (_enabled or _counters_enabled):
         return
     c = _counters()
     c[name] = max(c.get(name, 0), int(n))

@@ -51,7 +51,7 @@ class Communication:
             return
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from cylon_tpu._jax_compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         Pn = self.ctx.get_world_size()

@@ -45,6 +45,14 @@ CPU_DEVICES = jax.devices("cpu")
 jax.config.update("jax_default_device", CPU_DEVICES[0])
 
 
+def pytest_configure(config):
+    # the tier-1 gate runs `-m 'not slow'`; register the marker so the
+    # multi-rep benchmarks excluded by it don't warn as unknown
+    config.addinivalue_line(
+        "markers", "slow: multi-rep benchmarks excluded from the tier-1 "
+        "`-m 'not slow'` gate")
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _bound_jit_memory():
     """Free compiled executables at module boundaries.

@@ -1,0 +1,312 @@
+"""Broadcast (replicated small-side) joins: row-for-row parity with the
+shuffle path on the 8-device mesh, planner threshold selection, replica
+cache behavior, and the groupby pre-agg broadcast combine.
+
+Every parity test runs the SAME operation twice — once with the
+broadcast threshold engaged, once with ``broadcast_threshold=0`` pinning
+the shuffle path — and asserts identical row multisets; the trace
+counters prove which path actually ran (``join.broadcast`` vs
+``join.shuffle``)."""
+import dataclasses
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table, trace
+from cylon_tpu import config as cfgmod
+from cylon_tpu.config import JoinAlgorithm, JoinConfig, JoinType
+from cylon_tpu.parallel import (DTable, dist_anti_join, dist_groupby,
+                                dist_join, dist_semi_join)
+from cylon_tpu.parallel import broadcast
+
+from test_dist_ops import dtable_from_pandas
+from test_local_ops import assert_same_rows
+
+
+@pytest.fixture(autouse=True)
+def _counters():
+    trace.reset()
+    trace.enable()
+    broadcast.clear_replica_cache()
+    yield
+    trace.disable()
+    trace.reset()
+
+
+def _cfg(how=JoinType.INNER, thr=None):
+    return JoinConfig(how, JoinAlgorithm.SORT, "k", "k",
+                      broadcast_threshold=thr)
+
+
+def _both_paths(op):
+    """Run ``op(threshold)`` on the broadcast path (generous threshold)
+    and the shuffle path (0); return both frames + path counters."""
+    trace.reset()
+    out_b = op(10_000).to_table().to_pandas()
+    cnt_b = trace.counters()
+    trace.reset()
+    out_s = op(0).to_table().to_pandas()
+    cnt_s = trace.counters()
+    assert cnt_b.get("join.broadcast", 0) >= 1, cnt_b
+    assert cnt_b.get("join.shuffle", 0) == 0, cnt_b
+    assert cnt_s.get("join.shuffle", 0) >= 1, cnt_s
+    assert cnt_s.get("join.broadcast", 0) == 0, cnt_s
+    return out_b, out_s
+
+
+def _key_frames(rng, kind, n_l=311, n_r=29):
+    """Big-left/small-right frame pair per key flavor."""
+    if kind == "int":
+        lk = rng.integers(0, 40, n_l)
+        rk = rng.permutation(40)[:n_r]
+    elif kind == "str":  # dictionary-encoded at ingest
+        pool = np.array([f"key-{i:03d}" for i in range(40)], dtype=object)
+        lk = pool[rng.integers(0, 40, n_l)]
+        rk = rng.permutation(pool)[:n_r]
+    elif kind == "nullint":  # float keys with NaN → null keys
+        lk = rng.integers(0, 40, n_l).astype(np.float64)
+        lk[rng.random(n_l) < 0.12] = np.nan
+        rk = rng.permutation(40)[:n_r].astype(np.float64)
+        rk[rng.random(n_r) < 0.2] = np.nan
+    else:
+        raise AssertionError(kind)
+    ldf = pd.DataFrame({"k": lk, "a": rng.normal(size=n_l)})
+    rdf = pd.DataFrame({"k": rk, "b": rng.normal(size=n_r)})
+    return ldf, rdf
+
+
+@pytest.mark.parametrize("how", [JoinType.INNER, JoinType.LEFT])
+@pytest.mark.parametrize("kind", ["int", "str", "nullint"])
+def test_broadcast_join_matches_shuffle(dctx, rng, how, kind):
+    ldf, rdf = _key_frames(rng, kind)
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    out_b, out_s = _both_paths(
+        lambda thr: dist_join(lt, rt, _cfg(how, thr)))
+    assert_same_rows(out_b, out_s)
+    assert len(out_b.columns) == 4
+
+
+def test_broadcast_inner_small_left_side(dctx, rng):
+    """INNER is symmetric: a small LEFT side replicates too (the right
+    side stays unmoved)."""
+    ldf, rdf = _key_frames(rng, "int")
+    lt = dtable_from_pandas(dctx, rdf.rename(columns={"b": "a"}))  # small
+    rt = dtable_from_pandas(dctx, ldf.rename(columns={"a": "b"}))  # big
+    out_b, out_s = _both_paths(
+        lambda thr: dist_join(lt, rt, _cfg(JoinType.INNER, thr)))
+    assert_same_rows(out_b, out_s)
+
+
+def test_right_and_full_stay_on_shuffle(dctx, rng):
+    """RIGHT/FULL never broadcast (a replicated side's unmatched rows
+    would be emitted once per shard)."""
+    ldf, rdf = _key_frames(rng, "int")
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    for how in (JoinType.RIGHT, JoinType.FULL_OUTER):
+        trace.reset()
+        dist_join(lt, rt, _cfg(how, 10_000)).to_table()
+        cnt = trace.counters()
+        assert cnt.get("join.broadcast", 0) == 0, (how, cnt)
+        assert cnt.get("join.shuffle", 0) >= 1, (how, cnt)
+
+
+def test_broadcast_empty_small_side(dctx, rng):
+    ldf, rdf = _key_frames(rng, "int")
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf.iloc[:0])
+    inner_b, inner_s = _both_paths(
+        lambda thr: dist_join(lt, rt, _cfg(JoinType.INNER, thr)))
+    assert len(inner_b) == 0 and len(inner_s) == 0
+    left_b, left_s = _both_paths(
+        lambda thr: dist_join(lt, rt, _cfg(JoinType.LEFT, thr)))
+    assert len(left_b) == len(ldf)
+    assert_same_rows(left_b, left_s)
+
+
+def test_threshold_boundary_selects_path(dctx, rng):
+    """The planner broadcasts at rows == threshold and shuffles at
+    rows > threshold (ingest-cached counts make the decision exact)."""
+    ldf, rdf = _key_frames(rng, "int", n_r=29)
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    trace.reset()
+    dist_join(lt, rt, _cfg(thr=len(rdf))).to_table()
+    assert trace.counters().get("join.broadcast", 0) == 1
+    trace.reset()
+    dist_join(lt, rt, _cfg(thr=len(rdf) - 1)).to_table()
+    cnt = trace.counters()
+    assert cnt.get("join.broadcast", 0) == 0 and \
+        cnt.get("join.shuffle", 0) == 1, cnt
+
+
+def test_global_threshold_knob(dctx, rng):
+    """The session-wide config knob governs joins with no per-call
+    override."""
+    ldf, rdf = _key_frames(rng, "int")
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    prev = cfgmod.set_broadcast_join_threshold(0)
+    try:
+        trace.reset()
+        dist_join(lt, rt, _cfg()).to_table()
+        assert trace.counters().get("join.broadcast", 0) == 0
+    finally:
+        cfgmod.set_broadcast_join_threshold(prev)
+    trace.reset()
+    dist_join(lt, rt, _cfg()).to_table()
+    assert trace.counters().get("join.broadcast", 0) == 1
+
+
+@pytest.mark.parametrize("anti", [False, True])
+@pytest.mark.parametrize("dense", [False, True])
+def test_broadcast_semi_anti_matches_shuffle(dctx, rng, anti, dense):
+    ldf, rdf = _key_frames(rng, "int")
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    op = dist_anti_join if anti else dist_semi_join
+    dkr = (0, 39) if dense else None
+    out_b, out_s = _both_paths(
+        lambda thr: op(lt, rt, "k", "k", dense_key_range=dkr,
+                       broadcast_threshold=thr))
+    assert_same_rows(out_b, out_s)
+    exp = ldf[~ldf["k"].isin(rdf["k"])] if anti else \
+        ldf[ldf["k"].isin(rdf["k"])]
+    assert len(out_b) == len(exp)
+
+
+def test_broadcast_fk_dense_join_matches_shuffle(dctx, rng):
+    """The dense FK fast path composes with broadcast: a small build
+    side replicates (stride=1) and the probe side never moves."""
+    n_r = 29
+    rdf = pd.DataFrame({"k": np.arange(1, n_r + 1),
+                        "b": rng.normal(size=n_r)})
+    ldf = pd.DataFrame({"k": rng.integers(1, n_r + 1, 311),
+                        "a": rng.normal(size=311)})
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    for how in (JoinType.INNER, JoinType.LEFT):
+        out_b, out_s = _both_paths(
+            lambda thr: dist_join(lt, rt, _cfg(how, thr),
+                                  dense_key_range=(1, n_r)))
+        assert_same_rows(out_b, out_s)
+        if how == JoinType.LEFT:
+            assert len(out_b) == len(ldf)
+
+
+def test_replica_cache_gathers_once(dctx, rng):
+    """A dimension table joined N times is gathered ONCE: the replica
+    cache is keyed by the source arrays' identity, so re-projections of
+    the same base table hit it too."""
+    from cylon_tpu.parallel import dist_project
+    ldf, rdf = _key_frames(rng, "int")
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    trace.reset()
+    for _ in range(3):
+        dist_join(lt, dist_project(rt, ["k", "b"]),
+                  _cfg(thr=10_000)).to_table()
+    cnt = trace.counters()
+    assert cnt.get("join.broadcast", 0) == 3, cnt
+    assert cnt.get("join.broadcast_gather", 0) == 1, cnt
+    assert cnt.get("join.broadcast_replica_hit", 0) == 2, cnt
+
+
+def test_replica_cache_keyed_on_metadata_too(dctx, rng):
+    """A renamed handle shares the device arrays but must NOT hit the
+    replica cached under the old column names (the cache key includes
+    metadata, not just array identity)."""
+    ldf, rdf = _key_frames(rng, "int")
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+    dist_join(lt, rt, _cfg(thr=10_000)).to_table()  # caches k/b replica
+    rt2 = rt.rename(["key", "val"])
+    out = dist_join(lt, rt2, JoinConfig(
+        JoinType.INNER, JoinAlgorithm.SORT, "k", "key",
+        broadcast_threshold=10_000)).to_table().to_pandas()
+    assert "rt-key" in out.columns and "rt-val" in out.columns, \
+        list(out.columns)
+
+
+def test_groupby_preagg_broadcast_combine(dctx, rng):
+    """A small partial-group table combines via one all_gather instead
+    of the combine shuffle — results must match pandas exactly."""
+    df = pd.DataFrame({"k": rng.integers(0, 12, 500),
+                       "v": rng.normal(size=500)})
+    dt = dtable_from_pandas(dctx, df)
+    trace.reset()
+    g = dist_groupby(dt, ["k"], [("v", "sum"), ("v", "count"),
+                                 ("v", "mean"), ("v", "max")])
+    got = g.to_table().to_pandas().sort_values("k").reset_index(drop=True)
+    assert trace.counters().get("groupby.broadcast_combine", 0) == 1
+    exp = df.groupby("k")["v"].agg(["sum", "count", "mean", "max"]) \
+        .reset_index()
+    np.testing.assert_allclose(got["sum_v"], exp["sum"], rtol=1e-6)
+    np.testing.assert_array_equal(got["count_v"], exp["count"])
+    np.testing.assert_allclose(got["mean_v"], exp["mean"], rtol=1e-6)
+    np.testing.assert_allclose(got["max_v"], exp["max"], rtol=1e-6)
+
+
+def test_broadcast_after_deferred_select(dctx, rng):
+    """A deferred-select (compact=False) small side still joins
+    correctly: the planner collapses it before replicating."""
+    from cylon_tpu.parallel import dist_select
+    ldf, rdf = _key_frames(rng, "int")
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dist_select(dtable_from_pandas(dctx, rdf),
+                     lambda env: env["k"] < 20, compact=False)
+    out_b, out_s = _both_paths(
+        lambda thr: dist_join(lt, rt, _cfg(JoinType.INNER, thr)))
+    assert_same_rows(out_b, out_s)
+    exp = ldf.merge(rdf[rdf["k"] < 20], on="k")
+    assert len(out_b) == len(exp)
+
+
+def test_composite_key_broadcast(dctx, rng):
+    ldf = pd.DataFrame({"k1": rng.integers(0, 8, 257),
+                        "k2": rng.integers(0, 5, 257),
+                        "a": rng.normal(size=257)})
+    rdf = pd.DataFrame({"k1": rng.integers(0, 8, 21),
+                        "k2": rng.integers(0, 5, 21),
+                        "b": rng.normal(size=21)})
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+
+    def op(thr):
+        return dist_join(lt, rt, JoinConfig(
+            JoinType.INNER, JoinAlgorithm.SORT, ("k1", "k2"),
+            ("k1", "k2"), broadcast_threshold=thr))
+
+    out_b, out_s = _both_paths(op)
+    assert_same_rows(out_b, out_s)
+    assert len(out_b) == len(ldf.merge(rdf, on=["k1", "k2"]))
+
+
+@pytest.mark.slow
+def test_broadcast_beats_shuffle_multirep(dctx, rng):
+    """Multi-rep micro-benchmark: the broadcast path must not be slower
+    than shuffling both sides for the fact⋈dim shape (wall-clock is
+    noisy on the virtual-device mesh, so this only guards against a
+    pathological regression, 3x)."""
+    import time
+    ldf = pd.DataFrame({"k": rng.integers(0, 1000, 200_000),
+                        "a": rng.normal(size=200_000)})
+    rdf = pd.DataFrame({"k": np.arange(1000),
+                        "b": rng.normal(size=1000)})
+    lt = dtable_from_pandas(dctx, ldf)
+    rt = dtable_from_pandas(dctx, rdf)
+
+    def t(thr):
+        cfg = _cfg(thr=thr)
+        dist_join(lt, rt, cfg).to_table()  # compile + warm hints
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            dist_join(lt, rt, cfg).to_table()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    t_b, t_s = t(10_000), t(0)
+    assert t_b < 3 * t_s, (t_b, t_s)

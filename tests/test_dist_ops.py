@@ -113,9 +113,13 @@ def test_dist_join_string_keys(dctx):
 
 
 def test_dist_join_sample_sort_globally_ordered(dctx, rng):
-    """SORT algorithm range-partitions: shard i's keys all ≤ shard i+1's."""
+    """SORT algorithm range-partitions: shard i's keys all ≤ shard i+1's.
+    The ordering promise holds on the SHUFFLE path only — a small side
+    would otherwise broadcast, which (like the dense FK path) keeps the
+    probe side's layout — so the broadcast planner is pinned off."""
     ldf, rdf = _join_dfs(rng, 120, 90, with_nulls=False)
-    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0,
+                     broadcast_threshold=0)
     out = dist_join(dtable_from_pandas(dctx, ldf),
                     dtable_from_pandas(dctx, rdf), cfg)
     assert_same_rows(out.to_table().to_pandas(),

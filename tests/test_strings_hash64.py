@@ -85,15 +85,53 @@ def test_collision_detected_at_ingest():
 
 
 def test_null_keys_masked(dctx, rng):
-    """None hashes to (0,0); a validity-style treatment is the caller's
-    choice — here we check resolve returns None for unknown pairs."""
+    """``None`` entries emit NULLABLE lane columns so DTable ingest
+    marks those rows null (SQL null semantics, matching the dictionary
+    path) — they must no longer ride the data plane as the valid key
+    pair (0, 0)."""
     store = cstr.StringStore()
     enc, _ = cstr.encode_frame(
         pd.DataFrame({"k": np.array(["x", None, "y"], dtype=object)}),
         ["k"], store)
-    back = store.resolve("k", enc["k#h0"].to_numpy()[1:2],
-                         enc["k#h1"].to_numpy()[1:2])
-    assert back[0] is None
+    assert str(enc["k#h0"].dtype) == "Int32"  # nullable lanes
+    assert enc["k#h0"].isna().tolist() == [False, True, False]
+    assert enc["k#h1"].isna().tolist() == [False, True, False]
+    dt = DTable.from_pandas(dctx, enc)
+    for lane in ("k#h0", "k#h1"):
+        c = dt.column(lane)
+        assert c.validity is not None  # ingest carries the null mask
+    # resolve_frame decodes the null lanes back to None
+    back = store.resolve_frame(enc)
+    assert back["k"].tolist() == ["x", None, "y"]
+
+
+def test_null_keys_group_like_dictionary_path(dctx, rng):
+    """End-to-end null parity: a groupby over hash64 lanes with None
+    keys must produce the same groups as the dictionary-string path on
+    identical data."""
+    from cylon_tpu.parallel import dist_groupby
+    ks = np.array(["a", None, "b", "a", None, "b", "a", None],
+                  dtype=object)
+    df = pd.DataFrame({"k": ks, "v": np.arange(8.0)})
+    # dictionary path (plain ingest)
+    gd = dist_groupby(DTable.from_pandas(dctx, df), ["k"],
+                      [("v", "sum"), ("v", "count")]) \
+        .to_table().to_pandas()
+    # hash64 path
+    enc, store = cstr.encode_frame(df, ["k"])
+    gh_raw = dist_groupby(DTable.from_pandas(dctx, enc),
+                          ["k#h0", "k#h1"],
+                          [("v", "sum"), ("v", "count")]) \
+        .to_table().to_pandas()
+    gh = store.resolve_frame(gh_raw)
+    gd = gd.sort_values("k", na_position="last").reset_index(drop=True)
+    gh = gh.sort_values("k", na_position="last").reset_index(drop=True)
+    assert list(gd["k"].fillna("~null~")) == \
+        list(gh["k"].fillna("~null~"))
+    np.testing.assert_allclose(gd["sum_v"].to_numpy(),
+                               gh["sum_v"].to_numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(gd["count_v"].to_numpy(),
+                                  gh["count_v"].to_numpy())
 
 
 def test_native_and_fallback_agree(rng):

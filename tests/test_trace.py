@@ -54,9 +54,14 @@ def test_bench_line_shape():
 
 
 def test_dist_join_emits_phases(dctx):
+    import dataclasses
     df = pd.DataFrame({"k": np.arange(64) % 7, "v": np.arange(64)})
     dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
-    cfg = JoinConfig.InnerJoin(0, 0, algorithm=JoinAlgorithm.HASH)
+    # broadcast_threshold=0 pins the shuffle path (a 64-row side would
+    # otherwise broadcast and skip the partition/shuffle spans asserted)
+    cfg = dataclasses.replace(
+        JoinConfig.InnerJoin(0, 0, algorithm=JoinAlgorithm.HASH),
+        broadcast_threshold=0)
     trace.reset()
     out = dist_join(dt, dt, cfg)
     assert out.num_rows > 0
@@ -64,7 +69,38 @@ def test_dist_join_emits_phases(dctx):
     for phase in ("join.partition", "join.shuffle", "join.count",
                   "join.gather", "shuffle.counts", "shuffle.exchange"):
         assert phase in totals, f"missing span {phase}: {sorted(totals)}"
+    assert trace.counters().get("join.shuffle", 0) == 1
     assert trace.counters().get("join.out_rows", 0) == out.num_rows
+
+
+def test_dist_join_broadcast_emits_gather_span(dctx):
+    from cylon_tpu.parallel import broadcast
+    broadcast.clear_replica_cache()
+    df = pd.DataFrame({"k": np.arange(64) % 7, "v": np.arange(64)})
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    trace.reset()
+    out = dist_join(dt, dt, JoinConfig.InnerJoin(0, 0))
+    assert out.num_rows > 0
+    totals = trace.phase_totals()
+    assert "join.broadcast_gather" in totals, sorted(totals)
+    for phase in ("join.partition", "join.shuffle"):
+        assert phase not in totals, f"unexpected span {phase}"
+    assert trace.counters().get("join.broadcast", 0) == 1
+
+
+def test_counter_only_mode_records_without_spans():
+    trace.disable()
+    trace.enable_counters()
+    try:
+        with trace.span("x"):
+            trace.count("n", 2)
+        trace.count("n", 3)
+        assert trace.counters() == {"n": 5}
+        assert trace.get_spans() == []  # spans stay off — no device syncs
+    finally:
+        trace.disable_counters()
+    trace.count("n", 1)  # both off again: dropped
+    assert trace.counters() == {"n": 5}
 
 
 def test_dist_sort_emits_phases(dctx):
