@@ -890,6 +890,29 @@ def main() -> None:
             _progress(f"TPC-H {qname}: compile+run")
             qfn = queries.QUERIES[qname]
 
+            if os.environ.get("CYLON_BENCH_PLAN_CHECK", "1") != "0":
+                # pre-flight: abstract-interpret the whole plan
+                # (analysis/plan_check — eval_shape, zero data movement)
+                # so a shape/dtype plan bug costs milliseconds here
+                # instead of a compiled-and-crashed bench stage below
+                from cylon_tpu.analysis import plan_check
+                t0 = time.perf_counter()
+                try:
+                    prep = plan_check.validate(
+                        lambda t, q=qfn: q(ctx, t), dts,
+                        concrete=("nation", "region"))
+                    em.detail[f"tpch_{qname}_plan_nodes"] = len(prep.nodes)
+                except plan_check.PlanValidationError as e:
+                    print(f"tpch {qname} PLAN INVALID: {e}")
+                    em.detail[f"tpch_{qname}_error"] = \
+                        f"plan_check: {str(e)[:180]}"
+                    em.emit(f"tpch_{qname}")
+                    continue
+                em.detail.setdefault("tpch_plan_check_s", 0.0)
+                em.detail["tpch_plan_check_s"] = round(
+                    em.detail["tpch_plan_check_s"]
+                    + (time.perf_counter() - t0), 2)
+
             def run_q():
                 # a query is done when its RESULT is host-visible — some
                 # queries return lazily-computed local tables (e.g. the

@@ -13,10 +13,14 @@ Layer map (tpu-native mirror of SURVEY.md §1):
     L2  parallel/     shuffle = two-phase static-shape all_to_all; dist tables
     L1  (XLA)         collectives over ICI/DCN — no user-space progress engine
     L0  context.py    CylonContext over a jax Mesh; native/ host runtime
+
+    analysis/         graftlint (AST linter), plan_check (eval_shape plan
+                      validation), sanitizer mode (config.sanitize) —
+                      docs/static_analysis.md
 """
 
-from . import trace
-from .config import JoinAlgorithm, JoinConfig, JoinType
+from . import analysis, trace
+from .config import JoinAlgorithm, JoinConfig, JoinType, sanitize
 from .context import CylonContext
 from .dtypes import DataType, Layout, Type
 from .row import Row
@@ -28,5 +32,5 @@ __version__ = "0.1.0"
 __all__ = [
     "CylonContext", "Table", "Column", "Row", "Status", "Code", "CylonError",
     "DataType", "Type", "Layout", "JoinConfig", "JoinType", "JoinAlgorithm",
-    "trace", "__version__",
+    "trace", "analysis", "sanitize", "__version__",
 ]

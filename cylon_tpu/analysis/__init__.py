@@ -1,0 +1,35 @@
+"""Static & plan-time analysis for the engine.
+
+Three coordinated layers (docs/static_analysis.md):
+
+  * ``graftlint``  — AST linter for the hazard classes the Python type
+    system cannot see: hidden host syncs, unkeyed kernel factories
+    (retrace storms), jit-in-loop, unguarded 64-bit literals, hardcoded
+    mesh-axis names.  CLI: ``python -m cylon_tpu.analysis.graftlint``.
+  * ``plan_check`` — abstract interpretation of whole distributed plans
+    via ``jax.eval_shape``: shapes/dtypes of every kernel in a plan are
+    checked with zero data movement (``DTable.explain(validate=True)``).
+  * sanitizer mode — ``cylon_tpu.config.sanitize()``, the runtime
+    backstop for what graftlint proves statically.
+
+``graftlint`` and ``plan_check`` load lazily so importing the analysis
+package never drags the linter (ast/symtable machinery) into runtime
+processes.  (The CLI spelling ``python -m cylon_tpu.analysis.graftlint``
+still imports the parent ``cylon_tpu`` package — and therefore jax —
+because ``-m`` executes parent ``__init__``s; the linting itself only
+needs the stdlib.)
+"""
+from __future__ import annotations
+
+from ._abstract import PlanExportReached, any_abstract, is_abstract
+
+__all__ = ["graftlint", "plan_check", "is_abstract", "any_abstract",
+           "PlanExportReached"]
+
+
+def __getattr__(name):
+    if name in ("graftlint", "plan_check"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

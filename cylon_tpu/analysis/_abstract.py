@@ -1,0 +1,46 @@
+"""Abstract-value plumbing shared by plan_check and the runtime.
+
+The plan checker (analysis/plan_check.py) abstract-interprets whole
+distributed plans by running them under one outer ``jax.eval_shape``:
+every DTable leaf becomes a tracer, every jitted/shard_map kernel
+evaluates abstractly, and no data moves.  The runtime has a handful of
+HOST boundaries (the optimistic count protocol, ``counts_host``,
+``head``/``to_table`` exports) that cannot read a tracer; each of those
+sites branches on :func:`is_abstract` — "abstractness IS the mode", so
+no global flag can ever desync from the values actually flowing.
+
+This module is import-light on purpose: table.py / dtable.py /
+ops/compact.py import it at module load, so it must not import any
+cylon_tpu module (and jax only lazily would be pointless — every caller
+already has jax loaded).
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["is_abstract", "any_abstract", "PlanExportReached"]
+
+
+def is_abstract(x) -> bool:
+    """True for values that exist only inside an abstract trace (plan
+    checking) — reading them on the host would be a concretization
+    error, so host-boundary code branches on this."""
+    return isinstance(x, jax.core.Tracer)
+
+
+def any_abstract(xs) -> bool:
+    return any(isinstance(x, jax.core.Tracer) for x in xs)
+
+
+class PlanExportReached(Exception):
+    """Raised by host-export boundaries (``Table.to_arrow`` & friends)
+    when reached with abstract data: everything UP TO this point of the
+    plan has been shape/dtype-checked, and what follows is host-side
+    post-processing outside the distributed plan.  plan_check catches
+    this and reports the plan as validated-to-boundary."""
+
+    def __init__(self, where: str, schema=None):
+        self.where = where
+        self.schema = schema  # [(name, dtype name, length)] if known
+        super().__init__(
+            f"abstract plan reached the host-export boundary at {where}")

@@ -1,0 +1,489 @@
+"""graftlint — AST linter for the hazard classes XLA cannot type-check.
+
+The engine's performance contract rests on invariants invisible to the
+Python type system: no implicit device→host transfer inside hot spans,
+kernel factories keyed so jit/shard_map caches stay bounded, 64-bit
+literals guarded by the x64 switch, and mesh-axis names flowing from the
+mesh rather than string literals.  Each rule here encodes one of those
+invariants as a static check (docs/static_analysis.md describes them
+with examples):
+
+  implicit-host-sync      ``.item()``; ``int()/float()/bool()`` /
+                          ``np.asarray()/np.array()`` applied to a
+                          device-valued expression; ``jax.device_get``
+                          outside the allow-listed ingest/export modules.
+  kernel-factory-unkeyed  a ``*_fn`` factory that builds jit/shard_map
+                          programs without a cache decorator (every call
+                          re-traces — the retrace-storm bug class), or
+                          whose nested kernel closes over a name that is
+                          not part of its cache key.
+  jit-in-loop             ``jax.jit``/``jax.pmap`` called inside a
+                          ``for``/``while`` body.
+  raw-float64-literal     ``jnp.{float64,int64,uint64,complex128}``
+                          outside an ``enable_x64``-guarded branch
+                          (breaks under the TPU-default x32 config
+                          without the ``_jax_compat.enable_x64`` guard).
+  shard-map-axis-literal  a string-literal axis name handed to
+                          ``P()``/``PartitionSpec()`` or a ``jax.lax``
+                          collective instead of the mesh's axis.
+
+Findings carry ``file:line:col``; suppress a deliberate site with a
+``# graftlint: ok[rule]`` (or bare ``# graftlint: ok``) comment on any
+line the flagged expression spans.
+
+CLI::
+
+    python -m cylon_tpu.analysis.graftlint cylon_tpu bench.py
+
+exits 0 when clean, 1 with findings, 2 on usage/parse errors.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import symtable
+import sys
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "RULES", "lint_source", "lint_paths", "main"]
+
+RULES = (
+    "implicit-host-sync",
+    "kernel-factory-unkeyed",
+    "jit-in-loop",
+    "raw-float64-literal",
+    "shard-map-axis-literal",
+)
+
+# Modules whose job IS the device↔host boundary: ingest, export, the
+# batched count protocol, the tracing sync, per-cell accessors.  A
+# ``jax.device_get`` there is the sanctioned spelling; anywhere else it
+# must be suppressed with a comment saying why.
+DEVICE_GET_ALLOWED = (
+    "cylon_tpu/trace.py",
+    "cylon_tpu/table.py",
+    "cylon_tpu/row.py",
+    "cylon_tpu/parallel/dtable.py",
+    "cylon_tpu/ops/compact.py",
+    "cylon_tpu/io/",
+)
+
+# Attribute names that hold device arrays throughout this codebase
+# (DColumn/Column/DTable fields).  ``host_data``/``_counts_host`` are the
+# host-side mirrors and intentionally absent.
+_DEVICE_ATTRS = {"data", "counts", "validity", "pending_mask"}
+
+# static metadata reads on a device array — no transfer involved
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize",
+                 "is_fully_addressable", "sharding"}
+
+# jnp dtypes that require the x64 switch to exist at all
+_X64_DTYPES = {"float64", "int64", "uint64", "complex128"}
+
+_AXIS_COLLECTIVES = {"all_gather", "psum", "pmax", "pmin", "all_to_all",
+                     "axis_index", "psum_scatter", "ppermute", "pmean"}
+
+_CACHE_DECORATORS = {"lru_cache", "cache", "kernel_factory"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*ok(?:\[([A-Za-z0-9_,\- ]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line → None (all rules) or the set of rule names waived there."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_deviceish(node: ast.AST) -> bool:
+    """Syntactic heuristic: does this expression produce a DEVICE value?
+
+    Tuned for precision over recall (a silent miss beats a noisy false
+    positive): jnp/jax.lax call results, the device-array attributes of
+    the table types, and method/index chains hanging off either.
+    """
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False  # static metadata of a device array, not data
+        if node.attr in _DEVICE_ATTRS:
+            return True
+        return _is_deviceish(node.value)
+    if isinstance(node, ast.Subscript):
+        return _is_deviceish(node.value)
+    if isinstance(node, ast.Call):
+        target = _dotted(node.func)
+        if target is not None:
+            root = target.split(".", 1)[0]
+            if root in ("jnp", "lax") or target.startswith("jax.lax.") \
+                    or target.startswith("jax.numpy."):
+                return True
+        if isinstance(node.func, ast.Attribute):  # method chain: x.sum()
+            return _is_deviceish(node.func.value)
+    if isinstance(node, ast.BinOp):
+        return _is_deviceish(node.left) or _is_deviceish(node.right)
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.findings: List[Finding] = []
+        self.module_names: Set[str] = set()
+        self.loop_depth = 0
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self._suppress = _suppressions(source)
+        self._finding_lines: Dict[Tuple[int, int, str], Tuple[int, int]] = {}
+
+    # -- plumbing -----------------------------------------------------------
+
+    def run(self, tree: ast.Module) -> List[Finding]:
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self.module_names = _module_bindings(tree)
+        self.visit(tree)
+        self._check_factories(tree)
+        return [f for f in self.findings if not self._suppressed(f)]
+
+    def _suppressed(self, f: Finding) -> bool:
+        node_lines = self._finding_lines.get((f.line, f.col, f.rule),
+                                             (f.line, f.line))
+        for line in range(node_lines[0], node_lines[1] + 1):
+            rules = self._suppress.get(line, "missing")
+            if rules is None or (rules != "missing" and f.rule in rules):
+                return True
+        return False
+
+    def _emit(self, node: ast.AST, rule: str, message: str,
+              def_line_only: bool = False) -> None:
+        """``def_line_only`` narrows the suppression span to the node's
+        first line — used for function-level findings, where the full
+        span would let an unrelated suppression deep in the body waive
+        the finding by accident."""
+        f = Finding(self.path, node.lineno, node.col_offset, rule, message)
+        end = node.lineno if def_line_only else (
+            getattr(node, "end_lineno", node.lineno) or node.lineno)
+        self._finding_lines[(f.line, f.col, rule)] = (node.lineno, end)
+        self.findings.append(f)
+
+    # -- traversal ----------------------------------------------------------
+
+    def visit_For(self, node: ast.For) -> None:
+        self._loop(node)
+
+    def visit_AsyncFor(self, node: ast.AsyncFor) -> None:
+        self._loop(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop(node)
+
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        target = _dotted(node.func)
+        self._check_host_sync(node, target)
+        self._check_jit_in_loop(node, target)
+        self._check_axis_literal(node, target)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self._check_x64_literal(node)
+        self.generic_visit(node)
+
+    # -- implicit-host-sync --------------------------------------------------
+
+    def _check_host_sync(self, node: ast.Call, target: Optional[str]) -> None:
+        if (isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+                and not node.args and not node.keywords):
+            self._emit(node, "implicit-host-sync",
+                       ".item() blocks on a device→host transfer")
+            return
+        if target in ("int", "float", "bool") and len(node.args) == 1 \
+                and _is_deviceish(node.args[0]):
+            self._emit(node, "implicit-host-sync",
+                       f"{target}() on a device value forces a host sync; "
+                       "keep the value on device or read it via an explicit "
+                       "batched jax.device_get")
+            return
+        if target in ("np.asarray", "np.array", "numpy.asarray",
+                      "numpy.array") and node.args \
+                and _is_deviceish(node.args[0]):
+            self._emit(node, "implicit-host-sync",
+                       f"{target}() on a device value is a hidden "
+                       "device→host transfer")
+            return
+        if target in ("jax.device_get", "device_get"):
+            norm = self.path.replace(os.sep, "/")
+            if not any(a in norm for a in DEVICE_GET_ALLOWED):
+                self._emit(node, "implicit-host-sync",
+                           "jax.device_get outside the ingest/export "
+                           "allow-list (route host reads through the "
+                           "batched protocols in ops/compact.py or "
+                           "DTable.counts_host)")
+
+    # -- jit-in-loop ---------------------------------------------------------
+
+    def _check_jit_in_loop(self, node: ast.Call,
+                           target: Optional[str]) -> None:
+        if self.loop_depth > 0 and target in ("jax.jit", "jit", "jax.pmap"):
+            self._emit(node, "jit-in-loop",
+                       f"{target}() inside a loop builds a fresh traced "
+                       "program per iteration — hoist it (or a cached "
+                       "factory) out of the loop")
+
+    # -- raw-float64-literal -------------------------------------------------
+
+    def _check_x64_literal(self, node: ast.Attribute) -> None:
+        if node.attr not in _X64_DTYPES:
+            return
+        base = _dotted(node.value)
+        if base not in ("jnp", "jax.numpy"):
+            return
+        if self._x64_guarded(node):
+            return
+        self._emit(node, "raw-float64-literal",
+                   f"jnp.{node.attr} without an enable_x64 guard silently "
+                   "narrows (or raises) under the TPU-default x32 config — "
+                   "branch on jax.config.jax_enable_x64 or use "
+                   "_jax_compat.enable_x64")
+
+    def _x64_guarded(self, node: ast.AST) -> bool:
+        cur = node
+        while cur is not None:
+            parent = self._parents.get(cur)
+            if isinstance(parent, (ast.If, ast.IfExp)):
+                try:
+                    test_src = ast.get_source_segment(self.source,
+                                                      parent.test) or ""
+                except Exception:
+                    test_src = ""
+                if "enable_x64" in test_src or "x64" in test_src:
+                    return True
+            cur = parent
+        return False
+
+    # -- shard-map-axis-literal ----------------------------------------------
+
+    def _check_axis_literal(self, node: ast.Call,
+                            target: Optional[str]) -> None:
+        if target in ("P", "PartitionSpec", "jax.sharding.PartitionSpec"):
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    self._emit(arg, "shard-map-axis-literal",
+                               f"hardcoded axis name {arg.value!r} in "
+                               f"{target}(…) — pass the mesh's axis "
+                               "(ctx.axis / a factory parameter) instead")
+            return
+        leaf = target.rsplit(".", 1)[-1] if target else None
+        if leaf in _AXIS_COLLECTIVES and (
+                target.startswith("jax.lax.") or target.startswith("lax.")
+                or target == leaf):
+            candidates = list(node.args[1:]) + [
+                kw.value for kw in node.keywords
+                if kw.arg in ("axis_name", "axis")]
+            for arg in candidates:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    self._emit(arg, "shard-map-axis-literal",
+                               f"hardcoded axis name {arg.value!r} in "
+                               f"{leaf}(…) — pass the mesh's axis instead")
+
+    # -- kernel-factory-unkeyed ----------------------------------------------
+
+    def _check_factories(self, tree: ast.Module) -> None:
+        blocks = {}
+        try:
+            table = symtable.symtable(self.source, self.path, "exec")
+            _index_symtable(table, blocks)
+        except Exception:
+            # symtable alone is best-effort: without it the closure-
+            # capture arm degrades (blocks stay empty), but the uncached-
+            # factory arm below must keep firing — a blanket except here
+            # would silently turn the whole rule off
+            pass
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if not node.name.endswith("_fn"):
+                continue
+            builds = [n for n in ast.walk(node) if isinstance(n, ast.Call)
+                      and _dotted(n.func) in ("jax.jit", "jit", "shard_map",
+                                              "jax.shard_map")]
+            if not builds:
+                continue
+            deco_exprs = [d.func if isinstance(d, ast.Call) else d
+                          for d in node.decorator_list]
+            cached = any(
+                (_dotted(d) or "").rsplit(".", 1)[-1] in _CACHE_DECORATORS
+                for d in deco_exprs)
+            if not cached:
+                self._emit(node, "kernel-factory-unkeyed",
+                           f"kernel factory {node.name!r} builds a "
+                           "jit/shard_map program but has no cache "
+                           "decorator — every call re-traces (decorate "
+                           "with functools.lru_cache keyed on the static "
+                           "arguments)", def_line_only=True)
+                continue
+            params = {a.arg for a in (node.args.posonlyargs + node.args.args
+                                      + node.args.kwonlyargs)}
+            if node.args.vararg:
+                params.add(node.args.vararg.arg)
+            if node.args.kwarg:
+                params.add(node.args.kwarg.arg)
+            fblock = blocks.get((node.name, node.lineno))
+            if fblock is None:
+                continue
+            flocals = set(fblock.get_locals()) | params
+            for child, enclosing in _nested_function_blocks(fblock, flocals):
+                for free in child.get_frees():
+                    if free in enclosing or free in self.module_names:
+                        continue
+                    self._emit(node, "kernel-factory-unkeyed",
+                               f"kernel {child.get_name()!r} inside "
+                               f"{node.name!r} closes over {free!r}, which "
+                               "is not part of the factory's cache key — "
+                               "thread it through the (hashable) factory "
+                               "arguments", def_line_only=True)
+
+
+def _nested_function_blocks(block, enclosing: Set[str]) -> Iterable:
+    """(function block, names bound in any enclosing scope) pairs — a
+    genexpr inside the kernel legitimately closes over kernel locals."""
+    for child in block.get_children():
+        if child.get_type() == "function":
+            yield child, enclosing
+            yield from _nested_function_blocks(
+                child, enclosing | set(child.get_locals()))
+
+
+def _index_symtable(table, out: Dict[Tuple[str, int], object]) -> None:
+    for child in table.get_children():
+        if child.get_type() == "function":
+            out[(child.get_name(), child.get_lineno())] = child
+        _index_symtable(child, out)
+
+
+def _module_bindings(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                            ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Lint one module's source; returns unsuppressed findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, e.offset or 0,
+                        "parse-error", str(e))]
+    return _Linter(path, source).run(tree)
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            yield p
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in _iter_py_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), path))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--list-rules" in argv:
+        for r in RULES:
+            print(r)
+        return 0
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths:
+        print("usage: python -m cylon_tpu.analysis.graftlint "
+              "[--list-rules] PATH [PATH ...]", file=sys.stderr)
+        return 2
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"graftlint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f)
+    if any(f.rule == "parse-error" for f in findings):
+        # a syntactically broken tree is a tooling failure, not lint
+        # findings — the documented exit-code contract separates them
+        print("graftlint: parse error", file=sys.stderr)
+        return 2
+    if findings:
+        print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

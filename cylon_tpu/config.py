@@ -9,6 +9,8 @@ import enum
 from dataclasses import dataclass
 from typing import Optional
 
+from .status import Code, CylonError, Status
+
 # Global row-count threshold below which a distributed join/semi/anti
 # replicates the small side to every shard (one all_gather) instead of
 # hash/range-shuffling BOTH sides — the dimension-table join shape
@@ -25,13 +27,108 @@ def broadcast_join_threshold() -> int:
     return _broadcast_join_threshold
 
 
-def set_broadcast_join_threshold(n: int) -> int:
+def set_broadcast_join_threshold(n: "Optional[int]") -> "Optional[int]":
     """Set the session-wide broadcast threshold; returns the previous
-    value (callers restore it in a finally — test/bench A/B idiom)."""
+    setting (callers restore it in a finally — test/bench A/B idiom).
+
+    ``n`` must be a positive int (a row count) or ``None`` to disable
+    broadcast joins session-wide.  Zero, negative, and non-int values
+    are rejected: they used to be stored silently and poisoned every
+    planner decision downstream (``0.5`` truncated to "always
+    broadcast-off", ``-1`` read as disabled by one check and as a tiny
+    threshold by another).  Per-call disabling keeps its existing
+    spelling, ``JoinConfig.broadcast_threshold = 0``.
+    """
     global _broadcast_join_threshold
+    if n is not None:
+        if isinstance(n, bool) or not isinstance(n, int):
+            raise CylonError(Status(Code.Invalid,
+                "broadcast join threshold must be a positive int row "
+                f"count or None to disable, got {type(n).__name__} "
+                f"{n!r}"))
+        if n <= 0:
+            raise CylonError(Status(Code.Invalid,
+                f"broadcast join threshold must be positive, got {n} "
+                "(pass None to disable broadcast joins)"))
     prev = _broadcast_join_threshold
-    _broadcast_join_threshold = int(n)
-    return prev
+    _broadcast_join_threshold = 0 if n is None else n
+    return prev if prev > 0 else None
+
+
+# ---------------------------------------------------------------------------
+# sanitizer mode (docs/static_analysis.md): the RUNTIME backstop for the
+# invariants graftlint proves statically.  When on:
+#
+#   * every trace span body runs under
+#     ``jax.transfer_guard_device_to_host("disallow")`` — a hidden
+#     implicit device→host sync inside a hot span (``.item()``,
+#     ``float()``, ``np.asarray`` on a device array) raises instead of
+#     silently stalling the pipeline.  The sanctioned host reads (the
+#     batched count protocol, trace.hard_sync) use explicit
+#     ``jax.device_get``, which the guard permits by design.
+#   * ``jax_debug_nans`` is enabled — kernels that manufacture NaNs fail
+#     at the producing op.
+#   * the stale-host-cache checks in ``Table.to_arrow`` (always-on
+#     structurally) additionally byte-compare every host cache against
+#     the device truth before export.
+#
+# Enable for a whole run with CYLON_SANITIZE=1 (tests/conftest.py wires
+# it), or scoped:  ``with config.sanitize(): ...``.
+# ---------------------------------------------------------------------------
+
+_sanitizing = False
+
+
+def sanitizing() -> bool:
+    """Whether sanitizer mode is active (read by trace.py / table.py)."""
+    return _sanitizing
+
+
+def sanitize_guard():
+    """A fresh device→host transfer-guard context for one span body, or
+    None when sanitizer mode is off (context managers are single-use,
+    so every span asks for its own)."""
+    if not _sanitizing:
+        return None
+    import jax
+
+    return jax.transfer_guard_device_to_host("disallow")
+
+
+class _SanitizeHandle:
+    """Returned by ``sanitize()``: already active; usable as a context
+    manager for scoped enabling, or kept for the process lifetime."""
+
+    def __init__(self, prev_on: bool, prev_debug_nans):
+        self._prev_on = prev_on
+        self._prev_debug_nans = prev_debug_nans
+
+    def __enter__(self) -> "_SanitizeHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        global _sanitizing
+        import jax
+
+        _sanitizing = self._prev_on
+        jax.config.update("jax_debug_nans", self._prev_debug_nans)
+
+
+def sanitize(enable: bool = True) -> _SanitizeHandle:
+    """Turn sanitizer mode on (default) or off; see the section comment
+    above for what it checks.  Returns a handle whose ``close()`` (or
+    ``with``-exit) restores the previous state."""
+    global _sanitizing
+    import jax
+
+    prev_on = _sanitizing
+    prev_nans = jax.config.jax_debug_nans
+    _sanitizing = bool(enable)
+    jax.config.update("jax_debug_nans", bool(enable))
+    return _SanitizeHandle(prev_on, prev_nans)
 
 
 class JoinType(enum.Enum):

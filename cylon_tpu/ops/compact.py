@@ -14,6 +14,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis._abstract import is_abstract
+
 
 def compact_indices(mask: jax.Array, size: int, fill: int = -1) -> jax.Array:
     """Indices of True entries in order, padded with ``fill`` to ``size``.
@@ -101,6 +103,18 @@ def optimistic_dispatch(hints: dict, key, dispatch, cnt_dev, post):
     the region (``run_pipeline`` automates this).  The returned counts are
     ``None`` in deferred mode.
     """
+    if is_abstract(cnt_dev):
+        # abstract plan run (analysis/plan_check.py): the counts exist
+        # only as shapes, so size the dispatch from zeroed counts — any
+        # size-class is equally valid for shape/dtype checking, and
+        # post() still runs so its contract checks see a clean header.
+        # Hints are left untouched: a plan run must not steer the sizes
+        # of later REAL dispatches.
+        import numpy as np
+
+        counts = np.zeros(cnt_dev.shape, cnt_dev.dtype)
+        need = tuple(post(counts))
+        return dispatch(need), need, counts
     _abort_if_poisoned()  # don't pile device work onto a doomed attempt
     hint = hint_value(hints, key)
     if hint is not None and _deferred.depth > 0:

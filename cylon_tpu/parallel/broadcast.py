@@ -58,6 +58,8 @@ from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import trace
+from ..analysis import plan_check
+from ..analysis._abstract import is_abstract
 from ..config import broadcast_join_threshold
 from ..ops import compact as ops_compact
 from .dtable import DColumn, DTable
@@ -168,6 +170,11 @@ def replicate_table(dt: DTable, mode: str = ALL,
     ``cache=False`` for one-shot intermediates (the groupby combine) —
     caching them would only pin dead arrays."""
     assert dt.pending_mask is None, "collapse the pending mask first"
+    plan_check.note("replicate_table", dt, mode=mode)
+    if cache and any(is_abstract(c.data) for c in dt.columns):
+        # abstract plan run: tracer identities are meaningless across
+        # traces, and caching them would pin trace-internal values
+        cache = False
     key = _cache_key(dt, mode) if cache else None
     if cache:
         hit = _replica_cache.get(key)

@@ -31,6 +31,7 @@ from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import trace
+from ..analysis import plan_check
 from ..config import JoinAlgorithm, JoinConfig
 from ..dtypes import DataType, is_dictionary_encoded
 from ..ops import compact as ops_compact
@@ -219,6 +220,7 @@ def shuffle_table(dt: DTable, key_columns: Sequence[Union[int, str]]
     ArrowAllToAll + concat collapsed into partition-ids + one two-phase
     all_to_all exchange.
     """
+    plan_check.note("shuffle_table", dt, keys=tuple(key_columns))
     dt._collapse_pending()
     key_ids = _resolve_ids(dt, key_columns)
     return _shuffle_by_pids(dt, _hash_pids(dt, key_ids))
@@ -355,6 +357,9 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig,
     (order an output that needs it with dist_sort, as the TPC-H plans
     do).
     """
+    plan_check.note("dist_join", left, right, how=config.join_type.value,
+                    alg=config.algorithm.value,
+                    dense=dense_key_range is not None or None)
     if dense_key_range is not None:
         out = _try_fk_join(left, right, config, dense_key_range)
         if out is not None:
@@ -796,6 +801,7 @@ def _setop_fn(mesh, axis: str, op: str, cap_a: int, cap_b: int,
 
 
 def _dist_set_op(a: DTable, b: DTable, op: str) -> DTable:
+    plan_check.note(f"dist_{op.lower()}", a, b)
     a._collapse_pending()
     b._collapse_pending()
     a.verify_same_schema(b)
@@ -1010,6 +1016,11 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     raw-row shuffle (e.g. keys known near-unique, where the partial pass
     is pure overhead).
     """
+    if not _local_only:
+        plan_check.note("dist_groupby", dt, keys=tuple(key_columns),
+                        aggs=tuple(op for _, op in aggregations),
+                        dense=dense_key_range is not None or None,
+                        where=where is not None or None)
     key_ids = _resolve_ids(dt, key_columns)
     val_ids = [dt.column_index(c) for c, _ in aggregations]
     # distinct value columns enter the kernels ONCE (they ride phase 1's
@@ -1346,6 +1357,9 @@ def dist_aggregate(dt: DTable,
     as ``dist_select``/``dist_groupby``; it rides the reduction mask, so a
     filtered scalar aggregate is one fused device pass + one host read.
     """
+    plan_check.note("dist_aggregate", dt,
+                    aggs=tuple(op for _, op in aggregations),
+                    where=where is not None or None)
     val_ids = [dt.column_index(c) for c, _ in aggregations]
     aggs = tuple(op for _, op in aggregations)
     pmask = _effective_mask(dt, where)
@@ -1684,6 +1698,7 @@ def dist_select(dt: DTable, predicate, params=(), compact: bool = True
     or the consumer is mask-aware end-to-end; compact when the filter is
     highly selective and the consumer re-traverses the block per pass.
     """
+    plan_check.note("dist_select", dt, deferred=(not compact) or None)
     mesh, axis, cap = dt.ctx.mesh, dt.ctx.axis, dt.cap
     names = tuple(c.name for c in dt.columns)
     has_pm = dt.pending_mask is not None
@@ -1800,6 +1815,8 @@ def _semi_mask_fn(mesh, axis: str, cap_l: int, cap_r: int, anti: bool):
 def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
                        anti: bool, dense_key_range=None,
                        broadcast_threshold=None) -> DTable:
+    plan_check.note("dist_anti_join" if anti else "dist_semi_join",
+                    left, right, dense=dense_key_range is not None or None)
     li_keys = _join_keys(left, left_on)
     ri_keys = _join_keys(right, right_on)
     if len(li_keys) != len(ri_keys):
@@ -1945,6 +1962,7 @@ def dist_project(dt: DTable, columns: Sequence[Union[int, str]]) -> DTable:
     """Column subset — zero-copy, like the local Project
     (reference table_api.cpp:1007-1029).  A deferred-select mask rides
     along (projection commutes with row filtering)."""
+    plan_check.note("dist_project", dt, keep=len(columns))
     ids = _resolve_ids(dt, columns)
     out = DTable(dt.ctx, [dt.columns[i] for i in ids], dt.cap, dt.counts,
                  dt.pending_mask, dt.pending_cnts)
@@ -1963,6 +1981,7 @@ def dist_with_column(dt: DTable, name: str, fn, out_type,
     needed; XLA propagates the mesh sharding through the expression.
     ``validity_from`` names input columns whose nulls null the output.
     """
+    plan_check.note("dist_with_column", dt, name=name)
     from ..dtypes import DataType as _DT, Type, device_dtype
     if not jax.config.jax_enable_x64:
         # the same logical-type downgrade ingest applies (table._narrow_host):
@@ -1990,6 +2009,7 @@ def dist_head(dt: DTable, n: int) -> "Table":
     """First ``n`` global rows (shard-major order) as a local Table — the
     small-result gather after a dist_sort (ORDER BY … LIMIT n).  Rows are
     compacted on device first, so the transfer is O(n), not O(P·cap)."""
+    plan_check.note("dist_head", dt, n=n)
     return dt.head(n)
 
 
@@ -2015,6 +2035,7 @@ def dist_sort_multi(dt: DTable, sort_columns: Sequence[Union[int, str]],
     shuffle regardless of key count — the scalable spelling of the
     host-side ``compute.sort_multi`` tail every small query uses.
     ``ascending``: one bool or a per-column sequence."""
+    plan_check.note("dist_sort_multi", dt, keys=tuple(sort_columns))
     dt._collapse_pending()
     key_ids = _resolve_ids(dt, sort_columns)
     asc = ([ascending] * len(key_ids) if isinstance(ascending, bool)
@@ -2059,6 +2080,7 @@ def dist_sort(dt: DTable, sort_column: Union[int, str],
     requested order, and rows within a shard are sorted (nulls last
     globally), so concatenating shards in mesh order is the sorted table.
     """
+    plan_check.note("dist_sort", dt, key=sort_column)
     dt._collapse_pending()
     key_i = dt.column_index(sort_column)
     if dt.ctx.get_world_size() == 1:

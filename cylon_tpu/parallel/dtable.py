@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis._abstract import is_abstract
 from ..context import CylonContext
 from ..dtypes import DataType, is_dictionary_encoded
 from ..ops import compact as ops_compact
@@ -121,6 +122,15 @@ class DTable:
 
     def counts_host(self) -> np.ndarray:
         self._collapse_pending()
+        if self._counts_host is None and is_abstract(self.counts):
+            # abstract plan run: the counts of a derived table are data-
+            # dependent by definition — a plan that needs them on host
+            # is a plan that cannot be checked without executing
+            from ..status import Code, CylonError, Status
+            raise CylonError(Status(Code.ExecutionError,
+                "plan_check: host row counts of a derived table are "
+                "data-dependent (only ingest-cached counts are known "
+                "at plan time)"))
         if self._counts_host is None:
             # resolve queued optimistic-capacity validations before trusting
             # any host-visible row counts; inside a failed deferred attempt
@@ -338,6 +348,23 @@ class DTable:
             pulls.append(_export_take(c.data, idx))
             if c.validity is not None:
                 pulls.append(_export_take(c.validity, idx))
+        if any(is_abstract(p) for p in pulls):
+            # abstract plan run: the "export" is the traced compaction
+            # itself — hand back an abstract local Table (no host copies,
+            # no transfer); Table.to_arrow marks the plan boundary
+            cols_a: List[Column] = []
+            hi = 0
+            for c in self.columns:
+                d = pulls[hi]
+                hi += 1
+                v = None
+                if c.validity is not None:
+                    v = pulls[hi]
+                    hi += 1
+                cols_a.append(Column(c.name, c.dtype, d, v,
+                                     dictionary=c.dictionary,
+                                     arrow_type=c.arrow_type))
+            return Table(self.ctx, cols_a)
         hosts = jax.device_get(pulls)
         cols: List[Column] = []
         hi = 0
@@ -367,8 +394,19 @@ class DTable:
         a bigger result falls through to the counts-based export having
         already paid for its counts (2 trips total, same as before).
         """
+        if is_abstract(self.counts) \
+                or any(is_abstract(c.data) for c in self.columns):
+            # abstract plan run: gather the full capacity bound — row
+            # counts are data-dependent, shapes are what the plan checks
+            self._collapse_pending()
+            return self._export([self.cap] * self.nparts)
         n_arrays = sum(1 + (c.validity is not None) for c in self.columns)
+        # the fused probe is a shard_map program: under an ambient trace
+        # escape hatch (jax.ensure_compile_time_eval — the plan-time
+        # constant-fold path of plan_check) collectives cannot bind the
+        # mesh axis, so take the collective-free export path there
         if (self.pending_mask is None and self.columns
+                and jax.core.trace_state_clean()
                 and self.nparts * self.cap * n_arrays
                 <= _TO_TABLE_PROBE_MAX_CELLS):
             n = min(_HEAD_FUSED_MAX, self.nparts * self.cap)
@@ -438,6 +476,12 @@ class DTable:
         n_eff = min(int(n), self.nparts * self.cap)
         if n_eff <= 0:
             return self._export([0] * self.nparts)
+        abstract = (is_abstract(self.counts)
+                    or any(is_abstract(c.data) for c in self.columns))
+        if abstract and n_eff > _HEAD_FUSED_MAX:
+            # abstract plan run, counts-based path: per-shard takes are
+            # data-dependent — export the capacity bound instead
+            return self._export([min(n_eff, self.cap)] * self.nparts)
         if n_eff > _HEAD_FUSED_MAX:
             # the fused kernel replicates an [n_eff] block per device and
             # psums it — O(P·n) memory for a big head().  Past a modest n
@@ -454,6 +498,13 @@ class DTable:
         outs, got = _head_fn(self.ctx.mesh, self.ctx.axis, self.cap, n_eff,
                              tuple(c.validity is not None
                                    for c in self.columns))(self.counts, leaves)
+        if abstract:
+            # abstract plan run: the fused [n] block IS the head's shape;
+            # rows-taken is data-dependent, so keep the full block
+            return Table(self.ctx, [
+                Column(c.name, c.dtype, d, v, dictionary=c.dictionary,
+                       arrow_type=c.arrow_type)
+                for c, (d, v) in zip(self.columns, outs)])
         flat: List[Any] = [got]
         for d, v in outs:
             flat.append(d)
@@ -481,9 +532,55 @@ class DTable:
         out._counts_host = self._counts_host  # same rows, same counts
         return out
 
+    def explain(self, plan=None, *, tables=None, validate: bool = False,
+                concrete=()):
+        """Describe — and optionally validate — a plan over this table.
+
+        ``dt.explain()`` returns a structural summary of the table
+        itself; with ``validate=True`` it additionally checks the
+        engine's plan-shape invariants (counts dtype/width, leaf
+        lengths, validity dtypes, dictionary sort order).
+
+        ``dt.explain(plan, validate=True)`` abstract-interprets
+        ``plan`` — a callable receiving this table (or, when ``tables``
+        is given, that dict of tables, the whole-query shape:
+        ``dt.explain(lambda t: q5(ctx, t), tables=t, validate=True)``) —
+        via ``jax.eval_shape``: every distributed op in the plan is
+        shape/dtype-checked with ZERO data movement, and the returned
+        ``PlanReport`` lists the operator sequence.  ``concrete`` names
+        tables in ``tables`` to keep un-abstracted (tiny dimension
+        tables whose values the plan folds at build time).  See
+        docs/static_analysis.md.
+        """
+        from ..analysis import plan_check
+        if plan is None:
+            if validate:
+                plan_check._check_table("explain", self)
+            cols = ", ".join(f"{c.name}:{c.dtype.type.name}"
+                             for c in self.columns)
+            ch = self._counts_host
+            rows = (f"{int(ch.sum())} rows" if ch is not None
+                    else "rows data-dependent")
+            mask = ", deferred-select mask pending" \
+                if self.pending_mask is not None else ""
+            return (f"DTable[{rows} over {self.nparts} shards, "
+                    f"cap={self.cap}{mask}]({cols})")
+        target = tables if tables is not None else self
+        return plan_check.explain(plan, target, validate=validate,
+                                  concrete=concrete)
+
     def __repr__(self) -> str:
         cols = ", ".join(f"{c.name}:{c.dtype.type.name}" for c in self.columns)
-        return (f"DTable[{self.num_rows} rows over {self.nparts} shards, "
+        ch = self._counts_host
+        if ch is not None:
+            rows = f"{int(ch.sum())} rows"
+        elif is_abstract(self.counts):
+            # abstract plan run: a repr (user print, debugger, error
+            # formatter) must never raise the counts_host plan error
+            rows = "abstract rows"
+        else:
+            rows = f"{self.num_rows} rows"
+        return (f"DTable[{rows} over {self.nparts} shards, "
                 f"cap={self.cap}]({cols})")
 
 

@@ -43,13 +43,15 @@ class Row:
         """Cell value as a Python scalar; None for a null cell; strings
         decode through the column dictionary."""
         c = self._table.column(col)
-        if c.validity is not None and not bool(c.validity[self._i]):
+        # a per-cell accessor IS a host read by contract — the one place
+        # the blocking transfer is the requested behavior, not a leak
+        if c.validity is not None and not bool(c.validity[self._i]):  # graftlint: ok[implicit-host-sync]
             return None
         v = c.data[self._i]
         if is_dictionary_encoded(c.dtype.type):
             s = c.dictionary[int(v)]
             return s.decode() if isinstance(s, bytes) else str(s)
-        return np.asarray(v)[()].item()
+        return np.asarray(v)[()].item()  # graftlint: ok[implicit-host-sync]
 
     def __getitem__(self, col: Union[int, str]) -> Any:
         return self.get(col)

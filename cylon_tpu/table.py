@@ -325,22 +325,43 @@ class Table:
         already and transfer nothing."""
         import pyarrow as pa
 
+        from .analysis._abstract import PlanExportReached, is_abstract
+        if any(is_abstract(c.data) for c in self.columns):
+            # abstract plan run (analysis/plan_check.py): this is the
+            # host-export boundary — the distributed plan above has been
+            # fully checked; what follows is host post-processing
+            raise PlanExportReached(
+                "Table.to_arrow",
+                [(c.name, c.dtype.type.name, c.length)
+                 for c in self.columns])
+        from .config import sanitizing
+        for c in self.columns:
+            # host-cache staleness guard, ALWAYS ON (formerly asserts,
+            # promoted by the sanitizer work — a stripped-assert build
+            # must not silently export stale host copies): a cache may
+            # only coexist with the device array it was copied from
+            # (every contents change routes through Column.with_data,
+            # which drops it).  A length mismatch is the cheap
+            # observable of a violation.
+            if c.host_data is not None \
+                    and c.host_data.shape[0] != c.length:
+                raise CylonError(Status(Code.ExecutionError,
+                    f"stale host_data cache on column {c.name!r} "
+                    f"({c.host_data.shape[0]} host vs {c.length} device "
+                    "rows) — derive columns via Column.with_data"))
+            if c.host_validity is not None and (
+                    c.validity is None
+                    or c.host_validity.shape[0] != c.length):
+                raise CylonError(Status(Code.ExecutionError,
+                    f"stale host_validity cache on column {c.name!r} — "
+                    "derive columns via Column.with_data"))
+        if sanitizing():
+            # sanitizer backstop: byte-compare every host cache against
+            # the device truth before trusting it for export.  Costs a
+            # full pull — exactly what sanitize mode is for.
+            self._verify_host_caches()
         pulls, slots = [], []
         for i, c in enumerate(self.columns):
-            # host-cache staleness guard: a cache may only coexist with
-            # the device array it was copied from (every contents change
-            # must route through Column.with_data, which drops it).  A
-            # length mismatch is the cheap observable of a violation.
-            assert c.host_data is None \
-                or c.host_data.shape[0] == c.length, \
-                f"stale host_data cache on column {c.name!r} " \
-                f"({c.host_data.shape[0]} host vs {c.length} device " \
-                "rows) — derive columns via Column.with_data"
-            assert c.host_validity is None or (
-                c.validity is not None
-                and c.host_validity.shape[0] == c.length), \
-                f"stale host_validity cache on column {c.name!r} — " \
-                "derive columns via Column.with_data"
             if c.host_data is None:
                 pulls.append(c.data)
                 slots.append((i, False))
@@ -376,6 +397,27 @@ class Table:
                 arrays.append(pa.array(host, type=at, mask=mask))
             names.append(c.name)
         return pa.table(arrays, names=names)
+
+    def _verify_host_caches(self) -> None:
+        """Sanitizer content check (config.sanitize()): device arrays are
+        the truth; any host cache that disagrees is a with_data-contract
+        violation that would otherwise export silently-wrong data."""
+        pulls = []
+        for c in self.columns:
+            if c.host_data is not None:
+                pulls.append((c.name, "host_data", c.host_data, c.data))
+            if c.host_validity is not None:
+                pulls.append((c.name, "host_validity", c.host_validity,
+                              c.validity))
+        if not pulls:
+            return
+        fresh = jax.device_get([d for _, _, _, d in pulls])
+        for (name, kind, cached, _), dev in zip(pulls, fresh):
+            if not np.array_equal(np.asarray(cached), np.asarray(dev)):
+                raise CylonError(Status(Code.ExecutionError,
+                    f"sanitize: {kind} cache on column {name!r} disagrees "
+                    "with the device array — a contents change bypassed "
+                    "Column.with_data"))
 
     def to_pandas(self):
         return self.to_arrow().to_pandas()

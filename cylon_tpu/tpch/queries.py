@@ -602,14 +602,30 @@ def q19(ctx, t: Tables) -> Table:
 # counts).  Keyed by DTable object id: callers (bench, tests) hold the
 # table dict alive for the whole run, so ids are stable; worst case a
 # recycled id re-reads a 25-row table.
-_host_cache: dict = {}
-
-
 def _host_df(t: Tables, name: str):
-    key = (name, id(t[name]))
-    if key not in _host_cache:
-        _host_cache[key] = t[name].to_table().to_pandas()
-    return _host_cache[key]
+    # cached ON the DTable instance: an id()-keyed dict here would hand a
+    # recycled address the previous table's DataFrame (the same hazard
+    # this PR removed from _table_rows); an attribute dies with its table
+    dt = t[name]
+    df = getattr(dt, "_host_df_cache", None)
+    if df is None:
+        import jax
+        if jax.core.trace_state_clean():
+            df = dt.to_table().to_pandas()
+        else:
+            # inside an abstract trace (plan_check interpreting the
+            # query): dimension-table lookups are PLAN-TIME constants
+            # (name → key maps over 25-row tables), so fold them eagerly
+            # under ensure_compile_time_eval — omnistaging would
+            # otherwise stage the export into the abstract trace and
+            # fail at the host read.  Entered ONLY in-trace: at top
+            # level the eval context cannot bind shard_map's mesh axis
+            # (to_table's probe gates on trace_state_clean for the same
+            # reason).
+            with jax.ensure_compile_time_eval():
+                df = dt.to_table().to_pandas()
+        dt._host_df_cache = df
+    return df
 
 
 def _nation_keys(t: Tables, names) -> tuple:
@@ -657,11 +673,12 @@ def _pk0(t: Tables, table: str):
 
 
 def _table_rows(dt: DTable) -> int:
-    import jax
-    key = ("rows", id(dt))
-    if key not in _host_cache:
-        _host_cache[key] = int(np.asarray(jax.device_get(dt.counts)).sum())
-    return _host_cache[key]
+    # num_rows rides DTable's counts protocol: the ingest-cached host
+    # counts answer without any transfer (and under plan checking an
+    # abstract table answers from the same cache instead of syncing) —
+    # the raw jax.device_get this used to do was a graftlint
+    # implicit-host-sync finding AND an id()-keyed cache hazard
+    return dt.num_rows
 
 
 @functools.lru_cache(maxsize=None)

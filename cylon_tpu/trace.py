@@ -52,10 +52,18 @@ def hard_sync(tree) -> None:
     """
     import jax
 
-    leaves = [x for x in jax.tree_util.tree_leaves(tree)
-              if hasattr(x, "ravel") and getattr(x, "size", 0)]
+    from .analysis._abstract import any_abstract, is_abstract
+
+    all_leaves = jax.tree_util.tree_leaves(tree)
+    # abstract plan run (analysis/plan_check): tracers cannot be synced —
+    # drop them and sync whatever concrete arrays ride the same tree
+    has_abstract = any_abstract(all_leaves)
+    leaves = [x for x in all_leaves
+              if not is_abstract(x)
+              and hasattr(x, "ravel") and getattr(x, "size", 0)]
     if not leaves:
-        jax.block_until_ready(tree)
+        if not has_abstract:
+            jax.block_until_ready(tree)
         return
     reads = []
     for x in leaves:
@@ -160,14 +168,23 @@ class _SyncSpan:
 @contextlib.contextmanager
 def span_sync(name: str) -> Iterator[_SyncSpan]:
     sp = _SyncSpan()
+    # sanitizer mode (config.sanitize): span bodies are the engine's hot
+    # device regions, so ban IMPLICIT device→host transfers inside them —
+    # the sanctioned host reads (batched count protocol, hard_sync) use
+    # explicit jax.device_get, which the guard permits.  The guard wraps
+    # only the body: the sync at span exit runs outside it.
+    from .config import sanitize_guard
+    guard = sanitize_guard() or contextlib.nullcontext()
     if not _enabled:
-        yield sp
+        with guard:
+            yield sp
         return
     depth = getattr(_state, "depth", 0)
     _state.depth = depth + 1
     t0 = time.perf_counter()
     try:
-        yield sp
+        with guard:
+            yield sp
     finally:
         if sp._target is not None:
             hard_sync(sp._target)

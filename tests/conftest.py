@@ -44,6 +44,15 @@ jax.config.update("jax_enable_x64", True)
 CPU_DEVICES = jax.devices("cpu")
 jax.config.update("jax_default_device", CPU_DEVICES[0])
 
+# CYLON_SANITIZE=1 runs the whole suite in sanitizer mode
+# (cylon_tpu.config.sanitize): implicit device→host transfers inside
+# trace spans raise, NaN debugging is on, and host-cache content is
+# verified at every export — the acceptance gate for the sanitizer is
+# that the full suite stays green under it.
+if os.environ.get("CYLON_SANITIZE", "0") not in ("", "0"):
+    from cylon_tpu import config as _cylon_config
+    _cylon_config.sanitize()
+
 
 def pytest_configure(config):
     # the tier-1 gate runs `-m 'not slow'`; register the marker so the

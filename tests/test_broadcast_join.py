@@ -148,7 +148,7 @@ def test_global_threshold_knob(dctx, rng):
     ldf, rdf = _key_frames(rng, "int")
     lt = dtable_from_pandas(dctx, ldf)
     rt = dtable_from_pandas(dctx, rdf)
-    prev = cfgmod.set_broadcast_join_threshold(0)
+    prev = cfgmod.set_broadcast_join_threshold(None)  # disable session-wide
     try:
         trace.reset()
         dist_join(lt, rt, _cfg()).to_table()

@@ -1,0 +1,269 @@
+"""plan_check: abstract interpretation of distributed plans.
+
+Three layers of coverage:
+
+  * an eval_shape smoke over every kernel-factory family in
+    dist_ops.py/broadcast.py (join inner/left × shuffle/broadcast/FK,
+    semi/anti × sort/dense, set ops, groupby sort/dense/pre-agg, sort,
+    select deferred/compacted, scalar aggregate) for the int,
+    dict-string, and null-key column flavors — abstract inputs only,
+    zero data movement;
+  * all 22 TPC-H queries plan-checked through
+    ``DTable.explain(validate=True)``;
+  * deliberately broken inputs asserting readable errors, and proof a
+    plan run leaves the runtime caches clean (a real join after a plan
+    run still answers correctly).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table, trace
+from cylon_tpu.config import JoinConfig, JoinType
+from cylon_tpu.parallel import (DTable, dist_aggregate, dist_anti_join,
+                                dist_groupby, dist_head, dist_intersect,
+                                dist_join, dist_select, dist_semi_join,
+                                dist_sort, dist_union, shuffle_table)
+from cylon_tpu.parallel import broadcast
+from cylon_tpu.analysis import plan_check
+from cylon_tpu.analysis.plan_check import PlanValidationError
+
+from test_broadcast_join import _key_frames
+from test_dist_ops import dtable_from_pandas
+from test_local_ops import assert_same_rows
+
+
+@pytest.fixture(params=["int", "str", "nullint"])
+def sides(request, dctx, rng):
+    ldf, rdf = _key_frames(rng, request.param)
+    return (dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf),
+            ldf, rdf)
+
+
+# ---------------------------------------------------------------------------
+# kernel-factory smoke: every distributed-op family, abstractly
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("how", [JoinType.INNER, JoinType.LEFT])
+def test_join_factories_abstract(sides, how):
+    lt, rt, _, _ = sides
+    # broadcast-eligible (small right) AND shuffle-pinned — both planner
+    # arms trace their full factory chains
+    for thr in (None, 0):
+        rep = plan_check.validate(
+            dist_join, lt, rt,
+            JoinConfig(how, left_column_idx="k", right_column_idx="k",
+                       broadcast_threshold=thr))
+        assert rep.ok and rep.nodes[0].op == "dist_join"
+        assert rep.result.startswith("DTable(")
+
+
+def test_fk_join_factories_abstract(dctx, rng):
+    n = 200
+    ldf = pd.DataFrame({"k": rng.integers(1, 41, n), "a": rng.normal(size=n)})
+    rdf = pd.DataFrame({"k": np.arange(1, 41), "b": rng.normal(size=40)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    for how in (JoinType.INNER, JoinType.LEFT):
+        rep = plan_check.validate(
+            dist_join, lt, rt,
+            JoinConfig(how, left_column_idx="k", right_column_idx="k"),
+            dense_key_range=(1, 40))
+        assert rep.ok
+
+
+@pytest.mark.parametrize("op", [dist_semi_join, dist_anti_join])
+def test_semi_anti_factories_abstract(sides, op):
+    lt, rt, _, _ = sides
+    rep = plan_check.validate(op, lt, rt, "k", "k")
+    assert rep.ok
+    assert rep.result.count(":") == len(lt.columns)  # left schema out
+
+
+def test_semi_dense_factories_abstract(dctx, rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 40, 300)})
+    rdf = pd.DataFrame({"k": np.arange(0, 40, 3)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    rep = plan_check.validate(dist_semi_join, lt, rt, "k", "k",
+                              dense_key_range=(0, 39))
+    assert rep.ok
+
+
+@pytest.mark.parametrize("op", [dist_union, dist_intersect])
+def test_setop_factories_abstract(sides, op):
+    lt, rt, ldf, _ = sides
+    rt2 = dtable_from_pandas(lt.ctx, ldf.iloc[:40])
+    rep = plan_check.validate(op, lt, rt2)
+    assert rep.ok
+
+
+def test_groupby_shuffle_and_scalar_agg_abstract(sides):
+    lt, _, _, _ = sides
+    rep = plan_check.validate(
+        dist_groupby, lt, ["k"], [("a", "sum"), ("a", "mean")])
+    assert rep.ok
+    rep = plan_check.validate(dist_aggregate, lt, [("a", "sum")])
+    assert rep.ok and rep.result.startswith("Table(")
+
+
+def test_groupby_dense_emit_empty_abstract(dctx, rng):
+    df = pd.DataFrame({"k": rng.integers(1, 21, 150),
+                       "v": rng.normal(size=150)})
+    dt = dtable_from_pandas(dctx, df)
+    rep = plan_check.validate(dist_groupby, dt, ["k"], [("v", "sum")],
+                              dense_key_range=(1, 20), emit_empty=True)
+    assert rep.ok
+
+
+def test_shuffle_select_sort_head_abstract(sides):
+    lt, _, _, _ = sides
+    rep = plan_check.validate(shuffle_table, lt, ["k"])
+    assert rep.ok
+    plan = lambda dt: dist_head(
+        dist_sort(dist_select(dt, lambda env: env["a"] > 0.0,
+                              compact=False), "k"), 5)
+    rep = plan_check.validate(plan, lt)
+    assert rep.ok and [n.op for n in rep.nodes] == \
+        ["dist_select", "dist_sort", "dist_head"]
+
+
+def test_broadcast_replicate_abstract(sides):
+    lt, rt, _, _ = sides
+    broadcast.clear_replica_cache()
+    rep = plan_check.validate(broadcast.replicate_table, rt)
+    assert rep.ok
+    # tracer identities must never enter the replica cache
+    assert broadcast._replica_cache == {}
+
+
+# ---------------------------------------------------------------------------
+# whole-plan checking: all 22 TPC-H queries, via DTable.explain
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_tables(dctx):
+    from cylon_tpu.tpch import generate
+
+    data = generate(0.002, seed=7)
+    return {name: DTable.from_pandas(dctx, df)
+            for name, df in data.items()}
+
+
+def test_explain_validates_every_tpch_query(dctx, tpch_tables):
+    from cylon_tpu.tpch.queries import QUERIES
+
+    anchor = tpch_tables["lineitem"]
+    for name, qfn in QUERIES.items():
+        rep = anchor.explain(lambda t, q=qfn: q(dctx, t),
+                             tables=tpch_tables, validate=True,
+                             concrete=("nation", "region"))
+        assert rep.ok, f"{name}: {rep}"
+        assert rep.nodes, f"{name} recorded no distributed ops"
+        # q7/q8 end in host-side pandas tails: the report must say the
+        # plan was checked up to the export boundary
+        if name in ("q7", "q8"):
+            assert rep.boundary == "Table.to_arrow", rep
+        text = str(rep)
+        assert "VALID" in text and "dist_" in text
+
+
+def test_explain_structure_mode(tpch_tables):
+    s = tpch_tables["nation"].explain(validate=True)
+    assert "DTable[" in s and "n_nationkey" in s
+
+
+# ---------------------------------------------------------------------------
+# negative space: broken plans fail with readable errors, before any
+# data would have moved
+# ---------------------------------------------------------------------------
+
+def test_misshaped_leaf_readable_error(dctx, rng):
+    df = pd.DataFrame({"k": rng.integers(0, 9, 64), "a": rng.normal(size=64)})
+    dt = dtable_from_pandas(dctx, df)
+    import dataclasses
+    bad_col = dataclasses.replace(dt.columns[1],
+                                  data=dt.columns[1].data[:-3])
+    bad = DTable(dt.ctx, [dt.columns[0], bad_col], dt.cap, dt.counts)
+    with pytest.raises(PlanValidationError, match=r"leaf length .* P\*cap"):
+        plan_check.validate(dist_sort, bad, "k")
+
+
+def test_key_type_mismatch_readable_error(dctx, rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 9, 64).astype(np.int32)})
+    rdf = pd.DataFrame({"k": rng.normal(size=16)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    with pytest.raises(PlanValidationError, match="type mismatch"):
+        plan_check.validate(dist_join, lt, rt, JoinConfig.InnerJoin("k", "k"))
+
+
+def test_validate_rejects_boundary_before_any_op(dctx, tpch_tables):
+    """A plan whose dimension-table host fold fires before the first
+    dist op must NOT report a vacuous VALID — it names the concrete=()
+    remedy instead (q7 folds nation keys at build time)."""
+    from cylon_tpu.tpch.queries import q7
+
+    with pytest.raises(PlanValidationError, match="concrete"):
+        plan_check.validate(lambda t: q7(dctx, t), tpch_tables)
+
+
+def test_explain_is_reentrant(dctx, rng):
+    """A plan callable may pre-flight a sub-plan with its own explain;
+    the outer capture must keep recording afterwards."""
+    df = pd.DataFrame({"k": rng.integers(0, 9, 64), "a": rng.normal(size=64)})
+    dt = dtable_from_pandas(dctx, df)
+
+    def plan(t):
+        inner = plan_check.explain(dist_sort, dt, "k")  # nested, concrete
+        assert inner.ok
+        return dist_select(t, lambda env: env["a"] > 0.0)
+
+    rep = plan_check.validate(plan, dt)
+    assert rep.ok and [n.op for n in rep.nodes][-1] == "dist_select"
+
+
+def test_abstract_repr_never_raises(dctx, rng):
+    df = pd.DataFrame({"k": rng.integers(0, 9, 64), "a": rng.normal(size=64)})
+    dt = dtable_from_pandas(dctx, df)
+
+    def plan(t):
+        out = dist_select(t, lambda env: env["a"] > 0.0)
+        assert "abstract rows" in repr(out)  # derived: counts unknown
+        return out
+
+    assert plan_check.validate(plan, dt).ok
+
+
+def test_explain_without_validate_reports_instead_of_raising(dctx, rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 9, 64).astype(np.int32)})
+    rdf = pd.DataFrame({"k": rng.normal(size=16)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    rep = plan_check.explain(dist_join, lt, rt, JoinConfig.InnerJoin("k", "k"))
+    assert not rep.ok and rep.error is not None
+    assert "INVALID" in str(rep)
+
+
+# ---------------------------------------------------------------------------
+# a plan run is free of side effects on the real runtime
+# ---------------------------------------------------------------------------
+
+def test_plan_run_moves_no_rows_and_poisons_no_caches(dctx, rng):
+    ldf, rdf = _key_frames(rng, "int")
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    cfg = JoinConfig(JoinType.INNER, left_column_idx="k",
+                     right_column_idx="k", broadcast_threshold=0)
+    trace.reset()
+    trace.enable_counters()
+    try:
+        rep = plan_check.validate(lambda t: dist_join(t["l"], t["r"], cfg)
+                                  .to_table(), {"l": lt, "r": rt})
+        assert rep.ok
+        # the abstract run dispatched nothing: no exchange capacity was
+        # ever allocated (the counters the shuffle bumps are host-side
+        # and fire either way; the sync-free proof is row parity below)
+        out = dist_join(lt, rt, cfg).to_table().to_pandas()
+    finally:
+        trace.disable_counters()
+        trace.reset()
+    want = ldf.merge(rdf, on="k").rename(
+        columns={"k": "lt-k", "a": "lt-a", "b": "rt-b"})
+    want.insert(2, "rt-k", want["lt-k"])
+    assert_same_rows(out, want)

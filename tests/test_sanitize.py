@@ -1,0 +1,143 @@
+"""Sanitizer mode (config.sanitize) + the broadcast-threshold knob
+validation.
+
+The device→host transfer guard is exercised as wiring here: on the CPU
+test backend JAX treats host-resident arrays as non-transfers, so the
+guard only bites on real device backends — what IS testable everywhere
+is the NaN backstop (jax_debug_nans), the stale-host-cache content
+verification at export, scope restoration, and that the whole engine
+keeps answering correctly with sanitize on (the full suite runs under
+CYLON_SANITIZE=1 as the acceptance gate)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table, trace
+from cylon_tpu import config as cfgmod
+from cylon_tpu.config import JoinConfig
+from cylon_tpu.parallel import DTable, dist_join
+from cylon_tpu.status import CylonError
+
+from test_dist_ops import dtable_from_pandas
+from test_local_ops import assert_same_rows
+
+
+# ---------------------------------------------------------------------------
+# sanitize(): wiring, scoping, NaN backstop
+# ---------------------------------------------------------------------------
+
+def test_sanitize_scope_and_restore():
+    if cfgmod.sanitizing():
+        pytest.skip("suite-wide sanitize already on (CYLON_SANITIZE=1)")
+    prev_nans = jax.config.jax_debug_nans
+    with cfgmod.sanitize():
+        assert cfgmod.sanitizing()
+        assert jax.config.jax_debug_nans
+        assert cfgmod.sanitize_guard() is not None
+    assert not cfgmod.sanitizing()
+    assert jax.config.jax_debug_nans == prev_nans
+    assert cfgmod.sanitize_guard() is None
+
+
+def test_sanitize_nan_debugging_catches_producer():
+    with cfgmod.sanitize():
+        with pytest.raises(FloatingPointError):
+            jnp.log(jnp.asarray(-1.0)).block_until_ready()
+
+
+def test_span_bodies_run_under_guard():
+    """Spans must stay functional with the guard installed — the
+    sanctioned host reads are explicit device_get, which the
+    device→host 'disallow' level permits by design."""
+    with cfgmod.sanitize():
+        with trace.span_sync("sanitize.test") as sp:
+            x = jnp.arange(8) * 2
+            sp.sync(x)
+            got = jax.device_get(x)  # explicit: sanctioned
+    assert got[3] == 6
+
+
+def test_engine_answers_correctly_under_sanitize(dctx, rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 20, 200),
+                        "a": rng.normal(size=200)})
+    rdf = pd.DataFrame({"k": np.arange(20), "b": rng.normal(size=20)})
+    lt, rt = dtable_from_pandas(dctx, ldf), dtable_from_pandas(dctx, rdf)
+    with cfgmod.sanitize():
+        out = dist_join(lt, rt, JoinConfig.InnerJoin("k", "k")) \
+            .to_table().to_pandas()
+    want = ldf.merge(rdf, on="k").rename(
+        columns={"k": "lt-k", "a": "lt-a", "b": "rt-b"})
+    want.insert(2, "rt-k", want["lt-k"])
+    assert_same_rows(out, want)
+
+
+# ---------------------------------------------------------------------------
+# stale-host-cache checks: structural (always on) + content (sanitize)
+# ---------------------------------------------------------------------------
+
+def _cached_table(ctx):
+    t = Table.from_pandas(ctx, pd.DataFrame({"v": np.arange(6.0)}))
+    assert t.columns[0].host_data is not None  # ingest caches host copies
+    return t
+
+
+def test_stale_cache_length_check_is_always_on(ctx):
+    t = _cached_table(ctx)
+    c = t.columns[0]
+    # bypass with_data on purpose: the device side changes length but the
+    # host cache survives — the structural check must catch it even
+    # outside sanitize mode (formerly an assert, stripped under -O)
+    t.columns[0] = dataclasses.replace(c, data=c.data[:-2])
+    with pytest.raises(CylonError, match="stale host_data"):
+        t.to_arrow()
+
+
+def test_stale_cache_content_check_under_sanitize(ctx):
+    t = _cached_table(ctx)
+    c = t.columns[0]
+    # same length, different contents: invisible structurally, caught by
+    # the sanitizer's byte-compare
+    t.columns[0] = dataclasses.replace(c, data=c.data + 1.0)
+    with cfgmod.sanitize(False):  # structural check alone passes
+        assert t.to_arrow() is not None
+    with cfgmod.sanitize():
+        with pytest.raises(CylonError, match="disagrees"):
+            t.to_arrow()
+
+
+def test_with_data_keeps_export_honest(ctx):
+    t = _cached_table(ctx)
+    t.columns[0] = t.columns[0].with_data(t.columns[0].data + 1.0)
+    with cfgmod.sanitize():
+        got = t.to_arrow().column("v").to_pylist()
+    assert got == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+
+
+# ---------------------------------------------------------------------------
+# set_broadcast_join_threshold validation (planner-poisoning fix)
+# ---------------------------------------------------------------------------
+
+def test_threshold_rejects_zero_negative_nonint():
+    for bad in (0, -1, -(1 << 20), 0.5, 1.5, "128k", True, False):
+        with pytest.raises(CylonError, match="threshold"):
+            cfgmod.set_broadcast_join_threshold(bad)
+    # rejected calls must not have clobbered the setting
+    assert cfgmod.broadcast_join_threshold() \
+        == cfgmod.DEFAULT_BROADCAST_JOIN_THRESHOLD
+
+
+def test_threshold_none_disables_and_roundtrips():
+    prev = cfgmod.set_broadcast_join_threshold(None)
+    try:
+        assert cfgmod.broadcast_join_threshold() <= 0  # disabled
+        back = cfgmod.set_broadcast_join_threshold(4096)
+        assert back is None  # the disabled state round-trips
+        assert cfgmod.broadcast_join_threshold() == 4096
+    finally:
+        cfgmod.set_broadcast_join_threshold(prev)
+    assert cfgmod.broadcast_join_threshold() \
+        == cfgmod.DEFAULT_BROADCAST_JOIN_THRESHOLD
