@@ -948,6 +948,17 @@ def main() -> None:
                 q_counters.get("join.broadcast", 0)
             em.detail[f"tpch_{qname}_join_shuffle_hits"] = \
                 q_counters.get("join.shuffle", 0)
+            # exchange volume + host-round-trip accounting from the
+            # metrics registry (counter-only mode: no span syncs) — the
+            # benchdiff gate's per-query inputs beyond wall-clock
+            em.detail[f"tpch_{qname}_bytes_moved"] = \
+                q_counters.get("shuffle.bytes_sent", 0) \
+                + q_counters.get("broadcast.bytes_sent", 0)
+            em.detail[f"tpch_{qname}_rows_moved"] = \
+                q_counters.get("shuffle.rows_sent", 0) \
+                + q_counters.get("broadcast.rows_sent", 0)
+            em.detail[f"tpch_{qname}_host_reads"] = \
+                q_counters.get("host.read", 0)
             _progress(f"TPC-H {qname}: {q_t * 1e3:.0f} ms")
             em.emit(f"tpch_{qname}")
 

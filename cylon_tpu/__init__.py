@@ -15,11 +15,14 @@ Layer map (tpu-native mirror of SURVEY.md §1):
     L0  context.py    CylonContext over a jax Mesh; native/ host runtime
 
     analysis/         graftlint (AST linter), plan_check (eval_shape plan
-                      validation), sanitizer mode (config.sanitize) —
+                      validation), benchdiff (bench regression gate),
+                      sanitizer mode (config.sanitize) —
                       docs/static_analysis.md
+    observe.py        metrics registry, Chrome/Perfetto trace export,
+                      EXPLAIN ANALYZE — docs/observability.md
 """
 
-from . import analysis, trace
+from . import analysis, observe, trace
 from .config import JoinAlgorithm, JoinConfig, JoinType, sanitize
 from .context import CylonContext
 from .dtypes import DataType, Layout, Type
@@ -32,5 +35,5 @@ __version__ = "0.1.0"
 __all__ = [
     "CylonContext", "Table", "Column", "Row", "Status", "Code", "CylonError",
     "DataType", "Type", "Layout", "JoinConfig", "JoinType", "JoinAlgorithm",
-    "trace", "analysis", "sanitize", "__version__",
+    "trace", "observe", "analysis", "sanitize", "__version__",
 ]

@@ -9,6 +9,9 @@ Three coordinated layers (docs/static_analysis.md):
   * ``plan_check`` — abstract interpretation of whole distributed plans
     via ``jax.eval_shape``: shapes/dtypes of every kernel in a plan are
     checked with zero data movement (``DTable.explain(validate=True)``).
+  * ``benchdiff`` — the BENCH-artifact regression gate.  CLI:
+    ``python -m cylon_tpu.analysis.benchdiff OLD.json NEW.json``
+    (docs/observability.md).
   * sanitizer mode — ``cylon_tpu.config.sanitize()``, the runtime
     backstop for what graftlint proves statically.
 
@@ -23,12 +26,12 @@ from __future__ import annotations
 
 from ._abstract import PlanExportReached, any_abstract, is_abstract
 
-__all__ = ["graftlint", "plan_check", "is_abstract", "any_abstract",
-           "PlanExportReached"]
+__all__ = ["graftlint", "plan_check", "benchdiff", "is_abstract",
+           "any_abstract", "PlanExportReached"]
 
 
 def __getattr__(name):
-    if name in ("graftlint", "plan_check"):
+    if name in ("graftlint", "plan_check", "benchdiff"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

@@ -1,0 +1,260 @@
+"""benchdiff — the BENCH-artifact regression gate.
+
+Diffs two bench artifacts (bench.py's incremental JSON lines, or the
+driver's ``{"tail": ...}`` wrapper around them) and exits non-zero when
+a gated metric regressed past the threshold — the observability loop's
+enforcement end: bench.py folds per-query wall-clock, bytes-moved and
+planner-decision fields into its artifact; this gate is what makes a
+silent perf regression LOUD in CI (docs/observability.md).
+
+Gated metrics (relative threshold, default 15%):
+
+  * ``tpch_<q>_ms``            per-query wall-clock    (higher = worse)
+  * ``tpch_<q>_bytes_moved``   per-query exchange bytes (higher = worse)
+  * ``tpch_<q>_host_reads``    per-query host round trips (higher = worse)
+  * ``tpch_geomean_vs_pandas`` speedup geomean          (lower = worse)
+  * ``tpch_<q>_vs_pandas``     per-query speedup        (lower = worse)
+  * ``dist_join_rows_per_sec`` headline throughput      (lower = worse)
+
+A gated metric present in OLD but absent from NEW fails the gate
+outright (``MISSING``): a query that crashed or was skipped emits no ms
+field, and "went from measured to crashing" must not read as clean.
+
+Everything else numeric is reported in the delta table but never gates
+(oracle timings, spreads, env details).  Each gated family also has an
+ABSOLUTE floor (``--min-abs-ms`` / ``--min-abs-bytes`` /
+``--min-abs-reads``): at the sync floor a 15% swing on a 6 ms query is
+scheduler noise, and a relative gate alone would turn ``host_reads``
+0→1 into +inf% — sub-floor deltas never fail CI.
+
+CLI::
+
+    python -m cylon_tpu.analysis.benchdiff OLD.json NEW.json
+    python -m cylon_tpu.analysis.benchdiff --baseline OLD.json NEW.json \
+        --threshold 0.15 --min-abs-ms 2.0
+
+exits 0 when clean, 1 on a regression past threshold, 2 on usage/parse
+errors (the graftlint exit contract).
+
+Artifact parsing is tolerant by design: a full JSON artifact line is
+preferred, but a driver wrapper whose ``tail`` truncated the line mid-
+object still yields every ``"key": number`` pair the text retains (a
+timed-out bench run loses the line's HEAD, not its scoring fields —
+regex recovery keeps the gate usable on exactly the runs that most need
+watching).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_artifact", "diff", "main"]
+
+_NUM_PAIR_RE = re.compile(
+    r'"([A-Za-z0-9_.]+)"\s*:\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')
+
+# gated key patterns → direction ("up" = an increase is the regression)
+_GATES: Tuple[Tuple[str, str], ...] = (
+    (r"tpch_q\d+_ms$", "up"),
+    (r"tpch_q\d+_bytes_moved$", "up"),
+    (r"tpch_q\d+_host_reads$", "up"),
+    (r"tpch_q\d+_vs_pandas$", "down"),
+    (r"tpch_geomean_vs_pandas$", "down"),
+    (r"dist_join_rows_per_sec$", "down"),
+)
+
+
+def _gate_direction(key: str) -> Optional[str]:
+    for pat, direction in _GATES:
+        if re.search(pat, key):
+            return direction
+    return None
+
+
+def _flatten(obj: dict) -> Dict[str, float]:
+    """One bench artifact object → flat {key: number} (headline value
+    keyed under its metric name; detail fields keyed as-is)."""
+    out: Dict[str, float] = {}
+    metric = obj.get("metric")
+    if isinstance(metric, str) and isinstance(obj.get("value"),
+                                              (int, float)):
+        out[metric] = float(obj["value"])
+    detail = obj.get("detail", obj)
+    if isinstance(detail, dict):
+        for k, v in detail.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = float(v)
+    return out
+
+
+def _scrape(text: str) -> Dict[str, float]:
+    """Last-resort recovery: every ``"key": number`` pair in the text
+    (later occurrences win — the bench re-emits refined lines)."""
+    out: Dict[str, float] = {}
+    for k, v in _NUM_PAIR_RE.findall(text):
+        try:
+            out[k] = float(v)
+        except ValueError:
+            continue
+    return out
+
+
+def load_artifact(path: str) -> Dict[str, float]:
+    """Read one BENCH artifact file into a flat numeric dict.
+
+    Accepts (a) bench.py stdout — one or more incremental JSON lines,
+    the LAST parseable one wins; (b) one artifact object; (c) the
+    driver wrapper ``{"cmd", "rc", "tail", "parsed"}`` — ``parsed``
+    when present, else the tail's last full line, else regex-scraped
+    pairs from whatever survived truncation.  Raises ValueError when
+    nothing numeric is recoverable."""
+    with open(path) as f:
+        text = f.read()
+    best: Optional[Dict[str, float]] = None
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict):
+        if "tail" in obj or "parsed" in obj:  # driver wrapper
+            parsed = obj.get("parsed")
+            if isinstance(parsed, dict):
+                best = _flatten(parsed)
+            else:
+                text = str(obj.get("tail", ""))
+        else:
+            best = _flatten(obj)
+    if best is None:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and ("metric" in cand
+                                           or "detail" in cand):
+                best = _flatten(cand)
+    if not best:
+        best = _scrape(text)
+    if not best:
+        raise ValueError(f"{path}: no bench artifact fields found")
+    return best
+
+
+def diff(old: Dict[str, float], new: Dict[str, float],
+         threshold: float = 0.15, min_abs_ms: float = 1.0,
+         min_abs_bytes: float = 65536.0, min_abs_reads: float = 2.0
+         ) -> Tuple[List[dict], List[dict]]:
+    """Compare two flat artifacts.  Returns ``(rows, regressions)``:
+    ``rows`` is every changed shared key (sorted worst regression
+    first), ``regressions`` the gated subset past ``threshold``.
+
+    Each gated family carries an ABSOLUTE floor besides the relative
+    threshold — a relative gate alone is unusable at small baselines
+    (``host_reads`` 0→1 is +inf%, a few bytes on an empty-exchange query
+    likewise): ``min_abs_ms`` for wall-clock, ``min_abs_bytes`` for
+    exchange volume, ``min_abs_reads`` for host round trips."""
+    rows: List[dict] = []
+    # a gated metric that DISAPPEARED is the worst regression there is —
+    # the query went from measured to crashed/skipped (bench.py emits
+    # tpch_<q>_error and omits the ms field).  Shared-key diffing alone
+    # would wave exactly that through as "clean".
+    for key in sorted(set(old) - set(new)):
+        if _gate_direction(key) is not None:
+            rows.append({"key": key, "old": old[key], "new": None,
+                         "rel": float("inf"), "worse": float("inf"),
+                         "gated": True})
+    for key in sorted(set(old) & set(new)):
+        o, n = old[key], new[key]
+        if o == n:
+            continue
+        rel = (n - o) / abs(o) if o else float("inf")
+        direction = _gate_direction(key)
+        # signed severity: positive = worse (direction-aware)
+        worse = rel if direction == "up" else (-rel if direction == "down"
+                                               else 0.0)
+        gated = direction is not None
+        if gated:  # sub-floor deltas are noise, not signal
+            floor = (min_abs_ms if key.endswith("_ms")
+                     else min_abs_bytes if key.endswith("_bytes_moved")
+                     else min_abs_reads if key.endswith("_host_reads")
+                     else 0.0)
+            if abs(n - o) < floor:
+                gated = False
+        rows.append({"key": key, "old": o, "new": n, "rel": rel,
+                     "worse": worse, "gated": gated})
+    rows.sort(key=lambda r: -r["worse"])
+    regressions = [r for r in rows if r["gated"] and r["worse"] > threshold]
+    return rows, regressions
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.3f}"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cylon_tpu.analysis.benchdiff",
+        description="diff two BENCH artifacts; exit 1 past the "
+                    "regression threshold")
+    ap.add_argument("artifacts", nargs="*",
+                    help="OLD.json NEW.json (or just NEW.json with "
+                         "--baseline)")
+    ap.add_argument("--baseline", help="baseline artifact (the OLD side)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative regression threshold (default 0.15)")
+    ap.add_argument("--min-abs-ms", type=float, default=1.0,
+                    help="ignore ms deltas smaller than this (default 1.0)")
+    ap.add_argument("--min-abs-bytes", type=float, default=65536.0,
+                    help="ignore bytes_moved deltas smaller than this "
+                         "(default 65536)")
+    ap.add_argument("--min-abs-reads", type=float, default=2.0,
+                    help="ignore host_reads deltas smaller than this "
+                         "(default 2)")
+    args = ap.parse_args(argv)
+    paths = ([args.baseline] if args.baseline else []) + args.artifacts
+    if len(paths) != 2:
+        print("benchdiff: need exactly OLD and NEW artifacts",
+              file=sys.stderr)
+        return 2
+    try:
+        old = load_artifact(paths[0])
+        new = load_artifact(paths[1])
+    except (OSError, ValueError) as e:
+        print(f"benchdiff: {e}", file=sys.stderr)
+        return 2
+    rows, regressions = diff(old, new, args.threshold, args.min_abs_ms,
+                             args.min_abs_bytes, args.min_abs_reads)
+    if not rows:
+        print(f"benchdiff: no changed metrics "
+              f"({len(set(old) & set(new))} shared keys identical)")
+        return 0
+    w = max(len(r["key"]) for r in rows)
+    print(f"{'metric':<{w}}  {'old':>14}  {'new':>14}  {'delta':>8}  gate")
+    for r in rows:
+        flag = ""
+        if r["gated"]:
+            flag = ("MISSING" if r["new"] is None else
+                    "REGRESSED" if r in regressions else
+                    "ok" if r["worse"] <= 0 else "within-threshold")
+        new_s = "—" if r["new"] is None else _fmt(r["new"])
+        print(f"{r['key']:<{w}}  {_fmt(r['old']):>14}  "
+              f"{new_s:>14}  {r['rel']:>+7.1%}  {flag}")
+    if regressions:
+        print(f"\nbenchdiff: {len(regressions)} metric(s) regressed past "
+              f"{args.threshold:.0%}", file=sys.stderr)
+        return 1
+    print(f"\nbenchdiff: clean ({len(rows)} changed, none past "
+          f"{args.threshold:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

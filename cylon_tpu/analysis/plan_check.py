@@ -36,6 +36,7 @@ rows are plan-time constants, not data movement.
 """
 from __future__ import annotations
 
+import functools
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -46,7 +47,8 @@ import numpy as np
 from ._abstract import PlanExportReached, is_abstract
 
 __all__ = ["PlanNode", "PlanReport", "PlanValidationError",
-           "explain", "validate", "note", "capturing"]
+           "explain", "validate", "note", "annotate", "instrument",
+           "capturing"]
 
 
 class PlanValidationError(Exception):
@@ -56,18 +58,47 @@ class PlanValidationError(Exception):
     altitude, not stack-trace altitude."""
 
 
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{int(n)} B"
+
+
+def _fmt_rows(n: Optional[int]) -> str:
+    return "?" if n is None else str(int(n))
+
+
 @dataclass
 class PlanNode:
-    """One distributed operator as the abstract run saw it."""
+    """One distributed operator as the abstract run saw it.  An EXPLAIN
+    ANALYZE run (observe.analyze) additionally stitches ``runtime`` on:
+    ``{ms, rows_in, rows_out, bytes_moved, decision, counters, depth}``
+    — the window deltas of the op's real execution, INCLUSIVE of nested
+    operators it triggered."""
 
     op: str
     tables: List[str] = field(default_factory=list)   # input summaries
     info: Dict[str, Any] = field(default_factory=dict)
+    runtime: Optional[Dict[str, Any]] = None
 
     def __str__(self) -> str:
-        extra = (" " + " ".join(f"{k}={v}" for k, v in self.info.items())
-                 if self.info else "")
-        return f"{self.op}({', '.join(self.tables)}){extra}"
+        rt = self.runtime
+        # analyzed nodes render the decision inside the runtime bracket;
+        # repeating it from info would print every decision twice
+        info = {k: v for k, v in self.info.items()
+                if not (rt is not None and k == "decision")}
+        extra = (" " + " ".join(f"{k}={v}" for k, v in info.items())
+                 if info else "")
+        text = f"{self.op}({', '.join(self.tables)}){extra}"
+        if rt is not None:
+            text += (f" [rows {_fmt_rows(rt.get('rows_in'))}"
+                     f"->{_fmt_rows(rt.get('rows_out'))}"
+                     f" | {_fmt_bytes(rt.get('bytes_moved', 0))}"
+                     f" | {rt.get('ms', 0.0):.1f} ms"
+                     f" | {rt.get('decision', 'local')}]")
+        return text
 
 
 @dataclass
@@ -77,8 +108,53 @@ class PlanReport:
     boundary: Optional[str] = None     # export boundary reached (if any)
     result: Optional[str] = None       # output schema summary
     error: Optional[BaseException] = None
+    analyzed: bool = False             # runtime-annotated (EXPLAIN ANALYZE)
+    totals: Dict[str, Any] = field(default_factory=dict)
+    output: Any = None                 # the analyzed run's actual result
+
+    def _exclusive_ms(self) -> List[float]:
+        """Per-node exclusive wall-clock: inclusive ms minus the direct
+        children's inclusive ms (nodes are preorder; a node's children
+        are the following deeper-depth run until depth falls back)."""
+        depths = [(n.runtime or {}).get("depth", 1) for n in self.nodes]
+        incl = [(n.runtime or {}).get("ms", 0.0) for n in self.nodes]
+        excl = list(incl)
+        for i, d in enumerate(depths):
+            for j in range(i + 1, len(self.nodes)):
+                if depths[j] <= d:
+                    break
+                if depths[j] == d + 1:
+                    excl[i] -= incl[j]
+        return [max(e, 0.0) for e in excl]
+
+    def _str_analyzed(self) -> str:
+        t = self.totals
+        head = (f"EXPLAIN ANALYZE: {len(self.nodes)} distributed op(s), "
+                f"{t.get('ms', 0.0):.1f} ms, "
+                f"{_fmt_bytes(t.get('bytes_moved', 0))} moved, "
+                f"{t.get('syncs', 0)} syncs")
+        if not self.ok:
+            head += " [FAILED]"
+        lines = [head]
+        excl = self._exclusive_ms()
+        total = sum(excl) or 1.0
+        hottest = max(range(len(excl)), key=excl.__getitem__, default=None)
+        for i, n in enumerate(self.nodes):
+            depth = (n.runtime or {}).get("depth", 1)
+            hot = "  *HOT*" if (i == hottest or excl[i] >= 0.2 * total) \
+                else ""
+            lines.append(f"{'  ' * depth}{i:3d}. {n}{hot}")
+        if self.boundary:
+            lines.append(f"  ... host-export boundary: {self.boundary}")
+        if self.result:
+            lines.append(f"  -> {self.result}")
+        if self.error is not None:
+            lines.append(f"  error: {self.error}")
+        return "\n".join(lines)
 
     def __str__(self) -> str:
+        if self.analyzed:
+            return self._str_analyzed()
         lines = [f"plan: {len(self.nodes)} distributed op(s), "
                  + ("VALID" if self.ok else "INVALID")]
         lines += [f"  {i:3d}. {n}" for i, n in enumerate(self.nodes)]
@@ -102,22 +178,65 @@ def capturing() -> bool:
     return getattr(_capture, "report", None) is not None
 
 
-def note(op: str, *tables, **info) -> None:
+def note(op: str, *tables, **info) -> Optional[PlanNode]:
     """Record one distributed operator in the active plan capture (no-op
     outside plan_check runs — one thread-local read).  ``tables`` are the
     op's DTable inputs; ``info`` is small static detail (join type,
     strategy hints).  Summaries only — never store live arrays here, the
-    values may be tracers of the abstract run."""
+    values may be tracers of the abstract run.  Returns the created node
+    (None outside a capture) so late planner decisions can ``annotate``
+    it after other nodes were recorded."""
     report: Optional[PlanReport] = getattr(_capture, "report", None)
     if report is None:
-        return
+        return None
     summaries = [_summarize(t) for t in tables]
     if getattr(_capture, "validate", False):
         for t in tables:
             _check_table(op, t)
-    report.nodes.append(PlanNode(op, summaries,
-                                 {k: v for k, v in info.items()
-                                  if v is not None}))
+    node = PlanNode(op, summaries, {k: v for k, v in info.items()
+                                    if v is not None})
+    report.nodes.append(node)
+    return node
+
+
+def annotate(node: Optional[PlanNode] = None, **info) -> None:
+    """Attach late-bound detail — typically the planner's decision and
+    its reason — to ``node`` (or, when None, to the most recently noted
+    node: safe from any point BEFORE a nested op notes its own).  No-op
+    outside a capture; None values are dropped like ``note``'s."""
+    report: Optional[PlanReport] = getattr(_capture, "report", None)
+    if report is None:
+        return
+    if node is None:
+        node = report.nodes[-1] if report.nodes else None
+    if node is None:
+        return
+    node.info.update({k: v for k, v in info.items() if v is not None})
+
+
+def instrument(fn: Callable) -> Callable:
+    """Decorator on the public distributed ops: under an EXPLAIN ANALYZE
+    run (observe.analyze) each call opens a measurement window whose
+    deltas — wall-clock, rows, exchange bytes, counters — are stitched
+    onto the PlanNode the op's own ``note()`` creates.  Outside an
+    analyze run the wrapper costs one thread-local read (the same budget
+    as ``note`` itself)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        state = getattr(_capture, "analyze", None)
+        if state is None:
+            return fn(*args, **kwargs)
+        token = state.enter(fn.__name__, args, kwargs)
+        try:
+            out = fn(*args, **kwargs)
+        except BaseException:
+            state.abort(token)
+            raise
+        state.exit(token, out)
+        return out
+
+    return wrapper
 
 
 def _summarize(dt) -> str:
@@ -286,15 +405,20 @@ def explain(op: Callable, *args, validate: bool = False,
         rebuilt = [r(vals) for r in recons]
         # save/restore, not set/clear: a plan callable may itself call
         # explain/validate (pre-flighting a sub-plan), and clearing would
-        # silence the outer run's note()/invariant checks from there on
+        # silence the outer run's note()/invariant checks from there on.
+        # The analyze state is SUSPENDED for the abstract run: its row
+        # peeks and syncs cannot touch tracers (restored on exit, so an
+        # analyze whose plan pre-flights a sub-plan keeps measuring).
         prev = (getattr(_capture, "report", None),
-                getattr(_capture, "validate", False))
+                getattr(_capture, "validate", False),
+                getattr(_capture, "analyze", None))
         _capture.report = report
         _capture.validate = validate
+        _capture.analyze = None
         try:
             out = op(*rebuilt, **kwargs)
         finally:
-            _capture.report, _capture.validate = prev
+            _capture.report, _capture.validate, _capture.analyze = prev
         report.result = _schema_of(out)
         return ()
 

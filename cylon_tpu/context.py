@@ -172,6 +172,17 @@ class CylonContext:
         from . import trace
         trace.hard_sync(out)
 
+    def analyze(self, op, tables=None):
+        """EXPLAIN ANALYZE a plan: run ``op(tables)`` (or ``op()`` when
+        ``tables`` is None) for real, once, with every distributed
+        operator instrumented; returns the runtime-annotated PlanReport
+        — the context-level spelling of ``DTable.explain(op, tables=...,
+        analyze=True)``.  See docs/observability.md."""
+        from . import observe
+        if tables is None:
+            return observe.analyze(op)
+        return observe.analyze(op, tables)
+
     def finalize(self) -> None:
         self._finalized = True
 

@@ -1,0 +1,585 @@
+"""Observability: the structured metrics registry + EXPLAIN ANALYZE.
+
+The reference's only runtime channel is glog phase lines (reference:
+cpp/src/cylon/join/join.cpp:61-102, table_api.cpp:636-662); trace.py
+reproduces that shape as spans + counters.  This module is the subsystem
+underneath and above it (docs/observability.md):
+
+  * **MetricsRegistry** — typed counters (sums), watermarks (maxes) and
+    gauges (last value), each buffered per thread for lock-free bumping
+    and merged into one process-level view at ``snapshot()`` time (a
+    count bumped on a worker thread — the multihost harness, any future
+    async dispatch — lands in the same report as main-thread counts).
+    ``trace.count``/``count_max``/``gauge`` delegate here, so every
+    existing call site feeds the registry unchanged.
+  * **Chrome trace export** — ``export_chrome_trace(path)`` emits the
+    recorded spans as ``X`` (complete) events and the counter bump
+    series as ``C`` (counter) events in Chrome trace-event JSON, so a
+    query's phase profile opens in Perfetto / ``chrome://tracing`` next
+    to the XLA-level profile from ``trace.profile()``.
+  * **EXPLAIN ANALYZE** — ``analyze(plan, tables)`` runs the real query
+    ONCE with tracing on and stitches runtime statistics (rows in/out,
+    bytes moved per exchange, planner decision, span wall-clock) onto
+    the same ``PlanNode`` DAG that plan_check's abstract run produces,
+    via the ``plan_check.instrument`` hooks on every distributed op.
+    Surfaces: ``DTable.explain(plan, tables=..., analyze=True)`` and
+    ``CylonContext.analyze(plan, tables)``.
+
+ANALYZE is a measurement run: it hard-syncs after every operator so the
+wall-clock charged to each node is honest, which on a tunneled TPU
+backend adds one sync floor per node (docs/tpu_perf_notes.md "the sync
+floor").  The per-node SPLIT is the signal; absolute totals of an
+analyzed run sit above a production (fully async) run by design —
+exactly the trade the bench's phase decomposition already makes.
+
+This module is one of the sanctioned device→host boundaries (with
+trace/table/dtable/compact — see graftlint's allow-list): the row peeks
+below read counts explicitly and WITHOUT caching them on the table, so
+measuring a plan never changes what a later planner decision sees.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "COUNTER", "WATERMARK", "GAUGE", "MetricSpec", "METRICS",
+    "MetricsRegistry", "REGISTRY", "export_chrome_trace", "analyze",
+]
+
+# ---------------------------------------------------------------------------
+# metric kinds + catalogue
+# ---------------------------------------------------------------------------
+
+COUNTER = "counter"      # monotone sum (merge across threads: +)
+WATERMARK = "watermark"  # peak value (merge across threads: max)
+GAUGE = "gauge"          # last written value (process-level)
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """One catalogued metric: its kind, unit and meaning.  The catalogue
+    is the docs' source of truth (docs/observability.md mirrors it) and
+    lets ``snapshot()``/``trace.report()`` tag values by kind."""
+
+    name: str
+    kind: str
+    unit: str
+    doc: str
+
+
+def _specs(*rows: Tuple[str, str, str, str]) -> Dict[str, MetricSpec]:
+    return {n: MetricSpec(n, k, u, d) for n, k, u, d in rows}
+
+
+# Every metric the engine emits.  Names are ``<subsystem>.<what>``; the
+# registry accepts unknown names too (tests, ad-hoc probes), but a
+# TPC-H run must stay inside this catalogue (tests/test_observe.py).
+METRICS: Dict[str, MetricSpec] = _specs(
+    # planner decisions (one bump per decided join/groupby)
+    ("join.broadcast", COUNTER, "joins",
+     "joins that took the broadcast (replicated small side) path"),
+    ("join.shuffle", COUNTER, "joins",
+     "joins that took a shuffle (co-partition both sides) path"),
+    ("join.broadcast_gather", COUNTER, "gathers",
+     "small-side replica gathers actually executed for joins"),
+    ("groupby.broadcast_gather", COUNTER, "gathers",
+     "partial-group replica gathers executed for the groupby combine"),
+    ("join.broadcast_replica_hit", COUNTER, "hits",
+     "joins served from the replica cache (no gather ran)"),
+    ("groupby.broadcast_combine", COUNTER, "combines",
+     "groupby combines that replaced the shuffle with one all_gather"),
+    ("join.out_rows", COUNTER, "rows", "distributed-join output rows"),
+    # exchange volume (payload actually crossing the wire)
+    ("shuffle.rows_sent", COUNTER, "rows",
+     "rows that left their home shard in shuffle exchanges "
+     "(off-diagonal of the count matrix)"),
+    ("shuffle.bytes_sent", COUNTER, "bytes",
+     "payload bytes of shuffle.rows_sent (leaf dtypes x rows; "
+     "validity lanes count 1 byte/row)"),
+    ("broadcast.rows_sent", COUNTER, "rows",
+     "rows x (P-1) replicated by broadcast gathers (each shard's rows "
+     "travel to every other shard)"),
+    ("broadcast.bytes_sent", COUNTER, "bytes",
+     "payload bytes of broadcast.rows_sent"),
+    # exchange footprint (allocated block capacity, not payload)
+    ("shuffle.capacity_rows", COUNTER, "rows",
+     "allocated receive-block slots summed over shuffles (P x outcap)"),
+    ("shuffle.capacity_cells", COUNTER, "cells",
+     "allocated slots x column leaves summed over shuffles"),
+    ("shuffle.capacity_cells_max", WATERMARK, "cells",
+     "largest single exchange block (peak transient footprint)"),
+    ("shuffle.capacity_cells_live_peak", WATERMARK, "cells",
+     "peak LIVE exchange cells of a staged plan (resident right "
+     "co-partition + in-flight chunk, streaming join)"),
+    # host-boundary accounting (the per-query sync floor)
+    ("trace.sync", COUNTER, "syncs",
+     "hard completion barriers (trace.hard_sync) — each costs one "
+     "tunnel round trip on remote backends"),
+    ("host.read", COUNTER, "reads",
+     "batched device->host reads (count-protocol flushes, exports, "
+     "optimistic-dispatch validations)"),
+    ("broadcast.replica_cache_size", GAUGE, "entries",
+     "live entries in the broadcast replica cache"),
+)
+
+
+# ---------------------------------------------------------------------------
+# registry: per-thread cells, process-level merge at snapshot time
+# ---------------------------------------------------------------------------
+
+class _Cell:
+    """One thread's lock-free metric buffers."""
+
+    __slots__ = ("thread", "counters", "watermarks", "events")
+
+    def __init__(self) -> None:
+        self.thread = threading.current_thread()
+        self.counters: Dict[str, int] = {}
+        self.watermarks: Dict[str, int] = {}
+        # (t_seconds, name, delta_or_value, thread_id) — recorded only
+        # while span tracing is on; the Chrome exporter's C-event input.
+        # Counter events carry the bump DELTA (not the thread-local
+        # cumulative): the exporter re-accumulates across the merged,
+        # time-sorted series, so a counter bumped from several threads
+        # renders as ONE monotone process-level track whose final value
+        # equals merged() — not a per-thread sawtooth
+        self.events: List[Tuple[float, str, Any, int]] = []
+
+
+class MetricsRegistry:
+    """Process-level metric store with per-thread write buffers.
+
+    Writes (``bump``/``watermark``) touch only the calling thread's cell
+    — no lock on the hot path.  Reads (``merged``/``snapshot``) take the
+    registry lock, fold cells of DEAD threads into a retained aggregate
+    (so a worker thread's counts survive its exit), and merge the live
+    cells: counters sum, watermarks max, gauges last-write."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._cells: List[_Cell] = []
+        self._retired = _Cell()          # dead threads' folded totals
+        self._gauges: Dict[str, Any] = {}
+        self._kinds: Dict[str, str] = {}
+
+    # -- writes (per-thread, lock only on first touch) ----------------------
+
+    def _cell(self) -> _Cell:
+        cell = getattr(self._tls, "cell", None)
+        if cell is None:
+            cell = _Cell()
+            with self._lock:
+                self._cells.append(cell)
+            self._tls.cell = cell
+        return cell
+
+    def bump(self, name: str, n: int = 1, record_event: bool = False) -> None:
+        cell = self._cell()
+        # read the dict reference ONCE: reset() swaps live cells' dicts
+        # from another thread, and a get/set pair spanning the swap
+        # would carry a pre-reset total into the fresh window.  Against
+        # a single snapshot the race collapses to "a bump concurrent
+        # with reset may land in the discarded window" — inherently
+        # ambiguous timing, never a resurrected count.
+        d = cell.counters
+        d[name] = d.get(name, 0) + int(n)
+        self._kinds.setdefault(name, COUNTER)
+        if record_event:
+            cell.events.append((time.perf_counter(), name, int(n),
+                                threading.get_ident()))
+
+    def watermark(self, name: str, n: int,
+                  record_event: bool = False) -> None:
+        cell = self._cell()
+        d = cell.watermarks  # single snapshot — same race note as bump
+        v = max(d.get(name, 0), int(n))
+        d[name] = v
+        self._kinds.setdefault(name, WATERMARK)
+        if record_event:
+            cell.events.append((time.perf_counter(), name, v,
+                                threading.get_ident()))
+
+    def gauge(self, name: str, value: Any,
+              record_event: bool = False) -> None:
+        self._kinds.setdefault(name, GAUGE)
+        with self._lock:
+            self._gauges[name] = value
+        if record_event:
+            self._cell().events.append((time.perf_counter(), name,
+                                        value, threading.get_ident()))
+
+    # -- reads (merge under the lock) ---------------------------------------
+
+    def _fold_dead_locked(self) -> None:
+        live = []
+        for cell in self._cells:
+            if cell.thread.is_alive():
+                live.append(cell)
+                continue
+            for k, v in cell.counters.items():
+                self._retired.counters[k] = \
+                    self._retired.counters.get(k, 0) + v
+            for k, v in cell.watermarks.items():
+                self._retired.watermarks[k] = \
+                    max(self._retired.watermarks.get(k, 0), v)
+            self._retired.events.extend(cell.events)
+        self._cells = live
+
+    def merged(self) -> Dict[str, int]:
+        """Flat process-level view: counters summed + watermarks maxed
+        across every thread that ever bumped (the ``trace.counters()``
+        payload; gauges are typed separately — see ``snapshot``)."""
+        with self._lock:
+            self._fold_dead_locked()
+            cells = [self._retired] + list(self._cells)
+            out: Dict[str, int] = {}
+            for cell in cells:
+                for k, v in cell.counters.items():
+                    out[k] = out.get(k, 0) + v
+            for cell in cells:
+                for k, v in cell.watermarks.items():
+                    out[k] = max(out.get(k, 0), v)
+            return out
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """One-shot typed snapshot: ``{"counters": {...}, "watermarks":
+        {...}, "gauges": {...}}`` merged across threads under one lock
+        acquisition (a consistent cut, not three racing reads)."""
+        with self._lock:
+            self._fold_dead_locked()
+            cells = [self._retired] + list(self._cells)
+            counters: Dict[str, int] = {}
+            marks: Dict[str, int] = {}
+            for cell in cells:
+                for k, v in cell.counters.items():
+                    counters[k] = counters.get(k, 0) + v
+                for k, v in cell.watermarks.items():
+                    marks[k] = max(marks.get(k, 0), v)
+            return {"counters": counters, "watermarks": marks,
+                    "gauges": dict(self._gauges)}
+
+    def counter_events(self) -> List[Tuple[float, str, Any, int]]:
+        """Time-ordered PROCESS-LEVEL value series across threads
+        (Chrome C events): the merged raw events re-accumulated by kind
+        — counters sum their deltas, watermarks keep the running max,
+        gauges pass through — so the exported track's last sample
+        agrees with ``merged()`` no matter which threads bumped."""
+        with self._lock:
+            self._fold_dead_locked()
+            raw: List[Tuple[float, str, Any, int]] = []
+            for cell in [self._retired] + list(self._cells):
+                raw.extend(cell.events)
+        out: List[Tuple[float, str, Any, int]] = []
+        running: Dict[str, Any] = {}
+        for t, name, val, tid in sorted(raw, key=lambda e: e[0]):
+            kind = self.kind_of(name)
+            if kind == COUNTER:
+                running[name] = running.get(name, 0) + val
+            elif kind == WATERMARK:
+                running[name] = max(running.get(name, 0), val)
+            else:
+                running[name] = val
+            out.append((t, name, running[name], tid))
+        return out
+
+    def kind_of(self, name: str) -> str:
+        spec = METRICS.get(name)
+        if spec is not None:
+            return spec.kind
+        return self._kinds.get(name, COUNTER)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._retired = _Cell()
+            for cell in self._cells:
+                cell.counters = {}
+                cell.watermarks = {}
+                cell.events = []
+            self._gauges = {}
+
+
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export
+# ---------------------------------------------------------------------------
+
+def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
+    """Serialize the recorded spans + counter series as Chrome
+    trace-event JSON (the ``chrome://tracing`` / Perfetto format).
+
+    Spans become complete (``"ph": "X"``) events — ``ts``/``dur`` in
+    microseconds on the ``time.perf_counter`` clock, one track per
+    thread, nesting recovered by Perfetto from containment (our recorded
+    span depth rides along in ``args.depth``).  Counter bumps recorded
+    while tracing was enabled become ``"ph": "C"`` events, so exchange
+    volume lines up under the phase spans.  Returns the document (and
+    writes it to ``path`` when given) — load the file via Perfetto's
+    "Open trace file" next to an XLA profile from ``trace.profile()``.
+    """
+    from . import trace
+
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    for name, depth, ms, t0, tid in trace.get_span_records(
+            all_threads=True):
+        events.append({
+            "name": name, "cat": "phase", "ph": "X",
+            "ts": round(t0 * 1e6, 3), "dur": round(ms * 1e3, 3),
+            "pid": pid, "tid": tid, "args": {"depth": depth},
+        })
+    for t, name, value, tid in REGISTRY.counter_events():
+        events.append({
+            "name": name, "cat": "metric", "ph": "C",
+            "ts": round(t * 1e6, 3), "pid": pid, "tid": tid,
+            "args": {name: value},
+        })
+    events.sort(key=lambda e: e["ts"])
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"clock": "time.perf_counter",
+                         "producer": "cylon_tpu.observe"}}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE
+# ---------------------------------------------------------------------------
+
+# byte-volume counters whose per-window delta IS a node's "bytes moved"
+_BYTE_COUNTERS = ("shuffle.bytes_sent", "broadcast.bytes_sent")
+
+
+def row_bytes(leaves) -> int:
+    """Payload width of ONE row across exchanged column leaves: dtype
+    width x trailing-dim element count (validity lanes are bool = 1
+    byte/row).  The single definition behind ``shuffle.bytes_sent`` and
+    ``broadcast.bytes_sent`` — both exchange paths price a row through
+    this, so the metric cannot drift between them.  Static metadata
+    only; never touches device data."""
+    import numpy as np
+
+    return sum(
+        int(np.dtype(lf.dtype).itemsize)
+        * int(np.prod(lf.shape[1:], dtype=np.int64)) for lf in leaves)
+
+
+def _bytes_of(counters: Dict[str, int]) -> int:
+    return sum(counters.get(k, 0) for k in _BYTE_COUNTERS)
+
+
+def _peek_rows(x) -> Optional[int]:
+    """Global row count of a DTable / local Table WITHOUT mutating it:
+    no pending-mask collapse, no ``_counts_host`` caching — measuring a
+    plan must not hand a later broadcast-threshold decision counts the
+    un-measured run would not have had."""
+    import jax
+    import numpy as np
+
+    from .parallel.dtable import DTable, _replicate_counts_fn
+    from .table import Table
+
+    if isinstance(x, DTable):
+        if x.pending_mask is not None:
+            pc = x.pending_cnts
+            if pc is None:
+                return None
+            # pending_cnts is the replicated per-shard survivor vector
+            return int(np.asarray(jax.device_get(pc)).sum())
+        ch = x._counts_host
+        if ch is not None:
+            return int(np.asarray(ch).sum())
+        c = x.counts
+        if not c.is_fully_addressable:
+            c = _replicate_counts_fn(x.ctx.mesh, x.ctx.axis)(c)
+        return int(np.asarray(jax.device_get(c)).sum())
+    if isinstance(x, Table):
+        return x.num_rows
+    return None
+
+
+def _rows_in(args, kwargs, peek=_peek_rows) -> Optional[int]:
+    from .parallel.dtable import DTable
+
+    flat = list(args) + list(kwargs.values())
+    tables = [a for a in flat if isinstance(a, DTable)]
+    for a in flat:
+        if isinstance(a, dict):
+            tables += [v for v in a.values() if isinstance(v, DTable)]
+        elif isinstance(a, (list, tuple)):
+            tables += [v for v in a if isinstance(v, DTable)]
+    if not tables:
+        return None
+    rows = [peek(t) for t in tables]
+    return None if any(r is None for r in rows) else sum(rows)
+
+
+def _sync_result(out) -> None:
+    """Honest per-node wall-clock: block until the op's output arrays
+    have materialized (spans already sync their own phase tails; this
+    catches work dispatched after the last span)."""
+    from . import trace
+    from .parallel.dtable import DTable
+    from .table import Table
+
+    if isinstance(out, (DTable, Table)) and out.columns:
+        trace.hard_sync([c.data for c in out.columns])
+
+
+class _AnalyzeState:
+    """Per-run bookkeeping behind ``plan_check.instrument``: each
+    instrumented distributed op opens a window at entry and, at exit,
+    stitches the window's runtime deltas onto the PlanNode its own
+    ``note()`` created (windows nest; a node's numbers are INCLUSIVE of
+    the operators it triggered — the replica gather inside a broadcast
+    join charges both its own node and the join's)."""
+
+    def __init__(self, report) -> None:
+        self.report = report
+        self.depth = 0
+        # id-keyed row-peek memo for THIS run: a chained plan peeks the
+        # same intermediate table as producer rows_out and consumer
+        # rows_in — one blocking read, not two, per table.  Entries pin
+        # the table so ids stay unique for the run's lifetime; a table's
+        # logical row count never changes in place (collapse swaps the
+        # blocks but keeps the rows), so the memo cannot go stale.
+        self._rows_memo: Dict[int, Tuple[Any, Optional[int]]] = {}
+
+    def _peek(self, t) -> Optional[int]:
+        hit = self._rows_memo.get(id(t))
+        if hit is not None:
+            return hit[1]
+        rows = _peek_rows(t)
+        self._rows_memo[id(t)] = (t, rows)
+        return rows
+
+    def enter(self, name: str, args, kwargs):
+        from . import trace
+
+        self.depth += 1
+        return (len(self.report.nodes), self.depth,
+                _rows_in(args, kwargs, self._peek), trace.counters(),
+                time.perf_counter())
+
+    def abort(self, token) -> None:
+        self.depth -= 1
+
+    def exit(self, token, out) -> None:
+        from . import trace
+
+        idx, depth, rows_in, c0, t0 = token
+        _sync_result(out)
+        ms = (time.perf_counter() - t0) * 1e3
+        self.depth -= 1
+        nodes = self.report.nodes
+        if idx >= len(nodes) or nodes[idx].runtime is not None:
+            # no node of its own inside this window (a _local_only
+            # helper), or the node belongs to a nested op that already
+            # claimed it — nothing to stitch here
+            return
+        c1 = trace.counters()
+        delta: Dict[str, int] = {}
+        for k, v in c1.items():
+            if v == c0.get(k, 0):
+                continue
+            # a watermark's difference is meaningless — report the new
+            # peak itself when the window moved it
+            delta[k] = v if REGISTRY.kind_of(k) == WATERMARK else \
+                v - c0.get(k, 0)
+        node = nodes[idx]
+        node.runtime = {
+            "depth": depth,
+            "ms": ms,
+            "rows_in": rows_in,
+            "rows_out": self._peek(out) if out is not None else None,
+            "bytes_moved": _bytes_of(c1) - _bytes_of(c0),
+            "decision": node.info.get("decision", "local"),
+            "counters": delta,
+        }
+
+
+def analyze(op, *args, **kwargs):
+    """EXPLAIN ANALYZE: run ``op(*args, **kwargs)`` — the real query,
+    once — with tracing on and every distributed operator instrumented;
+    return the runtime-annotated :class:`plan_check.PlanReport`.
+
+    Each node carries ``runtime = {ms, rows_in, rows_out, bytes_moved,
+    decision, counters, depth}``; ``report.totals`` holds the run-level
+    aggregates (wall ms, bytes moved, syncs, the full merged counter
+    map, per-phase span totals) and ``report.output`` the query's actual
+    result.  ``str(report)`` renders the pandas-EXPLAIN-style tree with
+    hot-node highlighting; ``trace.export_chrome_trace(path)`` right
+    after an analyze run exports the same run's span profile.
+
+    Trace state is reset at entry (the run IS the measurement) and left
+    populated at exit so the Chrome exporter / ``trace.report()`` can
+    read it; the enable flags are restored to what they were.
+
+    A failing plan does NOT raise: the partially-annotated report comes
+    back with ``ok=False`` and ``error`` set — the nodes measured before
+    the failure are diagnostics, and losing them at the moment they
+    matter most would defeat the tool (the same contract as
+    ``plan_check.explain`` without ``validate``); ``str(report)`` then
+    renders the ``[FAILED]`` head and the error line.
+    """
+    from . import trace
+    from .analysis import plan_check
+
+    report = plan_check.PlanReport()
+    report.analyzed = True
+    # counter-only mode (_counters_enabled) is never touched here, so
+    # only the span-enable flag needs saving; an ambient counter-only
+    # session keeps tallying through and after the run
+    prev_enabled = trace.enabled()
+    trace.reset()
+    trace.enable()
+    cap = plan_check._capture
+    prev_cap = (getattr(cap, "report", None),
+                getattr(cap, "validate", False),
+                getattr(cap, "analyze", None))
+    cap.report = report
+    cap.validate = False
+    cap.analyze = _AnalyzeState(report)
+    t0 = time.perf_counter()
+    try:
+        out = op(*args, **kwargs)
+        report.ok = True
+        report.output = out
+        if report.result is None:
+            report.result = plan_check._schema_of(out)
+    except Exception as e:
+        report.error = e
+        report.ok = False
+    finally:
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        cap.report, cap.validate, cap.analyze = prev_cap
+        if not prev_enabled:
+            trace.disable()
+        counters = trace.counters()
+        for node in report.nodes:   # a note() outside any instrumented
+            if node.runtime is None:  # window still reports SOMETHING
+                node.runtime = {"depth": 1, "ms": 0.0, "rows_in": None,
+                                "rows_out": None, "bytes_moved": 0,
+                                "decision": node.info.get("decision",
+                                                          "local"),
+                                "counters": {}}
+        report.totals = {
+            "ms": wall_ms,
+            "bytes_moved": _bytes_of(counters),
+            "rows_sent": counters.get("shuffle.rows_sent", 0)
+            + counters.get("broadcast.rows_sent", 0),
+            "syncs": counters.get("trace.sync", 0),
+            "host_reads": counters.get("host.read", 0),
+            "counters": counters,
+            "phase_ms": trace.phase_totals(),
+        }
+    return report

@@ -143,6 +143,8 @@ def _read_counts(cnt_dev):
     import jax
     import numpy as np
 
+    from .. import trace
+    trace.count("host.read")  # one blocking count read (sync-floor unit)
     return np.asarray(jax.device_get(cnt_dev))
 
 
@@ -230,6 +232,8 @@ def flush_pending_with(extra):
     _deferred.pending = []
     if not batch and not extra:
         return _deferred.ok, []
+    from .. import trace
+    trace.count("host.read")  # ONE batched read for the whole flush
     values = jax.device_get([cnt for _, _, _, cnt, _ in batch] + list(extra))
     # Entries queue in dispatch order, so everything after the first
     # undersized dispatch computed on truncated inputs — its counts are

@@ -159,6 +159,17 @@ def _cache_key(dt: DTable, mode: str) -> Tuple:
                   for c in dt.columns))
 
 
+def small_side_reason(dt: DTable, rows: int) -> str:
+    """Human-readable planner reason for a ``rows_if_small`` hit — which
+    sync-free evidence proved the side small (EXPLAIN / EXPLAIN ANALYZE
+    annotations; docs/observability.md)."""
+    if dt._counts_host is not None and dt.pending_mask is None:
+        return f"{rows} rows <= threshold (ingest-cached counts)"
+    return (f"capacity bound {dt.nparts}x{dt.cap} = {rows} "
+            "<= threshold")
+
+
+@plan_check.instrument
 def replicate_table(dt: DTable, mode: str = ALL,
                     span_name: str = "join.broadcast_gather",
                     cache: bool = True) -> DTable:
@@ -171,7 +182,8 @@ def replicate_table(dt: DTable, mode: str = ALL,
     caching them would only pin dead arrays."""
     assert dt.pending_mask is None, "collapse the pending mask first"
     plan_check.note("replicate_table", dt, mode=mode)
-    if cache and any(is_abstract(c.data) for c in dt.columns):
+    abstract = any(is_abstract(c.data) for c in dt.columns)
+    if cache and abstract:
         # abstract plan run: tracer identities are meaningless across
         # traces, and caching them would pin trace-internal values
         cache = False
@@ -180,7 +192,9 @@ def replicate_table(dt: DTable, mode: str = ALL,
         hit = _replica_cache.get(key)
         if hit is not None:
             trace.count("join.broadcast_replica_hit")
+            plan_check.annotate(decision="replica-cache hit")
             return hit[1]
+    plan_check.annotate(decision="gather")
     ch = dt._counts_host
     total_bound = int(ch.sum()) if ch is not None else dt.nparts * dt.cap
     outcap = ops_compact.next_bucket(max(total_bound, 1), minimum=8)
@@ -192,6 +206,23 @@ def replicate_table(dt: DTable, mode: str = ALL,
         if c.validity is not None:
             leaves.append(c.validity)
             slots.append((i, True))
+    # exchange-volume accounting: each shard's rows travel to the other
+    # P-1 shards, so the gather's wire payload is rows x (P-1) x row
+    # width (validity lanes 1 byte).  total_bound is exact whenever the
+    # planner had ingest counts; else it is the same capacity bound the
+    # decision itself used — documented in docs/observability.md.
+    # Abstract plan runs move ZERO bytes and must report zero, exactly
+    # like the shuffle path (whose post() sees zeroed counts there) —
+    # including the closure-captured-concrete-table case, where the
+    # leaves are real arrays but the gather is merely STAGED into the
+    # ambient eval_shape trace, never executed (trace_state_clean is
+    # the same ambient-trace probe DTable.to_table uses).
+    if not abstract and jax.core.trace_state_clean():
+        from .. import observe
+        moved = total_bound * max(dt.nparts - 1, 0)
+        trace.count("broadcast.rows_sent", moved)
+        trace.count("broadcast.bytes_sent",
+                    moved * observe.row_bytes(leaves))
     with trace.span_sync(span_name) as sp:
         trace.count(span_name)  # counter mirrors the span name
         outs, counts = _gather_fn(dt.ctx.mesh, dt.ctx.axis, dt.cap,
@@ -210,4 +241,5 @@ def replicate_table(dt: DTable, mode: str = ALL,
             _replica_cache.pop(next(iter(_replica_cache)))
         # pin the source columns: their ids ARE the key
         _replica_cache[key] = (dt.columns, rep)
+        trace.gauge("broadcast.replica_cache_size", len(_replica_cache))
     return rep

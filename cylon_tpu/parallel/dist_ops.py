@@ -212,6 +212,7 @@ def _shuffle_masked(dt: DTable, pid: jax.Array) -> DTable:
     return _shuffle_by_pids(dt, pid)
 
 
+@plan_check.instrument
 def shuffle_table(dt: DTable, key_columns: Sequence[Union[int, str]]
                   ) -> DTable:
     """Hash-repartition rows so equal keys co-locate on one shard.
@@ -220,7 +221,9 @@ def shuffle_table(dt: DTable, key_columns: Sequence[Union[int, str]]
     ArrowAllToAll + concat collapsed into partition-ids + one two-phase
     all_to_all exchange.
     """
-    plan_check.note("shuffle_table", dt, keys=tuple(key_columns))
+    plan_check.note("shuffle_table", dt, keys=tuple(key_columns),
+                    decision="shuffle" if dt.ctx.get_world_size() > 1
+                    else "local")
     dt._collapse_pending()
     key_ids = _resolve_ids(dt, key_columns)
     return _shuffle_by_pids(dt, _hash_pids(dt, key_ids))
@@ -311,6 +314,7 @@ def _join_phase2_fn(mesh, axis: str, how: str, alg: str, capacity: int,
                              in_specs=(spec,) * 5, out_specs=(spec,) * 3))
 
 
+@plan_check.instrument
 def dist_join(left: DTable, right: DTable, config: JoinConfig,
               dense_key_range=None) -> DTable:
     """Distributed equi-join: co-partition both sides on the key, then a
@@ -371,6 +375,11 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig,
         left, right, config)
     if left.ctx.get_world_size() > 1:
         trace.count("join.shuffle")
+        plan_check.annotate(decision="shuffle",
+                            reason="no side provably under the broadcast "
+                                   "threshold")
+    else:
+        plan_check.annotate(decision="local", reason="world=1")
     lsh = _copartition(left, li_keys, alg, splitters)
     rsh = _copartition(right, ri_keys, alg, splitters)
     return _join_copartitioned(lsh, rsh, li_keys, ri_keys,
@@ -496,13 +505,23 @@ def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
     # compact it (build sides are dimension-sized); the PROBE side's mask
     # fuses: INNER folds it into `matched` (one shared compaction), LEFT
     # keeps the zero-copy probe and passes the mask through to the output
+    # the decision's evidence comes from the table AS THE PLANNER SAW it
+    # — the collapse below may shrink cap and drop the ingest counts the
+    # reason string reports (same ordering rule as _try_broadcast_join)
+    r_reason = (broadcast.small_side_reason(right, r_rows)
+                if r_rows is not None else None)
     right._collapse_pending()
     if world > 1:
         if r_rows is not None:
             trace.count("join.broadcast")
+            plan_check.annotate(decision="fk-dense+broadcast",
+                                reason=r_reason)
             right = broadcast.replicate_table(right)
         else:
             trace.count("join.shuffle")
+            plan_check.annotate(decision="fk-dense+shuffle",
+                                reason="build side not provably small; "
+                                       "modulo co-partition")
             with trace.span("join.shuffle"):
                 left = _shuffle_masked(
                     left, _mod_pids(left, li_keys[0], lo, world))
@@ -510,6 +529,8 @@ def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
                     right, _mod_pids(right, ri_keys[0], lo, world))
             lkc = left.columns[li_keys[0]]
         rkc = right.columns[ri_keys[0]]
+    else:
+        plan_check.annotate(decision="fk-dense", reason="world=1")
     ctx = left.ctx
     mesh, axis = ctx.mesh, ctx.axis
     has_lm = how == "inner" and left.pending_mask is not None
@@ -643,9 +664,18 @@ def _try_broadcast_join(left: DTable, right: DTable, config: JoinConfig
               if how == "inner" else None)
     if r_rows is None and l_rows is None:
         return None
+    # the decision's evidence comes from the tables AS THE PLANNER SAW
+    # them — _join_setup may rebuild handles (collapse, dict unify) and
+    # lose the ingest-count provenance the reason string reports
+    take_right = r_rows is not None and (l_rows is None or r_rows <= l_rows)
+    reason = (broadcast.small_side_reason(right, r_rows) if take_right
+              else broadcast.small_side_reason(left, l_rows))
     left, right, li_keys, ri_keys = _join_setup(left, right, config)
     trace.count("join.broadcast")
-    if r_rows is not None and (l_rows is None or r_rows <= l_rows):
+    plan_check.annotate(decision="broadcast",
+                        side="right" if take_right else "left",
+                        reason=reason)
+    if take_right:
         rrep = broadcast.replicate_table(right)
         return _join_copartitioned(left, rrep, li_keys, ri_keys, how,
                                    "sort")
@@ -801,7 +831,9 @@ def _setop_fn(mesh, axis: str, op: str, cap_a: int, cap_b: int,
 
 
 def _dist_set_op(a: DTable, b: DTable, op: str) -> DTable:
-    plan_check.note(f"dist_{op.lower()}", a, b)
+    plan_check.note(f"dist_{op.lower()}", a, b,
+                    decision="shuffle" if a.ctx.get_world_size() > 1
+                    else "local")
     a._collapse_pending()
     b._collapse_pending()
     a.verify_same_schema(b)
@@ -829,14 +861,17 @@ def _dist_set_op(a: DTable, b: DTable, op: str) -> DTable:
     return DTable(a.ctx, cols, capacity, counts)
 
 
+@plan_check.instrument
 def dist_union(a: DTable, b: DTable) -> DTable:
     return _dist_set_op(a, b, ops_setops.UNION)
 
 
+@plan_check.instrument
 def dist_intersect(a: DTable, b: DTable) -> DTable:
     return _dist_set_op(a, b, ops_setops.INTERSECT)
 
 
+@plan_check.instrument
 def dist_subtract(a: DTable, b: DTable) -> DTable:
     return _dist_set_op(a, b, ops_setops.SUBTRACT)
 
@@ -969,6 +1004,7 @@ _group_cap_hints: dict = {}
 _GROUP_HINTS_MAX = 256
 
 
+@plan_check.instrument
 def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
                  aggregations: Sequence[Tuple[Union[int, str], str]],
                  where=None, dense_key_range=None, pre_aggregate=None,
@@ -1016,11 +1052,12 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
     raw-row shuffle (e.g. keys known near-unique, where the partial pass
     is pure overhead).
     """
+    node = None
     if not _local_only:
-        plan_check.note("dist_groupby", dt, keys=tuple(key_columns),
-                        aggs=tuple(op for _, op in aggregations),
-                        dense=dense_key_range is not None or None,
-                        where=where is not None or None)
+        node = plan_check.note("dist_groupby", dt, keys=tuple(key_columns),
+                               aggs=tuple(op for _, op in aggregations),
+                               dense=dense_key_range is not None or None,
+                               where=where is not None or None)
     key_ids = _resolve_ids(dt, key_columns)
     val_ids = [dt.column_index(c) for c, _ in aggregations]
     # distinct value columns enter the kernels ONCE (they ride phase 1's
@@ -1056,6 +1093,15 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
                        and (int(dense_key_range[1])
                             - int(dense_key_range[0]) + 1) > dt.cap)
         pre_aggregate = world > 1 and not _local_only and not near_unique
+    if node is not None:
+        if world > 1 and pre_aggregate:
+            decision = "pre-aggregate"
+        elif world == 1:
+            decision = "dense-local" if dense is not None else "local"
+        else:
+            decision = ("dense+shuffle" if dense is not None
+                        else "shuffle")
+        plan_check.annotate(node, decision=decision)
     if world > 1 and pre_aggregate and not _local_only:
         return _dist_groupby_preagg(dt, key_ids, aggregations, where,
                                     dense_key_range, emit_empty)
@@ -1347,6 +1393,7 @@ def _scalar_agg_fn(mesh, axis: str, cap: int, aggs: Tuple[str, ...],
                              check_vma=False))
 
 
+@plan_check.instrument
 def dist_aggregate(dt: DTable,
                    aggregations: Sequence[Tuple[Union[int, str], str]],
                    where=None) -> "Table":
@@ -1673,6 +1720,7 @@ def _compact_survivors(dt: DTable, mask: jax.Array, cnts, hint_key,
     return DTable(dt.ctx, cols, used[0], counts)
 
 
+@plan_check.instrument
 def dist_select(dt: DTable, predicate, params=(), compact: bool = True
                 ) -> DTable:
     """Distributed row filter: ``predicate`` maps {column name: sharded data
@@ -1815,8 +1863,9 @@ def _semi_mask_fn(mesh, axis: str, cap_l: int, cap_r: int, anti: bool):
 def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
                        anti: bool, dense_key_range=None,
                        broadcast_threshold=None) -> DTable:
-    plan_check.note("dist_anti_join" if anti else "dist_semi_join",
-                    left, right, dense=dense_key_range is not None or None)
+    node = plan_check.note("dist_anti_join" if anti else "dist_semi_join",
+                           left, right,
+                           dense=dense_key_range is not None or None)
     li_keys = _join_keys(left, left_on)
     ri_keys = _join_keys(right, right_on)
     if len(li_keys) != len(ri_keys):
@@ -1839,12 +1888,22 @@ def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
     # no exchange on either side (semi/anti emit left rows only, so a
     # replicated right is always sound)
     use_bcast = False
-    if world > 1 and broadcast.rows_if_small(
-            right, broadcast_threshold) is not None:
+    r_rows = (broadcast.rows_if_small(right, broadcast_threshold)
+              if world > 1 else None)
+    if r_rows is not None:
         use_bcast = True
         trace.count("join.broadcast")
+        plan_check.annotate(
+            node, decision="broadcast",
+            reason=broadcast.small_side_reason(right, r_rows))
         right._collapse_pending()
         right = broadcast.replicate_table(right)
+    elif world > 1:
+        plan_check.annotate(node, decision="shuffle",
+                            reason="build-side keys not provably under "
+                                   "the broadcast threshold")
+    else:
+        plan_check.annotate(node, decision="local", reason="world=1")
     # presence bits cost R/stride BYTES per shard — gate against the
     # larger side's capacity (a 1.5M-key range is nothing next to a
     # 15M-row probe side, even when the filtered LEFT block is small)
@@ -1919,6 +1978,7 @@ def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
     return _compact_survivors(left, mask, cnts, hint_key, "semijoin.gather")
 
 
+@plan_check.instrument
 def dist_semi_join(left: DTable, right: DTable, left_on, right_on,
                    dense_key_range=None, broadcast_threshold=None) -> DTable:
     """Distributed LEFT SEMI join: the rows of ``left`` whose key has at
@@ -1946,6 +2006,7 @@ def dist_semi_join(left: DTable, right: DTable, left_on, right_on,
                               broadcast_threshold=broadcast_threshold)
 
 
+@plan_check.instrument
 def dist_anti_join(left: DTable, right: DTable, left_on, right_on,
                    dense_key_range=None, broadcast_threshold=None) -> DTable:
     """Distributed LEFT ANTI join: the rows of ``left`` whose key has NO
@@ -1958,6 +2019,7 @@ def dist_anti_join(left: DTable, right: DTable, left_on, right_on,
                               broadcast_threshold=broadcast_threshold)
 
 
+@plan_check.instrument
 def dist_project(dt: DTable, columns: Sequence[Union[int, str]]) -> DTable:
     """Column subset — zero-copy, like the local Project
     (reference table_api.cpp:1007-1029).  A deferred-select mask rides
@@ -1973,6 +2035,7 @@ def dist_project(dt: DTable, columns: Sequence[Union[int, str]]) -> DTable:
     return out
 
 
+@plan_check.instrument
 def dist_with_column(dt: DTable, name: str, fn, out_type,
                      validity_from: Sequence[str] = ()) -> DTable:
     """Append a derived column ``name = fn({col name: data array})``.
@@ -2005,6 +2068,7 @@ def dist_with_column(dt: DTable, name: str, fn, out_type,
                   dt.pending_cnts)
 
 
+@plan_check.instrument
 def dist_head(dt: DTable, n: int) -> "Table":
     """First ``n`` global rows (shard-major order) as a local Table — the
     small-result gather after a dist_sort (ORDER BY … LIMIT n).  Rows are
@@ -2027,6 +2091,7 @@ def _local_sort_multi_fn(mesh, axis: str, cap: int, nkeys: int,
                              in_specs=(spec,) * 3, out_specs=spec))
 
 
+@plan_check.instrument
 def dist_sort_multi(dt: DTable, sort_columns: Sequence[Union[int, str]],
                     ascending=True) -> DTable:
     """Distributed multi-key ORDER BY: range-partition on the PRIMARY
@@ -2035,7 +2100,9 @@ def dist_sort_multi(dt: DTable, sort_columns: Sequence[Union[int, str]],
     shuffle regardless of key count — the scalable spelling of the
     host-side ``compute.sort_multi`` tail every small query uses.
     ``ascending``: one bool or a per-column sequence."""
-    plan_check.note("dist_sort_multi", dt, keys=tuple(sort_columns))
+    plan_check.note("dist_sort_multi", dt, keys=tuple(sort_columns),
+                    decision="shuffle" if dt.ctx.get_world_size() > 1
+                    else "local")
     dt._collapse_pending()
     key_ids = _resolve_ids(dt, sort_columns)
     asc = ([ascending] * len(key_ids) if isinstance(ascending, bool)
@@ -2073,6 +2140,7 @@ def _local_sort_fn(mesh, axis: str, cap: int, ascending: bool):
                              in_specs=(spec,) * 3, out_specs=spec))
 
 
+@plan_check.instrument
 def dist_sort(dt: DTable, sort_column: Union[int, str],
               ascending: bool = True) -> DTable:
     """Distributed sample-sort: sample splitters → range-partition shuffle →
@@ -2080,7 +2148,9 @@ def dist_sort(dt: DTable, sort_column: Union[int, str],
     requested order, and rows within a shard are sorted (nulls last
     globally), so concatenating shards in mesh order is the sorted table.
     """
-    plan_check.note("dist_sort", dt, key=sort_column)
+    plan_check.note("dist_sort", dt, key=sort_column,
+                    decision="shuffle" if dt.ctx.get_world_size() > 1
+                    else "local")
     dt._collapse_pending()
     key_i = dt.column_index(sort_column)
     if dt.ctx.get_world_size() == 1:

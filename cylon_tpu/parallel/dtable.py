@@ -365,6 +365,8 @@ class DTable:
                                      dictionary=c.dictionary,
                                      arrow_type=c.arrow_type))
             return Table(self.ctx, cols_a)
+        from .. import trace
+        trace.count("host.read")  # one batched export transfer
         hosts = jax.device_get(pulls)
         cols: List[Column] = []
         hi = 0
@@ -533,8 +535,8 @@ class DTable:
         return out
 
     def explain(self, plan=None, *, tables=None, validate: bool = False,
-                concrete=()):
-        """Describe — and optionally validate — a plan over this table.
+                concrete=(), analyze: bool = False):
+        """Describe — and optionally validate or measure — a plan.
 
         ``dt.explain()`` returns a structural summary of the table
         itself; with ``validate=True`` it additionally checks the
@@ -551,9 +553,22 @@ class DTable:
         tables in ``tables`` to keep un-abstracted (tiny dimension
         tables whose values the plan folds at build time).  See
         docs/static_analysis.md.
+
+        ``dt.explain(plan, tables=..., analyze=True)`` is **EXPLAIN
+        ANALYZE**: the plan runs FOR REAL, once, with tracing on and
+        every distributed operator instrumented; the returned report's
+        nodes carry runtime annotations (rows in/out, bytes moved per
+        exchange, planner decision + reason, wall-clock) and
+        ``report.output`` holds the query's actual result.  ``validate``
+        and ``concrete`` do not apply to an analyze run (the tables are
+        already concrete).  See docs/observability.md.
         """
         from ..analysis import plan_check
         if plan is None:
+            if analyze:
+                raise CylonError(Status(Code.Invalid,
+                    "explain(analyze=True) needs a plan callable — there "
+                    "is nothing to run"))
             if validate:
                 plan_check._check_table("explain", self)
             cols = ", ".join(f"{c.name}:{c.dtype.type.name}"
@@ -566,6 +581,9 @@ class DTable:
             return (f"DTable[{rows} over {self.nparts} shards, "
                     f"cap={self.cap}{mask}]({cols})")
         target = tables if tables is not None else self
+        if analyze:
+            from .. import observe
+            return observe.analyze(plan, target)
         return plan_check.explain(plan, target, validate=validate,
                                   concrete=concrete)
 

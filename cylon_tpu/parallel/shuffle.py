@@ -147,6 +147,10 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
     """
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
     hint_key = (mesh, Pn, pid.shape[0])
+    # payload width of one row across every exchanged leaf (the shared
+    # pricing rule behind both byte counters — observe.row_bytes)
+    from .. import observe
+    rbytes = observe.row_bytes(leaves)
     with trace.span("shuffle.counts"):
         cnt_dev = _counts_fn(mesh, axis, Pn)(pid)  # async dispatch
 
@@ -154,6 +158,13 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
         return _exchange_fn(mesh, axis, Pn, *sizes)(pid, tuple(leaves))
 
     def post(counts):
+        # exchange-volume accounting lives HERE, not after the dispatch:
+        # post() sees the count matrix in immediate mode AND at the
+        # deferred flush, so bench pipelines (run_pipeline) tally the
+        # same rows/bytes a blocking run would (docs/observability.md)
+        moved = int(counts.sum() - np.trace(counts))
+        trace.count("shuffle.rows_sent", moved)
+        trace.count("shuffle.bytes_sent", moved * rbytes)
         block = ops_compact.next_bucket(
             max(int(counts.max(initial=0)), 1), minimum=8)
         per_recv = counts.sum(axis=0)
@@ -186,7 +197,4 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
         (newcounts, outs), used, counts = ops_compact.optimistic_dispatch(
             _block_hints, hint_key, dispatch, cnt_dev, post)
         sp.sync(outs)
-    if counts is not None:  # None ⇒ deferred validation (no host read yet)
-        trace.count("shuffle.rows_sent",
-                    int(counts.sum() - np.trace(counts)))
     return list(outs), newcounts, used[1]

@@ -34,6 +34,7 @@ from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import trace
+from ..analysis import plan_check
 from ..config import JoinConfig
 from ..ops import compact as ops_compact
 from ..ops import gather as ops_gather
@@ -114,6 +115,7 @@ def _concat_compact(parts: List[DTable]) -> DTable:
     return DTable(ctx, cols, outcap, counts)
 
 
+@plan_check.instrument
 def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
                         chunks: int = 4) -> DTable:
     """Chunked distributed join of ``left`` against a resident ``right``.
@@ -136,6 +138,9 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
                   "dist_join — %s", config.join_type.value, reason)
         return dist_join(left, right, config)
 
+    plan_check.note("dist_join_streaming", left, right,
+                    how=config.join_type.value, chunks=chunks,
+                    decision="streaming-shuffle")
     left, right, li_key, ri_key, alg, splitters = _join_prologue(
         left, right, config)
     rsh = _copartition(right, ri_key, alg, splitters)  # once, resident

@@ -15,10 +15,16 @@ This module is the structured equivalent:
     span *synchronizes* on the arrays produced inside it — but only while
     tracing is enabled; disabled spans cost one attribute load and never
     force a device sync, keeping production dispatch fully async.
-  * counters — the eq/hash-call-count analogue (``count(name, n)``).
+  * counters — the eq/hash-call-count analogue (``count(name, n)``),
+    backed by the typed registry in observe.py (counters sum, watermarks
+    max, gauges last-write; per-thread buffers merged at read time, so
+    worker-thread bumps land in the same report as main-thread ones).
   * ``report()`` / ``bench_line()`` — aggregated phase totals; the bench
     line keeps the reference's ``j_t``/``w_t`` vocabulary so BENCH output
     diffs against the reference's logs.
+  * ``export_chrome_trace(path)`` — the recorded spans + counter series
+    as Chrome trace-event JSON, viewable in Perfetto next to the
+    XLA-level trace from ``profile()`` (docs/observability.md).
   * ``profile(path)`` — wraps ``jax.profiler.trace`` for XLA-level traces
     viewable in TensorBoard/Perfetto.
 
@@ -32,11 +38,14 @@ import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from . import observe
+
 __all__ = [
-    "enable", "disable", "enabled", "span", "count", "reset",
-    "enable_counters", "disable_counters", "counters_enabled",
-    "get_spans", "phase_totals", "counters", "report", "bench_line",
-    "profile", "hard_sync",
+    "enable", "disable", "enabled", "span", "count", "count_max", "gauge",
+    "reset", "enable_counters", "disable_counters", "counters_enabled",
+    "get_spans", "get_span_records", "phase_totals", "counters",
+    "snapshot", "report", "bench_line", "export_chrome_trace", "profile",
+    "hard_sync",
 ]
 
 
@@ -49,6 +58,11 @@ def hard_sync(tree) -> None:
     A host read of one element per leaf is an unambiguous completion
     barrier on every backend: the transfer cannot start before the
     producing program finishes.
+
+    Each barrier is itself observable: it bumps the ``trace.sync``
+    counter (the per-query sync floor becomes a measured number instead
+    of an inference from docs/tpu_perf_notes.md) and, while tracing is
+    on, charges a nested ``sync`` span for the blocking read.
     """
     import jax
 
@@ -63,6 +77,7 @@ def hard_sync(tree) -> None:
               and hasattr(x, "ravel") and getattr(x, "size", 0)]
     if not leaves:
         if not has_abstract:
+            count("trace.sync")
             jax.block_until_ready(tree)
         return
     reads = []
@@ -74,23 +89,57 @@ def hard_sync(tree) -> None:
             shards = getattr(x, "addressable_shards", None)
             if shards:
                 reads.append(shards[0].data.ravel()[:1])
+    count("trace.sync")
+    if not _enabled:
+        jax.device_get(reads)
+        return
+    # charge the blocking read as a nested span, appended directly (the
+    # span_sync machinery would call hard_sync again — recursion)
+    st = _span_state()
+    t0 = time.perf_counter()
     jax.device_get(reads)
-
-_state = threading.local()
-
-
-def _spans(create: bool = True) -> Optional[List[Tuple[str, int, float]]]:
-    s = getattr(_state, "spans", None)
-    if s is None and create:
-        s = _state.spans = []
-    return s
+    st.spans.append(("sync", st.depth, (time.perf_counter() - t0) * 1e3,
+                     t0, threading.get_ident()))
 
 
-def _counters(create: bool = True) -> Optional[Dict[str, int]]:
-    c = getattr(_state, "counters", None)
-    if c is None and create:
-        c = _state.counters = {}
-    return c
+class _SpanState:
+    """One thread's span records (registered for cross-thread reads)."""
+
+    __slots__ = ("thread", "spans", "depth")
+
+    def __init__(self) -> None:
+        self.thread = threading.current_thread()
+        # (name, depth, ms, t0_perf_counter_seconds, thread_id),
+        # appended in completion order
+        self.spans: List[Tuple[str, int, float, float, int]] = []
+        self.depth = 0
+
+
+_span_lock = threading.Lock()
+_span_states: List[_SpanState] = []
+_retired_spans: List[Tuple[str, int, float, float, int]] = []
+_tls = threading.local()
+
+
+def _span_state() -> _SpanState:
+    st = getattr(_tls, "state", None)
+    if st is None:
+        st = _SpanState()
+        with _span_lock:
+            _span_states.append(st)
+        _tls.state = st
+    return st
+
+
+def _fold_dead_locked() -> None:
+    global _span_states
+    live = []
+    for st in _span_states:
+        if st.thread.is_alive():
+            live.append(st)
+        else:
+            _retired_spans.extend(st.spans)
+    _span_states = live
 
 
 _enabled = os.environ.get("CYLON_TRACE", "") not in ("", "0")
@@ -179,8 +228,9 @@ def span_sync(name: str) -> Iterator[_SyncSpan]:
         with guard:
             yield sp
         return
-    depth = getattr(_state, "depth", 0)
-    _state.depth = depth + 1
+    st = _span_state()
+    depth = st.depth
+    st.depth = depth + 1
     t0 = time.perf_counter()
     try:
         with guard:
@@ -188,69 +238,126 @@ def span_sync(name: str) -> Iterator[_SyncSpan]:
     finally:
         if sp._target is not None:
             hard_sync(sp._target)
-        _spans().append((name, depth, (time.perf_counter() - t0) * 1e3))
-        _state.depth = depth
+        st.spans.append((name, depth, (time.perf_counter() - t0) * 1e3,
+                         t0, threading.get_ident()))
+        st.depth = depth
 
 
 def count(name: str, n: int = 1) -> None:
     """Bump a named counter (reference: the eq_calls/hash_calls tallies in
-    table_api.cpp:636-662)."""
+    table_api.cpp:636-662).  Sum-merged across threads at read time."""
     if not (_enabled or _counters_enabled):
         return
-    c = _counters()
-    c[name] = c.get(name, 0) + int(n)
+    observe.REGISTRY.bump(name, int(n), record_event=_enabled)
 
 
 def count_max(name: str, n: int) -> None:
     """Record the MAX a named quantity reaches (peak single-exchange
     block size, etc. — where the transient footprint is the max, not the
-    sum)."""
+    sum).  Max-merged across threads; ``report()`` tags these ``(max)``."""
     if not (_enabled or _counters_enabled):
         return
-    c = _counters()
-    c[name] = max(c.get(name, 0), int(n))
+    observe.REGISTRY.watermark(name, int(n), record_event=_enabled)
+
+
+def gauge(name: str, value) -> None:
+    """Record the CURRENT value of a named quantity (cache sizes and the
+    like — last write wins, no summing)."""
+    if not (_enabled or _counters_enabled):
+        return
+    observe.REGISTRY.gauge(name, value, record_event=_enabled)
 
 
 def reset() -> None:
-    _state.spans = []
-    _state.counters = {}
-    _state.depth = 0
+    """Clear spans + metrics of EVERY thread (the registry's process-level
+    aggregate included) — one query's trace never bleeds into the next."""
+    with _span_lock:
+        _retired_spans.clear()
+        for st in _span_states:
+            st.spans = []
+    _span_state().depth = 0
+    observe.REGISTRY.reset()
 
 
 def get_spans() -> List[Tuple[str, int, float]]:
-    """[(name, depth, ms)] in completion order."""
-    return list(_spans())
+    """[(name, depth, ms)] in completion order (this thread's spans)."""
+    return [(n, d, ms) for n, d, ms, _, _ in _span_state().spans]
+
+
+def get_span_records(all_threads: bool = False
+                     ) -> List[Tuple[str, int, float, float, int]]:
+    """Full span records ``(name, depth, ms, t0, thread_id)``; with
+    ``all_threads`` the merged process-level list sorted by start time
+    (dead threads' spans included) — the Chrome exporter's input."""
+    if not all_threads:
+        return list(_span_state().spans)
+    with _span_lock:
+        _fold_dead_locked()
+        records = list(_retired_spans)
+        for st in _span_states:
+            records.extend(st.spans)
+    return sorted(records, key=lambda r: r[3])
 
 
 def counters() -> Dict[str, int]:
-    return dict(_counters())
+    """Process-level counter view: sums + watermark peaks merged across
+    every thread that bumped (see observe.MetricsRegistry)."""
+    return observe.REGISTRY.merged()
 
 
-def phase_totals() -> Dict[str, float]:
-    """name → total ms across all recorded spans of that name."""
+def snapshot() -> Dict[str, Dict[str, int]]:
+    """One-shot typed snapshot — ``{"counters", "watermarks", "gauges"}``
+    — taken under a single registry lock acquisition."""
+    return observe.REGISTRY.snapshot()
+
+
+def phase_totals(sort: bool = True) -> Dict[str, float]:
+    """name → total ms across all recorded spans (every thread).
+    Ordered hottest phase first by default; ``sort=False`` keeps
+    completion order (deterministic across runs — what log-diffing
+    consumers like ``bench_line`` need, where a sort keyed on noisy ms
+    would swap near-equal phases between runs)."""
     out: Dict[str, float] = {}
-    for name, _, ms in _spans():
+    for name, _, ms, _, _ in get_span_records(all_threads=True):
         out[name] = out.get(name, 0.0) + ms
-    return out
+    if not sort:
+        return out
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))
 
 
 def report() -> str:
-    """Human-readable nested span report + counters."""
+    """Human-readable nested span report + counters (watermarks tagged
+    ``(max)``, gauges ``(gauge)`` — a peak is not a sum and must not
+    read like one)."""
     lines = []
-    for name, depth, ms in _spans():
+    for name, depth, ms, _, _ in _span_state().spans:
         lines.append(f"{'  ' * depth}{name} {ms:.2f} ms")
-    for name, n in sorted(_counters().items()):
-        lines.append(f"counter {name} = {n}")
+    snap = observe.REGISTRY.snapshot()
+    tagged = [(name, n, "") for name, n in snap["counters"].items()]
+    tagged += [(name, n, " (max)") for name, n in snap["watermarks"].items()]
+    tagged += [(name, n, " (gauge)") for name, n in snap["gauges"].items()]
+    for name, n, tag in sorted(tagged):
+        lines.append(f"counter {name} = {n}{tag}")
     return "\n".join(lines)
 
 
 def bench_line(op: str, j_t_ms: float, w_t_ms: float, lines: int) -> str:
     """The reference's benchmark log shape (table_join_dist_test.cpp:52-56):
-    ``<op> j_t <ms> w_t <ms> lines <n>`` plus recorded phase totals."""
+    ``<op> j_t <ms> w_t <ms> lines <n>`` plus recorded phase totals.
+    Phases stay in COMPLETION order (not phase_totals' hottest-first):
+    this line exists to diff textually against the reference's logs."""
     parts = [f"{op} j_t {j_t_ms:.2f} w_t {w_t_ms:.2f} lines {lines}"]
-    for name, ms in phase_totals().items():
+    for name, ms in phase_totals(sort=False).items():
         parts.append(f"{name} {ms:.2f}")
     return " ".join(parts)
+
+
+def export_chrome_trace(path: Optional[str] = None):
+    """Write the recorded spans (``X`` events) + counter series (``C``
+    events) as Chrome trace-event JSON and return the document — open it
+    in Perfetto (ui.perfetto.dev) or ``chrome://tracing``.  See
+    docs/observability.md for the workflow next to ``profile()``."""
+    return observe.export_chrome_trace(path)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,367 @@
+"""Observability subsystem: EXPLAIN ANALYZE runtime-annotated plans, the
+metrics catalogue, and the benchdiff regression gate.
+
+Coverage contract (ISSUE 3 acceptance):
+  * ``DTable.explain(..., analyze=True)`` on every TPC-H query returns a
+    plan whose EVERY node carries runtime annotations (rows, bytes
+    moved, decision, ms), with bytes-moved totals consistent with the
+    ``shuffle.rows_sent``-derived counters;
+  * ``benchdiff`` exits non-zero on a seeded regression and zero on
+    self-vs-self (including the shipped BENCH_r05.json driver wrapper).
+"""
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table, observe, trace
+from cylon_tpu.analysis import benchdiff
+from cylon_tpu.config import JoinConfig
+from cylon_tpu.parallel import (DTable, dist_groupby, dist_join,
+                                dist_select, dist_sort, shuffle_table)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RUNTIME_KEYS = {"ms", "rows_in", "rows_out", "bytes_moved", "decision",
+                 "counters", "depth"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    trace.reset()
+    yield
+    trace.disable()
+    trace.disable_counters()
+    trace.reset()
+
+
+def _tables(dctx, rng, n_l=500, n_r=40):
+    ldf = pd.DataFrame({"k": rng.integers(0, n_r, n_l),
+                        "a": rng.normal(size=n_l)})
+    rdf = pd.DataFrame({"k": np.arange(n_r), "b": rng.normal(size=n_r)})
+    return (DTable.from_table(dctx, Table.from_pandas(dctx, ldf)),
+            DTable.from_table(dctx, Table.from_pandas(dctx, rdf)))
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE
+# ---------------------------------------------------------------------------
+
+def test_analyze_annotates_every_node(dctx, rng):
+    lt, rt = _tables(dctx, rng)
+
+    def plan(tabs):
+        j = dist_join(tabs["l"], tabs["r"], JoinConfig.InnerJoin("k", "k"))
+        g = dist_groupby(j, ["lt-k"], [("rt-b", "sum")])
+        return dist_sort(g, 0).to_table()
+
+    rep = lt.explain(plan, tables={"l": lt, "r": rt}, analyze=True)
+    assert rep.ok and rep.analyzed and rep.nodes
+    for node in rep.nodes:
+        rt_ = node.runtime
+        assert rt_ is not None and _RUNTIME_KEYS <= set(rt_), node
+        assert rt_["ms"] >= 0 and rt_["bytes_moved"] >= 0
+        assert rt_["depth"] >= 1
+    ops = [n.op for n in rep.nodes]
+    assert ops[0] == "dist_join"
+    # the join is broadcast-eligible (40-row ingest-counted right side):
+    # the decision and its sync-free evidence ride the node
+    jn = rep.nodes[0]
+    assert jn.runtime["decision"] == "broadcast"
+    assert "ingest-cached counts" in jn.info["reason"]
+    assert jn.runtime["rows_in"] == 540 and jn.runtime["rows_out"] == 500
+    # the query's actual result rides the report
+    assert rep.output.num_rows == 40
+    text = str(rep)
+    assert "EXPLAIN ANALYZE" in text and "*HOT*" in text and "ms" in text
+
+
+def test_analyze_bytes_agree_with_counters(dctx, rng):
+    """Top-level nodes' bytes_moved must sum to the run totals, and the
+    totals must equal the rows_sent-derived byte counters."""
+    lt, rt = _tables(dctx, rng)
+
+    import dataclasses
+
+    def plan(tabs):
+        cfg = dataclasses.replace(JoinConfig.InnerJoin("k", "k"),
+                                  broadcast_threshold=0)
+        j = dist_join(tabs["l"], tabs["r"], cfg)  # pinned to shuffle
+        return dist_sort(j, "lt-k")
+
+    rep = lt.explain(plan, tables={"l": lt, "r": rt}, analyze=True)
+    top = [n for n in rep.nodes if n.runtime["depth"] == 1]
+    assert sum(n.runtime["bytes_moved"] for n in top) \
+        == rep.totals["bytes_moved"]
+    c = rep.totals["counters"]
+    assert rep.totals["bytes_moved"] == c.get("shuffle.bytes_sent", 0) \
+        + c.get("broadcast.bytes_sent", 0)
+    assert c.get("shuffle.rows_sent", 0) > 0  # the shuffle really moved rows
+    assert rep.totals["syncs"] == c.get("trace.sync", 0) > 0
+
+
+def test_analyze_shuffle_bytes_exact(dctx, rng):
+    """One shuffle of a known-schema table: bytes == rows_sent x the
+    per-row leaf width (int64 key + float64 value + nothing else)."""
+    lt, _ = _tables(dctx, rng)
+    rep = lt.explain(lambda t: shuffle_table(t, ["k"]), analyze=True)
+    c = rep.totals["counters"]
+    rows = c.get("shuffle.rows_sent", 0)
+    assert rows > 0
+    row_bytes = sum(np.dtype(col.data.dtype).itemsize
+                    for col in lt.columns)
+    assert c["shuffle.bytes_sent"] == rows * row_bytes
+    assert rep.nodes[0].runtime["bytes_moved"] == c["shuffle.bytes_sent"]
+
+
+def test_analyze_does_not_disturb_deferred_select(dctx, rng):
+    """The observer must not collapse a pending mask or cache counts the
+    un-measured run would not have had (heisenberg guard)."""
+    lt, _ = _tables(dctx, rng)
+
+    def plan(t):
+        return dist_select(t, lambda env: env["k"] < 10, compact=False)
+
+    rep = lt.explain(plan, analyze=True)
+    out = rep.output
+    assert out.pending_mask is not None       # still deferred
+    assert out._counts_host is None           # nothing cached on it
+    rows_out = rep.nodes[0].runtime["rows_out"]
+    assert rows_out == len(out.to_table().to_pandas())  # survivor count
+
+
+def test_analyze_failure_returns_partial_report(dctx, rng):
+    """A plan that fails mid-run must NOT lose the nodes measured before
+    the failure — the report comes back ok=False with the error and the
+    [FAILED] rendering (the diagnostics matter most exactly then)."""
+    lt, rt = _tables(dctx, rng)
+
+    def plan(t):
+        j = dist_join(t, rt, JoinConfig.InnerJoin("k", "k"))
+        return dist_sort(j, "no_such_column")
+
+    rep = lt.explain(plan, analyze=True)
+    assert not rep.ok and rep.error is not None
+    assert rep.nodes and rep.nodes[0].op == "dist_join"
+    assert rep.nodes[0].runtime is not None  # measured before the failure
+    text = str(rep)
+    assert "[FAILED]" in text and "no_such_column" in text
+
+
+def test_analyze_rows_in_sees_keyword_tables(dctx, rng):
+    lt, rt = _tables(dctx, rng)
+    rep = dctx.analyze(
+        lambda: dist_join(left=lt, right=rt,
+                          config=JoinConfig.InnerJoin("k", "k")))
+    assert rep.ok
+    assert rep.nodes[0].runtime["rows_in"] == 540
+
+
+def test_analyze_restores_trace_state(dctx, rng):
+    lt, rt = _tables(dctx, rng)
+    assert not trace.enabled()
+    lt.explain(lambda t: dist_join(t, rt, JoinConfig.InnerJoin("k", "k")),
+               analyze=True)
+    assert not trace.enabled()  # restored
+    # the run's spans stay readable for export right after
+    doc = trace.export_chrome_trace(None)
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+    # the capture is fully torn down: a fresh op records no new node
+    from cylon_tpu.analysis import plan_check
+    assert not plan_check.capturing()
+
+
+def test_static_explain_moves_zero_broadcast_bytes(dctx, rng):
+    """An abstract (static) explain of a broadcast-eligible join runs no
+    gather — with counters live it must report ZERO exchange volume,
+    exactly like the shuffle path's zeroed-counts post()."""
+    lt, rt = _tables(dctx, rng)
+    trace.enable_counters()
+    try:
+        rep = lt.explain(lambda t: dist_join(t, rt,
+                                             JoinConfig.InnerJoin("k", "k")))
+        assert rep.ok and rep.nodes[0].info.get("decision") == "broadcast"
+        c = trace.counters()
+        assert c.get("broadcast.rows_sent", 0) == 0, c
+        assert c.get("broadcast.bytes_sent", 0) == 0, c
+        assert c.get("shuffle.bytes_sent", 0) == 0, c
+    finally:
+        trace.disable_counters()
+
+
+def test_static_explain_unchanged_by_runtime_field(dctx, rng):
+    """The static (abstract) explain renders exactly as before — no
+    runtime clutter on un-analyzed nodes."""
+    lt, rt = _tables(dctx, rng)
+    rep = lt.explain(lambda t: dist_join(t, rt,
+                                         JoinConfig.InnerJoin("k", "k")))
+    assert rep.ok and not rep.analyzed
+    assert all(n.runtime is None for n in rep.nodes)
+    assert "EXPLAIN ANALYZE" not in str(rep) and "VALID" in str(rep)
+    # planner decisions are sync-free, so they appear statically too
+    assert rep.nodes[0].info.get("decision") == "broadcast"
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE x TPC-H: every node of every query annotated
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_tables(dctx):
+    from cylon_tpu.tpch import generate
+
+    data = generate(0.002, seed=7)
+    return {name: DTable.from_pandas(dctx, df)
+            for name, df in data.items()}
+
+
+def _qnames():
+    from cylon_tpu.tpch.queries import QUERIES
+    return sorted(QUERIES)
+
+
+@pytest.mark.parametrize("qname", _qnames())
+def test_analyze_tpch_query(dctx, tpch_tables, qname):
+    from cylon_tpu.tpch.queries import QUERIES
+
+    qfn = QUERIES[qname]
+    anchor = tpch_tables["lineitem"]
+    rep = anchor.explain(lambda t, q=qfn: q(dctx, t),
+                         tables=tpch_tables, analyze=True)
+    assert rep.ok and rep.analyzed
+    assert rep.nodes, f"{qname} recorded no distributed ops"
+    for node in rep.nodes:
+        rt = node.runtime
+        assert rt is not None and _RUNTIME_KEYS <= set(rt), (qname, node)
+        assert rt["ms"] >= 0 and rt["bytes_moved"] >= 0
+        assert isinstance(rt["decision"], str) and rt["decision"]
+    # bytes totals agree with the rows_sent-derived counters
+    c = rep.totals["counters"]
+    assert rep.totals["bytes_moved"] == c.get("shuffle.bytes_sent", 0) \
+        + c.get("broadcast.bytes_sent", 0)
+    top = [n for n in rep.nodes if n.runtime["depth"] == 1]
+    assert sum(n.runtime["bytes_moved"] for n in top) \
+        == rep.totals["bytes_moved"]
+    # every counter the query bumped is in the documented catalogue
+    unknown = set(c) - set(observe.METRICS)
+    assert not unknown, f"{qname}: undocumented metrics {unknown}"
+    assert "EXPLAIN ANALYZE" in str(rep)
+
+
+# ---------------------------------------------------------------------------
+# benchdiff: the regression gate
+# ---------------------------------------------------------------------------
+
+def _artifact(tmp_path, name, overrides=None):
+    detail = {"tpch_q1_ms": 100.0, "tpch_q9_ms": 400.0,
+              "tpch_q9_bytes_moved": 1 << 20,
+              "tpch_geomean_vs_pandas": 2.5,
+              "tpch_q1_pandas_ms": 900.0, "bench_wall_s": 300.0}
+    detail.update(overrides or {})
+    line = json.dumps({"metric": "dist_join_rows_per_sec",
+                       "value": 5e7, "unit": "rows/s",
+                       "vs_baseline": 30.0, "detail": detail})
+    p = tmp_path / name
+    p.write_text(line + "\n")
+    return str(p)
+
+
+def test_benchdiff_self_vs_self_is_clean(tmp_path, capsys):
+    a = _artifact(tmp_path, "a.json")
+    assert benchdiff.main([a, a]) == 0
+
+
+def test_benchdiff_flags_seeded_regression(tmp_path, capsys):
+    old = _artifact(tmp_path, "old.json")
+    new = _artifact(tmp_path, "new.json",
+                    {"tpch_q9_ms": 700.0,                 # +75%
+                     "tpch_q9_bytes_moved": 4 << 20,      # 4x
+                     "tpch_geomean_vs_pandas": 1.2})      # halved
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+    # sorted worst-first: the 4x bytes blowup leads the table
+    first = out.splitlines()[1].split()[0]
+    assert first == "tpch_q9_bytes_moved"
+
+
+def test_benchdiff_improvement_and_noise_pass(tmp_path):
+    old = _artifact(tmp_path, "old.json")
+    new = _artifact(tmp_path, "new.json",
+                    {"tpch_q9_ms": 300.0,          # improvement
+                     "tpch_q1_ms": 100.5,          # sub-floor jitter
+                     "tpch_q1_pandas_ms": 2000.0})  # ungated oracle drift
+    assert benchdiff.main([old, new]) == 0
+
+
+def test_benchdiff_missing_gated_metric_fails(tmp_path, capsys):
+    """A query that crashed in NEW emits no ms field — 'measured ->
+    missing' is the worst regression and must NOT read as clean."""
+    old = _artifact(tmp_path, "old.json", {"tpch_q5_ms": 120.0})
+    new = _artifact(tmp_path, "new.json")
+    # simulate the crash: NEW lacks tpch_q5_ms entirely
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "tpch_q5_ms" in out and "MISSING" in out
+    # ungated keys disappearing (oracle drift) stay non-fatal
+    old2 = _artifact(tmp_path, "old2.json", {"tpch_q5_pandas_ms": 999.0})
+    new2 = _artifact(tmp_path, "new2.json")
+    assert benchdiff.main([old2, new2]) == 0
+
+
+def test_benchdiff_absolute_floors_for_small_baselines(tmp_path):
+    """A relative gate alone is unusable at small baselines: host_reads
+    0->1 (+inf%) and a few stray bytes must pass; real jumps still
+    fail."""
+    old = _artifact(tmp_path, "old.json",
+                    {"tpch_q1_host_reads": 0, "tpch_q1_bytes_moved": 0})
+    small = _artifact(tmp_path, "small.json",
+                      {"tpch_q1_host_reads": 1,
+                       "tpch_q1_bytes_moved": 1024})
+    assert benchdiff.main([old, small]) == 0
+    big = _artifact(tmp_path, "big.json",
+                    {"tpch_q1_host_reads": 50,
+                     "tpch_q1_bytes_moved": 1 << 22})
+    assert benchdiff.main([old, big]) == 1
+
+
+def test_benchdiff_threshold_knob(tmp_path):
+    old = _artifact(tmp_path, "old.json")
+    new = _artifact(tmp_path, "new.json", {"tpch_q9_ms": 440.0})  # +10%
+    assert benchdiff.main([old, new]) == 0                # default 15%
+    assert benchdiff.main(["--threshold", "0.05", old, new]) == 1
+
+
+def test_benchdiff_parses_truncated_driver_wrapper(tmp_path):
+    """The driver's {tail: ...} wrapper with the artifact line truncated
+    mid-object still yields its scoring fields."""
+    tail = ('q1_ms": 100.0, "tpch_q9_ms": 400.0, '
+            '"tpch_geomean_vs_pandas": 2.5, "emitted_after": "final"}}\n'
+            "[bench 03:28:40] emit after final (4189 B)\n")
+    p = tmp_path / "wrapped.json"
+    p.write_text(json.dumps({"n": 5, "cmd": "python bench.py", "rc": 0,
+                             "tail": tail, "parsed": None}))
+    vals = benchdiff.load_artifact(str(p))
+    assert vals["tpch_q9_ms"] == 400.0
+    new = _artifact(tmp_path, "new.json", {"tpch_q9_ms": 900.0})
+    assert benchdiff.main(["--baseline", str(p), new]) == 1
+
+
+def test_benchdiff_usage_and_parse_errors(tmp_path):
+    assert benchdiff.main([]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("no numbers here\n")
+    good = _artifact(tmp_path, "good.json")
+    assert benchdiff.main([str(bad), good]) == 2
+    assert benchdiff.main([str(tmp_path / "missing.json"), good]) == 2
+
+
+@pytest.mark.slow
+def test_benchdiff_baseline_smoke():
+    """The gate itself, exercised against the shipped bench artifact:
+    self-vs-self over BENCH_r05.json (a driver wrapper with a truncated
+    tail) must parse and exit 0 — the slow-marked bench-path smoke."""
+    baseline = os.path.join(REPO, "BENCH_r05.json")
+    assert benchdiff.main(["--baseline", baseline, baseline]) == 0

@@ -45,6 +45,128 @@ def test_counters_accumulate():
     assert trace.counters()["eq_calls"] == 7
 
 
+def test_counters_merge_across_threads():
+    """A count bumped on a worker thread must NOT vanish from the
+    process-level view (the registry folds dead threads' buffers into a
+    retained aggregate at read time)."""
+    import threading
+
+    trace.count("xthread", 1)
+
+    def worker():
+        trace.count("xthread", 5)
+        trace.count_max("xthread_peak", 99)
+
+    ts = [threading.Thread(target=worker) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert trace.counters()["xthread"] == 16  # 1 + 3x5, summed
+    assert trace.counters()["xthread_peak"] == 99  # maxed, not summed
+    # a second read after the threads died still sees the folded totals
+    assert trace.counters()["xthread"] == 16
+
+
+def test_snapshot_is_typed_and_report_tags_watermarks():
+    trace.count("a.sum", 2)
+    trace.count("a.sum", 3)
+    trace.count_max("a.peak", 7)
+    trace.count_max("a.peak", 4)  # below the peak: ignored
+    trace.gauge("a.size", 12)
+    snap = trace.snapshot()
+    assert snap["counters"]["a.sum"] == 5
+    assert snap["watermarks"]["a.peak"] == 7
+    assert snap["gauges"]["a.size"] == 12
+    rep = trace.report()
+    assert "counter a.sum = 5" in rep
+    assert "counter a.peak = 7 (max)" in rep
+    assert "counter a.size = 12 (gauge)" in rep
+    # the merged compat view carries both sums and peaks
+    assert trace.counters() == {"a.sum": 5, "a.peak": 7}
+
+
+def test_phase_totals_sorted_hot_first():
+    import time as _time
+
+    with trace.span("cold"):
+        pass
+    with trace.span("hot"):
+        _time.sleep(0.02)
+    totals = trace.phase_totals()
+    assert list(totals) == ["hot", "cold"]
+
+
+def test_hard_sync_is_observable():
+    """hard_sync bumps trace.sync and, while tracing, charges a nested
+    `sync` span — the per-query sync floor is a measured number."""
+    import jax.numpy as jnp
+
+    x = jnp.arange(8)
+    with trace.span("outer"):
+        trace.hard_sync(x)
+    assert trace.counters().get("trace.sync", 0) == 1
+    spans = trace.get_spans()
+    assert ("sync", 1) in [(n, d) for n, d, _ in spans]  # nested in outer
+    trace.reset()
+    trace.disable()
+    trace.enable_counters()
+    try:
+        trace.hard_sync(x)  # counter-only mode: counted, no span
+        assert trace.counters().get("trace.sync", 0) == 1
+        assert trace.get_spans() == []
+    finally:
+        trace.disable_counters()
+
+
+def test_chrome_trace_export(tmp_path):
+    import json
+    import time as _time
+
+    with trace.span("outer"):
+        trace.count("work.items", 3)
+        with trace.span("inner"):
+            _time.sleep(0.002)
+        trace.count("work.items", 2)
+    path = str(tmp_path / "trace.json")
+    doc = trace.export_chrome_trace(path)
+    with open(path) as f:
+        ondisk = json.load(f)  # valid JSON on disk
+    assert ondisk["traceEvents"] == doc["traceEvents"]
+    xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert set(xs) == {"outer", "inner"}
+    outer, inner = xs["outer"], xs["inner"]
+    # event nesting matches span depth: the inner X event is contained
+    # in the outer one, and the recorded depths ride along
+    assert outer["args"]["depth"] == 0 and inner["args"]["depth"] == 1
+    assert inner["ts"] >= outer["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+    cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert [c["args"]["work.items"] for c in cs
+            if c["name"] == "work.items"] == [3, 5]  # cumulative series
+    # C events land inside the outer span on the timeline
+    assert all(outer["ts"] <= c["ts"] <= outer["ts"] + outer["dur"]
+               for c in cs if c["name"] == "work.items")
+
+
+def test_chrome_counter_track_merges_threads():
+    """A counter bumped from several threads must export as ONE monotone
+    process-level track whose last sample equals the merged total — not
+    a per-thread sawtooth."""
+    import threading
+
+    trace.count("mt.rows", 5000)
+    t = threading.Thread(target=lambda: trace.count("mt.rows", 100))
+    t.start()
+    t.join()
+    trace.count("mt.rows", 10)
+    doc = trace.export_chrome_trace(None)
+    series = [e["args"]["mt.rows"] for e in doc["traceEvents"]
+              if e["ph"] == "C" and e["name"] == "mt.rows"]
+    assert series == sorted(series), series  # monotone
+    assert series[-1] == trace.counters()["mt.rows"] == 5110
+
+
 def test_bench_line_shape():
     with trace.span("join.shuffle"):
         pass
