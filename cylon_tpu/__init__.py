@@ -20,10 +20,16 @@ Layer map (tpu-native mirror of SURVEY.md §1):
                       docs/static_analysis.md
     observe.py        metrics registry, Chrome/Perfetto trace export,
                       EXPLAIN ANALYZE — docs/observability.md
+    resilience.py     memory-budget guardrails (chunked degraded shuffle,
+                      broadcast veto) + bounded retry-with-backoff —
+                      docs/robustness.md
+    faults.py         deterministic fault injection (seeded FaultPlan
+                      over named fault points) — docs/robustness.md
 """
 
-from . import analysis, observe, trace
-from .config import JoinAlgorithm, JoinConfig, JoinType, sanitize
+from . import analysis, faults, observe, resilience, trace
+from .config import (JoinAlgorithm, JoinConfig, JoinType, sanitize,
+                     set_device_memory_budget)
 from .context import CylonContext
 from .dtypes import DataType, Layout, Type
 from .row import Row
@@ -35,5 +41,6 @@ __version__ = "0.1.0"
 __all__ = [
     "CylonContext", "Table", "Column", "Row", "Status", "Code", "CylonError",
     "DataType", "Type", "Layout", "JoinConfig", "JoinType", "JoinAlgorithm",
-    "trace", "observe", "analysis", "sanitize", "__version__",
+    "trace", "observe", "analysis", "resilience", "faults", "sanitize",
+    "set_device_memory_budget", "__version__",
 ]

@@ -26,6 +26,11 @@ with examples):
   shard-map-axis-literal  a string-literal axis name handed to
                           ``P()``/``PartitionSpec()`` or a ``jax.lax``
                           collective instead of the mesh's axis.
+  broad-except            a bare ``except:`` / ``except Exception:`` /
+                          ``except BaseException:`` handler that never
+                          re-raises — it can swallow ``ReplayNeeded``
+                          (breaking deferred-pipeline replay) or a typed
+                          ``CylonError`` (docs/robustness.md).
 
 Findings carry ``file:line:col``; suppress a deliberate site with a
 ``# graftlint: ok[rule]`` (or bare ``# graftlint: ok``) comment on any
@@ -55,6 +60,7 @@ RULES = (
     "jit-in-loop",
     "raw-float64-literal",
     "shard-map-axis-literal",
+    "broad-except",
 )
 
 # Modules whose job IS the device↔host boundary: ingest, export, the
@@ -232,6 +238,41 @@ class _Linter(ast.NodeVisitor):
         self._check_x64_literal(node)
         self.generic_visit(node)
 
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        self._check_broad_except(node)
+        self.generic_visit(node)
+
+    # -- broad-except --------------------------------------------------------
+
+    def _check_broad_except(self, node: ast.ExceptHandler) -> None:
+        """A handler catching Exception/BaseException (or everything)
+        that never re-raises swallows ``ReplayNeeded`` — the deferred
+        pipeline's replay signal, which inherits Exception by design —
+        and typed ``CylonError``s alike.  Handlers containing ANY
+        ``raise`` are exempt (convert-and-reraise is the sanctioned
+        shape); deliberate best-effort catches carry a suppression
+        comment saying why."""
+        broad_names = ("Exception", "BaseException",
+                       "builtins.Exception", "builtins.BaseException")
+        t = node.type
+        if t is None:
+            broad = True
+        elif isinstance(t, ast.Tuple):
+            broad = any(_dotted(e) in broad_names for e in t.elts)
+        else:
+            broad = _dotted(t) in broad_names
+        if not broad:
+            return
+        if _has_handler_raise(node.body):
+            return
+        what = "bare `except:`" if t is None else \
+            f"`except {_dotted(t) if not isinstance(t, ast.Tuple) else 'Exception'}:`"
+        self._emit(node, "broad-except",
+                   f"{what} with no re-raise can swallow ReplayNeeded / "
+                   "CylonError — catch the specific exceptions, re-raise, "
+                   "or suppress with a comment saying why the swallow is "
+                   "safe", def_line_only=True)
+
     # -- implicit-host-sync --------------------------------------------------
 
     def _check_host_sync(self, node: ast.Call, target: Optional[str]) -> None:
@@ -297,8 +338,8 @@ class _Linter(ast.NodeVisitor):
                 try:
                     test_src = ast.get_source_segment(self.source,
                                                       parent.test) or ""
-                except Exception:
-                    test_src = ""
+                except Exception:  # graftlint: ok[broad-except] — source-
+                    test_src = ""  # segment recovery is cosmetic only
                 if "enable_x64" in test_src or "x64" in test_src:
                     return True
             cur = parent
@@ -336,7 +377,7 @@ class _Linter(ast.NodeVisitor):
         try:
             table = symtable.symtable(self.source, self.path, "exec")
             _index_symtable(table, blocks)
-        except Exception:
+        except Exception:  # graftlint: ok[broad-except]
             # symtable alone is best-effort: without it the closure-
             # capture arm degrades (blocks stay empty), but the uncached-
             # factory arm below must keep firing — a blanket except here
@@ -385,6 +426,22 @@ class _Linter(ast.NodeVisitor):
                                "is not part of the factory's cache key — "
                                "thread it through the (hashable) factory "
                                "arguments", def_line_only=True)
+
+
+def _has_handler_raise(body) -> bool:
+    """A ``raise`` that can actually execute as part of the handler:
+    raises inside a nested function/lambda/class defined in the handler
+    body do NOT run when the handler does, so they must not exempt it."""
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
 
 
 def _nested_function_blocks(block, enclosing: Set[str]) -> Iterable:

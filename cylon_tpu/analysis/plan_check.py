@@ -133,6 +133,14 @@ class PlanReport:
                 f"{t.get('ms', 0.0):.1f} ms, "
                 f"{_fmt_bytes(t.get('bytes_moved', 0))} moved, "
                 f"{t.get('syncs', 0)} syncs")
+        # resilience events are rare enough that rendering zeros would
+        # be noise — the head names them only when the run had any
+        # (docs/robustness.md; the full map is in totals["counters"])
+        for key, label in (("chunked_rounds", "chunked rounds"),
+                           ("retries", "retries"),
+                           ("faults", "injected faults")):
+            if t.get(key, 0):
+                head += f", {t[key]} {label}"
         if not self.ok:
             head += " [FAILED]"
         lines = [head]
@@ -249,8 +257,8 @@ def _summarize(dt) -> str:
         if ch is not None:
             rows = f"{int(np.asarray(ch).sum())} rows, "
         return f"[{rows}{len(cols)} cols, {nparts}x{cap}]"
-    except Exception:
-        return "[?]"
+    except Exception:  # graftlint: ok[broad-except] — a summary helper
+        return "[?]"   # must never fail the plan capture it decorates
 
 
 def _check_table(op: str, dt) -> None:

@@ -6,6 +6,7 @@ builder (reference: cpp/src/cylon/join/join_config.hpp:22-89).
 from __future__ import annotations
 
 import enum
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -53,6 +54,100 @@ def set_broadcast_join_threshold(n: "Optional[int]") -> "Optional[int]":
     prev = _broadcast_join_threshold
     _broadcast_join_threshold = 0 if n is None else n
     return prev if prev > 0 else None
+
+
+# ---------------------------------------------------------------------------
+# device memory budget (docs/robustness.md): the per-device byte ceiling
+# the exchange stack prices transient allocations against.  shuffle
+# degrades an over-budget exchange to the chunked multi-round path;
+# broadcast vetoes a replica that would not fit.  Resolution order:
+#   1. an explicit set_device_memory_budget(bytes),
+#   2. the CYLON_MEMORY_BUDGET env var (bytes),
+#   3. DEFAULT_MEMORY_BUDGET_FRACTION of detected per-device memory
+#      (device memory_stats when the backend reports one, physical host
+#      RAM on CPU, a 16 GiB floor-of-last-resort otherwise).
+# ---------------------------------------------------------------------------
+
+DEFAULT_MEMORY_BUDGET_FRACTION = 0.5
+
+_device_memory_budget: Optional[int] = None   # None -> env/auto
+_auto_memory_budget: Optional[int] = None     # detection cache
+
+
+def _validate_budget(n, what: str) -> int:
+    if isinstance(n, bool) or not isinstance(n, int):
+        raise CylonError(Status(Code.Invalid,
+            f"{what} must be a positive int byte count, "
+            f"got {type(n).__name__} {n!r}"))
+    if n <= 0:
+        raise CylonError(Status(Code.Invalid,
+            f"{what} must be positive, got {n} (pass None to restore "
+            "auto-detection)"))
+    return n
+
+
+def set_device_memory_budget(n: "Optional[int]") -> "Optional[int]":
+    """Set the session-wide per-device memory budget in bytes; returns
+    the previous EXPLICIT setting (None when the budget was env/auto-
+    resolved) so callers can restore it in a finally.
+
+    ``None`` restores env/auto resolution.  Zero, negative, float and
+    bool values are rejected — a silently-stored ``0`` would degrade
+    every exchange to its smallest chunk size.
+    """
+    global _device_memory_budget
+    if n is not None:
+        n = _validate_budget(n, "device memory budget")
+    prev = _device_memory_budget
+    _device_memory_budget = n
+    return prev
+
+
+def _detect_memory_budget() -> int:
+    """Fraction of detected per-device memory (cached)."""
+    global _auto_memory_budget
+    if _auto_memory_budget is not None:
+        return _auto_memory_budget
+    limit = None
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit")
+    except Exception:  # graftlint: ok[broad-except] — detection is
+        limit = None   # best-effort; every backend fails differently
+    if not limit or limit <= 0:
+        try:  # CPU backends: physical host RAM is the honest ceiling
+            limit = (os.sysconf("SC_PAGE_SIZE")
+                     * os.sysconf("SC_PHYS_PAGES"))
+        except (ValueError, OSError, AttributeError):
+            limit = 0
+    if not limit or limit <= 0:  # sysconf may return -1 (indeterminate)
+        limit = 16 << 30
+    _auto_memory_budget = max(int(limit * DEFAULT_MEMORY_BUDGET_FRACTION),
+                              1 << 20)
+    return _auto_memory_budget
+
+
+def device_memory_budget() -> int:
+    """The effective per-device memory budget in bytes (explicit knob,
+    else ``CYLON_MEMORY_BUDGET``, else the auto-detected fraction).
+    Engine code reads it through ``resilience.exchange_budget`` so the
+    allocation-pressure fault point applies."""
+    if _device_memory_budget is not None:
+        return _device_memory_budget
+    env = os.environ.get("CYLON_MEMORY_BUDGET", "")
+    if env:
+        # any set value must be valid — "0" raises like the setter does
+        # (a silently-accepted zero would degrade every exchange)
+        try:
+            return _validate_budget(int(env), "CYLON_MEMORY_BUDGET")
+        except ValueError:
+            raise CylonError(Status(Code.Invalid,
+                f"CYLON_MEMORY_BUDGET must be an int byte count, "
+                f"got {env!r}")) from None
+    return _detect_memory_budget()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,285 @@
+"""Deterministic fault injection (docs/robustness.md).
+
+The reference ships zero fault tolerance — no retry, no elasticity, no
+fault injection anywhere in the tree (SURVEY.md §395-399) — so chaos
+behavior was whatever the first unlucky production run discovered.  This
+module makes failure a FIRST-CLASS, reproducible input instead: the
+engine's sanctioned failure boundaries each host a **named fault point**
+(the catalogue below), and a seeded :class:`FaultPlan` decides, per
+call, whether that point fires.  Two shapes of fault exist:
+
+  * **exception points** (``check(name)``) raise a typed
+    :class:`TransientFault` or :class:`PermanentFault` — both are
+    :class:`~cylon_tpu.status.CylonError` subclasses naming the point —
+    exactly where a real host-read / IO failure would surface.  The
+    transient class is what ``resilience.retrying`` retries; the
+    permanent class propagates immediately.
+  * **value points** (``perturb(name, value)``) mutate an engine-internal
+    value in flight: shrink an optimistic-dispatch size hint so the
+    undersized-dispatch replay machinery runs, or shrink the memory
+    budget mid-query to simulate allocation pressure (degrading shuffles
+    to the chunked exchange).
+
+Determinism: one ``random.Random(seed)`` drives every probability draw,
+guarded by a lock, and per-point call counters drive ``nth``/``once``
+triggers — the same seed over the same call sequence fires the same
+faults.  (Multi-threaded callers — the concurrent CSV reader — still
+draw from the one stream, so cross-thread interleaving can reorder
+draws; single-threaded runs, which is what chaos tests are, replay
+exactly.)
+
+Every fire bumps the ``fault.injected`` counter (visible in EXPLAIN
+ANALYZE totals) and the plan's own ``injected`` tally (visible without
+tracing enabled).
+
+Enable for a whole test run with ``CYLON_CHAOS=<seed>`` (conftest
+installs ``FaultPlan.default(seed)``, mirroring ``CYLON_SANITIZE=1``),
+or scoped::
+
+    with faults.active(faults.FaultPlan(seed=7, rules=[
+            faults.FaultRule("io.csv.read", kind="transient", nth=2)])):
+        ...
+"""
+from __future__ import annotations
+
+import contextlib
+import fnmatch
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from .status import Code, CylonError, Status
+
+__all__ = [
+    "POINTS", "FaultError", "TransientFault", "PermanentFault",
+    "FaultRule", "FaultPlan", "install", "uninstall", "active", "plan",
+    "check", "perturb", "undersize_hint",
+]
+
+# ---------------------------------------------------------------------------
+# the fault-point catalogue (docs/robustness.md mirrors it)
+# ---------------------------------------------------------------------------
+
+# Every sanctioned boundary that hosts a fault point, with what firing
+# there simulates.  Exception points accept transient/permanent rules;
+# value points accept kind="value" rules and are exercised via perturb().
+POINTS: Dict[str, str] = {
+    "compact.read_counts":
+        "the blocking per-op host count read (ops/compact._read_counts) "
+        "— a failed device→host transfer on a tunneled backend",
+    "compact.flush":
+        "the ONE batched device_get resolving a deferred region's queued "
+        "validations (ops/compact.flush_pending_with)",
+    "compact.hint":
+        "value point: the optimistic-dispatch size-hint lookup — an "
+        "undersized mutation forces the validation/replay machinery",
+    "io.csv.read":
+        "a CSV file read (io/csv._read_one) — flaky network filesystem / "
+        "object store",
+    "resilience.budget":
+        "value point: the device memory budget read — a shrinking "
+        "mutation simulates allocation pressure mid-query, degrading "
+        "over-budget exchanges to the chunked multi-round path",
+}
+
+
+class FaultError(CylonError):
+    """Base of every injected fault; carries the fault point's name."""
+
+    def __init__(self, point: str, kind: str):
+        super().__init__(Status(Code.ExecutionError,
+                                f"injected {kind} fault at {point!r}"))
+        self.point = point
+
+
+class TransientFault(FaultError):
+    """An injected failure of the retryable class (network blip, flaky
+    read) — ``resilience.retrying`` boundaries absorb these."""
+
+    def __init__(self, point: str):
+        super().__init__(point, "transient")
+
+
+class PermanentFault(FaultError):
+    """An injected failure classed permanent: never retried, surfaces to
+    the caller as a typed CylonError naming the fault point."""
+
+    def __init__(self, point: str):
+        super().__init__(point, "permanent")
+
+
+# ---------------------------------------------------------------------------
+# plans and rules
+# ---------------------------------------------------------------------------
+
+def undersize_hint(hint: Tuple[int, ...]) -> Tuple[int, ...]:
+    """The default ``compact.hint`` mutation: quarter every size-class
+    component (floored at the smallest bucket, so the perturbed sizes
+    stay inside the bounded compile vocabulary).  An undersized hint is
+    always SAFE — validation detects it and redoes/replays — which is
+    the point: this exercises the recovery machinery, not correctness."""
+    from .ops.compact import next_bucket
+
+    return tuple(next_bucket(max(int(h) // 4, 1), minimum=8)
+                 for h in hint)
+
+
+@dataclass
+class FaultRule:
+    """One trigger: WHERE (a point name or fnmatch pattern), WHAT
+    (transient / permanent exception, or a value mutation), and WHEN
+    (probability per call, the exact nth matching call, at most once,
+    or a total-fires cap)."""
+
+    point: str                      # exact name or fnmatch pattern
+    kind: str = "transient"         # transient | permanent | value
+    probability: float = 1.0        # seeded draw per matching call
+    nth: Optional[int] = None       # fire ONLY on the nth call (1-based)
+    once: bool = False              # at most one fire, ever
+    limit: Optional[int] = None     # max total fires
+    mutate: Optional[Callable] = None  # kind="value": old -> new
+
+    def __post_init__(self):
+        if self.kind not in ("transient", "permanent", "value"):
+            raise CylonError(Status(Code.Invalid,
+                f"fault kind must be transient/permanent/value, "
+                f"got {self.kind!r}"))
+        if self.kind == "value" and self.mutate is None:
+            raise CylonError(Status(Code.Invalid,
+                f"value fault at {self.point!r} needs a mutate callable"))
+
+
+class FaultPlan:
+    """A seeded set of rules; the same seed over the same call sequence
+    reproduces the same fault pattern (chaos runs are debuggable)."""
+
+    def __init__(self, seed: int = 0, rules: Sequence[FaultRule] = ()):
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = list(rules)
+        self.injected = 0               # total fires (no tracing needed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self._calls: Dict[str, int] = {}       # point -> times consulted
+        self._fires: Dict[int, int] = {}       # rule index -> times fired
+        self.fired: List[Tuple[str, str]] = []  # (point, kind) log
+
+    @staticmethod
+    def default(seed: int = 0) -> "FaultPlan":
+        """The ``CYLON_CHAOS`` plan: low-probability transient failures
+        at every host-read / IO boundary, occasional forced-undersized
+        hints, and occasional allocation pressure on the memory budget.
+        All injected classes are recoverable — a suite that is correct
+        under this plan demonstrated its retry, replay, and degraded-
+        exchange machinery end to end."""
+        return FaultPlan(seed, [
+            FaultRule("compact.read_counts", kind="transient",
+                      probability=0.03),
+            FaultRule("compact.flush", kind="transient", probability=0.03),
+            FaultRule("io.csv.read", kind="transient", probability=0.10),
+            FaultRule("compact.hint", kind="value", probability=0.05,
+                      mutate=undersize_hint),
+            FaultRule("resilience.budget", kind="value", probability=0.02,
+                      mutate=lambda b: max(int(b) // 8, 1 << 20)),
+        ])
+
+    def _decide(self, point: str, want_value: bool) -> Optional[FaultRule]:
+        """One consultation of ``point``: bump its call counter and
+        return the first rule that fires (None for no fault)."""
+        with self._lock:
+            n = self._calls.get(point, 0) + 1
+            self._calls[point] = n
+            for i, rule in enumerate(self.rules):
+                is_value = rule.kind == "value"
+                if is_value != want_value:
+                    continue
+                if not fnmatch.fnmatchcase(point, rule.point):
+                    continue
+                fires = self._fires.get(i, 0)
+                if rule.once and fires >= 1:
+                    continue
+                if rule.limit is not None and fires >= rule.limit:
+                    continue
+                if rule.nth is not None:
+                    if n != rule.nth:
+                        continue
+                elif self._rng.random() >= rule.probability:
+                    continue
+                self._fires[i] = fires + 1
+                self.injected += 1
+                self.fired.append((point, rule.kind))
+                return rule
+        return None
+
+
+# ---------------------------------------------------------------------------
+# activation + the two hook shapes
+# ---------------------------------------------------------------------------
+
+_active_plan: Optional[FaultPlan] = None
+
+
+def install(new_plan: FaultPlan) -> Optional[FaultPlan]:
+    """Make ``new_plan`` the process-wide active plan; returns the
+    previous one (callers restore it — or use :func:`active`)."""
+    global _active_plan
+    prev = _active_plan
+    _active_plan = new_plan
+    return prev
+
+
+def uninstall() -> None:
+    global _active_plan
+    _active_plan = None
+
+
+def plan() -> Optional[FaultPlan]:
+    """The active plan, or None (the production state)."""
+    return _active_plan
+
+
+@contextlib.contextmanager
+def active(new_plan: FaultPlan) -> Iterator[FaultPlan]:
+    """Scoped activation; restores whatever plan was active before."""
+    prev = install(new_plan)
+    try:
+        yield new_plan
+    finally:
+        global _active_plan
+        _active_plan = prev
+
+
+def _count_injection() -> None:
+    from . import trace
+
+    trace.count("fault.injected")
+
+
+def check(point: str) -> None:
+    """Exception hook: called at a sanctioned failure boundary right
+    before the real operation.  No-op without an active plan (one global
+    read — the production cost).  Raises :class:`TransientFault` or
+    :class:`PermanentFault` when the plan fires."""
+    p = _active_plan
+    if p is None:
+        return
+    rule = p._decide(point, want_value=False)
+    if rule is None:
+        return
+    _count_injection()
+    if rule.kind == "permanent":
+        raise PermanentFault(point)
+    raise TransientFault(point)
+
+
+def perturb(point: str, value):
+    """Value hook: returns ``value`` unchanged without an active plan /
+    firing rule, else the rule's mutation of it."""
+    p = _active_plan
+    if p is None:
+        return value
+    rule = p._decide(point, want_value=True)
+    if rule is None:
+        return value
+    _count_injection()
+    return rule.mutate(value)

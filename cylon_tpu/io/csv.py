@@ -183,10 +183,22 @@ class CSVWriteOptions:
 def _read_one(path: str, options: CSVReadOptions):
     import pyarrow.csv as pacsv
 
+    from .. import faults, resilience
+
     read, parse, convert = options.to_pyarrow()
-    try:
-        return pacsv.read_csv(path, read_options=read, parse_options=parse,
+
+    def attempt():
+        # fault point (docs/robustness.md): a flaky filesystem / object
+        # store read; resilience.retry_call absorbs the transient class
+        faults.check("io.csv.read")
+        return pacsv.read_csv(path, read_options=read,
+                              parse_options=parse,
                               convert_options=convert)
+
+    try:
+        return resilience.retry_call(attempt, point="io.csv.read")
+    except faults.FaultError:
+        raise  # already a typed CylonError naming the fault point
     except FileNotFoundError as e:
         raise CylonError(Status(Code.IOError, str(e))) from e
     except Exception as e:  # pyarrow raises ArrowInvalid etc.

@@ -69,6 +69,37 @@ def warning(msg: Any, *args) -> None:
     _emit(WARNING, str(msg) % args if args else str(msg))
 
 
+# Keys that already warned this session (warn_once).  A plain set — adds
+# are GIL-atomic, and the worst race is one duplicate line, not a lost
+# warning.
+_warned_keys: set = set()
+
+
+def warn_once(key: Any, msg: Any, *args) -> bool:
+    """Emit a WARNING at most once per ``key`` per session; returns
+    whether a line was emitted.
+
+    The shared rate-limit behind every per-condition diagnostic (the
+    shuffle skew warning keyed by shuffle signature, the ingest
+    narrowing warnings keyed by column) — a skewed query in a loop logs
+    one line, not one per call.  ``key`` must be hashable; tests reset
+    with :func:`reset_warn_once`.
+    """
+    if key in _warned_keys:
+        return False
+    _warned_keys.add(key)
+    _emit(WARNING, str(msg) % args if args else str(msg))
+    return True
+
+
+def reset_warn_once(key: Any = None) -> None:
+    """Forget one warn_once key (or all of them) — test isolation."""
+    if key is None:
+        _warned_keys.clear()
+    else:
+        _warned_keys.discard(key)
+
+
 def error(msg: Any, *args) -> None:
     _emit(ERROR, str(msg) % args if args else str(msg))
 

@@ -125,6 +125,32 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "optimistic-dispatch validations)"),
     ("broadcast.replica_cache_size", GAUGE, "entries",
      "live entries in the broadcast replica cache"),
+    # resilience (docs/robustness.md): budget guardrails, degraded
+    # exchanges, fault injection, bounded retries, pipeline replays
+    ("shuffle.chunked", COUNTER, "exchanges",
+     "shuffles degraded to the chunked multi-round exchange (single-"
+     "shot priced over the device memory budget)"),
+    ("shuffle.chunked_rounds", COUNTER, "rounds",
+     "bounded all_to_all rounds run by chunked exchanges"),
+    ("shuffle.exchange_bytes_peak", WATERMARK, "bytes",
+     "largest per-device transient priced for one exchange dispatch "
+     "(send + receive blocks + compacted round output)"),
+    ("broadcast.budget_veto", COUNTER, "vetoes",
+     "broadcast decisions vetoed because the replica would not fit the "
+     "device memory budget (the join fell back to shuffle)"),
+    ("fault.injected", COUNTER, "faults",
+     "faults fired by the active FaultPlan (cylon_tpu.faults)"),
+    ("retry.attempts", COUNTER, "retries",
+     "transient failures retried at resilience.retrying boundaries"),
+    ("retry.exhausted", COUNTER, "failures",
+     "retry loops that ran out of attempts (the transient error "
+     "propagated to the caller)"),
+    ("pipeline.replays", COUNTER, "replays",
+     "deferred pipeline attempts replayed after an undersized "
+     "optimistic dispatch (ops/compact.run_pipeline)"),
+    ("pipeline.fallback_plain", COUNTER, "fallbacks",
+     "run_pipeline attempts exhausted — the warned plain-mode (per-op "
+     "validated) fallback engaged"),
 )
 
 
@@ -556,7 +582,10 @@ def analyze(op, *args, **kwargs):
         report.output = out
         if report.result is None:
             report.result = plan_check._schema_of(out)
-    except Exception as e:
+    except Exception as e:  # graftlint: ok[broad-except] — ANALYZE's
+        # contract is to RETURN the partially-annotated report with
+        # ok=False/error set, not to lose the measured nodes at the
+        # moment they matter most (see the docstring)
         report.error = e
         report.ok = False
     finally:
@@ -579,6 +608,12 @@ def analyze(op, *args, **kwargs):
             + counters.get("broadcast.rows_sent", 0),
             "syncs": counters.get("trace.sync", 0),
             "host_reads": counters.get("host.read", 0),
+            # resilience visibility (docs/robustness.md): injected
+            # faults, retried transients, and degraded exchanges of the
+            # analyzed run surface at report altitude
+            "faults": counters.get("fault.injected", 0),
+            "retries": counters.get("retry.attempts", 0),
+            "chunked_rounds": counters.get("shuffle.chunked_rounds", 0),
             "counters": counters,
             "phase_ms": trace.phase_totals(),
         }

@@ -117,6 +117,15 @@ def optimistic_dispatch(hints: dict, key, dispatch, cnt_dev, post):
         return dispatch(need), need, counts
     _abort_if_poisoned()  # don't pile device work onto a doomed attempt
     hint = hint_value(hints, key)
+    if hint is not None:
+        # fault point (docs/robustness.md): an installed FaultPlan may
+        # shrink the hint, forcing the undersized-dispatch validation /
+        # replay machinery to run.  An undersized hint is always safe —
+        # steps 2-3 below (or the deferred flush) detect and redo it —
+        # and the hints dict itself is never polluted (update_size_hint
+        # records the TRUE need).
+        from .. import faults
+        hint = faults.perturb("compact.hint", hint)
     if hint is not None and _deferred.depth > 0:
         result = dispatch(hint)
         _deferred.pending.append((hints, key, hint, cnt_dev, post))
@@ -143,9 +152,16 @@ def _read_counts(cnt_dev):
     import jax
     import numpy as np
 
-    from .. import trace
+    from .. import faults, resilience, trace
     trace.count("host.read")  # one blocking count read (sync-floor unit)
-    return np.asarray(jax.device_get(cnt_dev))
+
+    def attempt():
+        faults.check("compact.read_counts")
+        return np.asarray(jax.device_get(cnt_dev))
+
+    # the read is side-effect-free, so a transient transfer failure
+    # (tunneled backend blip, injected chaos) is safely re-tried
+    return resilience.retry_call(attempt, point="compact.read_counts")
 
 
 class _DeferredState(threading.local):
@@ -153,9 +169,30 @@ class _DeferredState(threading.local):
         self.depth = 0
         self.pending = []
         self.ok = True
+        self.flushing = False
 
 
 _deferred = _DeferredState()
+
+
+def in_flush() -> bool:
+    """True while flush_pending_with is walking queued posts — a post
+    that wants to signal a degraded dispatch (shuffle's over-budget
+    path) must not raise from inside the batch walk; it calls
+    :func:`invalidate_flush` instead and the region replays."""
+    return _deferred.flushing
+
+
+def invalidate_flush() -> None:
+    """Fail the current flush/region WITHOUT marking downstream counts
+    poisoned: the dispatch that calls this was correctly SIZED (its
+    outputs and every downstream count are valid) but should not have
+    run — shuffle's over-budget case, where the replay must re-enter
+    through the degraded path.  Later queued posts still validate; the
+    region's flush returns False and ``run_pipeline`` replays.  Outside
+    a deferred region this is a no-op by construction: region entry
+    resets the flag and ``_abort_if_poisoned`` only fires at depth > 0."""
+    _deferred.ok = False
 
 
 class ReplayNeeded(Exception):
@@ -232,9 +269,15 @@ def flush_pending_with(extra):
     _deferred.pending = []
     if not batch and not extra:
         return _deferred.ok, []
-    from .. import trace
+    from .. import faults, resilience, trace
     trace.count("host.read")  # ONE batched read for the whole flush
-    values = jax.device_get([cnt for _, _, _, cnt, _ in batch] + list(extra))
+
+    def attempt():
+        faults.check("compact.flush")
+        return jax.device_get([cnt for _, _, _, cnt, _ in batch]
+                              + list(extra))
+
+    values = resilience.retry_call(attempt, point="compact.flush")
     # Entries queue in dispatch order, so everything after the first
     # undersized dispatch computed on truncated inputs — its counts are
     # poisoned (a zero-filled exchange can explode a downstream join
@@ -244,14 +287,18 @@ def flush_pending_with(extra):
     # The failing entry itself is trustworthy: its count came from
     # inputs that validated.
     trusted = _deferred.ok
-    for (hints, key, hint, _, post), v in zip(batch, values):
-        if not trusted:
-            continue
-        need = tuple(post(np.asarray(v)))
-        update_size_hint(hints, key, need)
-        if any(n > h for n, h in zip(need, hint)):
-            _deferred.ok = False
-            trusted = False
+    _deferred.flushing = True
+    try:
+        for (hints, key, hint, _, post), v in zip(batch, values):
+            if not trusted:
+                continue
+            need = tuple(post(np.asarray(v)))
+            update_size_hint(hints, key, need)
+            if any(n > h for n, h in zip(need, hint)):
+                _deferred.ok = False
+                trusted = False
+    finally:
+        _deferred.flushing = False
     return _deferred.ok, values[len(batch):]
 
 
@@ -263,16 +310,35 @@ def run_pipeline(fn, max_attempts: int = 3):
     exported values (the standard shape — build DTables, chain dist ops,
     export at the end — satisfies this).  Steady state is one batched
     count read per pipeline instead of one blocking read per op.
+
+    Observability (docs/robustness.md): every replayed attempt bumps
+    ``pipeline.replays``; exhausting ``max_attempts`` bumps
+    ``pipeline.fallback_plain`` and WARNS loudly before the plain-mode
+    (per-op validated) fallback runs — a pipeline thrashing replays on
+    every call used to be completely invisible.
     """
+    from .. import trace
     for _ in range(max_attempts):
         try:
             with deferred_region():
                 out = fn()
                 ok = flush_pending()
         except ReplayNeeded:
-            continue  # a host boundary detected the undersize mid-attempt
+            # a host boundary detected the undersize mid-attempt
+            trace.count("pipeline.replays")
+            continue
         if ok:
             return out
+        trace.count("pipeline.replays")
+    trace.count("pipeline.fallback_plain")
+    from .. import logging as glog
+    glog.warning(
+        "run_pipeline: %d deferred attempt(s) all required replay — "
+        "falling back to plain per-op validation for this run.  Hints "
+        "were corrected along the way; if this warning recurs on every "
+        "call, the workload's sizes oscillate faster than the grow-fast/"
+        "shrink-slow hint policy converges (docs/robustness.md).",
+        max_attempts)
     return fn()  # hints now corrected; plain mode validates per op
 
 

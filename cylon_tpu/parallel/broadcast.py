@@ -116,12 +116,23 @@ def _gather_fn(mesh, axis: str, cap: int, outcap: int, head_only: bool):
 
 def rows_if_small(dt: DTable, threshold: Optional[int]) -> Optional[int]:
     """Global-row upper bound if ``dt`` provably holds ≤ ``threshold``
-    rows, else None — WITHOUT a host sync (the planner contract above).
+    rows AND its replica fits the memory budget, else None — WITHOUT a
+    host sync (the planner contract above).
 
     ``threshold`` None resolves to the session-wide knob
     (config.broadcast_join_threshold); ≤ 0 disables.  A deferred-select
     mask only removes rows, so the capacity bound stays valid for
     mask-carrying tables (the caller collapses before replicating).
+
+    Budget veto (docs/robustness.md): replicating costs every shard the
+    all_gathered ``[P*cap]`` blocks plus the compacted replica — "small
+    enough to broadcast" must also mean "fits in memory P times over".
+    A veto records itself on the current plan node
+    (``plan_check.annotate``), bumps ``broadcast.budget_veto``, and the
+    caller falls back to the shuffle plan.  The session budget is
+    deterministic, so the planner contract (same decision on every
+    controller / every deferred replay) holds; only an installed
+    FaultPlan — a test-only state — can perturb it per call.
     """
     if threshold is None:
         threshold = broadcast_join_threshold()
@@ -130,9 +141,26 @@ def rows_if_small(dt: DTable, threshold: Optional[int]) -> Optional[int]:
     ch = dt._counts_host
     if ch is not None and dt.pending_mask is None:
         n = int(ch.sum())
-        return n if n <= threshold else None
-    bound = dt.nparts * dt.cap
-    return bound if bound <= threshold else None
+        rows = n if n <= threshold else None
+    else:
+        bound = dt.nparts * dt.cap
+        rows = bound if bound <= threshold else None
+    if rows is None:
+        return None
+    from .. import observe, resilience
+    rbytes = max(observe.row_bytes(
+        [lf for c in dt.columns for lf in (c.data, c.validity)
+         if lf is not None]), 1)
+    outcap = ops_compact.next_bucket(max(rows, 1), minimum=8)
+    priced = (dt.nparts * dt.cap + outcap) * rbytes
+    budget = resilience.exchange_budget()
+    if priced > budget:
+        trace.count("broadcast.budget_veto")
+        plan_check.annotate(
+            broadcast_veto=f"replica would price {priced} B/device "
+                           f"over the {budget} B budget")
+        return None
+    return rows
 
 
 # Replicated blocks by small-side array identity (see module docstring);

@@ -73,6 +73,17 @@ def _resolve_ids(dt: DTable, cols: Sequence[Union[int, str]]) -> List[int]:
     return [dt.column_index(c) for c in cols]
 
 
+def _shuffle_reason(node, default: str = "no side provably under the "
+                                         "broadcast threshold") -> str:
+    """The honest planner reason for a shuffle decision: when the
+    broadcast predicate was budget-vetoed (rows_if_small recorded
+    ``broadcast_veto`` on the node — docs/robustness.md), the side WAS
+    small enough and saying otherwise would mislead the EXPLAIN reader."""
+    if node is not None and "broadcast_veto" in node.info:
+        return "broadcast replica vetoed by the memory budget"
+    return default
+
+
 def _cleared(dt: DTable) -> DTable:
     """A handle on the same blocks with the pending mask dropped — used by
     callers that have already folded the mask into their partition ids
@@ -361,11 +372,12 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig,
     (order an output that needs it with dist_sort, as the TPC-H plans
     do).
     """
-    plan_check.note("dist_join", left, right, how=config.join_type.value,
-                    alg=config.algorithm.value,
-                    dense=dense_key_range is not None or None)
+    node = plan_check.note("dist_join", left, right,
+                           how=config.join_type.value,
+                           alg=config.algorithm.value,
+                           dense=dense_key_range is not None or None)
     if dense_key_range is not None:
-        out = _try_fk_join(left, right, config, dense_key_range)
+        out = _try_fk_join(left, right, config, dense_key_range, node)
         if out is not None:
             return out
     out = _try_broadcast_join(left, right, config)
@@ -375,11 +387,10 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig,
         left, right, config)
     if left.ctx.get_world_size() > 1:
         trace.count("join.shuffle")
-        plan_check.annotate(decision="shuffle",
-                            reason="no side provably under the broadcast "
-                                   "threshold")
+        plan_check.annotate(node, decision="shuffle",
+                            reason=_shuffle_reason(node))
     else:
-        plan_check.annotate(decision="local", reason="world=1")
+        plan_check.annotate(node, decision="local", reason="world=1")
     lsh = _copartition(left, li_keys, alg, splitters)
     rsh = _copartition(right, ri_keys, alg, splitters)
     return _join_copartitioned(lsh, rsh, li_keys, ri_keys,
@@ -468,7 +479,7 @@ def _fk_violations(per_shard):
 
 
 def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
-                 dense_key_range) -> "DTable | None":
+                 dense_key_range, node=None) -> "DTable | None":
     """Run the dense-unique-right-key join if eligible, else None (the
     general path handles every shape; the hint is advisory for dispatch
     but its CONTRACT — unique/non-null/in-range right keys — is enforced)."""
@@ -519,9 +530,10 @@ def _try_fk_join(left: DTable, right: DTable, config: JoinConfig,
             right = broadcast.replicate_table(right)
         else:
             trace.count("join.shuffle")
-            plan_check.annotate(decision="fk-dense+shuffle",
-                                reason="build side not provably small; "
-                                       "modulo co-partition")
+            plan_check.annotate(node, decision="fk-dense+shuffle",
+                                reason=_shuffle_reason(
+                                    node, "build side not provably "
+                                          "small; modulo co-partition"))
             with trace.span("join.shuffle"):
                 left = _shuffle_masked(
                     left, _mod_pids(left, li_keys[0], lo, world))
@@ -1900,8 +1912,9 @@ def _dist_semi_or_anti(left: DTable, right: DTable, left_on, right_on,
         right = broadcast.replicate_table(right)
     elif world > 1:
         plan_check.annotate(node, decision="shuffle",
-                            reason="build-side keys not provably under "
-                                   "the broadcast threshold")
+                            reason=_shuffle_reason(
+                                node, "build-side keys not provably "
+                                      "under the broadcast threshold"))
     else:
         plan_check.annotate(node, decision="local", reason="world=1")
     # presence bits cost R/stride BYTES per shard — gate against the

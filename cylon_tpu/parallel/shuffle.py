@@ -51,6 +51,86 @@ def _bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
 # the fact; undersized hints re-run with correct sizes.
 _block_hints: dict = {}
 
+# Shuffle signatures whose last sized exchange priced OVER the device
+# memory budget (docs/robustness.md): these skip the optimistic dispatch
+# entirely — blocking on the count matrix is the price of not allocating
+# an over-budget exchange — and run the chunked multi-round path until a
+# call prices back under budget (then they self-promote).
+_chunked_keys: set = set()
+
+
+def clear_chunk_state() -> None:
+    """Forget which signatures are degraded (test isolation)."""
+    _chunked_keys.clear()
+
+
+class _OverBudget(Exception):
+    """Raised by the count-protocol post() when the sized single-shot
+    exchange prices over the memory budget — carries the (already-read)
+    count matrix so shuffle_leaves can run the chunked path without a
+    second host read.  Internal control flow, never user-visible."""
+
+    def __init__(self, counts, need, priced):
+        super().__init__(f"exchange priced {priced} B over budget")
+        self.counts = counts
+        self.need = need
+        self.priced = priced
+
+
+def _priced_bytes(nparts: int, sizes, rbytes: int) -> int:
+    """Per-device transient footprint of ONE exchange dispatch: the
+    grouped send buffer ([P, block] rows per leaf) + the all_to_all
+    receive buffer (same shape) + the compacted [outcap] output block,
+    all × the payload width of one row.  The single pricing rule behind
+    both the budget comparison and the ``shuffle.exchange_bytes_peak``
+    watermark (docs/robustness.md derives the chunk math from it)."""
+    block, outcap = sizes
+    return int((2 * nparts * block + outcap) * rbytes)
+
+
+def _account(counts: np.ndarray, rbytes: int) -> None:
+    """Exchange-volume accounting shared by the single-shot post() and
+    the chunked path (docs/observability.md)."""
+    moved = int(counts.sum() - np.trace(counts))
+    trace.count("shuffle.rows_sent", moved)
+    trace.count("shuffle.bytes_sent", moved * rbytes)
+
+
+def _sizes_from_counts(counts: np.ndarray):
+    """counts [P, P] → (block, outcap, per_recv): THE sizing rule for a
+    single-shot exchange, shared by the optimistic post() and the
+    degraded steady-state branch so the two paths can never dispatch
+    different size classes for the same counts (the promotion
+    comparison and the compile-reuse claim both rely on that)."""
+    block = ops_compact.next_bucket(
+        max(int(counts.max(initial=0)), 1), minimum=8)
+    per_recv = counts.sum(axis=0)
+    outcap = ops_compact.next_bucket(
+        max(int(per_recv.max(initial=0)), 1), minimum=8)
+    return block, outcap, per_recv
+
+
+def _warn_skew(Pn: int, hint_key, per_recv: np.ndarray,
+               outcap: int) -> None:
+    """The hot-key-skew warning, rate-limited to ONCE per shuffle
+    signature per session (a skewed query in a loop used to log one line
+    per call).  See docs/tpu_perf_notes.md 'hot-key skew'."""
+    mean_recv = max(float(per_recv.mean()), 1.0)
+    # the 64k floor keeps toy tables (where count noise looks like
+    # skew) quiet; below that size the blowup is bytes, not a hazard
+    if not (Pn > 1 and outcap >= 65536 and outcap > 4 * mean_recv):
+        return
+    from .. import logging as glog
+    glog.warn_once(
+        ("shuffle.skew", hint_key),
+        "skewed exchange: hottest receiver gets %d rows "
+        "(%.1fx the %.0f mean); every shard's receive block is "
+        "bucketed to %d — peak memory ~%.1fx the data. "
+        "See docs/tpu_perf_notes.md 'hot-key skew'. "
+        "(warned once per shuffle signature per session)",
+        int(per_recv.max(initial=0)), per_recv.max() / mean_recv,
+        mean_recv, outcap, outcap / mean_recv)
+
 
 @functools.lru_cache(maxsize=None)
 def _counts_fn(mesh, axis: str, nparts: int):
@@ -134,6 +214,158 @@ def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
     return jax.jit(f)
 
 
+# ---------------------------------------------------------------------------
+# chunked degraded exchange (docs/robustness.md): when the sized single-
+# shot exchange prices over the device memory budget, the rows of every
+# (sender, target) cell are split into K contiguous rank-slices and moved
+# by K bounded all_to_all rounds reusing _exchange_fn, each round's
+# compacted output folded into the final block receiver-side.  The rounds
+# share ONE (block, outcap) size class, so the whole degraded path costs
+# at most three extra compiles (rank, slice, fold) + one exchange shape.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _rank_fn(mesh, axis: str, nparts: int):
+    """pid [P*cap] → per-row rank within its (shard, target) cell.
+
+    rank[i] = |{j < i in the same shard block : pid[j] == pid[i]}| —
+    the stable intra-cell position that round k's slice [k·C, (k+1)·C)
+    selects on.  One argsort, same cost shape as the counts phase."""
+
+    def kernel(pid_blk):
+        cap = pid_blk.shape[0]
+        order = jnp.argsort(pid_blk, stable=True)
+        cnt = jnp.bincount(pid_blk, length=nparts + 1)
+        offs = jnp.concatenate([jnp.zeros((1,), cnt.dtype),
+                                jnp.cumsum(cnt)])[:-1]      # [nparts+1]
+        sorted_pid = jnp.take(pid_blk, order)
+        rank_sorted = (jnp.arange(cap, dtype=jnp.int32)
+                       - jnp.take(offs, sorted_pid).astype(jnp.int32))
+        return jnp.zeros((cap,), jnp.int32).at[order].set(rank_sorted)
+
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=P(axis), out_specs=P(axis)))
+
+
+@functools.lru_cache(maxsize=None)
+def _slice_pids_fn(nparts: int):
+    """(pid, rank, lo, hi) → pid with rows outside the [lo, hi) rank
+    slice retargeted to P (dropped by the exchange).  lo/hi are traced
+    operands, so every round of every chunked shuffle shares one
+    compiled program per world size."""
+
+    def f(pid, rank, lo, hi):
+        keep = (rank >= lo) & (rank < hi) & (pid < nparts)
+        return jnp.where(keep, pid, jnp.int32(nparts))
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _fold_fn(mesh, axis: str, incap: int, outcap: int, fresh: bool):
+    """Receiver-side concatenation of one round's compacted output into
+    the final block: per shard, scatter the round's ``rcnt`` valid rows
+    at offset ``acc_cnt`` (rounds land back-to-back — the final block is
+    exactly what the single-shot exchange would have produced, up to
+    intra-shard row order).  ``fresh`` builds the zeroed accumulator for
+    round 0 instead of taking one as input."""
+
+    def scatter(acc, leaf, tgt, keep):
+        x = jnp.where(_bcast(keep, leaf), leaf, jnp.zeros((), leaf.dtype))
+        return acc.at[tgt].set(x, mode="drop")
+
+    if fresh:
+        def kernel(rcnt_blk, rleaves):
+            idx = jnp.arange(incap, dtype=jnp.int32)
+            keep = idx < rcnt_blk[0]
+            tgt = jnp.where(keep, idx, jnp.int32(outcap))
+            outs = tuple(
+                scatter(jnp.zeros((outcap,) + lf.shape[1:], lf.dtype),
+                        lf, tgt, keep) for lf in rleaves)
+            return rcnt_blk, outs
+
+        f = shard_map(kernel, mesh=mesh, in_specs=(P(axis), P(axis)),
+                      out_specs=(P(axis), P(axis)))
+    else:
+        def kernel(acc_cnt_blk, rcnt_blk, acc_leaves, rleaves):
+            idx = jnp.arange(incap, dtype=jnp.int32)
+            keep = idx < rcnt_blk[0]
+            tgt = jnp.where(keep, acc_cnt_blk[0] + idx, jnp.int32(outcap))
+            outs = tuple(scatter(acc, lf, tgt, keep)
+                         for acc, lf in zip(acc_leaves, rleaves))
+            return acc_cnt_blk + rcnt_blk, outs
+
+        f = shard_map(kernel, mesh=mesh,
+                      in_specs=(P(axis),) * 4, out_specs=(P(axis), P(axis)))
+    return jax.jit(f)
+
+
+def _chunk_sizes(Pn: int, counts: np.ndarray, rbytes: int, budget: int):
+    """The chunk math (docs/robustness.md): pick the smallest per-round
+    cell cap C such that a round's transient — send [P, bucket(C)] +
+    receive mirror + compacted [outcap_round] — prices within budget,
+    where outcap_round bounds EVERY round by round 0 (per-cell residues
+    ``clip(count − k·C, 0, C)`` are non-increasing in k).  Returns
+    (rounds, C, block, outcap_round); C = 1 is the floor — below it the
+    exchange cannot shrink further and the budget is best-effort."""
+    maxcell = max(int(counts.max(initial=0)), 1)
+    C = maxcell
+    while True:
+        C = max(C // 2, 1)
+        block = ops_compact.next_bucket(C, minimum=8)
+        recv0 = int(np.minimum(counts, C).sum(axis=0).max(initial=0))
+        outcap = ops_compact.next_bucket(max(recv0, 1), minimum=8)
+        if _priced_bytes(Pn, (block, outcap), rbytes) <= budget or C <= 1:
+            break
+    return -(-maxcell // C), C, block, outcap
+
+
+def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
+                      budget: int, outcap_total: int):
+    """Run the K bounded rounds and fold them into the final
+    [P*outcap_total] block.  Peak per-round transient is priced ≤ budget
+    (best-effort once the per-cell floor C=1 is reached); the final
+    block itself is the shuffle's RESULT — the same capacity the
+    single-shot exchange returns — and is not a transient this path can
+    shrink (the uniform-capacity DTable model, docs/tpu_perf_notes.md
+    'hot-key skew')."""
+    mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
+    rounds, C, block, outcap_k = _chunk_sizes(Pn, counts, rbytes, budget)
+    trace.count("shuffle.chunked")
+    trace.count("shuffle.chunked_rounds", rounds)
+    priced_k = _priced_bytes(Pn, (block, outcap_k), rbytes)
+    trace.count_max("shuffle.exchange_bytes_peak", priced_k)
+    if priced_k > budget:
+        from .. import logging as glog
+        glog.warn_once(
+            ("shuffle.budget_floor", ctx.mesh, Pn),
+            "memory budget %d B is below the smallest possible exchange "
+            "round (%d B at 1 row/cell) — running best-effort chunked "
+            "rounds anyway", budget, priced_k)
+    from ..analysis import plan_check
+    plan_check.annotate(
+        degraded=f"chunked shuffle: {rounds} rounds of <= {C} rows/cell "
+                 f"({priced_k} B/round vs {budget} B budget)")
+    with trace.span_sync("shuffle.exchange") as sp:
+        rank = _rank_fn(mesh, axis, Pn)(pid)
+        exchange = _exchange_fn(mesh, axis, Pn, block, outcap_k)
+        slicer = _slice_pids_fn(Pn)
+        acc_cnt = acc = None
+        for k in range(rounds):
+            pid_k = slicer(pid, rank, jnp.int32(k * C),
+                           jnp.int32((k + 1) * C))
+            cnt_k, outs_k = exchange(pid_k, tuple(leaves))
+            if acc is None:
+                acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
+                                        outcap_total, True)(cnt_k, outs_k)
+            else:
+                acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
+                                        outcap_total, False)(
+                    acc_cnt, cnt_k, acc, outs_k)
+        sp.sync(acc)
+    return list(acc), acc_cnt, outcap_total
+
+
 def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
                    ) -> Tuple[List[jax.Array], jax.Array, int]:
     """Repartition rows of sharded ``leaves`` by target ids ``pid``.
@@ -144,15 +376,29 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
 
     reference: cpp/src/cylon/table_api.cpp:214-297 (Shuffle) — here the
     HashPartition+split+AllToAll+concat pipeline is phase1+phase2.
+
+    Memory-budget guardrail (docs/robustness.md): the sized exchange is
+    priced against ``config.device_memory_budget()``; an over-budget
+    exchange (hot-key skew) degrades to a chunked multi-round all_to_all
+    with a bounded per-round transient — identical rows out, with
+    ``shuffle.chunked_rounds`` visible in EXPLAIN ANALYZE.
     """
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
     hint_key = (mesh, Pn, pid.shape[0])
     # payload width of one row across every exchanged leaf (the shared
     # pricing rule behind both byte counters — observe.row_bytes)
-    from .. import observe
-    rbytes = observe.row_bytes(leaves)
+    from .. import observe, resilience
+    from ..analysis._abstract import is_abstract
+    rbytes = max(observe.row_bytes(leaves), 1)
     with trace.span("shuffle.counts"):
         cnt_dev = _counts_fn(mesh, axis, Pn)(pid)  # async dispatch
+    # abstract plan runs (analysis/plan_check) price from zeroed counts
+    # and must never degrade — checked on BOTH pid and the staged count
+    # output (a concrete closure-captured table under an ambient
+    # eval_shape trace has concrete pid but a tracer cnt_dev); the
+    # budget guardrail is a RUNTIME concern
+    budget = None if (is_abstract(pid) or is_abstract(cnt_dev)) \
+        else resilience.exchange_budget()
 
     def dispatch(sizes):
         return _exchange_fn(mesh, axis, Pn, *sizes)(pid, tuple(leaves))
@@ -162,14 +408,8 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
         # post() sees the count matrix in immediate mode AND at the
         # deferred flush, so bench pipelines (run_pipeline) tally the
         # same rows/bytes a blocking run would (docs/observability.md)
-        moved = int(counts.sum() - np.trace(counts))
-        trace.count("shuffle.rows_sent", moved)
-        trace.count("shuffle.bytes_sent", moved * rbytes)
-        block = ops_compact.next_bucket(
-            max(int(counts.max(initial=0)), 1), minimum=8)
-        per_recv = counts.sum(axis=0)
-        outcap = ops_compact.next_bucket(
-            max(int(per_recv.max(initial=0)), 1), minimum=8)
+        _account(counts, rbytes)
+        block, outcap, per_recv = _sizes_from_counts(counts)
         # Skew cliff: EVERY shard's receive block is sized to the HOTTEST
         # receiver (XLA collectives are ragged-free — uniform shapes or
         # nothing), so one hot key/range makes the global arrays ≈ P× the
@@ -179,22 +419,79 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
         # when the skewed exchange is a join moving a small side, the
         # broadcast join skips this shuffle entirely — see broadcast.py
         # and docs/tpu_perf_notes.md "broadcast vs shuffle joins").
-        mean_recv = max(float(per_recv.mean()), 1.0)
-        # the 64k floor keeps toy tables (where count noise looks like
-        # skew) quiet; below that size the blowup is bytes, not a hazard
-        if Pn > 1 and outcap >= 65536 and outcap > 4 * mean_recv:
-            from .. import logging as glog
-            glog.warning(
-                "skewed exchange: hottest receiver gets %d rows "
-                "(%.1fx the %.0f mean); every shard's receive block is "
-                "bucketed to %d — peak memory ~%.1fx the data. "
-                "See docs/tpu_perf_notes.md 'hot-key skew'.",
-                int(per_recv.max(initial=0)), per_recv.max() / mean_recv,
-                mean_recv, outcap, outcap / mean_recv)
-        return (block, outcap)
+        _warn_skew(Pn, hint_key, per_recv, outcap)
+        need = (block, outcap)
+        # memory-budget guardrail (docs/robustness.md): an over-budget
+        # single-shot exchange — the skew case that used to only warn —
+        # degrades to the chunked multi-round path instead of letting
+        # XLA allocate it.  In immediate mode the raise aborts the
+        # dispatch optimistic_dispatch would otherwise launch.  Inside a
+        # deferred flush, raising would corrupt the batch walk: the
+        # hinted dispatch already RAN (its output is valid — hints are
+        # sizes, and over-budget is not undersized), so mark the
+        # signature, fail the flush explicitly, and let the replay
+        # re-enter through the degraded branch below.
+        if budget is not None \
+                and _priced_bytes(Pn, need, rbytes) > budget:
+            _chunked_keys.add(hint_key)
+            if ops_compact.in_flush():
+                ops_compact.invalidate_flush()
+            else:
+                # drop the stale optimism before aborting the dispatch
+                # (in the flush path the caller's update_size_hint
+                # re-records need right after post() returns anyway —
+                # the _chunked_keys gate is what keeps an over-budget
+                # hint from being dispatched; promotion overwrites it)
+                _block_hints.pop(hint_key, None)
+                raise _OverBudget(np.asarray(counts).copy(), need,
+                                  _priced_bytes(Pn, need, rbytes))
+        return need
 
-    with trace.span_sync("shuffle.exchange") as sp:
-        (newcounts, outs), used, counts = ops_compact.optimistic_dispatch(
-            _block_hints, hint_key, dispatch, cnt_dev, post)
-        sp.sync(outs)
+    if hint_key in _chunked_keys and budget is not None:
+        # degraded steady state: skip the optimistic dispatch (its
+        # single-shot program is exactly what blew the budget) and block
+        # on the counts — riding the same batched device_get as any
+        # queued validations in deferred mode — then chunk again or
+        # self-promote
+        if ops_compact.deferred_mode():
+            ok, vals = ops_compact.flush_pending_with((cnt_dev,))
+            if not ok:
+                ops_compact._abort_if_poisoned()
+            counts = np.asarray(vals[0])
+        else:
+            counts = ops_compact._read_counts(cnt_dev)
+        _account(counts, rbytes)
+        block, outcap, per_recv = _sizes_from_counts(counts)
+        _warn_skew(Pn, hint_key, per_recv, outcap)
+        need = (block, outcap)
+        priced = _priced_bytes(Pn, need, rbytes)
+        if priced <= budget:
+            # this call prices back under budget (the data shrank):
+            # promote to the single-shot path and reseed the optimism
+            # for the NEXT same-signature call
+            _chunked_keys.discard(hint_key)
+            _block_hints[hint_key] = (need, 0)
+            trace.count_max("shuffle.exchange_bytes_peak", priced)
+            with trace.span_sync("shuffle.exchange") as sp:
+                newcounts, outs = dispatch(need)
+                sp.sync(outs)
+            return list(outs), newcounts, outcap
+        return _chunked_exchange(ctx, pid, leaves, counts, rbytes,
+                                 budget, outcap)
+
+    try:
+        with trace.span_sync("shuffle.exchange") as sp:
+            (newcounts, outs), used, counts = \
+                ops_compact.optimistic_dispatch(
+                    _block_hints, hint_key, dispatch, cnt_dev, post)
+            sp.sync(outs)
+    except _OverBudget as ob:
+        # the hinted dispatch (if any) was launched before the counts
+        # came back — its result is discarded; the chunked path recovers
+        # with bounded rounds from the counts the exception carries
+        return _chunked_exchange(ctx, pid, leaves, ob.counts, rbytes,
+                                 budget, ob.need[1])
+    if budget is not None:
+        trace.count_max("shuffle.exchange_bytes_peak",
+                        _priced_bytes(Pn, used, rbytes))
     return list(outs), newcounts, used[1]

@@ -1,0 +1,159 @@
+"""Resilience: memory-budget guardrails + bounded retry-with-backoff.
+
+Two halves (docs/robustness.md):
+
+**Memory budget.**  ``config.set_device_memory_budget(bytes)`` bounds
+the per-device transient footprint an exchange may price
+(:func:`exchange_budget` is the engine-side read, with the
+``resilience.budget`` fault point applied so chaos runs can simulate
+allocation pressure).  The consumers:
+
+  * ``parallel/shuffle.shuffle_leaves`` prices every sized exchange
+    (send block + receive capacity × ``observe.row_bytes``) against the
+    budget and degrades an over-budget exchange — the hot-key-skew case
+    that previously only WARNED before XLA allocated ~P× the data — to
+    a chunked multi-round exchange with a bounded per-round peak
+    (arXiv:2112.01075's decomposition, adapted to ``lax.all_to_all``).
+  * ``parallel/broadcast.rows_if_small`` vetoes a broadcast whose
+    replica would not fit ("small enough to broadcast" must also mean
+    "fits in memory P times over", the budget-aware planner arm of
+    arXiv:2212.13732) — the join falls back to the shuffle plan, with
+    the veto recorded via ``plan_check.annotate``.
+
+**Bounded retry.**  :func:`retrying` / :func:`retry_call` wrap the
+transient-classed failure boundaries (host count reads, the batched
+deferred flush, CSV IO) with an attempt cap and exponential backoff.
+Classification is type-based: :class:`faults.TransientFault` plus
+``ConnectionError``/``TimeoutError``/``InterruptedError`` retry;
+everything else — including :class:`faults.PermanentFault` and
+``FileNotFoundError`` — propagates immediately.  Retries bump
+``retry.attempts``; an exhausted loop bumps ``retry.exhausted`` and
+re-raises the last transient error.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+from . import config, faults
+from .status import Code, CylonError, Status
+
+__all__ = [
+    "RetryPolicy", "retry_policy", "set_retry_policy", "retry_call",
+    "retrying", "exchange_budget",
+]
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Attempt cap + exponential backoff for one transient boundary.
+
+    ``max_attempts`` counts TOTAL tries (1 = no retry).  Delays grow
+    ``base_delay_s * multiplier**k`` capped at ``max_delay_s`` — bounded
+    by construction, no unbounded spin (the failure mode the reference's
+    missing fault tolerance would have had nothing to say about)."""
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.005
+    multiplier: float = 2.0
+    max_delay_s: float = 0.25
+    transient_types: Tuple[Type[BaseException], ...] = (
+        faults.TransientFault, ConnectionError, TimeoutError,
+        InterruptedError)
+
+    def __post_init__(self):
+        if not isinstance(self.max_attempts, int) or self.max_attempts < 1:
+            raise CylonError(Status(Code.Invalid,
+                f"max_attempts must be a positive int, "
+                f"got {self.max_attempts!r}"))
+
+    def is_transient(self, exc: BaseException) -> bool:
+        if isinstance(exc, faults.PermanentFault):
+            return False
+        return isinstance(exc, self.transient_types)
+
+
+_policy = RetryPolicy()
+
+
+def retry_policy() -> RetryPolicy:
+    """The session-wide default policy."""
+    return _policy
+
+
+def set_retry_policy(policy: RetryPolicy) -> RetryPolicy:
+    """Swap the session default; returns the previous policy (callers
+    restore it in a finally — the same A/B idiom as the config knobs)."""
+    global _policy
+    if not isinstance(policy, RetryPolicy):
+        raise CylonError(Status(Code.Invalid,
+            f"expected a RetryPolicy, got {type(policy).__name__}"))
+    prev = _policy
+    _policy = policy
+    return prev
+
+
+def retry_call(fn: Callable, *, point: str = "",
+               policy: Optional[RetryPolicy] = None):
+    """Run ``fn()`` under ``policy`` (default: the session policy).
+
+    Transient-classed failures are retried with backoff up to the
+    attempt cap; each retry bumps ``retry.attempts``.  A non-transient
+    error — or the last transient one once attempts are exhausted
+    (``retry.exhausted``) — propagates unchanged.
+    """
+    from . import logging as glog
+    from . import trace
+
+    pol = policy if policy is not None else _policy
+    delay = pol.base_delay_s
+    for attempt in range(1, pol.max_attempts + 1):
+        try:
+            return fn()
+        except BaseException as e:
+            if not pol.is_transient(e):
+                raise
+            if attempt >= pol.max_attempts:
+                trace.count("retry.exhausted")
+                glog.warning(
+                    "retry exhausted after %d attempt(s) at %s: %s",
+                    attempt, point or "<boundary>", e)
+                raise
+            trace.count("retry.attempts")
+            glog.vlog(1, "transient failure at %s (attempt %d/%d), "
+                         "retrying in %.0f ms: %s",
+                      point or "<boundary>", attempt, pol.max_attempts,
+                      min(delay, pol.max_delay_s) * 1e3, e)
+            if delay > 0:
+                time.sleep(min(delay, pol.max_delay_s))
+            delay *= pol.multiplier
+
+
+def retrying(policy: Optional[RetryPolicy] = None) -> Callable:
+    """Decorator form of :func:`retry_call`::
+
+        @resilience.retrying()
+        def read_counts(...): ...
+    """
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(lambda: fn(*args, **kwargs),
+                              point=getattr(fn, "__qualname__", ""),
+                              policy=policy)
+        return wrapper
+
+    return deco
+
+
+def exchange_budget() -> int:
+    """The effective per-device memory budget for one exchange, in
+    bytes: the config knob (explicit, env, or auto-detected — see
+    ``config.device_memory_budget``) with the ``resilience.budget``
+    fault point applied, so an installed FaultPlan can shrink it
+    mid-query (simulated allocation pressure)."""
+    return max(int(faults.perturb("resilience.budget",
+                                  config.device_memory_budget())), 1)

@@ -119,8 +119,13 @@ def _narrow_host(npv: np.ndarray, t: Type, col_name: str):
     logical-type downgrade when lossless, error otherwise); floats narrow
     with a warning (precision loss is the expected trade on TPU).
     Returns (host_array, effective_logical_type).
+
+    Warnings go through ``glog.warn_once`` keyed per (column, dtype) —
+    the engine's one warning channel (re-ingesting the same frame in a
+    loop logs one line per column, not one per call); the rest of the
+    tree logs through glog too, so capture/filtering is uniform.
     """
-    import warnings
+    from . import logging as glog
 
     if npv.dtype.itemsize == 8 and not jax.config.jax_enable_x64:
         if npv.dtype.kind in "iu":
@@ -133,14 +138,16 @@ def _narrow_host(npv: np.ndarray, t: Type, col_name: str):
                     f"column {col_name!r}: 64-bit values do not fit in 32 bits "
                     f"and jax_enable_x64 is off — enable x64 or use 32-bit data"))
             eff = {Type.INT64: Type.INT32, Type.UINT64: Type.UINT32}.get(t, t)
-            warnings.warn(
-                f"column {col_name!r}: narrowing {npv.dtype} to 32-bit "
-                "(jax_enable_x64 is off)", stacklevel=3)
+            glog.warn_once(
+                ("table.narrow", col_name, str(npv.dtype)),
+                "column %r: narrowing %s to 32-bit (jax_enable_x64 is "
+                "off)", col_name, npv.dtype)
             return npv.astype(narrow), eff
         if npv.dtype.kind == "f":
-            warnings.warn(
-                f"column {col_name!r}: narrowing float64 to float32 "
-                "(jax_enable_x64 is off)", stacklevel=3)
+            glog.warn_once(
+                ("table.narrow", col_name, str(npv.dtype)),
+                "column %r: narrowing float64 to float32 "
+                "(jax_enable_x64 is off)", col_name)
             return npv.astype(np.float32), \
                 Type.FLOAT if t == Type.DOUBLE else t
     return npv, t

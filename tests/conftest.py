@@ -53,6 +53,20 @@ if os.environ.get("CYLON_SANITIZE", "0") not in ("", "0"):
     from cylon_tpu import config as _cylon_config
     _cylon_config.sanitize()
 
+# CYLON_CHAOS=<seed> runs the whole suite under a seeded default fault
+# plan (cylon_tpu.faults.FaultPlan.default, mirroring the sanitizer
+# hook above): transient host-read/IO failures inject and are retried,
+# optimistic-dispatch hints are forced undersized and replayed, and the
+# memory budget shrinks under simulated allocation pressure (degrading
+# over-budget shuffles to the chunked exchange).  The acceptance gate is
+# the TPC-H correctness suite staying green; observability tests that
+# assert EXACT counter values may see replay-inflated counters under
+# chaos (docs/robustness.md).
+_chaos = os.environ.get("CYLON_CHAOS", "")
+if _chaos not in ("", "0"):
+    from cylon_tpu import faults as _cylon_faults
+    _cylon_faults.install(_cylon_faults.FaultPlan.default(int(_chaos)))
+
 
 def pytest_configure(config):
     # the tier-1 gate runs `-m 'not slow'`; register the marker so the

@@ -1,0 +1,163 @@
+"""Deferred-region edge cases (ops/compact.py): nested regions, an
+exception mid-region clearing the pending queue, ``flush_pending_with``
+on an empty batch, and the poisoned-prefix skip — the contract points
+the resilience subsystem leans on (docs/robustness.md).
+
+These tests drive ``optimistic_dispatch`` with synthetic dispatch/post
+closures so each contract point is pinned in isolation (the end-to-end
+shapes live in test_pipeline.py / test_resilience.py).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cylon_tpu.ops import compact as ops_compact
+from cylon_tpu.ops.compact import (ReplayNeeded, deferred_mode,
+                                   deferred_region, flush_pending,
+                                   flush_pending_with, optimistic_dispatch)
+
+
+def _queue(hints, key, hint, counts_value, post):
+    """Queue one synthetic optimistic dispatch (hint present + deferred
+    mode ⇒ validation is deferred).  dispatch() just echoes its sizes;
+    ``counts_value`` is the device array the flush will read."""
+    hints[key] = (tuple(hint), 0)
+    return optimistic_dispatch(hints, key, lambda sizes: sizes,
+                               jnp.asarray(np.asarray(counts_value)), post)
+
+
+def _post_need(need, calls=None):
+    def post(counts):
+        if calls is not None:
+            calls.append(np.asarray(counts).copy())
+        return tuple(need)
+    return post
+
+
+def test_nested_regions_flush_at_outer_exit():
+    hints = {}
+    with deferred_region():
+        with deferred_region():
+            res, used, counts = _queue(hints, "k", (8,), [4], _post_need((4,)))
+            assert counts is None and used == (8,)  # queued, not blocked
+        # inner exit must NOT flush or clear: the validation still pends
+        assert deferred_mode()
+        assert len(ops_compact._deferred.pending) == 1
+        assert flush_pending() is True
+        assert ops_compact._deferred.pending == []
+    assert not deferred_mode()
+
+
+def test_nested_region_exception_clears_pending_at_outer_exit():
+    """compact.py's except branch clears only at depth 1: an exception
+    escaping the INNER region leaves the queue for the outer region's
+    handler, and escaping the OUTER region clears it — no stale entries
+    pin device buffers or poison a later unrelated flush."""
+    hints = {}
+    with pytest.raises(ValueError):
+        with deferred_region():
+            with pytest.raises(ValueError):
+                with deferred_region():
+                    _queue(hints, "k", (8,), [4], _post_need((4,)))
+                    raise ValueError("inner")
+            # inner exception did not clear (depth was 2)...
+            assert len(ops_compact._deferred.pending) == 1
+            raise ValueError("outer")
+    # ...the outer one did (depth 1)
+    assert ops_compact._deferred.pending == []
+    assert flush_pending() is True  # and no stale not-ok leaks either
+
+
+def test_exception_mid_region_clears_pending():
+    hints = {}
+    with pytest.raises(RuntimeError):
+        with deferred_region():
+            _queue(hints, "k", (8,), [4], _post_need((4,)))
+            assert len(ops_compact._deferred.pending) == 1
+            raise RuntimeError("boom")
+    assert ops_compact._deferred.pending == []
+    assert not deferred_mode()
+    # a later flush outside any region is a clean no-op
+    ok, extra = flush_pending_with(())
+    assert ok is True and extra == []
+
+
+def test_failed_region_does_not_leak_not_ok_to_depth_zero():
+    hints = {}
+    with deferred_region():
+        _queue(hints, "k", (8,), [4], _post_need((16,)))  # undersized
+        assert flush_pending() is False
+    # region exit resets ok: DTable.head's not-ok branch outside a
+    # region must not observe a stale failure
+    assert flush_pending() is True
+
+
+def test_flush_pending_with_empty_batch_fetches_extra():
+    ok, vals = flush_pending_with((jnp.arange(3), jnp.int32(7)))
+    assert ok is True
+    np.testing.assert_array_equal(np.asarray(vals[0]), [0, 1, 2])
+    assert int(vals[1]) == 7
+
+
+def test_flush_pending_with_empty_batch_and_no_extra():
+    assert flush_pending_with(()) == (True, [])
+
+
+def test_poisoned_prefix_skips_downstream_posts():
+    """Entries queued after the first undersized dispatch computed on
+    truncated inputs: their posts must NOT run (compact.py:246-254) —
+    a contract-validating post would raise a spurious hard error on the
+    garbage — and the undersized entry's own hint is still corrected."""
+    hints = {}
+    calls_a = []
+
+    def poisoned_post(counts):
+        raise AssertionError("post ran on poisoned counts")
+
+    with deferred_region():
+        _queue(hints, "a", (8,), [32], _post_need((32,), calls_a))
+        _queue(hints, "b", (8,), [4], poisoned_post)
+        ok, extra = flush_pending_with((jnp.int32(5),))
+        assert ok is False
+        # the failing entry itself is trustworthy: its post ran and its
+        # hint grew to the observed need
+        assert len(calls_a) == 1
+        assert hints["a"][0] == (32,)
+        assert hints["b"][0] == (8,)  # skipped: untouched
+        # the caller's extra payload still rides the same batched read
+        assert int(extra[0]) == 5
+        # the pending queue drained even though validation failed
+        assert ops_compact._deferred.pending == []
+        # a host boundary inside the failed attempt aborts for replay
+        with pytest.raises(ReplayNeeded):
+            ops_compact._abort_if_poisoned()
+
+
+def test_poison_skip_resumes_validation_on_next_region():
+    """After a replay the region starts clean: the previously-skipped
+    entry's post runs on sound inputs."""
+    hints = {}
+    calls_b = []
+    with deferred_region():
+        _queue(hints, "a", (8,), [32], _post_need((32,)))  # undersized
+        _queue(hints, "b", (8,), [4], _post_need((4,), calls_b))
+        assert flush_pending() is False
+        assert calls_b == []  # skipped this attempt
+    with deferred_region():  # the replay
+        _queue(hints, "a", (32,), [32], _post_need((32,)))
+        _queue(hints, "b", (8,), [4], _post_need((4,), calls_b))
+        assert flush_pending() is True
+        assert len(calls_b) == 1
+
+
+def test_no_hint_mid_region_resolves_queued_upstream_first():
+    """An op with NO hint must flush queued validations before sizing
+    itself — and must abort for replay when that flush exposes an
+    undersized upstream dispatch (the counts it would have used are
+    poisoned)."""
+    hints = {}
+    with deferred_region():
+        _queue(hints, "a", (8,), [32], _post_need((32,)))  # undersized
+        with pytest.raises(ReplayNeeded):
+            optimistic_dispatch({}, "nohint", lambda sizes: sizes,
+                                jnp.asarray([1]), _post_need((1,)))

@@ -109,6 +109,49 @@ def test_shard_map_axis_literal():
     assert _rules(clean) == []
 
 
+def test_broad_except_flags_silent_swallow():
+    pos = "try:\n    x()\nexcept Exception:\n    pass\n"
+    assert _rules(pos) == ["broad-except"]
+    bare = "try:\n    x()\nexcept:\n    pass\n"
+    assert _rules(bare) == ["broad-except"]
+    base = "try:\n    x()\nexcept BaseException:\n    out = None\n"
+    assert _rules(base) == ["broad-except"]
+    tup = "try:\n    x()\nexcept (ValueError, Exception):\n    pass\n"
+    assert _rules(tup) == ["broad-except"]
+
+
+def test_broad_except_reraise_and_specific_are_clean():
+    # convert-and-reraise is the sanctioned broad shape
+    reraise = ("try:\n    x()\nexcept Exception as e:\n"
+               "    raise CylonError(str(e)) from e\n")
+    assert _rules(reraise) == []
+    # catching a SPECIFIC exception never swallows ReplayNeeded
+    spec = "try:\n    x()\nexcept ValueError:\n    pass\n"
+    assert _rules(spec) == []
+    # a conditional re-raise inside the handler also counts
+    cond = ("try:\n    x()\nexcept Exception as e:\n"
+            "    if bad(e):\n        raise\n    log(e)\n")
+    assert _rules(cond) == []
+    # ...but a raise inside a NESTED function never runs as part of the
+    # handler and must not exempt it
+    nested = ("try:\n    x()\nexcept Exception:\n"
+              "    def _cleanup():\n        raise RuntimeError('x')\n"
+              "    pass\n")
+    assert _rules(nested) == ["broad-except"]
+
+
+def test_broad_except_suppression_on_the_except_line():
+    src = ("try:\n    x()\n"
+           "except Exception:  # graftlint: ok[broad-except]\n"
+           "    pass\n")
+    assert _rules(src) == []
+    # a suppression buried in the handler BODY must not waive it (the
+    # finding is narrowed to the except line, like function findings)
+    buried = ("try:\n    x()\nexcept Exception:\n"
+              "    y = 1  # graftlint: ok[broad-except]\n")
+    assert _rules(buried) == ["broad-except"]
+
+
 def test_bare_suppression_waives_all_rules():
     assert _rules("x = v.item()  # graftlint: ok\n") == []
 

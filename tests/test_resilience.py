@@ -1,0 +1,666 @@
+"""Resilience subsystem: memory-budget guardrails (chunked degraded
+shuffle, broadcast veto), deterministic fault injection, bounded
+retry-with-backoff, and pipeline replay observability
+(docs/robustness.md).
+
+The acceptance shape: a skewed exchange forced over budget produces
+row-for-row identical results to the single-shot shuffle with the peak
+priced bytes bounded; a seeded FaultPlan injecting transient failures
+and forced-undersized hints leaves every TPC-H query correct with
+``retry.exhausted == 0``; a permanent-classed fault surfaces as a typed
+CylonError naming its fault point.
+"""
+import io
+import sys
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import CylonError, Table, config, faults, resilience, trace
+from cylon_tpu import logging as glog
+from cylon_tpu.config import JoinAlgorithm, JoinConfig, JoinType
+from cylon_tpu.ops import compact as ops_compact
+from cylon_tpu.parallel import DTable, dist_join, run_pipeline, shuffle_table
+from cylon_tpu.parallel import dist_ops as dops
+from cylon_tpu.parallel import shuffle as shmod
+from cylon_tpu.resilience import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _counters_and_clean_state():
+    """Counter-only tracing for every test here, plus teardown of the
+    module-level resilience state (degraded signatures, warn-once keys,
+    fault plans must never leak into later tests).  A session-wide
+    CYLON_CHAOS plan is restored, not dropped."""
+    session_plan = faults.plan()
+    trace.enable_counters()
+    trace.reset()
+    yield
+    trace.disable_counters()
+    trace.reset()
+    shmod.clear_chunk_state()
+    glog.reset_warn_once()
+    if session_plan is not None:
+        faults.install(session_plan)
+    else:
+        faults.uninstall()
+
+
+def _skewed_dtable(dctx, n=40_000, seed=3):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 1 << 16, n).astype(np.int32)
+    k[: n // 2] = 7  # hot key: half of all rows land on ONE shard
+    df = pd.DataFrame({"k": k, "v": rng.random(n, dtype=np.float32)})
+    return DTable.from_table(dctx, Table.from_pandas(dctx, df))
+
+
+def _sorted_frame(dt: DTable) -> pd.DataFrame:
+    return (dt.to_table().to_pandas().sort_values(["k", "v"])
+            .reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# memory budget knob
+# ---------------------------------------------------------------------------
+
+def test_budget_knob_validation():
+    for bad in (0, -1, 1.5, True, "1g"):
+        with pytest.raises(CylonError):
+            config.set_device_memory_budget(bad)
+    prev = config.set_device_memory_budget(1 << 20)
+    try:
+        assert config.device_memory_budget() == 1 << 20
+    finally:
+        config.set_device_memory_budget(prev)
+
+
+def test_budget_auto_detection_positive():
+    prev = config.set_device_memory_budget(None)
+    try:
+        b = config.device_memory_budget()
+        assert isinstance(b, int) and b >= 1 << 20
+        assert config.device_memory_budget() == b  # detection is cached
+    finally:
+        config.set_device_memory_budget(prev)
+
+
+def test_budget_env_override(monkeypatch):
+    prev = config.set_device_memory_budget(None)
+    try:
+        monkeypatch.setenv("CYLON_MEMORY_BUDGET", "123456789")
+        assert config.device_memory_budget() == 123456789
+        monkeypatch.setenv("CYLON_MEMORY_BUDGET", "nope")
+        with pytest.raises(CylonError):
+            config.device_memory_budget()
+        monkeypatch.setenv("CYLON_MEMORY_BUDGET", "0")
+        with pytest.raises(CylonError):  # zero rejected like the setter
+            config.device_memory_budget()
+        # an explicit knob beats the env var
+        config.set_device_memory_budget(42 << 10)
+        assert config.device_memory_budget() == 42 << 10
+    finally:
+        config.set_device_memory_budget(prev)
+
+
+def test_budget_fault_point_shrinks_effective_budget():
+    prev = config.set_device_memory_budget(1 << 30)
+    try:
+        plan = faults.FaultPlan(0, [faults.FaultRule(
+            "resilience.budget", kind="value", probability=1.0,
+            mutate=lambda b: 123)])
+        with faults.active(plan):
+            assert resilience.exchange_budget() == 123
+        assert plan.injected == 1
+        assert resilience.exchange_budget() == 1 << 30
+    finally:
+        config.set_device_memory_budget(prev)
+
+
+# ---------------------------------------------------------------------------
+# chunked degraded shuffle (the tentpole acceptance test)
+# ---------------------------------------------------------------------------
+
+BUDGET = 200_000  # between one chunked round (~123 KB) and the
+#                   single-shot skewed exchange (~500 KB) at n=40k
+
+
+def test_chunked_shuffle_parity_and_bounded_peak(dctx):
+    dt = _skewed_dtable(dctx)
+    base = shuffle_table(dt, ["k"])
+    base_frame = _sorted_frame(base)
+    base_counts = np.asarray(base.counts_host())
+
+    trace.reset()
+    prev = config.set_device_memory_budget(BUDGET)
+    try:
+        shmod.clear_chunk_state()
+        out = shuffle_table(_skewed_dtable(dctx), ["k"])
+        snap = trace.snapshot()
+    finally:
+        config.set_device_memory_budget(prev)
+    c = snap["counters"]
+    assert c.get("shuffle.chunked", 0) >= 1
+    assert c.get("shuffle.chunked_rounds", 0) > 1
+    # peak priced transient stayed within the budget
+    assert 0 < snap["watermarks"]["shuffle.exchange_bytes_peak"] <= BUDGET
+    # row-for-row identical: same per-shard counts, same sorted rows
+    np.testing.assert_array_equal(np.asarray(out.counts_host()),
+                                  base_counts)
+    pd.testing.assert_frame_equal(_sorted_frame(out), base_frame)
+
+
+def test_chunked_steady_state_and_promotion(dctx):
+    dt = _skewed_dtable(dctx)
+    base_frame = _sorted_frame(shuffle_table(dt, ["k"]))
+    prev = config.set_device_memory_budget(BUDGET)
+    try:
+        shmod.clear_chunk_state()
+        shuffle_table(_skewed_dtable(dctx), ["k"])  # degrades
+        assert shmod._chunked_keys
+        trace.reset()
+        out = shuffle_table(_skewed_dtable(dctx), ["k"])  # steady state
+        c = trace.counters()
+        assert c.get("shuffle.chunked", 0) == 1
+        pd.testing.assert_frame_equal(_sorted_frame(out), base_frame)
+    finally:
+        config.set_device_memory_budget(prev)
+    # budget restored: the signature self-promotes back to single-shot
+    trace.reset()
+    out = shuffle_table(_skewed_dtable(dctx), ["k"])
+    assert not shmod._chunked_keys
+    assert trace.counters().get("shuffle.chunked", 0) == 0
+    pd.testing.assert_frame_equal(_sorted_frame(out), base_frame)
+
+
+def test_chunked_shuffle_inside_deferred_pipeline(dctx):
+    dt = _skewed_dtable(dctx)
+    base_frame = _sorted_frame(shuffle_table(dt, ["k"]))
+    prev = config.set_device_memory_budget(BUDGET)
+    try:
+        shmod.clear_chunk_state()
+        shuffle_table(_skewed_dtable(dctx), ["k"])  # degrade first
+        out = run_pipeline(
+            lambda: _sorted_frame(shuffle_table(_skewed_dtable(dctx),
+                                                ["k"])))
+        pd.testing.assert_frame_equal(out, base_frame)
+        assert ops_compact._deferred.pending == []
+    finally:
+        config.set_device_memory_budget(prev)
+
+
+def test_deferred_adequate_hint_over_budget_replays_chunked(dctx):
+    """The hint-was-adequate gap: a signature's hint seeded under a
+    generous budget, budget then lowered, next call deferred.  The
+    hinted dispatch is correctly SIZED (no undersize to trip on), so
+    post() must fail the flush explicitly (compact.invalidate_flush)
+    and the replay must re-enter through the chunked branch."""
+    dt = _skewed_dtable(dctx)
+    base_frame = _sorted_frame(shuffle_table(dt, ["k"]))  # seeds big hint
+    prev = config.set_device_memory_budget(BUDGET)
+    try:
+        shmod.clear_chunk_state()
+        trace.reset()
+        out = run_pipeline(
+            lambda: _sorted_frame(shuffle_table(_skewed_dtable(dctx),
+                                                ["k"])))
+        c = trace.counters()
+    finally:
+        config.set_device_memory_budget(prev)
+    pd.testing.assert_frame_equal(out, base_frame)
+    assert c.get("pipeline.replays", 0) >= 1
+    assert c.get("shuffle.chunked", 0) >= 1
+    assert c.get("shuffle.chunked_rounds", 0) > 1
+
+
+def test_chunked_rounds_visible_in_analyze(dctx):
+    dt = _skewed_dtable(dctx)
+    prev = config.set_device_memory_budget(BUDGET)
+    try:
+        shmod.clear_chunk_state()
+        rep = dt.explain(lambda t: shuffle_table(t, ["k"]).to_table(),
+                         analyze=True)
+    finally:
+        config.set_device_memory_budget(prev)
+    assert rep.ok
+    assert rep.totals["chunked_rounds"] > 1
+    assert "chunked rounds" in str(rep)
+
+
+def test_skew_warning_rate_limited_per_signature(dctx):
+    """A skewed query in a loop logs the skew warning ONCE per shuffle
+    signature per session (previously one line per call)."""
+    n = 140_000  # past the 64k outcap floor the warning requires
+    rng = np.random.default_rng(5)
+    k = rng.integers(0, 1 << 20, n).astype(np.int32)
+    k[: n * 3 // 4] = 11
+    df = pd.DataFrame({"k": k})
+
+    sink = io.StringIO()
+    glog.set_sink(sink)
+    try:
+        for _ in range(3):
+            dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+            shuffle_table(dt, ["k"])
+    finally:
+        glog.set_sink(sys.stderr)
+    assert sink.getvalue().count("skewed exchange") == 1
+
+
+def test_plan_check_unaffected_by_tiny_budget(dctx, rng):
+    """Abstract plan runs price from zeroed counts and must never
+    degrade, whatever the budget knob says."""
+    df = pd.DataFrame({"k": rng.integers(0, 50, 300).astype(np.int32),
+                       "v": rng.random(300).astype(np.float32)})
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    prev = config.set_device_memory_budget(1 << 20)
+    try:
+        shmod.clear_chunk_state()
+        rep = dt.explain(lambda t: shuffle_table(t, ["k"]), validate=True)
+        assert rep.ok
+        assert not shmod._chunked_keys
+    finally:
+        config.set_device_memory_budget(prev)
+
+
+# ---------------------------------------------------------------------------
+# broadcast budget veto
+# ---------------------------------------------------------------------------
+
+def test_broadcast_budget_veto_falls_back_to_shuffle(dctx, rng):
+    small = pd.DataFrame({"k": np.arange(200, dtype=np.int32),
+                          "name": rng.random(200).astype(np.float32)})
+    big = pd.DataFrame({"k": rng.integers(0, 200, 5000).astype(np.int32),
+                        "v": rng.random(5000).astype(np.float32)})
+    sdt = DTable.from_table(dctx, Table.from_pandas(dctx, small))
+    bdt = DTable.from_table(dctx, Table.from_pandas(dctx, big))
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+
+    out = dist_join(bdt, sdt, cfg)
+    want = _join_frame(out)
+    c = trace.counters()
+    assert c.get("join.broadcast", 0) == 1  # small side broadcasts
+
+    trace.reset()
+    prev = config.set_device_memory_budget(2_000)  # replica can't fit
+    try:
+        shmod.clear_chunk_state()
+        sdt2 = DTable.from_table(dctx, Table.from_pandas(dctx, small))
+        bdt2 = DTable.from_table(dctx, Table.from_pandas(dctx, big))
+        out2 = dist_join(bdt2, sdt2, cfg)
+        got = _join_frame(out2)
+        c = trace.counters()
+    finally:
+        config.set_device_memory_budget(prev)
+        shmod.clear_chunk_state()
+    assert c.get("broadcast.budget_veto", 0) >= 1
+    assert c.get("join.broadcast", 0) == 0
+    assert c.get("join.shuffle", 0) == 1
+    pd.testing.assert_frame_equal(got, want)
+
+
+def _join_frame(dt: DTable) -> pd.DataFrame:
+    df = dt.to_table().to_pandas()
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def test_broadcast_veto_annotated_in_plan(dctx, rng):
+    small = pd.DataFrame({"k": np.arange(100, dtype=np.int32),
+                          "w": rng.random(100).astype(np.float32)})
+    big = pd.DataFrame({"k": rng.integers(0, 100, 3000).astype(np.int32),
+                        "v": rng.random(3000).astype(np.float32)})
+    sdt = DTable.from_table(dctx, Table.from_pandas(dctx, small))
+    bdt = DTable.from_table(dctx, Table.from_pandas(dctx, big))
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+    prev = config.set_device_memory_budget(1_000)  # vetoes BOTH sides
+    try:
+        shmod.clear_chunk_state()
+        rep = bdt.explain(lambda t: dist_join(t, sdt, cfg), validate=True)
+    finally:
+        config.set_device_memory_budget(prev)
+    assert rep.ok
+    join_nodes = [n for n in rep.nodes if n.op == "dist_join"]
+    assert join_nodes and "broadcast_veto" in join_nodes[0].info
+    assert join_nodes[0].info.get("decision") == "shuffle"
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+def test_fault_rule_validation():
+    with pytest.raises(CylonError):
+        faults.FaultRule("x", kind="weird")
+    with pytest.raises(CylonError):
+        faults.FaultRule("x", kind="value")  # value needs mutate
+
+
+def _fire_pattern(seed, n=64, p=0.3):
+    plan = faults.FaultPlan(seed, [faults.FaultRule("pt", probability=p)])
+    pat = []
+    with faults.active(plan):
+        for _ in range(n):
+            try:
+                faults.check("pt")
+                pat.append(0)
+            except faults.TransientFault:
+                pat.append(1)
+    return pat
+
+
+def test_fault_plan_seeded_determinism():
+    a, b = _fire_pattern(7), _fire_pattern(7)
+    assert a == b and sum(a) > 0
+    assert _fire_pattern(7) != _fire_pattern(8)
+
+
+def test_fault_triggers_nth_once_limit():
+    plan = faults.FaultPlan(0, [faults.FaultRule("a", nth=3),
+                                faults.FaultRule("b", once=True),
+                                faults.FaultRule("c", limit=2)])
+    with faults.active(plan):
+        fired_a = [i for i in range(6) if _fires("a")]
+        fired_b = [i for i in range(4) if _fires("b")]
+        fired_c = [i for i in range(5) if _fires("c")]
+    assert fired_a == [2]          # exactly the 3rd call
+    assert fired_b == [0]          # at most once
+    assert fired_c == [0, 1]       # capped at 2 fires
+    assert plan.injected == 4
+
+
+def _fires(point) -> bool:
+    try:
+        faults.check(point)
+        return False
+    except faults.FaultError:
+        return True
+
+
+def test_permanent_fault_surfaces_typed_error_naming_point(ctx, tmp_path):
+    from cylon_tpu.io import read_csv
+
+    p = tmp_path / "t.csv"
+    p.write_text("a,b\n1,2\n3,4\n")
+    plan = faults.FaultPlan(0, [faults.FaultRule("io.csv.read",
+                                                 kind="permanent")])
+    with faults.active(plan):
+        with pytest.raises(faults.PermanentFault) as ei:
+            read_csv(ctx, str(p))
+    assert isinstance(ei.value, CylonError)
+    assert "io.csv.read" in str(ei.value)
+    # without the plan the same read succeeds — and an injected
+    # TRANSIENT fault is absorbed by the retry boundary
+    plan2 = faults.FaultPlan(0, [faults.FaultRule("io.csv.read", nth=1)])
+    with faults.active(plan2):
+        t = read_csv(ctx, str(p))
+    assert t.num_rows == 2 and plan2.injected == 1
+
+
+def test_transient_count_read_fault_is_retried(dctx, rng):
+    df = pd.DataFrame({"k": rng.integers(0, 20, 200).astype(np.int32)})
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    want = _sorted_col(shuffle_table(dt, ["k"]))
+    plan = faults.FaultPlan(1, [
+        faults.FaultRule("compact.read_counts", probability=0.5, limit=2)])
+    prev = resilience.set_retry_policy(RetryPolicy(max_attempts=5,
+                                                   base_delay_s=0.0))
+    try:
+        with faults.active(plan):
+            dt2 = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+            got = _sorted_col(shuffle_table(dt2, ["k"]))
+    finally:
+        resilience.set_retry_policy(prev)
+    np.testing.assert_array_equal(got, want)
+    c = trace.counters()
+    assert c.get("retry.exhausted", 0) == 0
+    if plan.injected:
+        assert c.get("retry.attempts", 0) >= plan.injected
+        assert c.get("fault.injected", 0) == plan.injected
+
+
+def _sorted_col(dt: DTable) -> np.ndarray:
+    return np.sort(dt.to_table().to_pandas()["k"].to_numpy())
+
+
+def test_forced_undersized_hint_redoes_correctly(dctx, rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 10, 500).astype(np.int32),
+                        "v": rng.random(500).astype(np.float32)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 10, 400).astype(np.int32),
+                        "w": rng.random(400).astype(np.float32)})
+    left = DTable.from_table(dctx, Table.from_pandas(dctx, ldf))
+    right = DTable.from_table(dctx, Table.from_pandas(dctx, rdf))
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+    want = _join_frame(dist_join(left, right, cfg))  # seeds hints
+    plan = faults.FaultPlan(2, [faults.FaultRule(
+        "compact.hint", kind="value", probability=1.0,
+        mutate=faults.undersize_hint, limit=6)])
+    with faults.active(plan):
+        left2 = DTable.from_table(dctx, Table.from_pandas(dctx, ldf))
+        right2 = DTable.from_table(dctx, Table.from_pandas(dctx, rdf))
+        got = _join_frame(dist_join(left2, right2, cfg))
+    assert plan.injected >= 1
+    pd.testing.assert_frame_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# bounded retry-with-backoff
+# ---------------------------------------------------------------------------
+
+def test_retry_transient_then_success():
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise faults.TransientFault("unit.test")
+        return 42
+
+    pol = RetryPolicy(max_attempts=5, base_delay_s=0.0)
+    assert resilience.retry_call(fn, policy=pol) == 42
+    assert calls["n"] == 3
+    assert trace.counters().get("retry.attempts", 0) == 2
+
+
+def test_retry_exhausted_bumps_counter_and_raises():
+    def fn():
+        raise faults.TransientFault("unit.test")
+
+    pol = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+    sink = io.StringIO()
+    glog.set_sink(sink)
+    try:
+        with pytest.raises(faults.TransientFault):
+            resilience.retry_call(fn, point="unit.test", policy=pol)
+    finally:
+        glog.set_sink(sys.stderr)
+    c = trace.counters()
+    assert c.get("retry.attempts", 0) == 2       # retries before giving up
+    assert c.get("retry.exhausted", 0) == 1
+    assert "retry exhausted" in sink.getvalue()
+
+
+def test_retry_permanent_and_unrelated_errors_propagate_immediately():
+    calls = {"n": 0}
+
+    def perm():
+        calls["n"] += 1
+        raise faults.PermanentFault("unit.test")
+
+    with pytest.raises(faults.PermanentFault):
+        resilience.retry_call(perm, policy=RetryPolicy(base_delay_s=0.0))
+    assert calls["n"] == 1
+
+    def valueerr():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        resilience.retry_call(valueerr,
+                              policy=RetryPolicy(base_delay_s=0.0))
+    assert calls["n"] == 2
+    assert trace.counters().get("retry.attempts", 0) == 0
+
+
+def test_retry_policy_validation_and_decorator():
+    with pytest.raises(CylonError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(CylonError):
+        resilience.set_retry_policy("nope")
+
+    calls = {"n": 0}
+
+    @resilience.retrying(RetryPolicy(max_attempts=4, base_delay_s=0.0))
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise ConnectionError("blip")
+        return x * 2
+
+    assert flaky(21) == 42 and calls["n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# pipeline replay observability
+# ---------------------------------------------------------------------------
+
+def _mk_pipe_tables(dctx, rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 10, 500).astype(np.int32),
+                        "v": rng.random(500).astype(np.float32)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 10, 400).astype(np.int32),
+                        "w": rng.random(400).astype(np.float32)})
+    return (DTable.from_table(dctx, Table.from_pandas(dctx, ldf)),
+            DTable.from_table(dctx, Table.from_pandas(dctx, rdf)))
+
+
+def _sabotage_join_hints():
+    sab = False
+    for key in list(dops._capacity_hints):
+        if key[3] == "inner" and key[4] == "sort":
+            dops._capacity_hints[key] = ((8,), 0)
+            sab = True
+    return sab
+
+
+def test_pipeline_replays_counted(dctx, rng):
+    left, right = _mk_pipe_tables(dctx, rng)
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+
+    def query():
+        return dist_join(left, right, cfg).to_table().num_rows
+
+    want = query()  # seed hints
+    assert _sabotage_join_hints()
+    trace.reset()
+    got = run_pipeline(query)
+    assert got == want
+    assert trace.counters().get("pipeline.replays", 0) >= 1
+    assert trace.counters().get("pipeline.fallback_plain", 0) == 0
+
+
+def test_pipeline_fallback_plain_counted_and_warned(dctx, rng):
+    left, right = _mk_pipe_tables(dctx, rng)
+    cfg = JoinConfig(JoinType.INNER, JoinAlgorithm.SORT, 0, 0)
+
+    def query():
+        # re-sabotage on EVERY attempt: the deferred validation can never
+        # come back clean, so run_pipeline must fall back to plain mode
+        _sabotage_join_hints()
+        return dist_join(left, right, cfg).to_table().num_rows
+
+    want = dist_join(left, right, cfg).to_table().num_rows  # seed hints
+    trace.reset()
+    sink = io.StringIO()
+    glog.set_sink(sink)
+    try:
+        got = run_pipeline(query, max_attempts=2)
+    finally:
+        glog.set_sink(sys.stderr)
+    assert got == want
+    c = trace.counters()
+    assert c.get("pipeline.replays", 0) >= 2
+    assert c.get("pipeline.fallback_plain", 0) == 1
+    assert "plain per-op validation" in sink.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# chaos: TPC-H under a seeded default FaultPlan
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_data():
+    from cylon_tpu.tpch import generate
+
+    return generate(0.002, seed=7)
+
+
+def _tpch_tables(dctx, data):
+    return {name: DTable.from_pandas(dctx, df)
+            for name, df in data.items()}
+
+
+def _chaos_frame(t: Table) -> pd.DataFrame:
+    df = t.to_pandas()
+    for c in df.columns:
+        if isinstance(df[c].dtype, pd.CategoricalDtype):
+            df[c] = df[c].astype(str)
+    keys = [c for c in df.columns
+            if not pd.api.types.is_float_dtype(df[c])] or list(df.columns)
+    return df.sort_values(keys, kind="mergesort").reset_index(drop=True)
+
+
+def _assert_chaos_equal(got: pd.DataFrame, want: pd.DataFrame, qname):
+    assert list(got.columns) == list(want.columns), qname
+    assert len(got) == len(want), qname
+    for c in got.columns:
+        if pd.api.types.is_float_dtype(want[c]):
+            np.testing.assert_allclose(
+                got[c].to_numpy(np.float64), want[c].to_numpy(np.float64),
+                rtol=1e-5, err_msg=f"{qname}.{c}")
+        else:
+            assert got[c].astype(str).tolist() \
+                == want[c].astype(str).tolist(), f"{qname}.{c}"
+
+
+def _run_chaos(dctx, data, qnames, seed):
+    from cylon_tpu.tpch.queries import QUERIES
+
+    want = {}
+    tables = _tpch_tables(dctx, data)
+    for q in qnames:
+        want[q] = _chaos_frame(QUERIES[q](dctx, tables))
+    plan = faults.FaultPlan.default(seed)
+    prev = resilience.set_retry_policy(RetryPolicy(max_attempts=6,
+                                                   base_delay_s=0.0))
+    trace.reset()
+    try:
+        with faults.active(plan):
+            tables2 = _tpch_tables(dctx, data)
+            for q in qnames:
+                got = _chaos_frame(QUERIES[q](dctx, tables2))
+                _assert_chaos_equal(got, want[q], q)
+    finally:
+        resilience.set_retry_policy(prev)
+    assert trace.counters().get("retry.exhausted", 0) == 0
+    return plan
+
+
+def test_chaos_tpch_smoke(dctx, tpch_data):
+    """Two representative queries under the default chaos plan with a
+    seed chosen to inject early — correctness must be unaffected and no
+    retry loop may exhaust."""
+    plan = _run_chaos(dctx, tpch_data, ["q1", "q6"], seed=11)
+    # the plan consulted its points; firing depends on the seed, so only
+    # sanity-check the machinery was exercised
+    assert plan._calls.get("compact.read_counts", 0) > 0
+
+
+@pytest.mark.slow
+def test_chaos_tpch_all_queries(dctx, tpch_data):
+    """The full chaos gate: all 22 TPC-H queries under a seeded default
+    FaultPlan — every query completes with correct results and
+    ``retry.exhausted == 0``."""
+    from cylon_tpu.tpch.queries import QUERIES
+
+    plan = _run_chaos(dctx, tpch_data, sorted(QUERIES), seed=1234)
+    assert plan.injected > 0  # 22 queries × default probabilities: fires
