@@ -868,6 +868,7 @@ def main() -> None:
     sf = float(os.environ.get("CYLON_BENCH_TPCH_SF",
                               "10.0" if platform == "tpu" else "0.02"))
     if sf > 0:
+        from cylon_tpu.config import optimizer_enabled
         from cylon_tpu.parallel import run_pipeline
         from cylon_tpu.tpch import queries
         from cylon_tpu.tpch.datagen import date_to_days
@@ -880,6 +881,12 @@ def main() -> None:
         em.detail["tpch_datagen_device_s"] = round(
             time.perf_counter() - t0, 2)
         em.detail.update({"tpch_sf": sf, "tpch_key_dtype": "int32"})
+        # queries run through the logical planner when it's enabled —
+        # the serving-shape measurement (capture + plan-cache hit are
+        # inside the clock); CYLON_OPTIMIZER=0 is the A/B lever that
+        # reverts the whole stage to plain eager execution
+        use_opt = optimizer_enabled()
+        em.detail["tpch_optimizer"] = int(use_opt)
 
         q_ms = {}
         for qname in _QUERY_ORDER:
@@ -898,9 +905,17 @@ def main() -> None:
                 from cylon_tpu.analysis import plan_check
                 t0 = time.perf_counter()
                 try:
+                    # validate the form that will actually run: under
+                    # the optimizer that's the REWRITTEN plan, so a
+                    # rule bug fails here in milliseconds
+                    if use_opt:
+                        from cylon_tpu import plan as planner
+                        qform = (lambda t, q=qfn: planner.run(
+                            ctx, lambda tt: q(ctx, tt), t))
+                    else:
+                        qform = (lambda t, q=qfn: q(ctx, t))
                     prep = plan_check.validate(
-                        lambda t, q=qfn: q(ctx, t), dts,
-                        concrete=("nation", "region"))
+                        qform, dts, concrete=("nation", "region"))
                     em.detail[f"tpch_{qname}_plan_nodes"] = len(prep.nodes)
                 except plan_check.PlanValidationError as e:
                     print(f"tpch {qname} PLAN INVALID: {e}")
@@ -913,11 +928,15 @@ def main() -> None:
                     em.detail["tpch_plan_check_s"]
                     + (time.perf_counter() - t0), 2)
 
-            def run_q():
+            def run_q(optimized=use_opt):
                 # a query is done when its RESULT is host-visible — some
                 # queries return lazily-computed local tables (e.g. the
                 # scalar-aggregate ones), so materialize inside the clock
-                run_pipeline(lambda: qfn(ctx, dts)).to_pandas()
+                if optimized:
+                    run_pipeline(lambda: ctx.optimize(
+                        lambda t: qfn(ctx, t), dts)).to_pandas()
+                else:
+                    run_pipeline(lambda: qfn(ctx, dts)).to_pandas()
 
             try:
                 # counter-only tracing: tally which join path each query
@@ -951,14 +970,53 @@ def main() -> None:
             # exchange volume + host-round-trip accounting from the
             # metrics registry (counter-only mode: no span syncs) — the
             # benchdiff gate's per-query inputs beyond wall-clock
-            em.detail[f"tpch_{qname}_bytes_moved"] = \
-                q_counters.get("shuffle.bytes_sent", 0) \
+            bytes_moved = q_counters.get("shuffle.bytes_sent", 0) \
                 + q_counters.get("broadcast.bytes_sent", 0)
+            em.detail[f"tpch_{qname}_bytes_moved"] = bytes_moved
             em.detail[f"tpch_{qname}_rows_moved"] = \
                 q_counters.get("shuffle.rows_sent", 0) \
                 + q_counters.get("broadcast.rows_sent", 0)
             em.detail[f"tpch_{qname}_host_reads"] = \
                 q_counters.get("host.read", 0)
+            # logical-planner activity of the timed rep: cache hits
+            # prove the rep skipped rewriting; rule fires are replayed
+            # from the cached plan, so every rep reports them
+            em.detail[f"tpch_{qname}_plan_cache_hits"] = \
+                q_counters.get("plan.cache_hit", 0)
+            em.detail[f"tpch_{qname}_optimizer_rule_fires"] = \
+                q_counters.get("optimizer.rule_fires", 0)
+            if use_opt and remaining() > 120:
+                # optimizer-off control: untimed optimized + eager legs
+                # record the bytes the SAME query moves with and without
+                # the planner — tpch_*_optimizer_bytes_saved is the
+                # column benchdiff gates against regressing
+                # (docs/query_planner.md).  Both legs start from a
+                # cleared broadcast replica cache: a replica hit skips
+                # the gather AND its byte accounting, so a cache warmed
+                # by one leg only would fake savings either way.
+                from cylon_tpu.parallel import broadcast as _bc
+                legs = {}
+                try:
+                    _trace.enable_counters()
+                    for leg, flag in (("opt", True), ("noopt", False)):
+                        _bc.clear_replica_cache()
+                        _trace.reset()
+                        run_q(optimized=flag)
+                        nc = _trace.counters()
+                        legs[leg] = nc.get("shuffle.bytes_sent", 0) \
+                            + nc.get("broadcast.bytes_sent", 0)
+                except Exception as e:  # graftlint: ok[broad-except] — the control leg must not kill the bench
+                    print(f"tpch {qname} optimizer control FAILED: "
+                          f"{type(e).__name__}: {str(e)[:200]}",
+                          file=sys.stderr)
+                finally:
+                    _trace.disable_counters()
+                    _trace.reset()
+                if len(legs) == 2:
+                    em.detail[f"tpch_{qname}_bytes_moved_noopt"] = \
+                        legs["noopt"]
+                    em.detail[f"tpch_{qname}_optimizer_bytes_saved"] = \
+                        legs["noopt"] - legs["opt"]
             _progress(f"TPC-H {qname}: {q_t * 1e3:.0f} ms")
             em.emit(f"tpch_{qname}")
 

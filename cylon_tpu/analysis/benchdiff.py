@@ -15,6 +15,11 @@ Gated metrics (relative threshold, default 15%):
   * ``tpch_geomean_vs_pandas`` speedup geomean          (lower = worse)
   * ``tpch_<q>_vs_pandas``     per-query speedup        (lower = worse)
   * ``dist_join_rows_per_sec`` headline throughput      (lower = worse)
+  * ``tpch_<q>_optimizer_bytes_saved``  bytes the logical planner's
+    rewrites keep off the wire vs the eager plan (lower = worse — a
+    rewrite rule silently losing its byte savings fails here even when
+    total ``bytes_moved`` drifted for other reasons;
+    docs/query_planner.md)
 
 A gated metric present in OLD but absent from NEW fails the gate
 outright (``MISSING``): a query that crashed or was skipped emits no ms
@@ -64,6 +69,7 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     (r"tpch_q\d+_vs_pandas$", "down"),
     (r"tpch_geomean_vs_pandas$", "down"),
     (r"dist_join_rows_per_sec$", "down"),
+    (r"tpch_q\d+_optimizer_bytes_saved$", "down"),
 )
 
 
@@ -181,7 +187,8 @@ def diff(old: Dict[str, float], new: Dict[str, float],
         gated = direction is not None
         if gated:  # sub-floor deltas are noise, not signal
             floor = (min_abs_ms if key.endswith("_ms")
-                     else min_abs_bytes if key.endswith("_bytes_moved")
+                     else min_abs_bytes if key.endswith(("_bytes_moved",
+                                                         "_bytes_saved"))
                      else min_abs_reads if key.endswith("_host_reads")
                      else 0.0)
             if abs(n - o) < floor:

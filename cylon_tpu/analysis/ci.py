@@ -1,0 +1,160 @@
+"""ci — the one-command static-analysis gate.
+
+Replaces the three separate invocations the docs used to prescribe
+(graftlint, a plan_check pre-flight, benchdiff) with a single entry
+point that runs them in sequence and aggregates their exit codes::
+
+    python -m cylon_tpu.analysis.ci                      # lint + plan-check
+    python -m cylon_tpu.analysis.ci --baseline OLD.json NEW.json
+    python -m cylon_tpu.analysis.ci --no-plan-check      # lint only (fast)
+
+Stages:
+
+  1. **graftlint** over ``cylon_tpu/`` and ``bench.py`` (resolved from
+     the installed package location, so the command works from any cwd);
+  2. **plan_check pre-flight**: every TPC-H query abstract-interpreted
+     via ``DTable.explain(validate=True)`` against a tiny generated
+     dataset — twice when the optimizer is enabled (eager plan AND the
+     optimized plan through ``plan.run``), so a rewrite-rule bug fails
+     CI in milliseconds instead of a compiled-and-crashed bench stage
+     (``--tpch-sf`` scales the dataset; ``--no-plan-check`` skips);
+  3. **benchdiff** (only when ``--baseline`` and a candidate artifact
+     are given): the bench regression gate, unchanged semantics.
+
+Exit code is the worst across stages under the shared contract: 0 clean,
+1 findings/regressions/plan errors, 2 usage or tooling errors.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["main"]
+
+
+def _repo_paths() -> List[str]:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg)
+    paths = [pkg]
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        paths.append(bench)
+    return paths
+
+
+def _stage_lint() -> int:
+    from . import graftlint
+    print("== ci stage 1/3: graftlint ==")
+    rc = graftlint.main(_repo_paths())
+    print(f"graftlint: exit {rc}")
+    return rc
+
+
+def _stage_plan_check(sf: float) -> int:
+    print("== ci stage 2/3: plan_check pre-flight ==")
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        from .. import plan as planner
+        from ..config import optimizer_enabled
+        from ..context import CylonContext
+        from ..parallel.dtable import DTable
+        from ..tpch import generate
+        from ..tpch.queries import QUERIES
+        from . import plan_check
+
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(sf, seed=7)
+        dts = {name: DTable.from_pandas(ctx, df)
+               for name, df in data.items()}
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing (no jax backend, broken install) is a TOOLING
+        # error, not a plan finding: report it as exit 2, never crash CI
+        print(f"plan_check pre-flight: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    run_optimized = optimizer_enabled()
+    for name in sorted(QUERIES):
+        qfn = QUERIES[name]
+        forms = [("eager", lambda t, q=qfn: q(ctx, t))]
+        if run_optimized:
+            forms.append(("optimized",
+                          lambda t, q=qfn: planner.run(
+                              ctx, lambda tt: q(ctx, tt), t)))
+        for label, op in forms:
+            try:
+                plan_check.validate(op, dts, concrete=("nation", "region"))
+            except plan_check.PlanValidationError as e:
+                print(f"plan_check: {name} [{label}] INVALID: "
+                      f"{str(e)[:300]}", file=sys.stderr)
+                bad += 1
+            except Exception as e:  # graftlint: ok[broad-except] — a
+                # query crashing OUTSIDE the validator (capture bug,
+                # CylonError from a bad column ref) is still a finding:
+                # count it and keep the 0/1/2 exit contract + the
+                # aggregated summary line instead of dying with a
+                # traceback and skipping the remaining stages
+                print(f"plan_check: {name} [{label}] RAISED: "
+                      f"{type(e).__name__}: {str(e)[:300]}",
+                      file=sys.stderr)
+                bad += 1
+    n = len(QUERIES) * (2 if run_optimized else 1)
+    print(f"plan_check: {n - bad}/{n} plans valid "
+          f"({time.perf_counter() - t0:.1f}s, sf={sf}"
+          f"{', optimizer on' if run_optimized else ''})")
+    return 1 if bad else 0
+
+
+def _stage_benchdiff(baseline: str, candidate: str,
+                     threshold: float) -> int:
+    from . import benchdiff
+    print("== ci stage 3/3: benchdiff ==")
+    rc = benchdiff.main([baseline, candidate,
+                         "--threshold", str(threshold)])
+    print(f"benchdiff: exit {rc}")
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cylon_tpu.analysis.ci",
+        description="run graftlint + plan_check pre-flight (+ benchdiff) "
+                    "with aggregated exit codes")
+    ap.add_argument("candidate", nargs="?",
+                    help="NEW bench artifact (needs --baseline)")
+    ap.add_argument("--baseline", help="OLD bench artifact for benchdiff")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="benchdiff regression threshold (default 0.15)")
+    ap.add_argument("--tpch-sf", type=float, default=0.002,
+                    help="TPC-H scale factor for the plan-check "
+                         "pre-flight dataset (default 0.002)")
+    ap.add_argument("--no-plan-check", action="store_true",
+                    help="skip the plan_check pre-flight stage")
+    args = ap.parse_args(argv)
+    if bool(args.baseline) != bool(args.candidate):
+        print("ci: benchdiff needs BOTH --baseline OLD.json and a "
+              "candidate artifact", file=sys.stderr)
+        return 2
+    rcs = [_stage_lint()]
+    if not args.no_plan_check:
+        rcs.append(_stage_plan_check(args.tpch_sf))
+    else:
+        print("== ci stage 2/3: plan_check pre-flight == (skipped)")
+    if args.baseline:
+        rcs.append(_stage_benchdiff(args.baseline, args.candidate,
+                                    args.threshold))
+    else:
+        print("== ci stage 3/3: benchdiff == (no --baseline; skipped)")
+    worst = max(rcs)
+    print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
+          f"(stage exits {rcs} -> {worst})")
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

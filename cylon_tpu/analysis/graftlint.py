@@ -31,6 +31,12 @@ with examples):
                           re-raises — it can swallow ``ReplayNeeded``
                           (breaking deferred-pipeline replay) or a typed
                           ``CylonError`` (docs/robustness.md).
+  dist-op-unlowered       a new ``@plan_check.instrument`` ``dist_*``/
+                          ``shuffle_*`` entry point in cylon_tpu/parallel/
+                          with no lowering case in the plan executor's
+                          LOWERING table (cylon_tpu/plan/executor.py) —
+                          the op would silently fall off the optimized-
+                          plan surface (docs/query_planner.md).
 
 Findings carry ``file:line:col``; suppress a deliberate site with a
 ``# graftlint: ok[rule]`` (or bare ``# graftlint: ok``) comment on any
@@ -61,6 +67,7 @@ RULES = (
     "raw-float64-literal",
     "shard-map-axis-literal",
     "broad-except",
+    "dist-op-unlowered",
 )
 
 # Modules whose job IS the device↔host boundary: ingest, export, the
@@ -188,6 +195,7 @@ class _Linter(ast.NodeVisitor):
         self.module_names = _module_bindings(tree)
         self.visit(tree)
         self._check_factories(tree)
+        self._check_unlowered(tree)
         return [f for f in self.findings if not self._suppressed(f)]
 
     def _suppressed(self, f: Finding) -> bool:
@@ -370,6 +378,35 @@ class _Linter(ast.NodeVisitor):
                                f"hardcoded axis name {arg.value!r} in "
                                f"{leaf}(…) — pass the mesh's axis instead")
 
+    # -- dist-op-unlowered ---------------------------------------------------
+
+    def _check_unlowered(self, tree: ast.Module) -> None:
+        """Every instrumented ``dist_*``/``shuffle_*`` entry point in the
+        parallel layer must have a case in the plan executor's LOWERING
+        table, or the optimizer surface silently loses it as the op
+        surface grows (docs/query_planner.md)."""
+        keys = _lowering_keys(self.path)
+        if keys is None:
+            return  # not a parallel-layer file, or no executor to check
+        for node in tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if not _DIST_OP_RE.match(node.name):
+                continue
+            deco_exprs = [d.func if isinstance(d, ast.Call) else d
+                          for d in node.decorator_list]
+            instrumented = any(_dotted(d) in _INSTRUMENT_DECOS
+                               for d in deco_exprs)
+            if not instrumented:
+                continue
+            if node.name not in keys:
+                self._emit(node, "dist-op-unlowered",
+                           f"distributed op {node.name!r} has no lowering "
+                           "case in cylon_tpu/plan/executor.py LOWERING — "
+                           "add one (plus a CAPTURED_OPS spec in "
+                           "plan/ir.py) so optimized plans keep covering "
+                           "the whole op surface", def_line_only=True)
+
     # -- kernel-factory-unkeyed ----------------------------------------------
 
     def _check_factories(self, tree: ast.Module) -> None:
@@ -426,6 +463,54 @@ class _Linter(ast.NodeVisitor):
                                "is not part of the factory's cache key — "
                                "thread it through the (hashable) factory "
                                "arguments", def_line_only=True)
+
+
+_INSTRUMENT_DECOS = ("plan_check.instrument", "instrument")
+_DIST_OP_RE = re.compile(r"^(dist|shuffle)_[a-z0-9_]+$")
+
+# path of cylon_tpu/plan/executor.py -> frozenset of LOWERING keys (or
+# None when unreadable), keyed with the file's mtime so an edit during a
+# long-lived process invalidates the parse
+_lowering_keys_cache: Dict[str, Tuple[float, Optional[frozenset]]] = {}
+
+
+def _lowering_keys(linted_path: str) -> Optional[frozenset]:
+    """String keys of the plan executor's LOWERING dict, located
+    relative to the linted file (…/cylon_tpu/parallel/X.py →
+    …/cylon_tpu/plan/executor.py).  None when the executor cannot be
+    found/parsed — the rule then stays silent (best-effort, like the
+    symtable arm of kernel-factory-unkeyed)."""
+    norm = linted_path.replace(os.sep, "/")
+    idx = norm.rfind("cylon_tpu/parallel/")
+    if idx < 0:
+        return None
+    exec_path = norm[:idx] + "cylon_tpu/plan/executor.py"
+    try:
+        mtime = os.path.getmtime(exec_path)
+    except OSError:
+        return None
+    hit = _lowering_keys_cache.get(exec_path)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    keys: Optional[frozenset] = None
+    try:
+        with open(exec_path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=exec_path)
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "LOWERING"
+                       for t in node.targets):
+                continue
+            if isinstance(node.value, ast.Dict):
+                keys = frozenset(
+                    k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str))
+    except (OSError, SyntaxError):
+        keys = None
+    _lowering_keys_cache[exec_path] = (mtime, keys)
+    return keys
 
 
 def _has_handler_raise(body) -> bool:

@@ -47,8 +47,8 @@ import numpy as np
 from ._abstract import PlanExportReached, is_abstract
 
 __all__ = ["PlanNode", "PlanReport", "PlanValidationError",
-           "explain", "validate", "note", "annotate", "instrument",
-           "capturing"]
+           "explain", "validate", "note", "annotate", "annotate_at",
+           "capture_index", "instrument", "capturing"]
 
 
 class PlanValidationError(Exception):
@@ -144,6 +144,14 @@ class PlanReport:
         if not self.ok:
             head += " [FAILED]"
         lines = [head]
+        opt = t.get("optimizer")
+        if opt:
+            lines.append(
+                f"  optimizer: {opt.get('rule_fires', 0)} rule fire(s), "
+                f"exchange row-bytes {_fmt_bytes(opt.get('row_bytes_pre', 0))}"
+                f" -> {_fmt_bytes(opt.get('row_bytes_post', 0))}, "
+                f"plan cache {opt.get('cache_hits', 0)} hit(s) / "
+                f"{opt.get('cache_misses', 0)} miss(es)")
         excl = self._exclusive_ms()
         total = sum(excl) or 1.0
         hottest = max(range(len(excl)), key=excl.__getitem__, default=None)
@@ -222,16 +230,50 @@ def annotate(node: Optional[PlanNode] = None, **info) -> None:
     node.info.update({k: v for k, v in info.items() if v is not None})
 
 
+def capture_index() -> Optional[int]:
+    """Index the NEXT noted node will get in the active capture (None
+    outside one).  The plan executor snapshots this before lowering an
+    operator so it can annotate the operator's OWN node afterwards —
+    ``annotate(None)`` would hit whatever nested op noted last."""
+    report: Optional[PlanReport] = getattr(_capture, "report", None)
+    return None if report is None else len(report.nodes)
+
+
+def annotate_at(idx: Optional[int], **info) -> None:
+    """Attach detail to the node recorded at ``idx`` (a prior
+    :func:`capture_index` snapshot).  No-op outside a capture, or when
+    the lowered operator recorded no node of its own (rename, scan)."""
+    report: Optional[PlanReport] = getattr(_capture, "report", None)
+    if report is None or idx is None or idx >= len(report.nodes):
+        return
+    report.nodes[idx].info.update({k: v for k, v in info.items()
+                                   if v is not None})
+
+
 def instrument(fn: Callable) -> Callable:
-    """Decorator on the public distributed ops: under an EXPLAIN ANALYZE
-    run (observe.analyze) each call opens a measurement window whose
-    deltas — wall-clock, rows, exchange bytes, counters — are stitched
-    onto the PlanNode the op's own ``note()`` creates.  Outside an
-    analyze run the wrapper costs one thread-local read (the same budget
-    as ``note`` itself)."""
+    """Decorator on the public distributed ops — the ONE hook three
+    subsystems share:
+
+      * under a lazy-plan capture (``plan.ir.Builder``, installed by
+        ``ctx.optimize`` / ``DTable.explain(optimize=True)``) the call
+        does not execute at all: it is routed to the builder, which
+        records a typed IR node and hands back a ``LogicalTable``;
+      * under an EXPLAIN ANALYZE run (observe.analyze) each call opens a
+        measurement window whose deltas — wall-clock, rows, exchange
+        bytes, counters — are stitched onto the PlanNode the op's own
+        ``note()`` creates.
+
+    Outside both, the wrapper costs two thread-local reads (the same
+    budget class as ``note`` itself).  The capture check comes first:
+    when the plan executor later lowers the optimized DAG it suspends
+    capture, so the re-entrant eager calls take the analyze/plain path
+    and measure/record normally."""
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        lazy = getattr(_capture, "lazy", None)
+        if lazy is not None:
+            return lazy.intercept(fn, args, kwargs)
         state = getattr(_capture, "analyze", None)
         if state is None:
             return fn(*args, **kwargs)

@@ -151,6 +151,40 @@ def device_memory_budget() -> int:
 
 
 # ---------------------------------------------------------------------------
+# logical-plan optimizer switch (docs/query_planner.md): governs whether
+# ``ctx.optimize`` / ``DTable.explain(optimize=True)`` actually capture,
+# rewrite and cache plans, or fall through to plain eager execution.
+# Resolution: explicit set_optimizer_enabled() > CYLON_OPTIMIZER env
+# (default on).  This is the A/B lever bench.py uses for the
+# optimizer-off bytes-moved column.
+# ---------------------------------------------------------------------------
+
+_optimizer_enabled: Optional[bool] = None   # None -> env-resolved
+
+
+def optimizer_enabled() -> bool:
+    """Whether the logical-plan optimizer is active (explicit knob, else
+    ``CYLON_OPTIMIZER`` — any value but ``0``/empty enables)."""
+    if _optimizer_enabled is not None:
+        return _optimizer_enabled
+    return os.environ.get("CYLON_OPTIMIZER", "1") not in ("", "0")
+
+
+def set_optimizer_enabled(on: "Optional[bool]") -> "Optional[bool]":
+    """Set the optimizer switch (``None`` restores env resolution);
+    returns the previous EXPLICIT setting so callers restore it in a
+    ``finally`` — the same contract as ``set_device_memory_budget``."""
+    global _optimizer_enabled
+    if on is not None and not isinstance(on, bool):
+        raise CylonError(Status(Code.Invalid,
+            "optimizer switch must be True, False or None (env-resolved), "
+            f"got {type(on).__name__} {on!r}"))
+    prev = _optimizer_enabled
+    _optimizer_enabled = on
+    return prev
+
+
+# ---------------------------------------------------------------------------
 # sanitizer mode (docs/static_analysis.md): the RUNTIME backstop for the
 # invariants graftlint proves statically.  When on:
 #

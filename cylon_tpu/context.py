@@ -172,6 +172,20 @@ class CylonContext:
         from . import trace
         trace.hard_sync(out)
 
+    def optimize(self, op, tables=None):
+        """Run ``op(tables)`` (or ``op()`` when ``tables`` is None)
+        through the logical query planner: the plan is captured lazily,
+        rewritten (projection pruning, filter pushdown, plan-time join
+        strategy, common-subplan elimination) and executed via the
+        compiled-plan cache — repeated identical queries skip capture
+        tracing, rewriting and strategy re-decisions entirely.  Returns
+        the query's concrete result.  ``CYLON_OPTIMIZER=0`` (or
+        ``config.set_optimizer_enabled(False)``) makes this a plain
+        eager call — the A/B escape hatch.  See docs/query_planner.md.
+        """
+        from . import plan
+        return plan.optimize(self, op, tables)
+
     def analyze(self, op, tables=None):
         """EXPLAIN ANALYZE a plan: run ``op(tables)`` (or ``op()`` when
         ``tables`` is None) for real, once, with every distributed

@@ -151,6 +151,26 @@ METRICS: Dict[str, MetricSpec] = _specs(
     ("pipeline.fallback_plain", COUNTER, "fallbacks",
      "run_pipeline attempts exhausted — the warned plain-mode (per-op "
      "validated) fallback engaged"),
+    # logical query planner (docs/query_planner.md): compiled-plan cache
+    # traffic + rewrite activity of optimized plans
+    ("plan.cache_hit", COUNTER, "hits",
+     "materializations served from the compiled-plan cache (capture "
+     "replayed; no rewrite, no strategy re-decision)"),
+    ("plan.cache_miss", COUNTER, "misses",
+     "materializations that rewrote + compiled a fresh plan"),
+    ("plan.reads_trace", COUNTER, "traces",
+     "referenced-column discovery traces actually run (eval_shape over "
+     "one predicate/expression; cache-hit captures skip these)"),
+    ("optimizer.rule_fires", COUNTER, "fires",
+     "rewrite-rule fires embodied in executed plans (replayed from the "
+     "plan cache on hits, so every run of an optimized plan reports "
+     "the rules that shaped it)"),
+    ("optimizer.row_bytes_pre", COUNTER, "bytes",
+     "summed per-row exchange width of materialized plans BEFORE "
+     "rewriting (the projection-pruning baseline)"),
+    ("optimizer.row_bytes_post", COUNTER, "bytes",
+     "summed per-row exchange width of materialized plans AFTER "
+     "rewriting"),
 )
 
 
@@ -617,4 +637,18 @@ def analyze(op, *args, **kwargs):
             "counters": counters,
             "phase_ms": trace.phase_totals(),
         }
+        # optimized-plan runs (ctx.optimize / explain(optimize=True))
+        # surface the planner's work at report altitude: rule fires,
+        # pre/post exchange pricing, plan-cache traffic — the EXPLAIN
+        # ANALYZE head renders these (docs/query_planner.md)
+        if counters.get("plan.cache_hit", 0) \
+                or counters.get("plan.cache_miss", 0):
+            report.totals["optimizer"] = {
+                "rule_fires": counters.get("optimizer.rule_fires", 0),
+                "row_bytes_pre": counters.get("optimizer.row_bytes_pre", 0),
+                "row_bytes_post": counters.get("optimizer.row_bytes_post",
+                                               0),
+                "cache_hits": counters.get("plan.cache_hit", 0),
+                "cache_misses": counters.get("plan.cache_miss", 0),
+            }
     return report

@@ -211,9 +211,14 @@ def replicate_table(dt: DTable, mode: str = ALL,
     assert dt.pending_mask is None, "collapse the pending mask first"
     plan_check.note("replicate_table", dt, mode=mode)
     abstract = any(is_abstract(c.data) for c in dt.columns)
-    if cache and abstract:
-        # abstract plan run: tracer identities are meaningless across
-        # traces, and caching them would pin trace-internal values
+    # a CONCRETE-leaf table under an ambient abstract trace (a plan-
+    # check run whose ``concrete=`` tables flow into a broadcast, or an
+    # optimizer-pruned scan replicated directly) stages the gather into
+    # that trace — the outputs are tracers even though the inputs are
+    # real arrays.  Caching those would poison the next concrete run
+    # with dead-trace tracers, so the cache gate mirrors the byte-
+    # accounting guard below: concrete leaves AND a clean trace state.
+    if cache and (abstract or not jax.core.trace_state_clean()):
         cache = False
     key = _cache_key(dt, mode) if cache else None
     if cache:

@@ -1235,13 +1235,13 @@ def _dist_groupby_dense(dt: DTable, sh: DTable, kc: DColumn, key_id: int,
                 where, pmask is not None, emit_empty)
     while len(_group_cap_hints) > _GROUP_HINTS_MAX:
         _group_cap_hints.pop(next(iter(_group_cap_hints)))
+    floor = None
     if emit_empty:
         # group count is R/stride (+1 null) by construction — the first
         # dispatch can be sized exactly, no optimistic miss possible
         R_shard = -(-(hi - lo + 1) // stride)
-        _group_cap_hints.setdefault(
-            hint_key, ((ops_compact.next_bucket(R_shard + 1, minimum=8),),
-                       0))
+        floor = ops_compact.next_bucket(R_shard + 1, minimum=8)
+        _group_cap_hints.setdefault(hint_key, ((floor,), 0))
 
     def dispatch(sizes):
         return _dense_phase2_fn(mesh, axis, aggs, sizes[0], lo,
@@ -1256,8 +1256,20 @@ def _dist_groupby_dense(dt: DTable, sh: DTable, kc: DColumn, key_id: int,
             raise CylonError(Status(Code.Invalid,
                 f"dense_key_range ({lo}, {hi}) violated: "
                 f"{int(per_shard[:, 1].sum())} rows carry keys outside it"))
-        return (ops_compact.next_bucket(
-            max(int(per_shard[:, 0].max(initial=0)), 1), minimum=8),)
+        need = ops_compact.next_bucket(
+            max(int(per_shard[:, 0].max(initial=0)), 1), minimum=8)
+        if floor is not None:
+            # emit_empty's out cap is STRUCTURAL (every slot in the range
+            # emits, occupied or not), while per_shard counts only the
+            # occupied groups.  Reporting the occupancy here would let
+            # update_size_hint's shrink-slow policy walk the hint below
+            # the slot count after shrink_after runs of the same query —
+            # and an under-floor dispatch truncates the emitted range
+            # SILENTLY, because the occupancy-based validation can never
+            # exceed a cap-clamped kernel's output.  The floor is the
+            # honest need.
+            need = max(need, floor)
+        return (need,)
 
     with trace.span_sync("groupby.local") as sp:
         ((kd, kv), outs, out_valids, counts_out), used, _ = \

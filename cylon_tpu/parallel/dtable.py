@@ -535,7 +535,8 @@ class DTable:
         return out
 
     def explain(self, plan=None, *, tables=None, validate: bool = False,
-                concrete=(), analyze: bool = False):
+                concrete=(), analyze: bool = False,
+                optimize: bool = False):
         """Describe — and optionally validate or measure — a plan.
 
         ``dt.explain()`` returns a structural summary of the table
@@ -562,6 +563,13 @@ class DTable:
         ``report.output`` holds the query's actual result.  ``validate``
         and ``concrete`` do not apply to an analyze run (the tables are
         already concrete).  See docs/observability.md.
+
+        ``optimize=True`` routes the plan through the logical query
+        planner (docs/query_planner.md) first — both the static and the
+        analyze form then describe the OPTIMIZED plan: rewrite-rule
+        fires appear as ``optimizer=…`` annotations on the affected
+        nodes, and an analyze report's head carries the pre-/post-
+        optimization exchange byte totals and plan-cache hit counts.
         """
         from ..analysis import plan_check
         if plan is None:
@@ -581,10 +589,17 @@ class DTable:
             return (f"DTable[{rows} over {self.nparts} shards, "
                     f"cap={self.cap}{mask}]({cols})")
         target = tables if tables is not None else self
+        op = plan
+        if optimize:
+            from .. import plan as planner
+            ctx = self.ctx
+
+            def op(tgt, _plan=plan, _ctx=ctx):  # noqa: F811 — optimized form
+                return planner.run(_ctx, _plan, tgt)
         if analyze:
             from .. import observe
-            return observe.analyze(plan, target)
-        return plan_check.explain(plan, target, validate=validate,
+            return observe.analyze(op, target)
+        return plan_check.explain(op, target, validate=validate,
                                   concrete=concrete)
 
     def __repr__(self) -> str:

@@ -90,6 +90,19 @@ def _bound_jit_memory():
     jax.clear_caches()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_warn_once():
+    """Per-test warn_once isolation.  The rate limit behind skew /
+    narrowing warnings is session-scoped (cylon_tpu.logging._warned_keys),
+    so a warning fired by one test would silently suppress the SAME
+    key's warning in a later test — whose assertion then fails or passes
+    depending on execution order.  Reset after every test so each test
+    observes its own first fire."""
+    yield
+    from cylon_tpu import logging as glog
+    glog.reset_warn_once()
+
+
 @pytest.fixture(scope="session")
 def ctx():
     """Local (single-device) context."""

@@ -795,6 +795,28 @@ def test_dist_groupby_dense_emit_empty(dctx, rng):
             assert pd.isna(row["min_v"])
 
 
+def test_dist_groupby_dense_emit_empty_repeated_runs_keep_floor(dctx, rng):
+    """Regression: emit_empty's out cap is structural (every slot in the
+    range emits), but the occupancy-based size observation is smaller
+    whenever the range is sparsely occupied.  After ``shrink_after``
+    repeats of the same query the shrink-slow hint policy used to walk
+    the dispatch cap below the slot count — and the under-floor dispatch
+    truncated the emitted range SILENTLY (occupancy validation can never
+    exceed a cap-clamped kernel's output).  TPC-H q13 lost its zero-order
+    customers on the 4th in-process run exactly this way."""
+    df = pd.DataFrame({"k": rng.choice([2, 3, 5, 7, 11, 13, 290], 400)
+                       .astype(np.int64),
+                       "v": rng.normal(size=400)})
+    want_zero = 300 - 7
+    for rep in range(5):  # > shrink_after: the hint must never under-floor
+        dt = dtable_from_pandas(dctx, df)
+        out = dist_groupby(dt, ["k"], [("v", "count")],
+                           dense_key_range=(1, 300), emit_empty=True) \
+            .to_table().to_pandas()
+        assert len(out) == 300, f"run {rep}: emitted range truncated"
+        assert (out["count_v"] == 0).sum() == want_zero, f"run {rep}"
+
+
 def test_dist_groupby_dense_emit_empty_nullable_uneven(dctx, rng):
     """Nullable key + a range shorter than shards·slots: the null group
     must land in the compact prefix (not past ngroups) and short residue

@@ -167,6 +167,57 @@ def test_multiline_expression_suppression():
 # CLI contract + tier-1 self-lint gate
 # ---------------------------------------------------------------------------
 
+def test_dist_op_unlowered_fires_on_uncovered_entry_point():
+    """An instrumented ``dist_*`` entry point in the parallel layer with
+    no case in the plan executor's LOWERING table falls off the
+    optimized-plan surface — the rule keeps the IR total as the op
+    surface grows (docs/query_planner.md)."""
+    path = os.path.join(REPO, "cylon_tpu", "parallel", "zz_fixture.py")
+    pos = ("from ..analysis import plan_check\n"
+           "@plan_check.instrument\n"
+           "def dist_frobnicate(dt):\n"
+           "    return dt\n")
+    assert _rules(pos, path) == ["dist-op-unlowered"]
+    sup = pos.replace(
+        "def dist_frobnicate(dt):",
+        "def dist_frobnicate(dt):  # graftlint: ok[dist-op-unlowered]")
+    assert _rules(sup, path) == []
+    # a lowered op and a plain (uninstrumented) helper both stay quiet
+    covered = pos.replace("dist_frobnicate", "dist_join")
+    assert _rules(covered, path) == []
+    helper = "def dist_helper(dt):\n    return dt\n"
+    assert _rules(helper, path) == []
+    # outside the parallel layer the rule does not apply
+    assert _rules(pos, "fixture.py") == []
+
+
+def test_ci_entry_point(tmp_path):
+    """``python -m cylon_tpu.analysis.ci``: stage aggregation + the
+    usage contract (the plan-check stage itself is covered by the
+    repo-wide run in test_query_planner / the bench pre-flight)."""
+    from cylon_tpu.analysis import ci
+    # benchdiff needs both sides
+    assert ci.main(["--baseline", "old.json"]) == 2
+    # lint-only pass over the real tree is clean (stage 1 exit 0)
+    assert ci.main(["--no-plan-check"]) == 0
+
+
+def test_ci_plan_check_counts_non_validation_crashes(monkeypatch):
+    """A query that crashes OUTSIDE the validator (capture bug, bad
+    column ref raising CylonError) is still a finding: the stage must
+    keep the 0/1/2 exit contract instead of dying with a traceback and
+    skipping the aggregated summary."""
+    from cylon_tpu.analysis import ci
+    from cylon_tpu.status import CylonError, Status, Code
+    from cylon_tpu.tpch import queries
+
+    def qbad(ctx, t):
+        raise CylonError(Status(Code.KeyError, "no column 'nope'"))
+
+    monkeypatch.setattr(queries, "QUERIES", {"qbad": qbad})
+    assert ci._stage_plan_check(0.002) == 1
+
+
 def test_cli_exits_nonzero_on_seeded_violation(tmp_path):
     bad = tmp_path / "seeded.py"
     bad.write_text("import jax.numpy as jnp\n"

@@ -250,6 +250,42 @@ def test_analyze_tpch_query(dctx, tpch_tables, qname):
     assert "EXPLAIN ANALYZE" in str(rep)
 
 
+@pytest.mark.parametrize("qname", ["q3", "q9"])
+def test_analyze_optimized_query(dctx, tpch_tables, qname):
+    """EXPLAIN ANALYZE over ``optimize=True``: the report head carries
+    the pre-/post-rewrite exchange byte totals and plan-cache traffic,
+    rule fires render per node, and every ``plan.*``/``optimizer.*``
+    counter the run bumps is in the documented catalogue."""
+    from cylon_tpu import plan as planner
+    from cylon_tpu.tpch.queries import QUERIES
+
+    planner.clear_plan_cache()
+    qfn = QUERIES[qname]
+    anchor = tpch_tables["lineitem"]
+    rep = anchor.explain(lambda t, q=qfn: q(dctx, t), tables=tpch_tables,
+                         analyze=True, optimize=True)
+    assert rep.ok and rep.analyzed
+    opt = rep.totals["optimizer"]
+    assert opt["rule_fires"] > 0
+    assert 0 < opt["row_bytes_post"] < opt["row_bytes_pre"], \
+        "projection pruning must shrink the priced exchange width"
+    assert opt["cache_misses"] >= 1
+    c = rep.totals["counters"]
+    assert c.get("plan.cache_miss", 0) == opt["cache_misses"]
+    assert c.get("optimizer.rule_fires", 0) == opt["rule_fires"]
+    unknown = set(c) - set(observe.METRICS)
+    assert not unknown, f"undocumented planner metrics {unknown}"
+    # per-node rule fires + the optimizer head line both render
+    assert any("optimizer" in n.info for n in rep.nodes)
+    s = str(rep)
+    assert "optimizer:" in s and "optimizer=" in s
+    # a repeat of the same query replays the compiled plan
+    rep2 = anchor.explain(lambda t, q=qfn: q(dctx, t),
+                          tables=tpch_tables, analyze=True, optimize=True)
+    assert rep2.totals["optimizer"]["cache_hits"] >= 1
+    assert rep2.totals["optimizer"]["rule_fires"] == opt["rule_fires"]
+
+
 # ---------------------------------------------------------------------------
 # benchdiff: the regression gate
 # ---------------------------------------------------------------------------
@@ -294,6 +330,22 @@ def test_benchdiff_improvement_and_noise_pass(tmp_path):
                      "tpch_q1_ms": 100.5,          # sub-floor jitter
                      "tpch_q1_pandas_ms": 2000.0})  # ungated oracle drift
     assert benchdiff.main([old, new]) == 0
+
+
+def test_benchdiff_gates_optimizer_savings(tmp_path, capsys):
+    """tpch_*_optimizer_bytes_saved gates DOWN: a rewrite rule silently
+    losing its byte savings fails the gate; sub-floor wobble passes."""
+    old = _artifact(tmp_path, "old.json",
+                    {"tpch_q3_optimizer_bytes_saved": float(1 << 20)})
+    new = _artifact(tmp_path, "new.json",
+                    {"tpch_q3_optimizer_bytes_saved": 100.0})
+    assert benchdiff.main([old, new]) == 1
+    assert "tpch_q3_optimizer_bytes_saved" in capsys.readouterr().out
+    small_old = _artifact(tmp_path, "so.json",
+                          {"tpch_q3_optimizer_bytes_saved": 20000.0})
+    small_new = _artifact(tmp_path, "sn.json",
+                          {"tpch_q3_optimizer_bytes_saved": 0.0})
+    assert benchdiff.main([small_old, small_new]) == 0
 
 
 def test_benchdiff_missing_gated_metric_fails(tmp_path, capsys):
