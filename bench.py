@@ -429,6 +429,17 @@ def _pandas_tpch(qname: str, data, date_to_days, reps: int = 2,
     return min(ts)
 
 
+def _exchange_count(counters: dict) -> int:
+    """Whole data exchanges of one run — the one definition lives in
+    observe.exchange_count (shared with the multiway parity tests so
+    the CI-gated column and the tests measure the same quantity).  The
+    multiway star-join acceptance column: a fused plan must run
+    strictly fewer of these than its binary-cascade control
+    (docs/query_planner.md)."""
+    from cylon_tpu import observe
+    return observe.exchange_count(counters)
+
+
 def _progress(msg: str) -> None:
     """Timestamped stage marker on stderr (stdout carries only the JSON
     line).  The run crosses a tunneled TPU backend where a single wedged
@@ -967,6 +978,14 @@ def main() -> None:
                 q_counters.get("join.broadcast", 0)
             em.detail[f"tpch_{qname}_join_shuffle_hits"] = \
                 q_counters.get("join.shuffle", 0)
+            # whole exchanges (shuffle dispatches + replica gathers) and
+            # multiway-join fusion activity of the timed rep — benchdiff
+            # gates exchange_count UP, so a planner regression that
+            # re-splits a fused join fails CI (docs/query_planner.md)
+            em.detail[f"tpch_{qname}_exchange_count"] = \
+                _exchange_count(q_counters)
+            em.detail[f"tpch_{qname}_join_multiway_hits"] = \
+                q_counters.get("join.multiway", 0)
             # exchange volume + host-round-trip accounting from the
             # metrics registry (counter-only mode: no span syncs) — the
             # benchdiff gate's per-query inputs beyond wall-clock
@@ -1003,8 +1022,9 @@ def main() -> None:
                         _trace.reset()
                         run_q(optimized=flag)
                         nc = _trace.counters()
-                        legs[leg] = nc.get("shuffle.bytes_sent", 0) \
-                            + nc.get("broadcast.bytes_sent", 0)
+                        legs[leg] = (nc.get("shuffle.bytes_sent", 0)
+                                     + nc.get("broadcast.bytes_sent", 0),
+                                     _exchange_count(nc))
                 except Exception as e:  # graftlint: ok[broad-except] — the control leg must not kill the bench
                     print(f"tpch {qname} optimizer control FAILED: "
                           f"{type(e).__name__}: {str(e)[:200]}",
@@ -1014,9 +1034,19 @@ def main() -> None:
                     _trace.reset()
                 if len(legs) == 2:
                     em.detail[f"tpch_{qname}_bytes_moved_noopt"] = \
-                        legs["noopt"]
+                        legs["noopt"][0]
                     em.detail[f"tpch_{qname}_optimizer_bytes_saved"] = \
-                        legs["noopt"] - legs["opt"]
+                        legs["noopt"][0] - legs["opt"][0]
+                    # the binary-cascade control's exchange count — the
+                    # multiway acceptance pair.  Both control legs run
+                    # from a cleared replica cache, so _opt_control vs
+                    # _noopt is the like-for-like comparison (the gated
+                    # timed-rep exchange_count above is steady-state:
+                    # replica hits skip gathers there)
+                    em.detail[f"tpch_{qname}_exchange_count_noopt"] = \
+                        legs["noopt"][1]
+                    em.detail[f"tpch_{qname}_exchange_count_opt_control"] \
+                        = legs["opt"][1]
             _progress(f"TPC-H {qname}: {q_t * 1e3:.0f} ms")
             em.emit(f"tpch_{qname}")
 

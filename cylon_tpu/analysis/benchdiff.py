@@ -20,6 +20,10 @@ Gated metrics (relative threshold, default 15%):
     rewrite rule silently losing its byte savings fails here even when
     total ``bytes_moved`` drifted for other reasons;
     docs/query_planner.md)
+  * ``tpch_<q>_exchange_count``  whole exchanges run (shuffle
+    dispatches + replica gathers; higher = worse — a planner regression
+    that re-splits a fused multiway join back into a binary cascade
+    adds whole exchanges and fails here)
 
 A gated metric present in OLD but absent from NEW fails the gate
 outright (``MISSING``): a query that crashed or was skipped emits no ms
@@ -70,6 +74,11 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     (r"tpch_geomean_vs_pandas$", "down"),
     (r"dist_join_rows_per_sec$", "down"),
     (r"tpch_q\d+_optimizer_bytes_saved$", "down"),
+    # whole exchanges per query (shuffle dispatches + replica gathers):
+    # deterministic small integers, so any increase — e.g. a planner
+    # regression re-splitting a fused multiway join back into a binary
+    # cascade — clears the relative threshold and fails the gate
+    (r"tpch_q\d+_exchange_count$", "up"),
 )
 
 

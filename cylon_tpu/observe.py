@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "COUNTER", "WATERMARK", "GAUGE", "MetricSpec", "METRICS",
     "MetricsRegistry", "REGISTRY", "export_chrome_trace", "analyze",
+    "exchange_count",
 ]
 
 # ---------------------------------------------------------------------------
@@ -76,6 +77,19 @@ def _specs(*rows: Tuple[str, str, str, str]) -> Dict[str, MetricSpec]:
     return {n: MetricSpec(n, k, u, d) for n, k, u, d in rows}
 
 
+def exchange_count(counters: Dict[str, int]) -> int:
+    """Whole data exchanges of one counter window: two-phase shuffle
+    dispatches (a chunked degraded exchange counts once) plus replica
+    gathers actually executed (replica-cache hits cross no wire and do
+    not count).  THE definition behind bench.py's per-query
+    ``tpch_*_exchange_count`` column and the multiway-join parity
+    tests — one place, so the CI gate and the tests cannot
+    desynchronize."""
+    return (counters.get("shuffle.exchanges", 0)
+            + counters.get("join.broadcast_gather", 0)
+            + counters.get("groupby.broadcast_gather", 0))
+
+
 # Every metric the engine emits.  Names are ``<subsystem>.<what>``; the
 # registry accepts unknown names too (tests, ad-hoc probes), but a
 # TPC-H run must stay inside this catalogue (tests/test_observe.py).
@@ -94,7 +108,25 @@ METRICS: Dict[str, MetricSpec] = _specs(
     ("groupby.broadcast_combine", COUNTER, "combines",
      "groupby combines that replaced the shuffle with one all_gather"),
     ("join.out_rows", COUNTER, "rows", "distributed-join output rows"),
+    # fused multiway (star) joins — partition-once/probe-N
+    # (docs/query_planner.md "multiway join fusion")
+    ("join.multiway", COUNTER, "joins",
+     "fused multiway joins executed (one per dist_multiway_join node)"),
+    ("join.multiway_probes", COUNTER, "probes",
+     "dimension probes run inside multiway joins"),
+    ("join.multiway_dims_broadcast", COUNTER, "dims",
+     "multiway probes served by a replicated side under the effective "
+     "threshold + replica pricing (the dimension, or the small fact "
+     "side of an INNER edge) — no co-partitioning exchange ran"),
+    ("join.multiway_dims_shuffled", COUNTER, "dims",
+     "multiway dimensions that fell back to the per-edge "
+     "co-partitioning shuffle (over threshold or budget-vetoed)"),
     # exchange volume (payload actually crossing the wire)
+    ("shuffle.exchanges", COUNTER, "exchanges",
+     "two-phase shuffle exchanges dispatched (one per shuffle_leaves "
+     "call; a chunked degraded exchange still counts once) — with the "
+     "broadcast gather counters this derives bench's per-query "
+     "exchange_count"),
     ("shuffle.rows_sent", COUNTER, "rows",
      "rows that left their home shard in shuffle exchanges "
      "(off-diagonal of the count matrix)"),

@@ -114,10 +114,14 @@ def _gather_fn(mesh, axis: str, cap: int, outcap: int, head_only: bool):
                              out_specs=(spec, spec), check_vma=False))
 
 
-def rows_if_small(dt: DTable, threshold: Optional[int]) -> Optional[int]:
+def rows_if_small(dt: DTable, threshold: Optional[int],
+                  quiet: bool = False) -> Optional[int]:
     """Global-row upper bound if ``dt`` provably holds ≤ ``threshold``
     rows AND its replica fits the memory budget, else None — WITHOUT a
-    host sync (the planner contract above).
+    host sync (the planner contract above).  ``quiet`` suppresses the
+    veto counter/annotation side effects — for advisory pre-checks
+    (dist_multiway_join's decision counters) that the authoritative
+    re-check inside the join will repeat.
 
     ``threshold`` None resolves to the session-wide knob
     (config.broadcast_join_threshold); ≤ 0 disables.  A deferred-select
@@ -155,10 +159,11 @@ def rows_if_small(dt: DTable, threshold: Optional[int]) -> Optional[int]:
     priced = (dt.nparts * dt.cap + outcap) * rbytes
     budget = resilience.exchange_budget()
     if priced > budget:
-        trace.count("broadcast.budget_veto")
-        plan_check.annotate(
-            broadcast_veto=f"replica would price {priced} B/device "
-                           f"over the {budget} B budget")
+        if not quiet:
+            trace.count("broadcast.budget_veto")
+            plan_check.annotate(
+                broadcast_veto=f"replica would price {priced} B/device "
+                               f"over the {budget} B budget")
         return None
     return rows
 

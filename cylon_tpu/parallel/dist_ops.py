@@ -810,6 +810,163 @@ def _join_copartitioned(lsh: DTable, rsh: DTable, li_keys: Sequence[int],
 
 
 # ---------------------------------------------------------------------------
+# multiway (star) join: partition the fact once, probe every dimension
+# ---------------------------------------------------------------------------
+
+def _multiway_edges(edges) -> list:
+    """Normalize + validate the per-dimension edge specs.  Each edge is
+    ``(how, alg, fact_on, dim_on, dense_key_range, broadcast_threshold,
+    rename)``: join kind ("inner"/"left" — the fact must be the
+    preserved side), distributed algorithm, key NAMES on the running
+    intermediate / the dimension, the optional dense-FK hint, the
+    optional per-edge threshold override, and the (old, new) column
+    rename applied to the probe output (the consumed ``rename`` node of
+    the binary cascade this op replaces)."""
+    out = []
+    for e in edges:
+        how, alg, fact_on, dim_on, dkr, thr, ren = e
+        if how not in ("inner", "left"):
+            raise CylonError(Status(Code.Invalid,
+                f"dist_multiway_join: edge kind {how!r} unsupported — "
+                "the fact side must be preserved (INNER, or LEFT with "
+                "the fact on the left)"))
+        if len(tuple(fact_on)) != len(tuple(dim_on)):
+            raise CylonError(Status(Code.Invalid,
+                "dist_multiway_join: edge key arity mismatch "
+                f"{tuple(fact_on)} vs {tuple(dim_on)}"))
+        out.append((how, alg, tuple(fact_on), tuple(dim_on),
+                    None if dkr is None else (int(dkr[0]), int(dkr[1])),
+                    thr, tuple((o, n) for o, n in ren)))
+    return out
+
+
+def _multiway_threshold(current: DTable, explicit, world: int) -> int:
+    """Per-probe effective broadcast threshold — the partition-once
+    economics (docs/tpu_perf_notes.md "partition-once / probe-N").
+
+    Replicating a dimension of R rows costs R x (P-1) wire rows; the
+    alternative — the per-dimension co-partitioning shuffle — must
+    re-exchange the RUNNING intermediate (~I rows on the wire) plus the
+    dimension.  Replication therefore pays whenever R < I / (P-1), no
+    matter what the session threshold (tuned for binary joins, where
+    the alternative only moves the two join sides) says.  ``I`` is the
+    same sync-free evidence the broadcast planner reads: ingest-cached
+    counts when the intermediate still carries them, else the P*cap
+    capacity bound.  The PR-4 replica pricing
+    (``broadcast.rows_if_small``'s budget veto, docs/robustness.md)
+    keeps the last word on memory — the raised threshold can never
+    admit a replica the budget refuses.  An explicit per-edge 0 (or a
+    disabled session knob) disables broadcasting for the edge, same as
+    ``JoinConfig.broadcast_threshold``."""
+    from ..config import broadcast_join_threshold
+    base = broadcast_join_threshold() if explicit is None else int(explicit)
+    if base <= 0 or world <= 1:
+        return base
+    ch = current._counts_host
+    if ch is not None and current.pending_mask is None:
+        bound = int(np.asarray(ch).sum())
+    else:
+        bound = current.nparts * current.cap
+    return max(base, bound // max(world - 1, 1))
+
+
+def _multiway_rename(dt: DTable, ren) -> DTable:
+    if not ren:
+        return dt
+    m = dict(ren)
+    return dt.rename([m.get(n, n) for n in dt.column_names])
+
+
+@plan_check.instrument
+def dist_multiway_join(fact: DTable, dims: Sequence[DTable],
+                       edges: Sequence) -> DTable:
+    """Fused star join: probe ``fact`` against every dimension in one
+    pass — partition-once/probe-N (arXiv:1905.13376) — instead of the
+    binary cascade's re-exchange of the growing intermediate per join.
+
+    Created by the logical planner's multiway-join rewrite
+    (plan/rules.py; docs/query_planner.md has the detection conditions)
+    from chains of equi-joins sharing a fact side; callable directly
+    with the same edge specs (see :func:`_multiway_edges`).
+
+    Per dimension, in order:
+
+      * **replicate** when the dimension is provably under the edge's
+        EFFECTIVE broadcast threshold — the session knob raised to the
+        re-exchange crossover ``I/(P-1)`` (:func:`_multiway_threshold`)
+        — and its replica fits the PR-4 memory budget
+        (``broadcast.rows_if_small``, re-priced per dimension on EVERY
+        execution, so a plan cached under a large budget degrades
+        correctly when replayed under a smaller one).  The running
+        intermediate then never moves: dense-FK edges probe it in
+        place, general edges run the local sort-merge kernel per shard
+        against the replica.
+      * **fall back** to the ordinary co-partitioning shuffle for that
+        edge otherwise (both sides exchange — the binary-equivalent
+        degraded leg, ``join.multiway_dims_shuffled``).
+
+    Each probe reuses the existing ops/join.py kernels through
+    ``dist_join`` under the effective threshold, so key flavors (int /
+    dictionary / null / composite), LEFT-fact null-filling, deferred
+    select masks and the dense-FK contract behave byte-for-byte like
+    the cascade they replace; EXPLAIN ANALYZE shows one nested node per
+    probe with its row counts.  Counters: ``join.multiway``,
+    ``join.multiway_probes``, ``join.multiway_dims_broadcast`` /
+    ``_shuffled`` (observe catalogue)."""
+    from ..config import JoinType
+    edges = _multiway_edges(edges)
+    if not edges or len(edges) != len(dims):
+        raise CylonError(Status(Code.Invalid,
+            f"dist_multiway_join: {len(dims)} dimension table(s) for "
+            f"{len(edges)} edge spec(s)"))
+    node = plan_check.note("dist_multiway_join", fact, *dims,
+                           probes=len(edges))
+    trace.count("join.multiway")
+    world = fact.ctx.get_world_size()
+    current = fact
+    decisions = []
+    for dim, (how, alg, fact_on, dim_on, dkr, thr, ren) in zip(dims, edges):
+        trace.count("join.multiway_probes")
+        eff = _multiway_threshold(current, thr, world)
+        if world > 1:
+            # advisory pre-check mirroring the probe's strategy order
+            # (quiet: the authoritative re-check — veto counter and
+            # annotation included — runs inside the probe); under an
+            # installed FaultPlan the budget point may flip between the
+            # two reads, skewing ONLY these counters
+            label = None
+            if broadcast.rows_if_small(dim, eff, quiet=True) is not None:
+                label = "broadcast"
+            elif how == "inner" and dkr is None \
+                    and broadcast.rows_if_small(current, eff,
+                                                quiet=True) is not None:
+                # the general INNER path replicates a provably-small
+                # LEFT (running) side instead — a replica probe, not a
+                # co-partitioning exchange.  (A dense hint routes to
+                # the FK path first, which never broadcasts the left
+                # side; if the hint proves ineligible at probe time the
+                # general path may still take this arm — the label is
+                # advisory, the counters below stay directionally
+                # honest: replica vs co-partition.)
+                label = "broadcast-fact"
+            if label is not None:
+                trace.count("join.multiway_dims_broadcast")
+                decisions.append(label)
+            else:
+                trace.count("join.multiway_dims_shuffled")
+                decisions.append("shuffle")
+        else:
+            decisions.append("local")
+        cfg = JoinConfig(JoinType(how), JoinAlgorithm(alg),
+                         fact_on[0] if len(fact_on) == 1 else fact_on,
+                         dim_on[0] if len(dim_on) == 1 else dim_on,
+                         broadcast_threshold=eff)
+        current = _multiway_rename(dist_join(current, dim, cfg, dkr), ren)
+    plan_check.annotate(node, dims="/".join(decisions))
+    return current
+
+
+# ---------------------------------------------------------------------------
 # distributed set ops (reference: DoDistributedSetOperation,
 # table_api.cpp:904-975 — shuffle BOTH tables hashing on ALL columns)
 # ---------------------------------------------------------------------------

@@ -385,6 +385,12 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
     """
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
     hint_key = (mesh, Pn, pid.shape[0])
+    if Pn > 1:
+        # one logical exchange per call (a chunked degraded exchange is
+        # still ONE exchange — its rounds count separately); with the
+        # broadcast gather counters this derives the per-query
+        # exchange_count bench emits (docs/observability.md)
+        trace.count("shuffle.exchanges")
     # payload width of one row across every exchanged leaf (the shared
     # pricing rule behind both byte counters — observe.row_bytes)
     from .. import observe, resilience

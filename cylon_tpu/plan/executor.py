@@ -199,6 +199,12 @@ def _lower_join_streaming(ctx, ins, static, rt):
                                          static["chunks"])
 
 
+def _lower_multiway(ctx, ins, static, rt):
+    from ..parallel import dist_ops
+    return dist_ops.dist_multiway_join(ins[0], list(ins[1:]),
+                                       static["edges"])
+
+
 def _semi_threshold(static):
     planned = static.get("planned")
     if planned is not None and planned[0] == "shuffle":
@@ -280,6 +286,7 @@ LOWERING = {
     "dist_with_column": _lower_with_column,
     "dist_join": _lower_join,
     "dist_join_streaming": _lower_join_streaming,
+    "dist_multiway_join": _lower_multiway,
     "dist_semi_join": _lower_semi,
     "dist_anti_join": _lower_anti,
     "dist_groupby": _lower_groupby,

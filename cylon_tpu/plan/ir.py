@@ -131,6 +131,7 @@ EXCHANGE_OPS = frozenset({
     "shuffle_table", "dist_join", "dist_join_streaming", "dist_semi_join",
     "dist_anti_join", "dist_groupby", "dist_aggregate", "dist_sort",
     "dist_sort_multi", "dist_union", "dist_intersect", "dist_subtract",
+    "dist_multiway_join",
 })
 
 # row-count-preserving ops: plan-time row bounds flow through these
@@ -307,6 +308,24 @@ def infer_schema(op: str, ins: Sequence[Schema], static: Dict) -> Schema:
         out += [ColSpec("rt-" + c.name, c.dtype, c.nullable or rnull,
                         c.dictionary, c.arrow_type) for c in ins[1]]
         return tuple(out)
+    if op == "dist_multiway_join":
+        # fold the fused binary-join schemas forward: per edge the probe
+        # output is [lt-<running>, rt-<dim>] (rt nullable under a
+        # LEFT-fact edge) renamed through the edge's consumed mapping
+        run = tuple(ins[0])
+        for (how, _alg, _lo, _ro, _dkr, _thr, ren), dim in \
+                zip(static["edges"], ins[1:]):
+            rnull = how == "left"
+            joined = [ColSpec("lt-" + c.name, c.dtype, c.nullable,
+                              c.dictionary, c.arrow_type) for c in run]
+            joined += [ColSpec("rt-" + c.name, c.dtype,
+                               c.nullable or rnull, c.dictionary,
+                               c.arrow_type) for c in dim]
+            m = dict(ren)
+            run = tuple(ColSpec(m.get(c.name, c.name), c.dtype,
+                                c.nullable, c.dictionary, c.arrow_type)
+                        for c in joined)
+        return run
     if op in ("dist_union", "dist_intersect", "dist_subtract"):
         return tuple(ColSpec(a.name, a.dtype, a.nullable or b.nullable,
                              a.dictionary, a.arrow_type)
@@ -785,6 +804,8 @@ class Builder:
     def _concrete(self, x):
         if isinstance(x, LogicalTable):
             return x.materialize()
+        if isinstance(x, (list, tuple)):
+            return type(x)(self._concrete(v) for v in x)
         return x
 
     def wrap_tables(self, tables):

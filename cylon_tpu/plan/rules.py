@@ -19,6 +19,17 @@ Rules, in application order:
                         stop seeing the nulls it must veto).  Applied to
                         a fixed point: a select cascades through stacked
                         exchanges down to the scan.
+  multiway join fusion  chains of INNER/LEFT equi-joins sharing a fact
+                        side (directly or through single-consumer
+                        renames over prior join outputs) collapse into
+                        one ``dist_multiway_join`` node: the fact is
+                        partitioned (or replicated-around) ONCE and
+                        every dimension probes the running intermediate
+                        in place — the partition-once/probe-N plan
+                        (arXiv:1905.13376) only this layer can see.
+                        Broadcast-vs-shuffle per dimension is re-priced
+                        against the live memory budget at every
+                        execution, never baked into the cached plan.
   join strategy         broadcast-vs-shuffle decided ONCE at plan time
                         from ingest-cached row counts (`ir.known_rows` —
                         the same sync-free evidence
@@ -284,6 +295,114 @@ def _join_strategy(root: Node, fires: _Fires, world: int) -> Node:
 
 
 # ---------------------------------------------------------------------------
+# multiway (star) join fusion
+# ---------------------------------------------------------------------------
+
+def _compose_renames(maps: List[Dict[str, str]]) -> Dict[str, str]:
+    """Compose a stack of rename mappings, DEEPEST (applied first)
+    last in ``maps`` — returns one old→new mapping equivalent to
+    applying them in order."""
+    comp: Dict[str, str] = {}
+    for m in reversed(maps):  # deepest first
+        new: Dict[str, str] = {}
+        produced = set()
+        for k, v in comp.items():
+            new[k] = m.get(v, v)
+            produced.add(v)
+        for k, v in m.items():
+            if k in produced or k in comp:
+                continue  # k was produced/renamed away by a deeper map
+            new[k] = v
+        comp = {k: v for k, v in new.items() if k != v}
+    return comp
+
+
+def _multiway_fusion(root: Node, fires: _Fires) -> Node:
+    """Collapse chains of fact-preserving equi-joins into one
+    ``dist_multiway_join`` node — the partition-once/probe-N rewrite
+    (docs/query_planner.md "multiway join fusion").
+
+    A chain is a ``dist_join`` whose LEFT (fact) input — through
+    single-consumer ``rename`` nodes, which the fused node absorbs as
+    per-edge output renames — is itself a single-consumer INNER/LEFT
+    ``dist_join``, repeated to any depth.  The rule refuses:
+
+      * RIGHT/FULL edges (the fact side must be the preserved side);
+      * joins or renames with a second consumer — folding them in would
+        re-execute the shared intermediate (the q2 correlated-MIN
+        shape, where the chain output also feeds a groupby, stops the
+        chain exactly there);
+      * single joins (nothing to fuse).
+
+    Per-dimension broadcast-vs-shuffle is NOT decided here: the fused
+    operator re-prices every dimension against the live memory budget
+    at each execution (dist_ops._multiway_threshold +
+    broadcast.rows_if_small), so a cached plan stays budget-correct."""
+    parents: Dict[int, int] = {}
+    for n in ir.topo(root):
+        for c in n.inputs:
+            parents[id(c)] = parents.get(id(c), 0) + 1
+
+    memo: Dict[int, Node] = {}
+
+    def walk(n: Node) -> Node:
+        hit = memo.get(id(n))
+        if hit is not None:
+            return hit
+        out = try_fuse(n)
+        if out is None:
+            out = _clone(n, [walk(i) for i in n.inputs])
+        memo[id(n)] = out
+        return out
+
+    def try_fuse(top: Node) -> Optional[Node]:
+        if top.op != "dist_join" or top.static["how"] not in ("inner",
+                                                              "left"):
+            return None
+        # collect the chain inward: (join, rename applied to its output)
+        chain: List[Tuple[Node, Dict[str, str]]] = [(top, {})]
+        cur = top
+        while True:
+            base = cur.inputs[0]
+            maps: List[Dict[str, str]] = []
+            while base.op == "rename" and parents.get(id(base), 0) == 1:
+                maps.append(dict(base.static["mapping"]))
+                base = base.inputs[0]
+            if (base.op != "dist_join"
+                    or base.static["how"] not in ("inner", "left")
+                    or parents.get(id(base), 0) != 1):
+                break
+            chain.append((base, _compose_renames(maps)))
+            cur = base
+        if len(chain) < 2:
+            return None
+        chain.reverse()  # innermost join first
+        fact = walk(chain[0][0].inputs[0])
+        dims: List[Node] = []
+        edges = []
+        for j, ren in chain:
+            dims.append(walk(j.inputs[1]))
+            s = j.static
+            edges.append((s["how"], s["alg"], tuple(s["left_on"]),
+                          tuple(s["right_on"]), s.get("dense_key_range"),
+                          s.get("broadcast_threshold"),
+                          tuple(sorted(ren.items()))))
+        static = {"edges": tuple(edges)}
+        node = Node("dist_multiway_join", [fact] + dims, static, {},
+                    ir.infer_schema("dist_multiway_join",
+                                    [fact.schema] + [d.schema
+                                                     for d in dims],
+                                    static), None, [], None)
+        fires.fire(node, "multiway-join",
+                   f"fused {len(chain)} binary joins into one "
+                   f"partition-once/probe-{len(dims)} pass "
+                   "(per-dimension replica pricing at execution)")
+        return node
+
+    return walk(root)
+
+
+# ---------------------------------------------------------------------------
 # projection pruning
 # ---------------------------------------------------------------------------
 
@@ -316,6 +435,21 @@ def _required_inputs(node: Node, req: Set[str]) -> List[Set[str]]:
         left = {r[3:] for r in req if r.startswith("lt-")}
         right = {r[3:] for r in req if r.startswith("rt-")}
         return [left | set(s["left_on"]), right | set(s["right_on"])]
+    if node.op == "dist_multiway_join":
+        # walk the demand backward edge by edge: each probe's output is
+        # [lt-<running>, rt-<dim>] through the edge's rename, so invert
+        # the rename, split on the prefix, and carry the running-side
+        # demand (plus the edge keys, which live in the PREVIOUS
+        # stage's name space) down to the next edge
+        need = set(req)
+        dim_needs: List[Set[str]] = []
+        for how, _alg, lon, ron, _dkr, _thr, ren in reversed(s["edges"]):
+            inv = {new: old for old, new in ren}
+            jreq = {inv.get(r, r) for r in need}
+            dim_needs.append({r[3:] for r in jreq if r.startswith("rt-")}
+                             | set(ron))
+            need = {r[3:] for r in jreq if r.startswith("lt-")} | set(lon)
+        return [need] + list(reversed(dim_needs))
     if node.op in ("dist_semi_join", "dist_anti_join"):
         return [req | set(s["left_on"]), set(s["right_on"])]
     if node.op == "dist_groupby":
@@ -459,6 +593,7 @@ def optimize(builder, root: Node) -> Tuple[Node, List[str], int, int]:
     pre = exchange_row_bytes(root)
     world = builder.ctx.get_world_size()
     root = _filter_pushdown(root, fires)
+    root = _multiway_fusion(root, fires)
     root = _join_strategy(root, fires, world)
     root = _projection_pruning(root, fires)
     root = _project_cleanup(root)

@@ -191,6 +191,26 @@ def test_dist_op_unlowered_fires_on_uncovered_entry_point():
     assert _rules(pos, "fixture.py") == []
 
 
+def test_dist_op_unlowered_covers_multiway():
+    """The instrumented ``dist_multiway_join`` entry point must keep its
+    LOWERING case: with it present the fixture is quiet, and an
+    uncovered sibling spelling still fires — the guard that stops the
+    fused-join operator from silently falling off the optimized-plan
+    surface as it evolves."""
+    path = os.path.join(REPO, "cylon_tpu", "parallel", "zz_fixture.py")
+    covered = ("from ..analysis import plan_check\n"
+               "@plan_check.instrument\n"
+               "def dist_multiway_join(fact, dims, edges):\n"
+               "    return fact\n")
+    assert _rules(covered, path) == []
+    uncovered = covered.replace("dist_multiway_join",
+                                "dist_multiway_join_v2")
+    assert _rules(uncovered, path) == ["dist-op-unlowered"]
+    # and the real executor table genuinely carries the key
+    from cylon_tpu.plan.executor import LOWERING
+    assert "dist_multiway_join" in LOWERING
+
+
 def test_ci_entry_point(tmp_path):
     """``python -m cylon_tpu.analysis.ci``: stage aggregation + the
     usage contract (the plan-check stage itself is covered by the

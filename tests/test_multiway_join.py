@@ -1,0 +1,492 @@
+"""Multiway (star) joins: plan-time fusion of binary-join cascades into
+``dist_multiway_join`` — partition-once/probe-N (docs/query_planner.md
+"multiway join fusion").
+
+The contract under test:
+
+  * PARITY — the fused plan is row-identical to the binary cascade it
+    replaces across key flavors (int / dictionary-string / null keys,
+    composite keys), LEFT-fact edges, mixed under/over-threshold
+    dimensions, and an empty dimension side;
+  * EXCHANGES — when the cascade shuffles (dimensions over the binary
+    threshold), the fused op replicates them under the raised
+    partition-once economics instead: strictly fewer whole exchanges
+    and fewer wire bytes, with the running intermediate unmoved;
+  * BUDGET — the per-dimension replica decision is re-priced against
+    the LIVE memory budget at every execution, so a plan cached under a
+    large ``CYLON_MEMORY_BUDGET`` degrades per-dimension to the
+    co-partitioning shuffle when replayed under a smaller one;
+  * REFUSALS — RIGHT-edge joins and chains whose intermediate has a
+    second consumer (the q2 correlated-MIN shape) stay binary.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import JoinAlgorithm, JoinConfig, trace
+from cylon_tpu import config as cfgmod
+from cylon_tpu import plan as planner
+from cylon_tpu.config import JoinType
+from cylon_tpu.parallel import DTable, broadcast, dist_ops
+
+from test_local_ops import assert_same_rows
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    """Fresh plan cache + counter-only tracing + replica cache around
+    every test (the same isolation contract as test_query_planner)."""
+    planner.clear_plan_cache()
+    broadcast.clear_replica_cache()
+    trace.enable_counters()
+    trace.reset()
+    yield
+    trace.disable_counters()
+    trace.reset()
+    planner.clear_plan_cache()
+    broadcast.clear_replica_cache()
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a fact table with two FK columns + two dimension tables
+# ---------------------------------------------------------------------------
+
+N_FACT, N_D1, N_D2 = 6000, 700, 50
+
+
+@pytest.fixture(scope="module")
+def star(dctx):
+    rng = np.random.default_rng(5)
+    fact = DTable.from_pandas(dctx, pd.DataFrame({
+        "fk1": rng.integers(0, N_D1, N_FACT).astype(np.int32),
+        "fk2": rng.integers(0, N_D2, N_FACT).astype(np.int32),
+        "fv": rng.random(N_FACT).astype(np.float32),
+    }))
+    d1 = DTable.from_pandas(dctx, pd.DataFrame({
+        "k1": np.arange(N_D1, dtype=np.int32),
+        "w": rng.random(N_D1).astype(np.float32),
+    }))
+    d2 = DTable.from_pandas(dctx, pd.DataFrame({
+        "k2": np.arange(N_D2, dtype=np.int32),
+        "x": rng.random(N_D2).astype(np.float32),
+    }))
+    return {"fact": fact, "d1": d1, "d2": d2}
+
+
+def _strip(dt):
+    names = []
+    for n in dt.column_names:
+        while n.startswith("lt-") or n.startswith("rt-"):
+            n = n[3:]
+        names.append(n)
+    return dt.rename(names)
+
+
+def _cfg(l, r, how=JoinType.INNER, thr=None):
+    return JoinConfig(how, JoinAlgorithm.SORT, l, r,
+                      broadcast_threshold=thr)
+
+
+def _frame(res) -> pd.DataFrame:
+    if not hasattr(res, "to_pandas"):
+        res = res.to_table()
+    df = res.to_pandas()
+    for c in df.columns:
+        if isinstance(df[c].dtype, pd.CategoricalDtype):
+            df[c] = df[c].astype(str)
+    return df
+
+
+def _exchanges(c: dict) -> int:
+    """Whole exchanges of one run — bench.py's exchange_count column
+    (the shared definition: observe.exchange_count)."""
+    from cylon_tpu.observe import exchange_count
+    return exchange_count(c)
+
+
+def _run_pair(dctx, op, tables):
+    """(eager frame, opt frame, eager counters, opt counters); both legs
+    start from a cleared replica cache so replica hits can't skew the
+    exchange/byte comparison."""
+    out = {}
+    for leg in ("eager", "opt"):
+        broadcast.clear_replica_cache()
+        trace.reset()
+        res = op(tables) if leg == "eager" else dctx.optimize(op, tables)
+        out[leg] = (_frame(res), dict(trace.counters()))
+    (ef, ec), (of, oc) = out["eager"], out["opt"]
+    return ef, of, ec, oc
+
+
+def _chain2(how1=JoinType.INNER, how2=JoinType.INNER):
+    """The TPC-H star idiom: join, strip prefixes, join again."""
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["fact"], t["d1"],
+                                      _cfg("fk1", "k1", how1)))
+        b = dist_ops.dist_join(a, t["d2"], _cfg("fk2", "k2", how2))
+        return dist_ops.dist_project(_strip(b),
+                                     ["fk1", "fk2", "fv", "w", "x"])
+    return op
+
+
+# ---------------------------------------------------------------------------
+# parity across key flavors + fusion evidence
+# ---------------------------------------------------------------------------
+
+def test_multiway_parity_int_keys(dctx, star):
+    ef, of, ec, oc = _run_pair(dctx, _chain2(), star)
+    assert_same_rows(of, ef)
+    assert oc.get("join.multiway", 0) == 1
+    assert oc.get("join.multiway_probes", 0) == 2
+    assert ec.get("join.multiway", 0) == 0
+    assert _exchanges(oc) <= _exchanges(ec)
+
+
+def test_multiway_parity_left_fact_edges(dctx, star, rng):
+    """LEFT edges with the fact preserved: unmatched fact rows survive
+    with null-filled dimension columns on both legs."""
+    half = dist_ops.dist_select(star["d1"], lambda env: env["k1"] < 350)
+
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["fact"], t["half"],
+                                      _cfg("fk1", "k1", JoinType.LEFT)))
+        b = dist_ops.dist_join(a, t["d2"],
+                               _cfg("fk2", "k2", JoinType.LEFT))
+        return _strip(b)
+
+    tables = dict(star, half=half)
+    ef, of, ec, oc = _run_pair(dctx, op, tables)
+    assert len(ef) == N_FACT  # LEFT preserves every fact row
+    assert_same_rows(of, ef)
+    assert oc.get("join.multiway", 0) == 1
+
+
+def test_multiway_parity_dict_string_keys(dctx, rng):
+    pool = np.array([f"key-{i:03d}" for i in range(60)], dtype=object)
+    fact = DTable.from_pandas(dctx, pd.DataFrame({
+        "sk": pool[rng.integers(0, 60, 500)],
+        "ik": rng.integers(0, 40, 500).astype(np.int32),
+        "fv": rng.normal(size=500),
+    }))
+    d1 = DTable.from_pandas(dctx, pd.DataFrame({
+        "dk": rng.permutation(pool)[:45], "w": rng.normal(size=45)}))
+    d2 = DTable.from_pandas(dctx, pd.DataFrame({
+        "k2": np.arange(40, dtype=np.int32), "x": rng.normal(size=40)}))
+
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["fact"], t["d1"],
+                                      _cfg("sk", "dk")))
+        return _strip(dist_ops.dist_join(a, t["d2"], _cfg("ik", "k2")))
+
+    ef, of, ec, oc = _run_pair(dctx, op,
+                               {"fact": fact, "d1": d1, "d2": d2})
+    assert_same_rows(of, ef)
+    assert oc.get("join.multiway", 0) == 1
+
+
+def test_multiway_parity_null_keys(dctx, rng):
+    """Null keys follow the join kernels' null == null convention on
+    both legs (float keys with NaN → validity-masked ingest)."""
+    fk = rng.integers(0, 40, 400).astype(np.float64)
+    fk[rng.random(400) < 0.15] = np.nan
+    dk = rng.permutation(40)[:30].astype(np.float64)
+    dk[rng.random(30) < 0.2] = np.nan
+    fact = DTable.from_pandas(dctx, pd.DataFrame({
+        "fk": fk, "ik": rng.integers(0, 20, 400).astype(np.int32),
+        "fv": rng.normal(size=400)}))
+    d1 = DTable.from_pandas(dctx, pd.DataFrame({
+        "dk": dk, "w": rng.normal(size=30)}))
+    d2 = DTable.from_pandas(dctx, pd.DataFrame({
+        "k2": np.arange(20, dtype=np.int32), "x": rng.normal(size=20)}))
+
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["fact"], t["d1"],
+                                      _cfg("fk", "dk")))
+        return _strip(dist_ops.dist_join(a, t["d2"], _cfg("ik", "k2")))
+
+    ef, of, ec, oc = _run_pair(dctx, op,
+                               {"fact": fact, "d1": d1, "d2": d2})
+    assert_same_rows(of, ef)
+    assert oc.get("join.multiway", 0) == 1
+
+
+def test_multiway_parity_composite_keys(dctx, rng):
+    fact = DTable.from_pandas(dctx, pd.DataFrame({
+        "a": rng.integers(0, 12, 500).astype(np.int32),
+        "b": rng.integers(0, 9, 500).astype(np.int32),
+        "ik": rng.integers(0, 30, 500).astype(np.int32),
+        "fv": rng.normal(size=500)}))
+    pairs = pd.DataFrame({"ca": np.repeat(np.arange(12), 9).astype(np.int32),
+                          "cb": np.tile(np.arange(9), 12).astype(np.int32)})
+    pairs["w"] = rng.normal(size=len(pairs))
+    d1 = DTable.from_pandas(dctx, pairs.sample(70, random_state=3))
+    d2 = DTable.from_pandas(dctx, pd.DataFrame({
+        "k2": np.arange(30, dtype=np.int32), "x": rng.normal(size=30)}))
+
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["fact"], t["d1"],
+                                      _cfg(("a", "b"), ("ca", "cb"))))
+        return _strip(dist_ops.dist_join(a, t["d2"], _cfg("ik", "k2")))
+
+    ef, of, ec, oc = _run_pair(dctx, op,
+                               {"fact": fact, "d1": d1, "d2": d2})
+    assert_same_rows(of, ef)
+    assert oc.get("join.multiway", 0) == 1
+    assert oc.get("join.multiway_probes", 0) == 2
+
+
+def test_multiway_parity_empty_dimension(dctx, star, rng):
+    empty = DTable.from_pandas(dctx, pd.DataFrame({
+        "k2": np.array([], dtype=np.int32),
+        "x": np.array([], dtype=np.float32)}))
+
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["fact"], t["d1"],
+                                      _cfg("fk1", "k1")))
+        return _strip(dist_ops.dist_join(a, t["empty"],
+                                         _cfg("fk2", "k2")))
+
+    tables = dict(star, empty=empty)
+    ef, of, ec, oc = _run_pair(dctx, op, tables)
+    assert len(ef) == 0 and len(of) == 0
+    assert oc.get("join.multiway", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# partition-once economics: over-threshold dims replicate instead of
+# re-exchanging the intermediate — strictly fewer exchanges and bytes
+# ---------------------------------------------------------------------------
+
+def test_multiway_reduces_exchanges_vs_cascade(dctx, star):
+    """With the binary threshold tightened below both dimension sizes
+    the cascade co-partitions every join (4 shuffle exchanges); the
+    fused op raises each probe's effective threshold to the re-exchange
+    crossover I/(P-1), replicates both dims, and the fact never moves."""
+    prev = cfgmod.set_broadcast_join_threshold(8)
+    try:
+        ef, of, ec, oc = _run_pair(dctx, _chain2(), star)
+    finally:
+        cfgmod.set_broadcast_join_threshold(prev)
+    assert_same_rows(of, ef)
+    assert ec.get("join.shuffle", 0) == 2, ec
+    assert oc.get("join.multiway_dims_broadcast", 0) == 2, oc
+    assert _exchanges(oc) < _exchanges(ec), (oc, ec)
+    eb = ec.get("shuffle.bytes_sent", 0) + ec.get("broadcast.bytes_sent", 0)
+    ob = oc.get("shuffle.bytes_sent", 0) + oc.get("broadcast.bytes_sent", 0)
+    assert 0 < ob < eb, "replication must beat re-exchanging the fact"
+
+
+def test_multiway_mixed_threshold_dimensions(dctx, star, rng):
+    """A dimension past even the raised crossover (2000 rows >
+    6000/(P-1) ≈ 857) falls back to the per-edge co-partitioning
+    shuffle while the small one still replicates — mixed decisions
+    within one fused node."""
+    wide = DTable.from_pandas(dctx, pd.DataFrame({
+        "bk": np.arange(2000, dtype=np.int32),
+        "w": rng.random(2000).astype(np.float32)}))
+
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["fact"], t["d2"],
+                                      _cfg("fk2", "k2")))
+        return _strip(dist_ops.dist_join(a, t["wide"],
+                                         _cfg("fk1", "bk")))
+
+    prev = cfgmod.set_broadcast_join_threshold(8)
+    try:
+        ef, of, ec, oc = _run_pair(dctx, op, dict(star, wide=wide))
+    finally:
+        cfgmod.set_broadcast_join_threshold(prev)
+    assert_same_rows(of, ef)
+    assert oc.get("join.multiway", 0) == 1
+    assert oc.get("join.multiway_dims_broadcast", 0) == 1, oc
+    assert oc.get("join.multiway_dims_shuffled", 0) == 1, oc
+    assert _exchanges(oc) < _exchanges(ec), (oc, ec)
+
+
+# ---------------------------------------------------------------------------
+# budget re-pricing at lowering (the cached-plan scenario)
+# ---------------------------------------------------------------------------
+
+def test_multiway_cached_plan_repriced_under_smaller_budget(dctx, star):
+    """A compiled plan whose dimensions replicated under a roomy memory
+    budget must NOT replay those replicas under a smaller one: the
+    per-dimension veto re-prices at every execution and the edge falls
+    back to the co-partitioning shuffle — same rows either way."""
+    prev_thr = cfgmod.set_broadcast_join_threshold(8)
+    try:
+        trace.reset()
+        broadcast.clear_replica_cache()
+        first = _frame(dctx.optimize(_chain2(), star))
+        c1 = trace.counters()
+        assert c1.get("plan.cache_miss", 0) == 1
+        assert c1.get("join.multiway_dims_broadcast", 0) == 2
+        assert c1.get("broadcast.budget_veto", 0) == 0
+        # below d1's replica price ((P*cap + outcap) x 8 B ≈ 12 KB) but
+        # above d2's (~1 KB): exactly one dimension must be vetoed
+        prev_budget = cfgmod.set_device_memory_budget(8_000)
+        try:
+            trace.reset()
+            broadcast.clear_replica_cache()
+            second = _frame(dctx.optimize(_chain2(), star))
+            c2 = trace.counters()
+        finally:
+            cfgmod.set_device_memory_budget(prev_budget)
+    finally:
+        cfgmod.set_broadcast_join_threshold(prev_thr)
+    # same compiled plan (no re-rewrite), different per-dim decisions
+    assert c2.get("plan.cache_hit", 0) == 1, c2
+    assert c2.get("broadcast.budget_veto", 0) >= 1, c2
+    assert c2.get("join.multiway_dims_shuffled", 0) >= 1, c2
+    assert_same_rows(second, first)
+
+
+def test_multiway_small_fact_inner_counts_as_replica(dctx, star, rng):
+    """An INNER edge whose DIMENSION is over threshold but whose running
+    fact side is provably small takes the general path's left-side
+    broadcast — the decision counters must report a replica probe
+    (dims_broadcast, `broadcast-fact`), not a shuffle, and no
+    co-partitioning exchange may run."""
+    small = DTable.from_pandas(dctx, pd.DataFrame({
+        "fk1": rng.integers(0, N_D1, 500).astype(np.int32),
+        "fk2": rng.integers(0, N_D2, 500).astype(np.int32),
+        "fv": rng.random(500).astype(np.float32)}))
+    big = DTable.from_pandas(dctx, pd.DataFrame({
+        "k1": np.arange(5000, dtype=np.int32),
+        "w": rng.random(5000).astype(np.float32)}))
+
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["small"], t["big"],
+                                      _cfg("fk1", "k1")))
+        return _strip(dist_ops.dist_join(a, t["d2"], _cfg("fk2", "k2")))
+
+    prev = cfgmod.set_broadcast_join_threshold(1000)
+    try:
+        tables = {"small": small, "big": big, "d2": star["d2"]}
+        ef, of, ec, oc = _run_pair(dctx, op, tables)
+        rep = small.explain(op, tables=tables, optimize=True)
+    finally:
+        cfgmod.set_broadcast_join_threshold(prev)
+    assert_same_rows(of, ef)
+    assert oc.get("join.multiway", 0) == 1
+    assert oc.get("join.multiway_dims_broadcast", 0) == 2, oc
+    assert oc.get("join.multiway_dims_shuffled", 0) == 0, oc
+    assert oc.get("shuffle.exchanges", 0) == 0, oc
+    mw = [n for n in rep.nodes if n.op == "dist_multiway_join"]
+    assert mw and mw[0].info.get("dims") == "broadcast-fact/broadcast"
+
+
+def test_multiway_chaos_parity(dctx, star):
+    """The chaos gate over a fused plan: a seeded default FaultPlan
+    (transient host-read faults, undersized hints, budget pressure)
+    must not change the fused result, and no retry loop may exhaust."""
+    from cylon_tpu import faults, resilience
+    from cylon_tpu.resilience import RetryPolicy
+    want = _frame(_chain2()(star))
+    plan = faults.FaultPlan.default(11)
+    prev = resilience.set_retry_policy(RetryPolicy(max_attempts=6,
+                                                   base_delay_s=0.0))
+    trace.reset()
+    try:
+        with faults.active(plan):
+            broadcast.clear_replica_cache()
+            got = _frame(dctx.optimize(_chain2(), star))
+    finally:
+        resilience.set_retry_policy(prev)
+    assert_same_rows(got, want)
+    assert trace.counters().get("retry.exhausted", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# refusals + explain surfaces
+# ---------------------------------------------------------------------------
+
+def test_multiway_refuses_right_edge(dctx, star):
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["fact"], t["d1"],
+                                      _cfg("fk1", "k1")))
+        return _strip(dist_ops.dist_join(
+            a, t["d2"], _cfg("fk2", "k2", JoinType.RIGHT)))
+
+    ef, of, ec, oc = _run_pair(dctx, op, star)
+    assert_same_rows(of, ef)
+    assert oc.get("join.multiway", 0) == 0, \
+        "a RIGHT edge must not fuse (the fact is not the preserved side)"
+
+
+def test_multiway_refuses_shared_intermediate(dctx, star):
+    """The q2 correlated-MIN shape: the chain output feeds BOTH the next
+    join and a groupby — folding it into the fused node would execute
+    the shared intermediate twice, so the chain stops there."""
+    def op(t):
+        a = _strip(dist_ops.dist_join(t["fact"], t["d1"],
+                                      _cfg("fk1", "k1")))
+        mins = dist_ops.dist_groupby(a, ["fk1"], [("fv", "min")])
+        mins = mins.rename(["mk", "mv"])
+        out = dist_ops.dist_join(a, mins, _cfg("fk1", "mk"))
+        return _strip(out)
+
+    ef, of, ec, oc = _run_pair(dctx, op, star)
+    assert_same_rows(of, ef)
+    assert oc.get("join.multiway", 0) == 0, \
+        "a shared intermediate must keep the chain binary"
+
+
+def test_multiway_static_explain_and_analyze(dctx, star):
+    op = _chain2()
+    rep = star["fact"].explain(op, tables=star, validate=True,
+                               optimize=True)
+    assert rep.ok
+    mw = [n for n in rep.nodes if n.op == "dist_multiway_join"]
+    assert len(mw) == 1
+    assert mw[0].info.get("probes") == 2
+    assert "multiway" in mw[0].info.get("optimizer", "")
+    # ANALYZE: one nested per-probe join node with measured row counts
+    rep2 = star["fact"].explain(op, tables=star, analyze=True,
+                                optimize=True)
+    assert rep2.ok and rep2.analyzed
+    probes = [n for n in rep2.nodes
+              if n.op == "dist_join" and n.runtime is not None
+              and n.runtime.get("depth", 1) > 1]
+    assert len(probes) == 2
+    for n in probes:
+        assert n.runtime.get("rows_out") is not None
+    mw2 = [n for n in rep2.nodes if n.op == "dist_multiway_join"]
+    assert mw2 and mw2[0].runtime is not None
+    assert mw2[0].info.get("dims") == "broadcast/broadcast"
+
+
+def test_multiway_direct_call_matches_cascade(dctx, star):
+    """The eager operator surface: calling dist_multiway_join directly
+    (no planner) equals the cascade, and re-runs hit the plan-free
+    path with the same counters shape."""
+    edges = (
+        ("inner", "sort", ("fk1",), ("k1",), None, None,
+         (("lt-fk1", "fk1"), ("lt-fk2", "fk2"), ("lt-fv", "fv"),
+          ("rt-k1", "k1"), ("rt-w", "w"))),
+        ("inner", "sort", ("fk2",), ("k2",), None, None, ()),
+    )
+    trace.reset()
+    fused = dist_ops.dist_multiway_join(
+        star["fact"], [star["d1"], star["d2"]], edges)
+    got = _frame(fused)
+    c = trace.counters()
+    assert c.get("join.multiway", 0) == 1
+    assert c.get("join.multiway_probes", 0) == 2
+    want = _frame(_chain2()(star))
+    got = got.rename(columns={n: n.replace("lt-", "").replace("rt-", "")
+                              for n in got.columns})
+    cols = ["fk1", "fk2", "fv", "w", "x"]
+    assert_same_rows(got[cols], want[cols])
+
+
+def test_multiway_edge_validation(dctx, star):
+    with pytest.raises(Exception):
+        dist_ops.dist_multiway_join(
+            star["fact"], [star["d1"]],
+            [("right", "sort", ("fk1",), ("k1",), None, None, ())])
+    with pytest.raises(Exception):
+        dist_ops.dist_multiway_join(star["fact"], [star["d1"]], [])
+    with pytest.raises(Exception):
+        dist_ops.dist_multiway_join(
+            star["fact"], [star["d1"]],
+            [("inner", "sort", ("fk1", "fk2"), ("k1",), None, None, ())])

@@ -275,6 +275,14 @@ def test_analyze_optimized_query(dctx, tpch_tables, qname):
     assert c.get("optimizer.rule_fires", 0) == opt["rule_fires"]
     unknown = set(c) - set(observe.METRICS)
     assert not unknown, f"undocumented planner metrics {unknown}"
+    if qname == "q9":
+        # the star chain fuses: the multiway counters the run bumps are
+        # all in the catalogue (the `unknown` check above) and visible
+        assert c.get("join.multiway", 0) >= 1, c
+        assert c.get("join.multiway_probes", 0) >= 3, c
+        mw = [n for n in rep.nodes if n.op == "dist_multiway_join"]
+        assert mw and mw[0].runtime is not None
+        assert "multiway" in mw[0].info.get("optimizer", "")
     # per-node rule fires + the optimizer head line both render
     assert any("optimizer" in n.info for n in rep.nodes)
     s = str(rep)
@@ -346,6 +354,38 @@ def test_benchdiff_gates_optimizer_savings(tmp_path, capsys):
     small_new = _artifact(tmp_path, "sn.json",
                           {"tpch_q3_optimizer_bytes_saved": 0.0})
     assert benchdiff.main([small_old, small_new]) == 0
+
+
+def test_multiway_metrics_catalogued():
+    """The multiway-join and exchange-count counters are documented
+    catalogue entries (the ANALYZE compliance checks above reject any
+    counter a TPC-H run bumps outside observe.METRICS)."""
+    for name in ("join.multiway", "join.multiway_probes",
+                 "join.multiway_dims_broadcast",
+                 "join.multiway_dims_shuffled", "shuffle.exchanges"):
+        spec = observe.METRICS.get(name)
+        assert spec is not None, name
+        assert spec.kind == observe.COUNTER, name
+        assert spec.doc
+
+
+def test_benchdiff_gates_exchange_count_up(tmp_path, capsys):
+    """tpch_*_exchange_count gates UP: a planner regression that
+    re-splits a fused multiway join adds whole exchanges and fails;
+    the _noopt control column never gates."""
+    old = _artifact(tmp_path, "old.json",
+                    {"tpch_q5_exchange_count": 3.0,
+                     "tpch_q5_exchange_count_noopt": 7.0})
+    new = _artifact(tmp_path, "new.json",
+                    {"tpch_q5_exchange_count": 7.0,
+                     "tpch_q5_exchange_count_noopt": 3.0})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "tpch_q5_exchange_count" in out and "REGRESSED" in out
+    better = _artifact(tmp_path, "better.json",
+                       {"tpch_q5_exchange_count": 2.0,
+                        "tpch_q5_exchange_count_noopt": 7.0})
+    assert benchdiff.main([old, better]) == 0
 
 
 def test_benchdiff_missing_gated_metric_fails(tmp_path, capsys):
