@@ -997,6 +997,13 @@ def main() -> None:
                 + q_counters.get("broadcast.rows_sent", 0)
             em.detail[f"tpch_{qname}_host_reads"] = \
                 q_counters.get("host.read", 0)
+            # largest per-device transient priced for one exchange
+            # dispatch in the timed rep — benchdiff gates this UP, so a
+            # chunked-path peak-memory regression (e.g. the fused
+            # groupby's fold-by-key silently reverting to concatenation)
+            # fails CI instead of passing silently
+            em.detail[f"tpch_{qname}_exchange_bytes_peak"] = \
+                q_counters.get("shuffle.exchange_bytes_peak", 0)
             # logical-planner activity of the timed rep: cache hits
             # prove the rep skipped rewriting; rule fires are replayed
             # from the cached plan, so every rep reports them
@@ -1024,7 +1031,8 @@ def main() -> None:
                         nc = _trace.counters()
                         legs[leg] = (nc.get("shuffle.bytes_sent", 0)
                                      + nc.get("broadcast.bytes_sent", 0),
-                                     _exchange_count(nc))
+                                     _exchange_count(nc),
+                                     nc.get("groupby.bytes_moved", 0))
                 except Exception as e:  # graftlint: ok[broad-except] — the control leg must not kill the bench
                     print(f"tpch {qname} optimizer control FAILED: "
                           f"{type(e).__name__}: {str(e)[:200]}",
@@ -1047,6 +1055,13 @@ def main() -> None:
                         legs["noopt"][1]
                     em.detail[f"tpch_{qname}_exchange_count_opt_control"] \
                         = legs["opt"][1]
+                    # bytes the fused aggregation exchange keeps off the
+                    # wire vs the eager groupby tail (groupby-owned
+                    # exchanges only — partial shuffles, combine
+                    # gathers, psum combines); benchdiff gates it DOWN
+                    # (docs/query_planner.md "groupby pushdown")
+                    em.detail[f"tpch_{qname}_groupby_bytes_saved"] = \
+                        legs["noopt"][2] - legs["opt"][2]
             _progress(f"TPC-H {qname}: {q_t * 1e3:.0f} ms")
             em.emit(f"tpch_{qname}")
 

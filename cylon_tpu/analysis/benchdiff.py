@@ -24,6 +24,13 @@ Gated metrics (relative threshold, default 15%):
     dispatches + replica gathers; higher = worse — a planner regression
     that re-splits a fused multiway join back into a binary cascade
     adds whole exchanges and fails here)
+  * ``tpch_<q>_exchange_bytes_peak``  largest per-device transient
+    priced for one exchange dispatch (higher = worse — a chunked-path
+    peak-memory regression, e.g. the fused groupby's fold-by-key
+    reverting to concatenation, previously passed CI silently)
+  * ``tpch_<q>_groupby_bytes_saved``  groupby-owned exchange bytes the
+    fused aggregation exchange keeps off the wire vs the eager tail
+    (lower = worse; docs/query_planner.md "groupby pushdown")
 
 A gated metric present in OLD but absent from NEW fails the gate
 outright (``MISSING``): a query that crashed or was skipped emits no ms
@@ -79,6 +86,12 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     # regression re-splitting a fused multiway join back into a binary
     # cascade — clears the relative threshold and fails the gate
     (r"tpch_q\d+_exchange_count$", "up"),
+    # peak exchange transient: the chunked path's memory bound, gated
+    # UP as a first-class family (a regression here previously passed
+    # CI silently — only wall-clock and total bytes were gated)
+    (r"tpch_q\d+_exchange_bytes_peak$", "up"),
+    # groupby-owned bytes the fused aggregation exchange saves
+    (r"tpch_q\d+_groupby_bytes_saved$", "down"),
 )
 
 
@@ -197,7 +210,8 @@ def diff(old: Dict[str, float], new: Dict[str, float],
         if gated:  # sub-floor deltas are noise, not signal
             floor = (min_abs_ms if key.endswith("_ms")
                      else min_abs_bytes if key.endswith(("_bytes_moved",
-                                                         "_bytes_saved"))
+                                                         "_bytes_saved",
+                                                         "_bytes_peak"))
                      else min_abs_reads if key.endswith("_host_reads")
                      else 0.0)
             if abs(n - o) < floor:

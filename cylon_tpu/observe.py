@@ -87,7 +87,8 @@ def exchange_count(counters: Dict[str, int]) -> int:
     desynchronize."""
     return (counters.get("shuffle.exchanges", 0)
             + counters.get("join.broadcast_gather", 0)
-            + counters.get("groupby.broadcast_gather", 0))
+            + counters.get("groupby.broadcast_gather", 0)
+            + counters.get("groupby.psum_combine", 0))
 
 
 # Every metric the engine emits.  Names are ``<subsystem>.<what>``; the
@@ -108,6 +109,26 @@ METRICS: Dict[str, MetricSpec] = _specs(
     ("groupby.broadcast_combine", COUNTER, "combines",
      "groupby combines that replaced the shuffle with one all_gather"),
     ("join.out_rows", COUNTER, "rows", "distributed-join output rows"),
+    # fused aggregation exchange — aggregation below/inside the exchange
+    # (docs/query_planner.md "groupby pushdown",
+    # docs/tpu_perf_notes.md "aggregation below the exchange")
+    ("groupby.pushdown", COUNTER, "groupbys",
+     "groupbys executed through the planner's fused aggregation "
+     "exchange (dist_groupby_fused)"),
+    ("groupby.partials_rows", COUNTER, "rows",
+     "partial-group rows entering combine exchanges (the payload the "
+     "fused path moves instead of the pre-aggregation input rows)"),
+    ("groupby.psum_combine", COUNTER, "combines",
+     "fused groupbys whose combine ran as ONE all-reduce over a "
+     "plan-known dense slot space — no count protocol, no host read"),
+    ("groupby.bytes_moved", COUNTER, "bytes",
+     "exchange payload bytes attributable to groupby combines (partial "
+     "shuffles, combine gathers, psum combines) — the input to bench's "
+     "tpch_*_groupby_bytes_saved column"),
+    ("shuffle.fold_combined", COUNTER, "folds",
+     "chunk-round receiver folds that combined partial-group rows by "
+     "key instead of concatenating (exchange_bytes_peak then scales "
+     "with distinct groups, not received rows)"),
     # fused multiway (star) joins — partition-once/probe-N
     # (docs/query_planner.md "multiway join fusion")
     ("join.multiway", COUNTER, "joins",

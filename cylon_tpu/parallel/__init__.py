@@ -13,8 +13,8 @@ from .broadcast import replicate_table
 from .dtable import DColumn, DTable
 from .shuffle import shuffle_leaves
 from .dist_ops import (dist_aggregate, dist_anti_join, dist_groupby,
-                       dist_head, dist_intersect, dist_join,
-                       dist_multiway_join, dist_project,
+                       dist_groupby_fused, dist_head, dist_intersect,
+                       dist_join, dist_multiway_join, dist_project,
                        dist_select, dist_semi_join, dist_sort,
                        dist_sort_multi, dist_subtract, dist_union,
                        dist_with_column, shuffle_table)
@@ -26,7 +26,8 @@ __all__ = [
     "dist_join", "dist_join_streaming", "dist_multiway_join",
     "dist_semi_join", "dist_anti_join",
     "dist_union", "dist_intersect",
-    "dist_subtract", "dist_groupby", "dist_aggregate", "dist_sort",
+    "dist_subtract", "dist_groupby", "dist_groupby_fused",
+    "dist_aggregate", "dist_sort",
     "dist_sort_multi",
     "dist_select", "dist_project", "dist_with_column", "dist_head",
     "run_pipeline",

@@ -258,9 +258,13 @@ def replicate_table(dt: DTable, mode: str = ALL,
     if not abstract and jax.core.trace_state_clean():
         from .. import observe
         moved = total_bound * max(dt.nparts - 1, 0)
+        moved_bytes = moved * observe.row_bytes(leaves)
         trace.count("broadcast.rows_sent", moved)
-        trace.count("broadcast.bytes_sent",
-                    moved * observe.row_bytes(leaves))
+        trace.count("broadcast.bytes_sent", moved_bytes)
+        if span_name == "groupby.broadcast_gather":
+            # groupby-owned combine gathers feed the per-family bench
+            # accounting (tpch_*_groupby_bytes_saved)
+            trace.count("groupby.bytes_moved", moved_bytes)
     with trace.span_sync(span_name) as sp:
         trace.count(span_name)  # counter mirrors the span name
         outs, counts = _gather_fn(dt.ctx.mesh, dt.ctx.axis, dt.cap,

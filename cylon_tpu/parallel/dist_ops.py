@@ -170,8 +170,11 @@ def _unify_dtable_dicts(a: DTable, b: DTable,
 # shuffle_table (reference: Shuffle, table_api.cpp:214-297)
 # ---------------------------------------------------------------------------
 
-def _shuffle_by_pids(dt: DTable, pid: jax.Array) -> DTable:
-    """Exchange rows to their target shards; rebuild the DTable."""
+def _shuffle_by_pids(dt: DTable, pid: jax.Array, combine=None,
+                     owner: "str | None" = None) -> DTable:
+    """Exchange rows to their target shards; rebuild the DTable.
+    ``combine``/``owner`` thread through to :func:`shuffle_leaves` (the
+    partial-group fold spec and the byte-attribution tag)."""
     if dt.pending_mask is not None:
         # ``pid`` was computed against THESE blocks — a deferred select
         # must have been folded into it (dropped-partition routing, via a
@@ -190,7 +193,8 @@ def _shuffle_by_pids(dt: DTable, pid: jax.Array) -> DTable:
         if c.validity is not None:
             leaves.append(c.validity)
             slots.append((i, True))
-    new_leaves, newcounts, outcap = shuffle_leaves(dt.ctx, pid, leaves)
+    new_leaves, newcounts, outcap = shuffle_leaves(dt.ctx, pid, leaves,
+                                                   combine, owner)
     # structural exchange metric (static host-side sizes — no sync):
     # total exchanged slot capacity across shards, summed over leaves
     trace.count("shuffle.capacity_rows",
@@ -1257,20 +1261,39 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
             "emit_empty requires an engaged dense_key_range (integer "
             "non-dictionary single key, slot space within 4x capacity) — "
             "the zero-count groups only exist on the direct-address path"))
+    near_unique = False
     if pre_aggregate is None:
         near_unique = (dense_key_range is not None and len(key_ids) == 1
                        and (int(dense_key_range[1])
                             - int(dense_key_range[0]) + 1) > dt.cap)
+        explicit = False
         pre_aggregate = world > 1 and not _local_only and not near_unique
+    else:
+        explicit = True
     if node is not None:
+        # decision AND reason: static EXPLAIN / ANALYZE show WHY a
+        # groupby took its path, matching the join-strategy annotations
+        # (docs/observability.md)
         if world > 1 and pre_aggregate:
             decision = "pre-aggregate"
+            reason = ("explicit pre_aggregate=True" if explicit else
+                      "decomposable aggs: per-shard partials replace "
+                      "rows on the wire")
         elif world == 1:
             decision = "dense-local" if dense is not None else "local"
+            reason = "world=1: every group is already local"
         else:
             decision = ("dense+shuffle" if dense is not None
                         else "shuffle")
-        plan_check.annotate(node, decision=decision)
+            if near_unique:
+                width = (int(dense_key_range[1])
+                         - int(dense_key_range[0]) + 1)
+                reason = (f"near_unique-skip: dense key range {width} > "
+                          f"shard capacity {dt.cap} — the partial pass "
+                          "could not shrink the exchange")
+            else:
+                reason = "explicit pre_aggregate=False"
+        plan_check.annotate(node, decision=decision, reason=reason)
     if world > 1 and pre_aggregate and not _local_only:
         return _dist_groupby_preagg(dt, key_ids, aggregations, where,
                                     dense_key_range, emit_empty)
@@ -1287,7 +1310,7 @@ def dist_groupby(dt: DTable, key_columns: Sequence[Union[int, str]],
                 # filter pushdown: failing rows never enter the exchange
                 pid = jnp.where(pmask, pid, jnp.int32(dt.ctx.get_world_size()))
                 pmask = None  # rows arrive pre-filtered
-            sh = _shuffle_by_pids(_cleared(dt), pid)
+            sh = _shuffle_by_pids(_cleared(dt), pid, owner="groupby")
     mesh, axis = dt.ctx.mesh, dt.ctx.axis
     key_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
                        for i in key_ids)
@@ -1445,15 +1468,17 @@ def _dist_groupby_dense(dt: DTable, sh: DTable, kc: DColumn, key_id: int,
     return DTable(dt.ctx, cols, used[0], counts_out)
 
 
-def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
-                         where, dense_key_range,
-                         emit_empty: bool = False) -> DTable:
-    """Two-level aggregation tail of dist_groupby (``pre_aggregate``):
-    local per-shard groupby (no exchange) → shuffle the tiny partial-group
-    table → combining groupby (sum of sums, sum of counts, min of mins,
-    max of maxes; mean = Σsum/Σcount).  Column plumbing is positional —
-    partial column j sits at index K+j of the partial table."""
-    K = len(key_ids)
+# partial op → the aggregation that combines two partials of it
+_COMBINE_OP = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
+
+def _decompose_aggs(dt: DTable, aggregations):
+    """Two-level decomposition of ``aggregations`` (arXiv:2010.14596):
+    one partial slot per distinct (column, partial op) — avg → sum +
+    count, count → sum-of-counts, min/max idempotent — plus the
+    per-output recomposition plan ``(op, partial ref[, count ref for
+    mean])``.  Shared by the runtime pre-aggregate tail and the
+    planner-lowered fused operator so the two can never drift."""
     partial: List[Tuple[int, str]] = []
     ppos: dict = {}
 
@@ -1464,7 +1489,7 @@ def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
             partial.append((ci, op))
         return ppos[k]
 
-    plan = []  # per final slot: (op, partial ref[, count ref for mean])
+    plan = []
     for cref, op in aggregations:
         ci = dt.column_index(cref)
         if op == "mean":
@@ -1473,33 +1498,14 @@ def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
             plan.append((op, _p(ci, "count")))
         else:
             plan.append((op, _p(ci, op)))
-    # emit_empty rides the LOCAL pass only: with every shard emitting the
-    # full key range, every key reaches the combine as ≥1 partial row, so
-    # the zero groups survive it without a second emit-empty pass
-    part = dist_groupby(dt, key_ids, partial, where=where,
-                        dense_key_range=dense_key_range,
-                        pre_aggregate=False, _local_only=True,
-                        emit_empty=emit_empty)
-    comb_op = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
-    comb_aggs = [(K + j, comb_op[op]) for j, (_, op) in enumerate(partial)]
-    if broadcast.rows_if_small(part, None) is not None:
-        # small partial table: replace the combine SHUFFLE with one
-        # all_gather — every shard receives all partial rows, shard 0
-        # alone owns them (HEAD counts), and the local combining groupby
-        # produces the full result there.  One collective instead of
-        # partition + two-phase exchange; the result lands on one shard,
-        # which is where a few-group aggregate ends up anyway.
-        trace.count("groupby.broadcast_combine")
-        part_rep = broadcast.replicate_table(
-            part, mode=broadcast.HEAD,
-            span_name="groupby.broadcast_gather", cache=False)
-        comb = dist_groupby(part_rep, list(range(K)), comb_aggs,
-                            dense_key_range=dense_key_range,
-                            pre_aggregate=False, _local_only=True)
-    else:
-        comb = dist_groupby(part, list(range(K)), comb_aggs,
-                            dense_key_range=dense_key_range,
-                            pre_aggregate=False)
+    return partial, plan
+
+
+def _recompose_partials(dt: DTable, aggregations, plan, comb: DTable,
+                        K: int) -> DTable:
+    """Final columns from a combined partial table: mean = Σsum/Σcount,
+    everything else forwards its combined partial.  Column plumbing is
+    positional — partial column j sits at index K+j of ``comb``."""
     from ..compute import _agg_output_type
     fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     cols = list(comb.columns[:K])
@@ -1516,6 +1522,353 @@ def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
             cols.append(DColumn(name, DataType(t_out), src.data,
                                 src.validity))
     return DTable(dt.ctx, cols, comb.cap, comb.counts)
+
+
+def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
+                         where, dense_key_range,
+                         emit_empty: bool = False) -> DTable:
+    """Two-level aggregation tail of dist_groupby (``pre_aggregate``):
+    local per-shard groupby (no exchange) → shuffle the tiny partial-group
+    table → combining groupby (sum of sums, sum of counts, min of mins,
+    max of maxes; mean = Σsum/Σcount)."""
+    K = len(key_ids)
+    partial, plan = _decompose_aggs(dt, aggregations)
+    # emit_empty rides the LOCAL pass only: with every shard emitting the
+    # full key range, every key reaches the combine as ≥1 partial row, so
+    # the zero groups survive it without a second emit-empty pass
+    part = dist_groupby(dt, key_ids, partial, where=where,
+                        dense_key_range=dense_key_range,
+                        pre_aggregate=False, _local_only=True,
+                        emit_empty=emit_empty)
+    comb_aggs = [(K + j, _COMBINE_OP[op])
+                 for j, (_, op) in enumerate(partial)]
+    if broadcast.rows_if_small(part, None) is not None:
+        # small partial table: replace the combine SHUFFLE with one
+        # all_gather — every shard receives all partial rows, shard 0
+        # alone owns them (HEAD counts), and the local combining groupby
+        # produces the full result there.  One collective instead of
+        # partition + two-phase exchange; the result lands on one shard,
+        # which is where a few-group aggregate ends up anyway.  (The
+        # planner-lowered fused path prefers the partial SHUFFLE: the
+        # gather replicates every shard's padded partial block P-1
+        # times, strictly more wire bytes — docs/tpu_perf_notes.md
+        # "aggregation below the exchange".)
+        trace.count("groupby.broadcast_combine")
+        part_rep = broadcast.replicate_table(
+            part, mode=broadcast.HEAD,
+            span_name="groupby.broadcast_gather", cache=False)
+        comb = dist_groupby(part_rep, list(range(K)), comb_aggs,
+                            dense_key_range=dense_key_range,
+                            pre_aggregate=False, _local_only=True)
+    else:
+        comb = dist_groupby(part, list(range(K)), comb_aggs,
+                            dense_key_range=dense_key_range,
+                            pre_aggregate=False)
+    return _recompose_partials(dt, aggregations, plan, comb, K)
+
+
+def _combine_leaf_spec(part: DTable, K: int, partial_ops) -> Tuple:
+    """Static leaf-layout combiner spec of a partial-group table for the
+    chunked shuffle's fold-by-key (shuffle._fold_combine_fn): maps the
+    wire leaf positions (data + optional validity per column, in
+    _shuffle_by_pids order) to key slots and value slots with their
+    combine ops."""
+    idx = 0
+    key_slots, val_slots = [], []
+    for i, c in enumerate(part.columns):
+        d = idx
+        idx += 1
+        v = None
+        if c.validity is not None:
+            v = idx
+            idx += 1
+        if i < K:
+            key_slots.append((d, v))
+        else:
+            val_slots.append((d, v, _COMBINE_OP[partial_ops[i - K]]))
+    return (tuple(key_slots), tuple(val_slots))
+
+
+# plan-known dense slot spaces up to this size combine as ONE all-reduce
+# (docs/tpu_perf_notes.md derives the crossover: the psum's wire cost is
+# R x (P-1) lane-bytes regardless of occupancy, so a sparse domain must
+# stay small to beat the partial exchange's true-rows pricing)
+_PSUM_SLOT_CAP = 4096
+
+
+@functools.lru_cache(maxsize=None)
+def _psum_combine_fn(mesh, axis: str, cap: int, domains: Tuple,
+                     lanes: Tuple[str, ...], out_cap: int,
+                     has_where: bool):
+    """Fused groupby over a plan-known dense composite key space: per
+    shard, scatter-add every partial lane into the [R+1] slot array (R
+    real slots + 1 dropped), combine ALL shards with ONE ``psum`` — the
+    aggregation runs inside the collective (arXiv:2106.15565), with no
+    count protocol, no sort, and no host read anywhere — then decode the
+    present slots into an output block every shard computes identically
+    (shard 0 owns the rows, the HEAD-counts form).
+
+    ``domains`` is ``((size, nullable), ...)`` per key column, ``size``
+    INCLUDING the null code (= size-1) when nullable — composite null
+    keys compose correctly because each column contributes its own null
+    code.  ``lanes`` is one of "count"/"isum"/"fsum" per partial slot,
+    preceded by the implicit row-count lane deciding group presence."""
+
+    R = 1
+    for size, _ in domains:
+        R *= size
+
+    def kernel(cnt, key_leaves, val_leaves, *maybe_mask):
+        idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        row_valid = (maybe_mask[0] if has_where
+                     else (jnp.arange(cap) < cnt[0]))
+        slot = jnp.zeros(cap, jnp.int32)
+        for (kd, kv), (size, nullable) in zip(key_leaves, domains):
+            code = kd.astype(jnp.int32)
+            if nullable:
+                code = jnp.where(kv, code, jnp.int32(size - 1))
+            slot = slot * size + code
+        slot = jnp.where(row_valid, slot, jnp.int32(R))
+        ilanes = [row_valid.astype(idt)]   # lane 0: rows per group
+        flanes = []
+        fpos, ipos = [], [None]
+        for (vd, vv), kind in zip(val_leaves, lanes):
+            vmask = row_valid if vv is None else (row_valid & vv)
+            if kind == "count":
+                ipos.append(len(ilanes))
+                ilanes.append(vmask.astype(idt))
+            elif kind == "isum":
+                ipos.append(len(ilanes))
+                ilanes.append(jnp.where(vmask, vd,
+                                        jnp.zeros((), vd.dtype))
+                              .astype(idt))
+            else:
+                ipos.append(None)
+                fpos.append(len(flanes))
+                flanes.append(jnp.where(vmask, vd,
+                                        jnp.zeros((), vd.dtype))
+                              .astype(fdt))
+        ipack = jnp.zeros((R + 1, len(ilanes)), idt).at[slot].add(
+            jnp.stack(ilanes, axis=1), mode="drop")
+        packs = [ipack]
+        if flanes:
+            packs.append(jnp.zeros((R + 1, len(flanes)), fdt).at[slot]
+                         .add(jnp.stack(flanes, axis=1), mode="drop"))
+        packs = jax.lax.psum(tuple(packs), axis)  # the combine
+        itot = packs[0][:R]
+        ftot = packs[1][:R] if flanes else None
+        present = itot[:, 0] > 0
+        starts = ops_compact.compact_indices(present, out_cap, fill=-1)
+        ngroups = jnp.sum(present).astype(jnp.int32)
+        safe = jnp.clip(starts, 0, R - 1)
+        keys_out = []
+        rem = safe
+        for (kd, kv), (size, nullable) in reversed(
+                list(zip(key_leaves, domains))):
+            code = rem % size
+            rem = rem // size
+            valid = None
+            if nullable:
+                valid = code != (size - 1)
+                code = jnp.where(valid, code, 0)
+            keys_out.append((code.astype(kd.dtype), valid))
+        keys_out.reverse()
+        vals_out = []
+        fi = 0
+        for j, kind in enumerate(lanes):
+            if ipos[j + 1] is not None:
+                lane = jnp.take(itot[:, ipos[j + 1]], safe)
+            else:
+                lane = jnp.take(ftot[:, fpos[fi]], safe)
+                fi += 1
+            vals_out.append(lane)
+        me = jax.lax.axis_index(axis)
+        cnt_out = jnp.where(me == 0, ngroups, jnp.int32(0))
+        return tuple(keys_out), tuple(vals_out), cnt_out[None]
+
+    spec = P(axis)
+    nargs = 4 if has_where else 3
+    # check_vma=False: the psum'd packs are replicated; every shard
+    # emits the identical decoded block as its own P(axis) slice (the
+    # replicate_table idiom)
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * nargs,
+                             out_specs=(spec, spec, spec),
+                             check_vma=False))
+
+
+def _fused_psum_groupby(dt: DTable, key_ids: List[int], aggregations,
+                        where, node, reason) -> "DTable | None":
+    """The "combine during the collective" arm of dist_groupby_fused, if
+    eligible at execution time, else None.  Eligibility re-checks what
+    the plan decided from schema stats: every key dictionary-encoded
+    (codes are structurally in-range — no overflow validation, hence no
+    host read, is needed), the composite domain within _PSUM_SLOT_CAP,
+    and every aggregation sum/count/mean-decomposable (min/max have no
+    SUM all-reduce; some backends lower only SUM — see _scalar_agg_fn)."""
+    world = dt.ctx.get_world_size()
+    if world <= 1:
+        return None
+    domains = []
+    for i in key_ids:
+        c = dt.columns[i]
+        if (c.dictionary is None or len(c.dictionary) == 0
+                or not jnp.issubdtype(c.data.dtype, jnp.integer)):
+            return None
+        domains.append((len(c.dictionary) + (1 if c.validity is not None
+                                             else 0),
+                        c.validity is not None))
+    R = 1
+    for size, _ in domains:
+        R *= size
+    if not 0 < R + 1 <= _PSUM_SLOT_CAP:
+        return None
+    if any(op not in ("sum", "count", "mean") for _, op in aggregations):
+        return None
+    partial, plan = _decompose_aggs(dt, aggregations)
+    lanes = []
+    for ci, op in partial:
+        if op == "count":
+            lanes.append("count")
+        elif jnp.issubdtype(dt.columns[ci].data.dtype, jnp.floating):
+            lanes.append("fsum")
+        else:
+            lanes.append("isum")
+    trace.count("groupby.psum_combine")
+    plan_check.annotate(node, decision="psum-combine", reason=reason)
+    pmask = _effective_mask(dt, where)
+    out_cap = ops_compact.next_bucket(R, minimum=8)
+    key_leaves = tuple((dt.columns[i].data, dt.columns[i].validity)
+                      for i in key_ids)
+    val_leaves = tuple((dt.columns[ci].data, dt.columns[ci].validity)
+                       for ci, _ in partial)
+    from ..analysis._abstract import is_abstract
+    if not any(is_abstract(d) for d, _ in key_leaves) \
+            and jax.core.trace_state_clean():
+        # wire accounting: the all-reduce combines the [R+1, lanes]
+        # packs across shards — priced as R+1 slot-rows replicated to
+        # the other P-1 shards (the broadcast family; abstract plan
+        # runs move zero bytes, like every other exchange path)
+        idt_w = 8 if jax.config.jax_enable_x64 else 4
+        lane_bytes = (1 + len(lanes)) * idt_w
+        moved = (R + 1) * (world - 1)
+        trace.count("broadcast.rows_sent", moved)
+        trace.count("broadcast.bytes_sent", moved * lane_bytes)
+        trace.count("groupby.bytes_moved", moved * lane_bytes)
+    args = ((dt.counts, key_leaves, val_leaves)
+            + (() if pmask is None else (pmask,)))
+    with trace.span_sync("groupby.psum_combine") as sp:
+        keys_out, vals_out, counts_out = _psum_combine_fn(
+            dt.ctx.mesh, dt.ctx.axis, dt.cap, tuple(domains),
+            tuple(lanes), out_cap, pmask is not None)(*args)
+        sp.sync(vals_out)
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    cols = []
+    for i, (kd, kv) in zip(key_ids, keys_out):
+        c = dt.columns[i]
+        cols.append(DColumn(c.name, c.dtype, kd, kv, c.dictionary,
+                            c.arrow_type))
+    # rebuild a partial-table view so the shared recompose applies: the
+    # lanes ARE the combined partials (float sums cast back to the
+    # column dtype, the groupby kernels' convention)
+    pcols = list(cols)
+    from ..compute import _agg_output_type
+    for (ci, op), lane in zip(partial, vals_out):
+        base = dt.columns[ci]
+        if op == "sum" and jnp.issubdtype(base.data.dtype, jnp.floating):
+            lane = lane.astype(base.data.dtype)
+        pcols.append(DColumn(f"{op}_{base.name}",
+                             DataType(_agg_output_type(base.dtype.type,
+                                                       op)),
+                             lane, None))
+    comb = DTable(dt.ctx, pcols, out_cap, counts_out)
+    return _recompose_partials(dt, aggregations, plan, comb,
+                               len(key_ids))
+
+
+@plan_check.instrument
+def dist_groupby_fused(dt: DTable, key_columns: Sequence[Union[int, str]],
+                       aggregations: Sequence[Tuple[Union[int, str], str]],
+                       where=None, dense_key_range=None,
+                       emit_empty: bool = False,
+                       mode: str = "pre-aggregate",
+                       reason: "str | None" = None) -> DTable:
+    """Planner-lowered fused aggregation exchange: per-shard partial
+    aggregation → exchange of the partial-group table → combining
+    aggregation, with the decomposition (avg → sum+count, count →
+    sum-of-counts, min/max idempotent) and the strategy decided at PLAN
+    time (plan/rules.py "groupby-pushdown"; callable directly with the
+    same semantics as :func:`dist_groupby`).
+
+    ``mode`` is the plan's strategy, ``reason`` its recorded evidence:
+
+      * ``"psum"`` — every key is dictionary-encoded with a small
+        plan-known domain and every agg is sum/count/mean: the combine
+        runs INSIDE one all-reduce over the dense slot space
+        (arXiv:2106.15565's combine-during-the-collective) — no count
+        protocol, no sort, no host read; re-checked at execution and
+        degraded to ``pre-aggregate`` if the rebound table disagrees.
+      * ``"pre-aggregate"`` — local partials, then a hash shuffle of
+        the partial table carrying a combiner spec: the single-shot
+        exchange moves each partial row once (strictly fewer bytes than
+        the eager tail's replicate-everywhere combine gather), and the
+        over-budget chunked path folds rounds together BY GROUP KEY so
+        ``shuffle.exchange_bytes_peak`` scales with distinct groups,
+        not rows (shuffle._fold_combine_fn).
+      * ``"shuffle"`` — plan-proven near-unique keys: the partial pass
+        cannot shrink the exchange, so raw rows move once and aggregate
+        in place (identical to ``pre_aggregate=False``).
+
+    Counters: ``groupby.pushdown``, ``groupby.partials_rows``,
+    ``groupby.psum_combine``, ``shuffle.fold_combined`` (observe
+    catalogue; docs/tpu_perf_notes.md "aggregation below the
+    exchange")."""
+    if mode not in ("psum", "pre-aggregate", "shuffle"):
+        raise CylonError(Status(Code.Invalid,
+            f"dist_groupby_fused: unknown mode {mode!r}"))
+    node = plan_check.note("dist_groupby_fused", dt,
+                           keys=tuple(key_columns),
+                           aggs=tuple(op for _, op in aggregations),
+                           mode=mode,
+                           where=where is not None or None)
+    trace.count("groupby.pushdown")
+    key_ids = _resolve_ids(dt, key_columns)
+    world = dt.ctx.get_world_size()
+    for _, op in aggregations:
+        if op not in ops_groupby.AGG_OPS:
+            raise CylonError(Status(Code.Invalid,
+                                    f"unknown aggregation {op!r}"))
+    if mode == "psum" and not emit_empty:
+        out = _fused_psum_groupby(dt, key_ids, aggregations, where,
+                                  node, reason)
+        if out is not None:
+            return out
+        mode = "pre-aggregate"
+        reason = "psum re-check failed at execution; partial exchange"
+    if world <= 1 or mode == "shuffle":
+        plan_check.annotate(node, decision=("local" if world <= 1
+                                            else "shuffle"),
+                            reason=reason)
+        return dist_groupby(dt, key_ids, list(aggregations), where=where,
+                            dense_key_range=dense_key_range,
+                            pre_aggregate=False, emit_empty=emit_empty)
+    plan_check.annotate(node, decision="pre-aggregate", reason=reason)
+    K = len(key_ids)
+    partial, plan = _decompose_aggs(dt, aggregations)
+    part = dist_groupby(dt, key_ids, partial, where=where,
+                        dense_key_range=dense_key_range,
+                        pre_aggregate=False, _local_only=True,
+                        emit_empty=emit_empty)
+    comb_aggs = [(K + j, _COMBINE_OP[op])
+                 for j, (_, op) in enumerate(partial)]
+    spec = _combine_leaf_spec(part, K, [op for _, op in partial])
+    with trace.span("groupby.shuffle"):
+        sh = _shuffle_by_pids(part, _hash_pids(part, list(range(K))),
+                              combine=spec, owner="groupby")
+    comb = dist_groupby(sh, list(range(K)), comb_aggs,
+                        dense_key_range=dense_key_range,
+                        pre_aggregate=False, _local_only=True)
+    return _recompose_partials(dt, aggregations, plan, comb, K)
 
 
 @functools.lru_cache(maxsize=None)

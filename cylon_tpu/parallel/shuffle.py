@@ -88,12 +88,25 @@ def _priced_bytes(nparts: int, sizes, rbytes: int) -> int:
     return int((2 * nparts * block + outcap) * rbytes)
 
 
-def _account(counts: np.ndarray, rbytes: int) -> None:
+def _account(counts: np.ndarray, rbytes: int, combine=None,
+             owner: "str | None" = None) -> None:
     """Exchange-volume accounting shared by the single-shot post() and
-    the chunked path (docs/observability.md)."""
+    the chunked path (docs/observability.md).  Counts what ACTUALLY
+    crosses the wire: for a partial-group exchange (``combine`` set)
+    that is the partial rows, never the pre-aggregation input rows —
+    the count matrix here was computed over the partial table, so the
+    off-diagonal IS the partials moved.  ``owner`` attributes the bytes
+    to a subsystem (``groupby.bytes_moved`` feeds bench's
+    ``tpch_*_groupby_bytes_saved`` column)."""
     moved = int(counts.sum() - np.trace(counts))
     trace.count("shuffle.rows_sent", moved)
     trace.count("shuffle.bytes_sent", moved * rbytes)
+    if owner == "groupby":
+        trace.count("groupby.bytes_moved", moved * rbytes)
+    if combine is not None:
+        # every partial row entering the combine exchange (diagonal
+        # included: rows staying home are still partials produced)
+        trace.count("groupby.partials_rows", int(counts.sum()))
 
 
 def _sizes_from_counts(counts: np.ndarray):
@@ -300,6 +313,76 @@ def _fold_fn(mesh, axis: str, incap: int, outcap: int, fresh: bool):
     return jax.jit(f)
 
 
+@functools.lru_cache(maxsize=None)
+def _fold_combine_fn(mesh, axis: str, spec, incap: int, acc_cap: int,
+                     out_cap: int, fresh: bool):
+    """Receiver-side fold of one chunk round that COMBINES partial-group
+    rows by key instead of concatenating them — the hierarchical
+    variant of the fused aggregation exchange (docs/tpu_perf_notes.md
+    "aggregation below the exchange").
+
+    ``spec`` is the static leaf-layout combiner: ``(key_slots,
+    val_slots)`` with ``key_slots = ((data_idx, validity_idx|None), …)``
+    and ``val_slots = ((data_idx, validity_idx|None, comb_op), …)`` over
+    the wire leaf positions.  Each fold runs the local groupby kernel
+    over ``concat(accumulator, round)`` with the COMBINE ops (sum of
+    sums / sum of counts / min of mins / max of maxes), so the
+    accumulator holds one row per distinct group seen so far and its
+    capacity scales with groups, not received rows.  Output dtypes are
+    cast back to the wire dtypes: the block feeds further folds and
+    finally the DTable whose column dtypes the sender declared.  Rows
+    past the returned group count are unspecified, masked by the next
+    fold's row validity / the DTable counts — the standard contract."""
+    from ..ops import gather as ops_gather
+    from ..ops import groupby as ops_groupby
+    key_slots, val_slots = spec
+
+    def combine(leaves, row_valid):
+        kpairs = tuple((leaves[d], None if v is None else leaves[v])
+                       for d, v in key_slots)
+        key_idx, outs, out_valids, ng = ops_groupby.groupby_aggregate(
+            tuple(d for d, _ in kpairs), tuple(v for _, v in kpairs),
+            tuple(leaves[d] for d, _v, _op in val_slots),
+            tuple(None if v is None else leaves[v]
+                  for _d, v, _op in val_slots),
+            tuple(op for _d, _v, op in val_slots),
+            row_valid=row_valid, out_capacity=out_cap)
+        keys_out = ops_gather.take_many(kpairs, key_idx, fill_null=False)
+        folded = [None] * len(leaves)
+        for (d, v), (kd, kv) in zip(key_slots, keys_out):
+            folded[d] = kd
+            if v is not None:
+                folded[v] = kv
+        for (d, v, _op), arr, av in zip(val_slots, outs, out_valids):
+            folded[d] = arr.astype(leaves[d].dtype)
+            if v is not None:
+                folded[v] = (av if av is not None
+                             else jnp.ones(out_cap, bool))
+        return tuple(folded), ng
+
+    if fresh:
+        def kernel(rcnt_blk, rleaves):
+            row_valid = jnp.arange(incap) < rcnt_blk[0]
+            outs, ng = combine(rleaves, row_valid)
+            return ng[None], outs
+
+        f = shard_map(kernel, mesh=mesh, in_specs=(P(axis), P(axis)),
+                      out_specs=(P(axis), P(axis)))
+    else:
+        def kernel(acc_cnt_blk, rcnt_blk, acc_leaves, rleaves):
+            merged = tuple(jnp.concatenate([a, r])
+                           for a, r in zip(acc_leaves, rleaves))
+            row_valid = jnp.concatenate(
+                [jnp.arange(acc_cap) < acc_cnt_blk[0],
+                 jnp.arange(incap) < rcnt_blk[0]])
+            outs, ng = combine(merged, row_valid)
+            return ng[None], outs
+
+        f = shard_map(kernel, mesh=mesh,
+                      in_specs=(P(axis),) * 4, out_specs=(P(axis), P(axis)))
+    return jax.jit(f)
+
+
 def _chunk_sizes(Pn: int, counts: np.ndarray, rbytes: int, budget: int):
     """The chunk math (docs/robustness.md): pick the smallest per-round
     cell cap C such that a round's transient — send [P, bucket(C)] +
@@ -321,14 +404,24 @@ def _chunk_sizes(Pn: int, counts: np.ndarray, rbytes: int, budget: int):
 
 
 def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
-                      budget: int, outcap_total: int):
+                      budget: int, outcap_total: int, combine=None):
     """Run the K bounded rounds and fold them into the final
     [P*outcap_total] block.  Peak per-round transient is priced ≤ budget
     (best-effort once the per-cell floor C=1 is reached); the final
     block itself is the shuffle's RESULT — the same capacity the
     single-shot exchange returns — and is not a transient this path can
     shrink (the uniform-capacity DTable model, docs/tpu_perf_notes.md
-    'hot-key skew')."""
+    'hot-key skew').
+
+    With a ``combine`` spec (the payload is a partial-group table —
+    dist_groupby_fused's combine exchange) the receiver-side fold
+    COMBINES rows by group key between rounds instead of concatenating
+    them (:func:`_fold_combine_fn`): the accumulator block holds one row
+    per distinct group received so far, so the result capacity — and
+    ``shuffle.exchange_bytes_peak`` — scales with distinct groups, not
+    received rows.  The per-round fold capacity is sized exactly from
+    the previous fold's group count (one small blocking read per round —
+    the degraded path already trades syncs for bounded memory)."""
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
     rounds, C, block, outcap_k = _chunk_sizes(Pn, counts, rbytes, budget)
     trace.count("shuffle.chunked")
@@ -351,22 +444,61 @@ def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
         exchange = _exchange_fn(mesh, axis, Pn, block, outcap_k)
         slicer = _slice_pids_fn(Pn)
         acc_cnt = acc = None
+        acc_cap = outcap_total
+        acc_groups = None  # per-shard distinct-group counts (combine)
         for k in range(rounds):
             pid_k = slicer(pid, rank, jnp.int32(k * C),
                            jnp.int32((k + 1) * C))
             cnt_k, outs_k = exchange(pid_k, tuple(leaves))
+            if combine is None:
+                if acc is None:
+                    acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
+                                            outcap_total, True)(cnt_k,
+                                                                outs_k)
+                else:
+                    acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
+                                            outcap_total, False)(
+                        acc_cnt, cnt_k, acc, outs_k)
+                continue
+            trace.count("shuffle.fold_combined")
             if acc is None:
-                acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
-                                        outcap_total, True)(cnt_k, outs_k)
+                # round 0 already combines: duplicate groups from the P
+                # senders collapse to one row each — capacity outcap_k
+                # (groups ≤ received rows) can never overflow
+                prev_cap, out_cap = 0, outcap_k
+                acc_cnt, acc = _fold_combine_fn(
+                    mesh, axis, combine, outcap_k, 0, out_cap,
+                    True)(cnt_k, outs_k)
             else:
-                acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
-                                        outcap_total, False)(
-                    acc_cnt, cnt_k, acc, outs_k)
+                # exact sizing, no overflow possible: groups after the
+                # fold ≤ groups in the accumulator (read from the last
+                # fold) + rows this round adds (host count-matrix math)
+                recv_k = np.minimum(np.maximum(counts - k * C, 0),
+                                    C).sum(axis=0)
+                bound = acc_groups + recv_k
+                prev_cap = acc_cap
+                out_cap = ops_compact.next_bucket(
+                    max(int(bound.max(initial=0)), 1), minimum=8)
+                acc_cnt, acc = _fold_combine_fn(
+                    mesh, axis, combine, outcap_k, acc_cap, out_cap,
+                    False)(acc_cnt, cnt_k, acc, outs_k)
+            acc_cap = out_cap
+            # the fold's transient: the round blocks + both accumulator
+            # generations live at once
+            trace.count_max(
+                "shuffle.exchange_bytes_peak",
+                priced_k + (prev_cap + acc_cap) * rbytes)
+            if k + 1 < rounds:
+                acc_groups = np.asarray(
+                    ops_compact._read_counts(acc_cnt))
         sp.sync(acc)
+    if combine is not None:
+        return list(acc), acc_cnt, acc_cap
     return list(acc), acc_cnt, outcap_total
 
 
-def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
+def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
+                   combine=None, owner: "str | None" = None
                    ) -> Tuple[List[jax.Array], jax.Array, int]:
     """Repartition rows of sharded ``leaves`` by target ids ``pid``.
 
@@ -382,6 +514,16 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
     exchange (hot-key skew) degrades to a chunked multi-round all_to_all
     with a bounded per-round transient — identical rows out, with
     ``shuffle.chunked_rounds`` visible in EXPLAIN ANALYZE.
+
+    ``combine`` declares the payload a partial-group table (the fused
+    aggregation exchange, dist_groupby_fused): a static leaf-layout spec
+    ``(key_slots, val_slots)`` — see :func:`_fold_combine_fn` — that the
+    chunked degraded path uses to fold rounds together BY GROUP KEY, so
+    the accumulated block (and ``shuffle.exchange_bytes_peak``) scales
+    with distinct groups instead of received rows.  The single-shot path
+    ignores it (the local combine downstream handles concatenated
+    partials).  ``owner`` attributes exchange bytes to a subsystem for
+    the per-family bench accounting (docs/observability.md).
     """
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
     hint_key = (mesh, Pn, pid.shape[0])
@@ -414,7 +556,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
         # post() sees the count matrix in immediate mode AND at the
         # deferred flush, so bench pipelines (run_pipeline) tally the
         # same rows/bytes a blocking run would (docs/observability.md)
-        _account(counts, rbytes)
+        _account(counts, rbytes, combine, owner)
         block, outcap, per_recv = _sizes_from_counts(counts)
         # Skew cliff: EVERY shard's receive block is sized to the HOTTEST
         # receiver (XLA collectives are ragged-free — uniform shapes or
@@ -466,7 +608,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
             counts = np.asarray(vals[0])
         else:
             counts = ops_compact._read_counts(cnt_dev)
-        _account(counts, rbytes)
+        _account(counts, rbytes, combine, owner)
         block, outcap, per_recv = _sizes_from_counts(counts)
         _warn_skew(Pn, hint_key, per_recv, outcap)
         need = (block, outcap)
@@ -483,7 +625,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
                 sp.sync(outs)
             return list(outs), newcounts, outcap
         return _chunked_exchange(ctx, pid, leaves, counts, rbytes,
-                                 budget, outcap)
+                                 budget, outcap, combine)
 
     try:
         with trace.span_sync("shuffle.exchange") as sp:
@@ -496,7 +638,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array]
         # came back — its result is discarded; the chunked path recovers
         # with bounded rounds from the counts the exception carries
         return _chunked_exchange(ctx, pid, leaves, ob.counts, rbytes,
-                                 budget, ob.need[1])
+                                 budget, ob.need[1], combine)
     if budget is not None:
         trace.count_max("shuffle.exchange_bytes_peak",
                         _priced_bytes(Pn, used, rbytes))

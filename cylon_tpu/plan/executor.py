@@ -240,6 +240,21 @@ def _lower_groupby(ctx, ins, static, rt):
                                  emit_empty=static["emit_empty"])
 
 
+def _lower_groupby_fused(ctx, ins, static, rt):
+    from ..parallel import dist_ops
+    where = rt.get("where")
+    if where is not None and static.get("env_map"):
+        # a select folded into the aggregation mask after prior filter
+        # pushdowns keeps reading its original column names
+        where = _mapped_pred(where, static["env_map"])
+    return dist_ops.dist_groupby_fused(
+        ins[0], list(static["keys"]),
+        [(c, op) for c, op in static["aggs"]], where=where,
+        dense_key_range=static["dense_key_range"],
+        emit_empty=static["emit_empty"], mode=static["mode"],
+        reason=static["reason"])
+
+
 def _lower_aggregate(ctx, ins, static, rt):
     from ..parallel import dist_ops
     return dist_ops.dist_aggregate(ins[0],
@@ -290,6 +305,7 @@ LOWERING = {
     "dist_semi_join": _lower_semi,
     "dist_anti_join": _lower_anti,
     "dist_groupby": _lower_groupby,
+    "dist_groupby_fused": _lower_groupby_fused,
     "dist_aggregate": _lower_aggregate,
     "dist_sort": _lower_sort,
     "dist_sort_multi": _lower_sort_multi,

@@ -131,7 +131,7 @@ EXCHANGE_OPS = frozenset({
     "shuffle_table", "dist_join", "dist_join_streaming", "dist_semi_join",
     "dist_anti_join", "dist_groupby", "dist_aggregate", "dist_sort",
     "dist_sort_multi", "dist_union", "dist_intersect", "dist_subtract",
-    "dist_multiway_join",
+    "dist_multiway_join", "dist_groupby_fused",
 })
 
 # row-count-preserving ops: plan-time row bounds flow through these
@@ -330,7 +330,10 @@ def infer_schema(op: str, ins: Sequence[Schema], static: Dict) -> Schema:
         return tuple(ColSpec(a.name, a.dtype, a.nullable or b.nullable,
                              a.dictionary, a.arrow_type)
                      for a, b in zip(ins[0], ins[1]))
-    if op == "dist_groupby":
+    if op in ("dist_groupby", "dist_groupby_fused"):
+        # the fused aggregation exchange preserves dist_groupby's output
+        # contract exactly: keys, then {op}_{col} (plan/rules.py
+        # "groupby-pushdown" relies on this schema identity)
         keys = tuple(_col(ins[0], n) for n in static["keys"])
         aggs = tuple(_agg_spec(_col(ins[0], n), agg)
                      for n, agg in static["aggs"])

@@ -30,6 +30,26 @@ Rules, in application order:
                         Broadcast-vs-shuffle per dimension is re-priced
                         against the live memory budget at every
                         execution, never baked into the cached plan.
+  groupby pushdown      every multi-shard ``dist_groupby`` lowers to the
+                        fused aggregation exchange ``dist_groupby_fused``
+                        (partial aggregation below the exchange →
+                        partial-group shuffle with in-round combining →
+                        combining aggregation, arXiv:2010.14596), with
+                        the agg decomposition (avg → sum+count, count →
+                        sum-of-counts, min/max idempotent) and the
+                        pre-aggregate-vs-raw-shuffle choice made HERE
+                        from ``ir.known_rows`` + schema stats
+                        (dictionary domains, dense key ranges) instead
+                        of dist_groupby's runtime ``near_unique``
+                        heuristic — decision + reason recorded as a plan
+                        annotation.  A single-consumer ``shuffle_table``
+                        below the groupby is absorbed (the partials
+                        re-partition on the group keys anyway), and a
+                        single-consumer parameterless ``dist_select``
+                        folds into the aggregation's row mask.  Small
+                        all-dictionary key domains with sum/count/mean
+                        aggs lower to the psum combine — the aggregation
+                        runs inside ONE all-reduce (arXiv:2106.15565).
   join strategy         broadcast-vs-shuffle decided ONCE at plan time
                         from ingest-cached row counts (`ir.known_rows` —
                         the same sync-free evidence
@@ -403,6 +423,145 @@ def _multiway_fusion(root: Node, fires: _Fires) -> Node:
 
 
 # ---------------------------------------------------------------------------
+# groupby pushdown (the fused aggregation exchange)
+# ---------------------------------------------------------------------------
+
+def _groupby_strategy(child: Node, s: Dict) -> Tuple[str, str]:
+    """Plan-time strategy for a fused groupby over ``child`` — the
+    decision dist_groupby's runtime ``near_unique`` heuristic guessed
+    from per-shard capacity, made here from sync-free plan evidence
+    (``ir.known_rows`` ingest counts + schema stats) and recorded with
+    its reason.  Returns ``(mode, reason)``."""
+    keys = s["keys"]
+    schema = child.schema
+    agg_ops = [op for _, op in s["aggs"]]
+    emit_empty = bool(s.get("emit_empty", False))
+    sizes = []
+    psum_ok = not emit_empty
+    for k in keys:
+        c = ir._col(schema, k)
+        if c.dictionary is None or len(c.dictionary) == 0:
+            psum_ok = False
+            sizes = []
+            break
+        sizes.append(len(c.dictionary) + (1 if c.nullable else 0))
+    R = 1
+    for z in sizes:
+        R *= z
+    if psum_ok and sizes \
+            and all(op in ("sum", "count", "mean") for op in agg_ops):
+        from ..parallel.dist_ops import _PSUM_SLOT_CAP
+        if R + 1 <= _PSUM_SLOT_CAP:
+            return "psum", (f"{len(keys)} dictionary key(s) span a "
+                            f"{R}-slot dense domain with "
+                            "sum/count/mean aggs: the combine runs "
+                            "inside one all-reduce")
+    if s.get("pre_aggregate") is False:
+        return "shuffle", "explicit pre_aggregate=False"
+    if s.get("pre_aggregate") is True:
+        return "pre-aggregate", "explicit pre_aggregate=True"
+    rows = ir.known_rows(child)
+    groups = evidence = None
+    dkr = s.get("dense_key_range")
+    if dkr is not None and len(keys) == 1:
+        groups = int(dkr[1]) - int(dkr[0]) + 1
+        evidence = "dense key range"
+    elif sizes and len(sizes) == len(keys):
+        groups = R
+        evidence = "dictionary domain"
+    if groups is not None and rows is not None and groups > rows \
+            and not emit_empty:
+        return "shuffle", (f"near-unique keys: {evidence} {groups} > "
+                           f"{rows} ingest rows — the partial pass "
+                           "cannot shrink the exchange")
+    if groups is not None and rows is not None:
+        return "pre-aggregate", (f"{evidence} bounds groups at {groups} "
+                                 f"vs {rows} ingest rows: partials "
+                                 "shrink the exchange")
+    return "pre-aggregate", ("no plan-time group bound: partials can "
+                             "only shrink the exchange (at most one "
+                             "row per group per shard)")
+
+
+def _groupby_pushdown(root: Node, fires: _Fires, world: int) -> Node:
+    """Lower ``dist_groupby`` nodes to the fused aggregation exchange
+    (docs/query_planner.md "groupby pushdown").  Also absorbs, beneath
+    each groupby: single-consumer ``shuffle_table`` nodes (the exchange
+    is redundant — a groupby's result does not depend on its input
+    partitioning, and the fused operator re-partitions the PARTIALS on
+    the group keys) and a single-consumer parameterless ``dist_select``
+    (its predicate becomes the aggregation's pushed-down row mask — no
+    standalone compaction materializes the filtered table).  world <= 1
+    plans stay on the eager operator: there is no exchange to push
+    below."""
+    if world <= 1:
+        return root
+    parents: Dict[int, int] = {}
+    for n in ir.topo(root):
+        for c in n.inputs:
+            parents[id(c)] = parents.get(id(c), 0) + 1
+
+    memo: Dict[int, Node] = {}
+
+    def walk(n: Node) -> Node:
+        hit = memo.get(id(n))
+        if hit is not None:
+            return hit
+        out = try_fuse(n)
+        if out is None:
+            out = _clone(n, [walk(i) for i in n.inputs])
+        memo[id(n)] = out
+        return out
+
+    def try_fuse(n: Node) -> Optional[Node]:
+        if n.op != "dist_groupby":
+            return None
+        s = n.static
+        child = n.inputs[0]
+        absorbed: List[str] = []
+        while (child.op == "shuffle_table"
+               and parents.get(id(child), 0) == 1):
+            absorbed.append("absorbed the shuffle below (partials "
+                            "re-partition on the group keys)")
+            child = child.inputs[0]
+        where_id = s.get("where_id")
+        where_reads = s.get("where_reads")
+        env_map: Tuple = ()
+        runtime = {"where": n.runtime.get("where")}
+        if (where_id is None and child.op == "dist_select"
+                and parents.get(id(child), 0) == 1
+                and not child.runtime.get("params", ())):
+            where_id = child.static["pred_id"]
+            where_reads = child.static.get("reads")
+            env_map = tuple(child.static.get("env_map", ()))
+            runtime = {"where": child.runtime["predicate"]}
+            child = child.inputs[0]
+            absorbed.append("select folded into the aggregation row "
+                            "mask (no standalone compaction)")
+        mode, reason = _groupby_strategy(child, s)
+        static = {
+            "keys": tuple(s["keys"]), "aggs": tuple(s["aggs"]),
+            "where_id": where_id, "where_reads": where_reads,
+            "env_map": env_map,
+            "dense_key_range": s.get("dense_key_range"),
+            "emit_empty": bool(s.get("emit_empty", False)),
+            "mode": mode, "reason": reason,
+        }
+        new_child = walk(child)
+        node = Node("dist_groupby_fused", [new_child], static, runtime,
+                    ir.infer_schema("dist_groupby_fused",
+                                    [new_child.schema], static),
+                    None, [], None)
+        detail = f"{mode} decided at plan time ({reason})"
+        if absorbed:
+            detail += "; " + "; ".join(absorbed)
+        fires.fire(node, "groupby-pushdown", detail)
+        return node
+
+    return walk(root)
+
+
+# ---------------------------------------------------------------------------
 # projection pruning
 # ---------------------------------------------------------------------------
 
@@ -452,7 +611,7 @@ def _required_inputs(node: Node, req: Set[str]) -> List[Set[str]]:
         return [need] + list(reversed(dim_needs))
     if node.op in ("dist_semi_join", "dist_anti_join"):
         return [req | set(s["left_on"]), set(s["right_on"])]
-    if node.op == "dist_groupby":
+    if node.op in ("dist_groupby", "dist_groupby_fused"):
         need = set(s["keys"]) | {c for c, _ in s["aggs"]}
         if s.get("where_id") is not None:
             need |= _reads_or_all(s.get("where_reads"), _names_of(ins[0]))
@@ -594,6 +753,7 @@ def optimize(builder, root: Node) -> Tuple[Node, List[str], int, int]:
     world = builder.ctx.get_world_size()
     root = _filter_pushdown(root, fires)
     root = _multiway_fusion(root, fires)
+    root = _groupby_pushdown(root, fires, world)
     root = _join_strategy(root, fires, world)
     root = _projection_pruning(root, fires)
     root = _project_cleanup(root)

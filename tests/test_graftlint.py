@@ -211,6 +211,24 @@ def test_dist_op_unlowered_covers_multiway():
     assert "dist_multiway_join" in LOWERING
 
 
+def test_dist_op_unlowered_covers_groupby_fused():
+    """The fused aggregation exchange keeps its LOWERING case: the
+    covered fixture is quiet, an uncovered sibling spelling fires, and
+    the real executor table carries the key (same guard as the multiway
+    operator above)."""
+    path = os.path.join(REPO, "cylon_tpu", "parallel", "zz_fixture.py")
+    covered = ("from ..analysis import plan_check\n"
+               "@plan_check.instrument\n"
+               "def dist_groupby_fused(dt, key_columns, aggregations):\n"
+               "    return dt\n")
+    assert _rules(covered, path) == []
+    uncovered = covered.replace("dist_groupby_fused",
+                                "dist_groupby_fused_v2")
+    assert _rules(uncovered, path) == ["dist-op-unlowered"]
+    from cylon_tpu.plan.executor import LOWERING
+    assert "dist_groupby_fused" in LOWERING
+
+
 def test_ci_entry_point(tmp_path):
     """``python -m cylon_tpu.analysis.ci``: stage aggregation + the
     usage contract (the plan-check stage itself is covered by the

@@ -1,0 +1,459 @@
+"""Fused aggregation exchange (plan/rules "groupby pushdown" +
+dist_ops.dist_groupby_fused + shuffle fold-by-key): parity against the
+eager dist_groupby across key flavors x every supported agg, the
+plan-time strategy decisions and their recorded reasons, exact
+exchange-volume accounting of the partial-group exchange, the
+groups<<rows chunked case (exchange_bytes_peak bounded by the partial
+table, not input rows), and the chaos gate over a fused+chunked plan
+(docs/query_planner.md, docs/tpu_perf_notes.md "aggregation below the
+exchange")."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import config as cfg
+from cylon_tpu import plan as planner
+from cylon_tpu import trace
+from cylon_tpu.parallel import (DTable, broadcast, dist_groupby,
+                                dist_groupby_fused, dist_ops)
+from cylon_tpu.parallel import shuffle as shmod
+
+ALL_AGGS = [("v", "sum"), ("v", "mean"), ("w", "min"), ("w", "max"),
+            ("v", "count")]
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    """Fresh plan cache / chunk state / counter window per test."""
+    planner.clear_plan_cache()
+    shmod.clear_chunk_state()
+    broadcast.clear_replica_cache()
+    trace.enable_counters()
+    trace.reset()
+    yield
+    trace.disable_counters()
+    trace.reset()
+    shmod.clear_chunk_state()
+    planner.clear_plan_cache()
+
+
+def _frame(res) -> pd.DataFrame:
+    if not hasattr(res, "to_pandas"):
+        res = res.to_table()
+    df = res.to_pandas()
+    for c in df.columns:
+        if isinstance(df[c].dtype, pd.CategoricalDtype):
+            df[c] = df[c].astype(str)
+    return df
+
+
+def assert_same_groups(got: pd.DataFrame, want: pd.DataFrame):
+    """Row-set equality for groupby outputs: align on the (sorted)
+    stringified key columns, compare value columns with float
+    tolerance."""
+    assert list(got.columns) == list(want.columns)
+    assert len(got) == len(want), (len(got), len(want))
+
+    def canon(df):
+        s = df.copy()
+        for c in s.columns:
+            s[c] = s[c].astype(str)
+        return df.iloc[s.sort_values(list(s.columns)).index] \
+            .reset_index(drop=True)
+
+    g, w = canon(got), canon(want)
+    for c in g.columns:
+        if pd.api.types.is_float_dtype(w[c]):
+            np.testing.assert_allclose(
+                g[c].to_numpy(np.float64), w[c].to_numpy(np.float64),
+                rtol=1e-4, atol=1e-6)
+        else:
+            assert g[c].astype(str).tolist() == w[c].astype(str).tolist(), c
+
+
+def _run_pair(dctx, op, tables):
+    """(eager frame, opt frame, eager bytes, opt bytes, eager counters,
+    opt counters) with cleared replica cache per leg."""
+    out = {}
+    for leg in ("eager", "opt"):
+        broadcast.clear_replica_cache()
+        trace.reset()
+        res = op(tables) if leg == "eager" else dctx.optimize(op, tables)
+        f = _frame(res)
+        c = dict(trace.counters())
+        out[leg] = (f, c.get("shuffle.bytes_sent", 0)
+                    + c.get("broadcast.bytes_sent", 0), c)
+    return (out["eager"][0], out["opt"][0], out["eager"][1],
+            out["opt"][1], out["eager"][2], out["opt"][2])
+
+
+def _opt_notes(rep):
+    return [n.info["optimizer"] for n in rep.nodes if "optimizer" in n.info]
+
+
+# ---------------------------------------------------------------------------
+# fixtures: one table per key flavor (module-scoped: compiles amortize)
+# ---------------------------------------------------------------------------
+
+N = 6000
+
+
+@pytest.fixture(scope="module")
+def flavors(dctx):
+    rng = np.random.default_rng(5)
+    v = rng.random(N)
+    w = rng.integers(0, 1000, N)
+    wn = pd.array(np.where(np.arange(N) % 11 == 0, None, w),
+                  dtype="Int64")
+    base = {"v": v, "w": wn}
+    intk = (np.arange(N) % 37).astype(np.int64)
+    tabs = {
+        "int": pd.DataFrame({"k": intk, **base}),
+        "dict-string": pd.DataFrame({
+            "k": np.take(np.array([f"g{i:02d}" for i in range(23)]),
+                         rng.integers(0, 23, N)), **base}),
+        "null": pd.DataFrame({
+            "k": pd.array(np.where(np.arange(N) % 13 == 0, None, intk),
+                          dtype="Int64"), **base}),
+        "composite": pd.DataFrame({
+            "k": intk % 6,
+            "k2": np.take(np.array(["x", "y", "z"]),
+                          rng.integers(0, 3, N)), **base}),
+    }
+    return {name: DTable.from_pandas(dctx, df)
+            for name, df in tabs.items()}
+
+
+# ---------------------------------------------------------------------------
+# parity: fused (optimizer) vs eager across key flavors x all aggs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("flavor", ["int", "dict-string", "null",
+                                    "composite"])
+def test_fused_parity(dctx, flavors, flavor):
+    keys = ["k", "k2"] if flavor == "composite" else ["k"]
+
+    def op(t):
+        return dist_ops.dist_groupby(t, keys, ALL_AGGS)
+
+    ef, of, eb, ob, _, oc = _run_pair(dctx, op, flavors[flavor])
+    assert_same_groups(of, ef)
+    assert oc.get("groupby.pushdown", 0) >= 1, oc
+    assert ob <= eb, f"{flavor}: fused moved {ob - eb} MORE bytes"
+    assert ob < eb, f"{flavor}: fused must beat the combine gather"
+
+
+def test_fused_direct_call_modes(dctx, flavors):
+    """dist_groupby_fused is callable directly; every mode agrees with
+    the eager groupby (psum falls back when the keys aren't
+    dictionary-encoded)."""
+    dt = flavors["int"]
+    want = _frame(dist_groupby(dt, ["k"], ALL_AGGS))
+    for mode in ("pre-aggregate", "shuffle"):
+        got = _frame(dist_groupby_fused(dt, ["k"], ALL_AGGS, mode=mode))
+        assert_same_groups(got, want)
+    # int keys are not psum-eligible: the execution re-check degrades
+    trace.reset()
+    got = _frame(dist_groupby_fused(dt, ["k"], ALL_AGGS, mode="psum"))
+    assert_same_groups(got, want)
+    assert trace.counters().get("groupby.psum_combine", 0) == 0
+    with pytest.raises(Exception):
+        dist_groupby_fused(dt, ["k"], ALL_AGGS, mode="nope")
+
+
+# ---------------------------------------------------------------------------
+# the psum combine (aggregation inside the collective)
+# ---------------------------------------------------------------------------
+
+def test_psum_combine_dict_keys(dctx, flavors):
+    """Dictionary keys + sum/count/mean lower to the one-all-reduce
+    combine: no count protocol, fewer bytes than the eager gather, and
+    parity (incl. a nullable value column)."""
+    aggs = [("v", "sum"), ("v", "mean"), ("w", "sum"), ("v", "count")]
+
+    def op(t):
+        return dist_ops.dist_groupby(t, ["k"], aggs)
+
+    ef, of, eb, ob, _, oc = _run_pair(dctx, op, flavors["dict-string"])
+    assert_same_groups(of, ef)
+    assert oc.get("groupby.psum_combine", 0) == 1, oc
+    assert oc.get("groupby.broadcast_gather", 0) == 0, oc
+    assert oc.get("shuffle.exchanges", 0) == 0, oc
+    assert 0 < ob < eb
+    rep = flavors["dict-string"].explain(op, tables=flavors["dict-string"],
+                                         optimize=True)
+    notes = _opt_notes(rep)
+    assert any("groupby-pushdown" in n and "psum" in n for n in notes), \
+        notes
+
+
+def test_psum_combine_composite_nullable_keys(dctx):
+    """Composite dictionary keys with nulls: each column contributes
+    its own null code, so null==null grouping composes correctly."""
+    rng = np.random.default_rng(9)
+    n = 3000
+    a = np.take(np.array(["p", "q", "r"]), rng.integers(0, 3, n)
+                ).astype(object)
+    a[::17] = None
+    b = np.take(np.array(["u", "vv"]), rng.integers(0, 2, n)
+                ).astype(object)
+    b[::23] = None
+    df = pd.DataFrame({"a": a, "b": b, "v": rng.random(n)})
+    dt = DTable.from_pandas(dctx, df)
+
+    def op(t):
+        return dist_ops.dist_groupby(t, ["a", "b"],
+                                     [("v", "sum"), ("v", "count")])
+
+    ef, of, _, _, _, oc = _run_pair(dctx, op, dt)
+    assert_same_groups(of, ef)
+    assert oc.get("groupby.psum_combine", 0) == 1, oc
+
+
+def test_min_max_never_psum(dctx, flavors):
+    """min/max have no SUM all-reduce decomposition: dict keys still
+    take the partial exchange, not the psum combine."""
+    def op(t):
+        return dist_ops.dist_groupby(t, ["k"], [("w", "min")])
+
+    ef, of, _, _, _, oc = _run_pair(dctx, op, flavors["dict-string"])
+    assert_same_groups(of, ef)
+    assert oc.get("groupby.psum_combine", 0) == 0, oc
+    assert oc.get("groupby.pushdown", 0) == 1, oc
+
+
+# ---------------------------------------------------------------------------
+# plan-time strategy + annotations (the near_unique hoist)
+# ---------------------------------------------------------------------------
+
+def test_near_unique_planned_from_ingest_counts(dctx):
+    """A dense key range wider than the ingest row count plans the raw
+    shuffle (the partial pass cannot shrink the exchange) — decided
+    from ir.known_rows, recorded with its reason."""
+    n = 2000
+    df = pd.DataFrame({"k": np.arange(n, dtype=np.int64),
+                       "v": np.ones(n)})
+    dt = DTable.from_pandas(dctx, df)
+
+    def op(t):
+        return dist_ops.dist_groupby(t, ["k"], [("v", "sum")],
+                                     dense_key_range=(0, 3 * n))
+
+    rep = dt.explain(op, tables=dt, optimize=True)
+    notes = _opt_notes(rep)
+    assert any("groupby-pushdown" in x and "near-unique" in x
+               for x in notes), notes
+    ef, of, eb, ob, _, _ = _run_pair(dctx, op, dt)
+    assert_same_groups(of, ef)
+    assert ob <= eb
+
+
+def test_eager_decision_reasons_annotated(dctx, flavors):
+    """Satellite: the eager dist_groupby's pre_aggregate decision now
+    carries a REASON in static EXPLAIN (pre-aggregate default,
+    near_unique-skip, explicit False), like the join-strategy notes."""
+    dt = flavors["int"]
+    rep = dt.explain(lambda t: dist_ops.dist_groupby(t, ["k"],
+                                                     [("v", "sum")]),
+                     tables=dt)
+    g = [n for n in rep.nodes if n.op == "dist_groupby"]
+    assert g and g[0].info.get("decision") == "pre-aggregate"
+    assert "partials replace" in g[0].info.get("reason", "")
+    rep2 = dt.explain(
+        lambda t: dist_ops.dist_groupby(t, ["k"], [("v", "sum")],
+                                        pre_aggregate=False), tables=dt)
+    g2 = [n for n in rep2.nodes if n.op == "dist_groupby"]
+    assert g2 and g2[0].info.get("reason") == "explicit pre_aggregate=False"
+    n_rows = dt.num_rows
+    rep3 = dt.explain(
+        lambda t: dist_ops.dist_groupby(
+            t, ["k"], [("v", "sum")],
+            dense_key_range=(0, 50 * n_rows)), tables=dt)
+    g3 = [n for n in rep3.nodes if n.op == "dist_groupby"]
+    assert g3 and "near_unique-skip" in g3[0].info.get("reason", "")
+
+
+def test_shuffle_below_groupby_absorbed(dctx, flavors):
+    """A single-consumer shuffle_table below the groupby is redundant
+    (the fused exchange re-partitions partials on the group keys): the
+    optimized plan runs strictly fewer exchanges."""
+    dt = flavors["int"]
+
+    def op(t):
+        sh = dist_ops.shuffle_table(t, ["k"])
+        return dist_ops.dist_groupby(sh, ["k"], [("v", "sum")])
+
+    ef, of, eb, ob, ec, oc = _run_pair(dctx, op, dt)
+    assert_same_groups(of, ef)
+    assert ob < eb
+    from cylon_tpu.observe import exchange_count
+    assert exchange_count(oc) < exchange_count(ec), (oc, ec)
+    rep = dt.explain(op, tables=dt, optimize=True)
+    assert any("absorbed the shuffle" in n for n in _opt_notes(rep))
+
+
+def _pred_w(env):
+    return env["v"] > 0.25
+
+
+def test_select_folds_into_groupby_mask(dctx, flavors):
+    """A single-consumer parameterless select below the groupby becomes
+    the aggregation's pushed-down row mask — same rows, no standalone
+    compaction, SQL null semantics preserved."""
+    dt = flavors["null"]
+
+    def op(t):
+        sel = dist_ops.dist_select(t, _pred_w)
+        return dist_ops.dist_groupby(sel, ["k"], ALL_AGGS)
+
+    ef, of, eb, ob, _, oc = _run_pair(dctx, op, flavors["null"])
+    assert_same_groups(of, ef)
+    assert ob <= eb
+    rep = dt.explain(op, tables=dt, optimize=True)
+    assert any("select folded" in n for n in _opt_notes(rep))
+
+
+def test_emit_empty_dense_parity(dctx):
+    """The q13 shape: dense emit_empty groupby (zero-count keys
+    included) stays correct through the fused exchange."""
+    n = 4000
+    rng = np.random.default_rng(3)
+    # keys in [1, 300] with a gap: [120, 140) never occurs
+    k = rng.integers(1, 301, n)
+    k = np.where((k >= 120) & (k < 140), 7, k).astype(np.int64)
+    df = pd.DataFrame({"k": k, "v": rng.random(n)})
+    dt = DTable.from_pandas(dctx, df)
+
+    def op(t):
+        return dist_ops.dist_groupby(t, ["k"], [("k", "count")],
+                                     dense_key_range=(1, 300),
+                                     emit_empty=True)
+
+    ef, of, eb, ob, _, oc = _run_pair(dctx, op, dt)
+    assert len(ef) == 300
+    assert_same_groups(of, ef)
+    assert oc.get("groupby.pushdown", 0) == 1
+    assert ob <= eb
+
+
+def test_plan_cache_replays_fused_plan(dctx, flavors):
+    def op(t):
+        return dist_ops.dist_groupby(t, ["k"], [("v", "sum")])
+
+    first = _frame(dctx.optimize(op, flavors["int"]))
+    trace.reset()
+    second = _frame(dctx.optimize(op, flavors["int"]))
+    c = trace.counters()
+    assert c.get("plan.cache_hit", 0) == 1
+    assert c.get("groupby.pushdown", 0) == 1
+    assert_same_groups(second, first)
+
+
+# ---------------------------------------------------------------------------
+# exchange-volume accounting: partials, not pre-aggregation inputs
+# ---------------------------------------------------------------------------
+
+def test_partial_exchange_exact_bytes(dctx):
+    """The partial-group exchange accounts the PARTIALS actually moved,
+    never the pre-aggregation input rows: with a cyclic key every shard
+    holds all G keys, so exactly P x G partial rows enter the combine
+    (vs N >> P x G input rows), and bytes_sent == rows_sent x the
+    partial row width (the PR 3 exact-agreement shape)."""
+    import jax
+    G, P = 32, dctx.get_world_size()
+    n = 8960  # divisible by 8: every contiguous ingest block covers G
+    df = pd.DataFrame({"k": (np.arange(n) % G).astype(np.int64),
+                       "v": np.ones(n)})
+    dt = DTable.from_pandas(dctx, df)
+    trace.reset()
+    out = dist_groupby_fused(dt, ["k"], [("v", "sum"), ("v", "count")],
+                             mode="pre-aggregate")
+    assert out.num_rows == G
+    c = trace.counters()
+    assert c.get("groupby.partials_rows", 0) == P * G, c
+    rows = c.get("shuffle.rows_sent", 0)
+    assert 0 < rows <= P * G < n
+    assert jax.config.jax_enable_x64
+    width = 8 + 8 + 8  # k int64 + sum_v float64 + count_v int64
+    assert c.get("shuffle.bytes_sent", 0) == rows * width, c
+    assert c.get("groupby.bytes_moved", 0) == rows * width, c
+
+
+# ---------------------------------------------------------------------------
+# the hierarchical (chunked fold-by-key) variant
+# ---------------------------------------------------------------------------
+
+def _groups_ll_rows(dctx):
+    """groups << rows, every key on every shard, nullable keys/values,
+    every agg family — the fold-by-key coverage table."""
+    rng = np.random.default_rng(17)
+    n, G = 24000, 48
+    k = (np.arange(n) % G).astype(np.int64)
+    df = pd.DataFrame({
+        "k": pd.array(np.where(np.arange(n) % 53 == 0, None, k),
+                      dtype="Int64"),
+        "v": rng.random(n),
+        "w": pd.array(np.where(np.arange(n) % 29 == 0, None,
+                               rng.integers(0, 500, n)), dtype="Int64"),
+    })
+    return DTable.from_pandas(dctx, df), n, G
+
+
+def test_chunked_fold_peak_scales_with_groups(dctx):
+    """Under a tightened CYLON_MEMORY_BUDGET the partial-group exchange
+    degrades to chunked rounds whose receiver-side fold combines BY KEY:
+    exchange_bytes_peak stays bounded by the partial-group table (a few
+    group-sized blocks), nowhere near the input rows — and the rows
+    come out identical to the unbudgeted eager groupby."""
+    dt, n, G = _groups_ll_rows(dctx)
+    want = _frame(dist_groupby(dt, ["k"], ALL_AGGS))
+    trace.reset()
+    shmod.clear_chunk_state()
+    prev = cfg.set_device_memory_budget(6_000)
+    try:
+        got = _frame(dist_groupby_fused(dt, ["k"], ALL_AGGS,
+                                        mode="pre-aggregate"))
+        c = dict(trace.counters())
+    finally:
+        cfg.set_device_memory_budget(prev)
+        shmod.clear_chunk_state()
+    assert_same_groups(got, want)
+    assert c.get("shuffle.chunked", 0) >= 1, c
+    assert c.get("shuffle.fold_combined", 0) >= 2, c
+    peak = c.get("shuffle.exchange_bytes_peak", 0)
+    # partial row width: Int64 key (8+1 validity) + 5 partial lanes
+    # (sum f64, count i64, min/max i64 + validity, count i64) ~ 60 B;
+    # the bound below is ~3 partial-table blocks — input rows at this
+    # width would price ~60x higher
+    partial_bytes = (G + 1) * 70
+    assert peak <= 16 * partial_bytes, (peak, partial_bytes)
+    assert peak < n * 60 / 4, "peak must not scale with input rows"
+
+
+def test_chunked_fold_chaos_parity(dctx):
+    """CYLON_CHAOS leg over a fused + chunked plan: a seeded default
+    FaultPlan (transient host-read faults, undersized hints, budget
+    pressure) must not change the result, and no retry loop may
+    exhaust."""
+    from cylon_tpu import faults, resilience
+    from cylon_tpu.resilience import RetryPolicy
+    dt, n, G = _groups_ll_rows(dctx)
+    want = _frame(dist_groupby(dt, ["k"], ALL_AGGS))
+    plan = faults.FaultPlan.default(23)
+    prev_policy = resilience.set_retry_policy(
+        RetryPolicy(max_attempts=6, base_delay_s=0.0))
+    prev = cfg.set_device_memory_budget(6_000)
+    trace.reset()
+    shmod.clear_chunk_state()
+    try:
+        with faults.active(plan):
+            got = _frame(dctx.optimize(
+                lambda t: dist_ops.dist_groupby(t, ["k"], ALL_AGGS), dt))
+        c = dict(trace.counters())
+    finally:
+        cfg.set_device_memory_budget(prev)
+        resilience.set_retry_policy(prev_policy)
+        shmod.clear_chunk_state()
+    assert_same_groups(got, want)
+    assert c.get("retry.exhausted", 0) == 0, c
+    assert c.get("groupby.pushdown", 0) >= 1, c

@@ -369,6 +369,59 @@ def test_multiway_metrics_catalogued():
         assert spec.doc
 
 
+def test_groupby_pushdown_metrics_catalogued():
+    """The fused-aggregation-exchange counters are documented catalogue
+    entries (same compliance contract as the multiway set above)."""
+    for name in ("groupby.pushdown", "groupby.partials_rows",
+                 "groupby.psum_combine", "groupby.bytes_moved",
+                 "shuffle.fold_combined"):
+        spec = observe.METRICS.get(name)
+        assert spec is not None, name
+        assert spec.kind == observe.COUNTER, name
+        assert spec.doc
+    # the psum combine counts as a whole exchange (the bench column +
+    # the parity tests' exchange budget share this definition)
+    assert observe.exchange_count({"groupby.psum_combine": 2}) == 2
+
+
+def test_benchdiff_gates_exchange_bytes_peak_up(tmp_path, capsys):
+    """tpch_*_exchange_bytes_peak gates UP as a first-class family: a
+    chunked-path peak-memory regression no longer passes CI silently;
+    sub-floor byte deltas stay noise."""
+    old = _artifact(tmp_path, "old.json",
+                    {"tpch_q13_exchange_bytes_peak": 1 << 20})
+    new = _artifact(tmp_path, "new.json",
+                    {"tpch_q13_exchange_bytes_peak": 4 << 20})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "tpch_q13_exchange_bytes_peak" in out and "REGRESSED" in out
+    better = _artifact(tmp_path, "better.json",
+                       {"tpch_q13_exchange_bytes_peak": 1 << 18})
+    assert benchdiff.main([old, better]) == 0
+    # below the absolute bytes floor: scheduler noise, not a regression
+    tiny_old = _artifact(tmp_path, "tiny_old.json",
+                         {"tpch_q13_exchange_bytes_peak": 1000.0})
+    tiny_new = _artifact(tmp_path, "tiny_new.json",
+                         {"tpch_q13_exchange_bytes_peak": 9000.0})
+    assert benchdiff.main([tiny_old, tiny_new]) == 0
+
+
+def test_benchdiff_gates_groupby_bytes_saved_down(tmp_path, capsys):
+    """tpch_*_groupby_bytes_saved gates DOWN: the fused aggregation
+    exchange silently losing its byte savings fails even when total
+    bytes_moved drifted for other reasons."""
+    old = _artifact(tmp_path, "old.json",
+                    {"tpch_q1_groupby_bytes_saved": 4 << 20})
+    new = _artifact(tmp_path, "new.json",
+                    {"tpch_q1_groupby_bytes_saved": 1 << 20})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "tpch_q1_groupby_bytes_saved" in out and "REGRESSED" in out
+    better = _artifact(tmp_path, "better.json",
+                       {"tpch_q1_groupby_bytes_saved": 8 << 20})
+    assert benchdiff.main([old, better]) == 0
+
+
 def test_benchdiff_gates_exchange_count_up(tmp_path, capsys):
     """tpch_*_exchange_count gates UP: a planner regression that
     re-splits a fused multiway join adds whole exchanges and fails;

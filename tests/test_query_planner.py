@@ -434,6 +434,23 @@ def test_tpch_byte_savings_floor(dctx):
         f"only {reduced} moved fewer bytes under the optimizer"
 
 
+def test_tpch_groupby_byte_savings_floor(dctx):
+    """EVERY groupby-bearing acceptance target (q1/q3/q4/q13/q16) moves
+    strictly fewer bytes under the optimizer — the fused aggregation
+    exchange acceptance floor (ISSUE 8): the partial shuffle / psum
+    combine replaces the eager tail's replicate-everywhere combine
+    gather, measured, not priced."""
+    if len(_TPCH_BYTES) < 22:
+        pytest.skip("needs the full test_tpch_parity sweep in-session")
+    targets = ("q1", "q3", "q4", "q13", "q16")
+    not_reduced = sorted(q for q in targets
+                         if not _TPCH_BYTES[q][1] < _TPCH_BYTES[q][0])
+    assert not not_reduced, (
+        f"{not_reduced} did not move fewer bytes under the fused "
+        f"aggregation exchange: "
+        f"{ {q: _TPCH_BYTES[q] for q in targets} }")
+
+
 def test_tpch_multiway_fusion_floor(dctx):
     """≥ 3 of the star-schema targets (q2/q5/q7/q8/q9/q10) lower
     through ``dist_multiway_join`` under the optimizer — the ISSUE 6
