@@ -1105,6 +1105,73 @@ def main() -> None:
             em.detail["tpch_geomean_vs_pandas"] = round(
                 float(np.exp(np.mean(np.log(ratios)))), 3)
 
+        # serving stage (docs/serving.md): a mixed workload of
+        # concurrent TPC-H queries through cylon_tpu/serve — one client
+        # thread per query submitting CYLON_BENCH_SERVE_REPS times into
+        # shared batch windows, results exported to pandas on the async
+        # host lane.  QPS counts completed queries over the whole wall
+        # (submit of the first to export of the last); p50/p99 are
+        # per-query submit→export latencies.  benchdiff gates serve_qps
+        # DOWN and serve_p99_ms UP.  Plan/kernel caches are warm from
+        # the per-query stage above — this measures the serving loop's
+        # steady state, not compilation.
+        if (q_ms and remaining() > 90
+                and os.environ.get("CYLON_BENCH_SERVE", "1") != "0"):
+            import threading as _threading
+
+            from cylon_tpu.serve import ServeSession
+            prefer = ["q1", "q6", "q3", "q12", "q14", "q19", "q5", "q10"]
+            mix = [q for q in prefer if q in q_ms][:8]
+            if not mix:
+                mix = list(q_ms)[:8]
+            reps = int(os.environ.get("CYLON_BENCH_SERVE_REPS", "2"))
+            _progress(f"serving mixed workload: {len(mix)} clients x "
+                      f"{reps} reps")
+            try:
+                with ServeSession(ctx, tables=dts,
+                                  batch_window_ms=8.0) as srv:
+                    handles = []
+                    hlock = _threading.Lock()
+
+                    def client(qname):
+                        qfn = queries.QUERIES[qname]
+                        for _ in range(reps):
+                            h = srv.submit(lambda t, q=qfn: q(ctx, t),
+                                           label=qname,
+                                           export=lambda r: r.to_pandas())
+                            with hlock:
+                                handles.append(h)
+
+                    t0 = time.perf_counter()
+                    threads = [_threading.Thread(target=client,
+                                                 args=(q,))
+                               for q in mix]
+                    for th in threads:
+                        th.start()
+                    for th in threads:
+                        th.join()
+                    for h in handles:
+                        h.result(timeout=600)
+                    serve_wall = time.perf_counter() - t0
+                    st = srv.stats()
+                em.detail["serve_queries"] = len(handles)
+                em.detail["serve_clients"] = len(mix)
+                em.detail["serve_qps"] = round(len(handles) / serve_wall,
+                                               2)
+                em.detail["serve_p50_ms"] = round(st["p50_ms"], 2)
+                em.detail["serve_p99_ms"] = round(st["p99_ms"], 2)
+                em.detail["serve_subplan_shared"] = st["subplan_shared"]
+                em.detail["serve_deferred"] = st["deferred"]
+                em.detail["serve_batches"] = st["batches"]
+                _progress(f"serving: {em.detail['serve_qps']} qps, "
+                          f"p99 {em.detail['serve_p99_ms']} ms, "
+                          f"{st['subplan_shared']} shared subplans")
+            except Exception as e:  # graftlint: ok[broad-except] — the serving stage must not kill the bench
+                print(f"serving stage FAILED: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+                em.detail["serve_error"] = str(e)[:200]
+            em.emit("serve")
+
     em.detail["bench_wall_s"] = round(time.monotonic() - t_start, 1)
     em.emit("final")
 

@@ -25,6 +25,11 @@ Layer map (tpu-native mirror of SURVEY.md §1):
                       docs/robustness.md
     faults.py         deterministic fault injection (seeded FaultPlan
                       over named fault points) — docs/robustness.md
+    plan/             lazy logical-plan IR, rewrite rules, compiled-plan
+                      cache — docs/query_planner.md
+    serve/            multi-query serving: admission control, batch
+                      windows, cross-query subplan sharing, async
+                      export — docs/serving.md
 """
 
 from . import analysis, faults, observe, resilience, trace

@@ -31,6 +31,11 @@ Gated metrics (relative threshold, default 15%):
   * ``tpch_<q>_groupby_bytes_saved``  groupby-owned exchange bytes the
     fused aggregation exchange keeps off the wire vs the eager tail
     (lower = worse; docs/query_planner.md "groupby pushdown")
+  * ``serve_qps``               mixed-workload serving throughput
+    (lower = worse) and ``serve_p99_ms`` tail latency (higher = worse)
+    — the serving layer's benchdiff family (docs/serving.md); p50 is
+    reported but not gated (the tail is where admission/sharing
+    regressions surface first)
 
 A gated metric present in OLD but absent from NEW fails the gate
 outright (``MISSING``): a query that crashed or was skipped emits no ms
@@ -92,6 +97,12 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     (r"tpch_q\d+_exchange_bytes_peak$", "up"),
     # groupby-owned bytes the fused aggregation exchange saves
     (r"tpch_q\d+_groupby_bytes_saved$", "down"),
+    # serving family (docs/serving.md): mixed-workload throughput gated
+    # DOWN, tail latency gated UP — a regression in admission, sharing
+    # or the export overlap shows up in one of these two even when the
+    # per-query tpch numbers are unchanged
+    (r"serve_qps$", "down"),
+    (r"serve_p99_ms$", "up"),
 )
 
 

@@ -1,12 +1,13 @@
-"""ci — the one-command static-analysis gate.
+"""ci — the one-command static-analysis + smoke gate.
 
-Replaces the three separate invocations the docs used to prescribe
-(graftlint, a plan_check pre-flight, benchdiff) with a single entry
-point that runs them in sequence and aggregates their exit codes::
+Replaces the separate invocations the docs used to prescribe
+(graftlint, a plan_check pre-flight, a serving smoke, benchdiff) with a
+single entry point that runs them in sequence and aggregates their exit
+codes::
 
-    python -m cylon_tpu.analysis.ci                      # lint + plan-check
+    python -m cylon_tpu.analysis.ci                      # lint + checks
     python -m cylon_tpu.analysis.ci --baseline OLD.json NEW.json
-    python -m cylon_tpu.analysis.ci --no-plan-check      # lint only (fast)
+    python -m cylon_tpu.analysis.ci --no-plan-check --no-serve-smoke
 
 Stages:
 
@@ -18,8 +19,17 @@ Stages:
      optimized plan through ``plan.run``), so a rewrite-rule bug fails
      CI in milliseconds instead of a compiled-and-crashed bench stage
      (``--tpch-sf`` scales the dataset; ``--no-plan-check`` skips);
-  3. **benchdiff** (only when ``--baseline`` and a candidate artifact
-     are given): the bench regression gate, unchanged semantics.
+  3. **serving smoke** (docs/serving.md): a small mixed workload —
+     concurrent TPC-H queries through ``cylon_tpu/serve`` — must return
+     results row-identical to serial execution AND share at least one
+     cross-query subplan (``serve.subplan_shared`` floor ≥ 1): the
+     sharing machinery silently degrading to
+     every-query-executes-everything fails CI here
+     (``--no-serve-smoke`` skips);
+  4. **benchdiff** (only when ``--baseline`` and a candidate artifact
+     are given): the bench regression gate, unchanged semantics —
+     including the serving family (``serve_qps`` down /
+     ``serve_p99_ms`` up).
 
 Exit code is the worst across stages under the shared contract: 0 clean,
 1 findings/regressions/plan errors, 2 usage or tooling errors.
@@ -47,14 +57,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/3: graftlint ==")
+    print("== ci stage 1/4: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/3: plan_check pre-flight ==")
+    print("== ci stage 2/4: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -110,10 +120,130 @@ def _stage_plan_check(sf: float) -> int:
     return 1 if bad else 0
 
 
+def _stage_serve_smoke(sf: float) -> int:
+    """A small mixed serving workload: 3 client threads × 2 TPC-H
+    queries (q1 twice, q6 once) through one batch window — results must
+    match serial execution row-for-row and at least ONE cross-query
+    subplan must have been served from the shared memo."""
+    print("== ci stage 3/4: serving smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import threading
+
+        import jax
+
+        from .. import plan as planner
+        from ..context import CylonContext
+        from ..parallel.dtable import DTable
+        from ..serve import ServeSession
+        from ..tpch import generate
+        from ..tpch.queries import QUERIES
+
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(sf, seed=7)
+        dts = {name: DTable.from_pandas(ctx, df)
+               for name, df in data.items()}
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding —
+        # the same contract as the plan_check stage above
+        print(f"serving smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    try:
+        mix = [("q1", QUERIES["q1"]), ("q6", QUERIES["q6"]),
+               ("q1", QUERIES["q1"])]   # the repeat is the share seed
+        serial = {}
+        for name, qfn in mix:
+            if name not in serial:
+                serial[name] = planner.run(
+                    ctx, lambda t, q=qfn: q(ctx, t), dts).to_pandas()
+        with ServeSession(ctx, tables=dts, batch_window_ms=50.0) as s:
+            handles = []
+
+            def client(qfn, label):
+                handles.append(s.submit(
+                    lambda t, q=qfn: q(ctx, t), label=label,
+                    export=lambda r: r.to_pandas()))
+
+            threads = [threading.Thread(target=client, args=(q, n))
+                       for n, q in mix]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            results = [(h.label, h.result(timeout=600)) for h in handles]
+            stats = s.stats()
+        import numpy as np
+        import pandas as pd
+
+        def canon(df):
+            out = df.copy()
+            for c in out.columns:
+                if isinstance(out[c].dtype, pd.CategoricalDtype):
+                    out[c] = out[c].astype(str)
+            return out.sort_values(list(out.columns)) \
+                .reset_index(drop=True)
+
+        for label, got in results:
+            g, w = canon(got), canon(serial[label])
+            same = list(g.columns) == list(w.columns) and len(g) == len(w)
+            if same:
+                for c in g.columns:
+                    if pd.api.types.is_float_dtype(w[c]):
+                        # the suite's rowset tolerance (an rtol-only
+                        # compare flakes on near-zero aggregates)
+                        same = bool(np.allclose(
+                            g[c].to_numpy(np.float64),
+                            w[c].to_numpy(np.float64),
+                            rtol=1e-4, atol=1e-6))
+                    else:
+                        same = g[c].astype(str).tolist() \
+                            == w[c].astype(str).tolist()
+                    if not same:
+                        break
+            if not same:
+                print(f"serving smoke: {label} result DIVERGED from "
+                      "serial execution", file=sys.stderr)
+                bad += 1
+        if stats["subplan_shared"] < 1:
+            print("serving smoke: no cross-query subplan was shared "
+                  "(serve.subplan_shared floor is 1) — the sharing "
+                  "machinery degraded to execute-everything",
+                  file=sys.stderr)
+            bad += 1
+        # the floor must not be satisfiable by scan/metadata hits
+        # alone: the repeated q1 shares its whole chain (lru_cached
+        # predicate factories keep node identities stable), so demand
+        # at least one shared OPERATOR beyond the free prefix tier
+        shared_ops = {op for h in handles for op in h.shared_subplans}
+        if not (shared_ops - {"scan", "dist_project", "rename"}):
+            print("serving smoke: only scan/projection prefixes were "
+                  f"shared ({sorted(shared_ops)}) — exchange-level "
+                  "sharing degraded", file=sys.stderr)
+            bad += 1
+        if stats["failed"]:
+            print(f"serving smoke: {stats['failed']} quer(ies) failed",
+                  file=sys.stderr)
+            bad += 1
+        p50 = stats["p50_ms"]   # None when nothing completed
+        print(f"serving smoke: {len(results)} queries, "
+              f"{stats['subplan_shared']} shared subplans, "
+              f"p50={'n/a' if p50 is None else f'{p50:.0f} ms'} "
+              f"({time.perf_counter() - t0:.1f}s, sf={sf})")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract and
+        # let the remaining stages run instead of dying with a traceback
+        print(f"serving smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 3/3: benchdiff ==")
+    print("== ci stage 4/4: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -135,6 +265,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "pre-flight dataset (default 0.002)")
     ap.add_argument("--no-plan-check", action="store_true",
                     help="skip the plan_check pre-flight stage")
+    ap.add_argument("--no-serve-smoke", action="store_true",
+                    help="skip the serving smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -144,12 +276,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/3: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/4: plan_check pre-flight == (skipped)")
+    if not args.no_serve_smoke:
+        rcs.append(_stage_serve_smoke(args.tpch_sf))
+    else:
+        print("== ci stage 3/4: serving smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 3/3: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 4/4: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

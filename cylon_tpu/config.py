@@ -151,6 +151,67 @@ def device_memory_budget() -> int:
 
 
 # ---------------------------------------------------------------------------
+# compiled-plan cache capacity (docs/query_planner.md "cache semantics"):
+# the LRU entry cap of plan/executor.py's compiled-plan cache.  One
+# repeated query needs one entry; a SERVING workload (cylon_tpu/serve)
+# sees many distinct plans per session, and an unbounded cache would pin
+# their schemas/dictionaries forever.  Resolution order: explicit
+# set_plan_cache_capacity() > CYLON_PLAN_CACHE_CAP env > default.
+# Evictions bump the ``plan.cache_evictions`` counter.
+# ---------------------------------------------------------------------------
+
+DEFAULT_PLAN_CACHE_CAPACITY = 128
+
+_plan_cache_capacity: Optional[int] = None   # None -> env/default
+
+
+def plan_cache_capacity() -> int:
+    """The effective compiled-plan cache entry cap (explicit knob, else
+    ``CYLON_PLAN_CACHE_CAP``, else :data:`DEFAULT_PLAN_CACHE_CAPACITY`)."""
+    if _plan_cache_capacity is not None:
+        return _plan_cache_capacity
+    env = os.environ.get("CYLON_PLAN_CACHE_CAP", "")
+    if env:
+        try:
+            n = int(env)
+        except ValueError:
+            raise CylonError(Status(Code.Invalid,
+                f"CYLON_PLAN_CACHE_CAP must be an int entry count, "
+                f"got {env!r}")) from None
+        if n <= 0:
+            raise CylonError(Status(Code.Invalid,
+                f"CYLON_PLAN_CACHE_CAP must be positive, got {n}"))
+        return n
+    return DEFAULT_PLAN_CACHE_CAPACITY
+
+
+def set_plan_cache_capacity(n: "Optional[int]") -> "Optional[int]":
+    """Set the compiled-plan cache LRU capacity; returns the previous
+    EXPLICIT setting (None when env/default-resolved) so callers restore
+    it in a finally — the same contract as ``set_device_memory_budget``.
+
+    ``None`` restores env/default resolution.  Zero, negative, float and
+    bool values are rejected — a silently-stored ``0`` would evict every
+    plan at store time and turn the cache into pure overhead.  Shrinking
+    the capacity takes effect at the next store (the executor trims to
+    the new cap then)."""
+    global _plan_cache_capacity
+    if n is not None:
+        if isinstance(n, bool) or not isinstance(n, int):
+            raise CylonError(Status(Code.Invalid,
+                "plan cache capacity must be a positive int entry count "
+                f"or None to restore defaults, got {type(n).__name__} "
+                f"{n!r}"))
+        if n <= 0:
+            raise CylonError(Status(Code.Invalid,
+                f"plan cache capacity must be positive, got {n} (pass "
+                "None to restore env/default resolution)"))
+    prev = _plan_cache_capacity
+    _plan_cache_capacity = n
+    return prev
+
+
+# ---------------------------------------------------------------------------
 # logical-plan optimizer switch (docs/query_planner.md): governs whether
 # ``ctx.optimize`` / ``DTable.explain(optimize=True)`` actually capture,
 # rewrite and cache plans, or fall through to plain eager execution.

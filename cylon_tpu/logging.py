@@ -19,6 +19,7 @@ from __future__ import annotations
 import io
 import os
 import sys
+import threading
 import time
 import traceback
 from typing import Any
@@ -69,15 +70,19 @@ def warning(msg: Any, *args) -> None:
     _emit(WARNING, str(msg) % args if args else str(msg))
 
 
-# Keys that already warned this session (warn_once).  A plain set — adds
-# are GIL-atomic, and the worst race is one duplicate line, not a lost
-# warning.
+# Keys that already warned this session (warn_once), guarded by a lock:
+# concurrent queries (the serving dispatcher's export pipeline, client
+# threads running eager plans) hit the same registry, and the
+# check-then-add pair must be atomic for the "at most once" promise —
+# and for the RETURN value tests assert on — to hold across threads.
 _warned_keys: set = set()
+_warn_lock = threading.Lock()
 
 
 def warn_once(key: Any, msg: Any, *args) -> bool:
     """Emit a WARNING at most once per ``key`` per session; returns
-    whether a line was emitted.
+    whether a line was emitted.  Thread-safe: exactly one of N racing
+    callers with the same key emits (and returns True).
 
     The shared rate-limit behind every per-condition diagnostic (the
     shuffle skew warning keyed by shuffle signature, the ingest
@@ -85,19 +90,21 @@ def warn_once(key: Any, msg: Any, *args) -> bool:
     one line, not one per call.  ``key`` must be hashable; tests reset
     with :func:`reset_warn_once`.
     """
-    if key in _warned_keys:
-        return False
-    _warned_keys.add(key)
+    with _warn_lock:
+        if key in _warned_keys:
+            return False
+        _warned_keys.add(key)
     _emit(WARNING, str(msg) % args if args else str(msg))
     return True
 
 
 def reset_warn_once(key: Any = None) -> None:
     """Forget one warn_once key (or all of them) — test isolation."""
-    if key is None:
-        _warned_keys.clear()
-    else:
-        _warned_keys.discard(key)
+    with _warn_lock:
+        if key is None:
+            _warned_keys.clear()
+        else:
+            _warned_keys.discard(key)
 
 
 def error(msg: Any, *args) -> None:

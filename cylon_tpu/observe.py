@@ -49,7 +49,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "COUNTER", "WATERMARK", "GAUGE", "MetricSpec", "METRICS",
     "MetricsRegistry", "REGISTRY", "export_chrome_trace", "analyze",
-    "exchange_count",
+    "exchange_count", "counter_delta",
 ]
 
 # ---------------------------------------------------------------------------
@@ -224,6 +224,45 @@ METRICS: Dict[str, MetricSpec] = _specs(
     ("optimizer.row_bytes_post", COUNTER, "bytes",
      "summed per-row exchange width of materialized plans AFTER "
      "rewriting"),
+    ("plan.cache_evictions", COUNTER, "evictions",
+     "compiled plans evicted from the LRU plan cache (capacity: "
+     "config.set_plan_cache_capacity / CYLON_PLAN_CACHE_CAP) — churn "
+     "here means the serving working set exceeds the cap"),
+    # multi-query serving layer (docs/serving.md): admission control,
+    # cross-query subplan sharing, batch-window execution
+    ("serve.admitted", COUNTER, "queries",
+     "queries admitted to a batch window (their priced exchange "
+     "transients fit the remaining admission budget, or they were the "
+     "window's head-of-line query)"),
+    ("serve.deferred", COUNTER, "deferrals",
+     "admission deferrals — a query held back to a later window because "
+     "the batch's priced exchange transients would exceed the device "
+     "memory budget (a query deferred twice counts twice)"),
+    ("serve.rejected", COUNTER, "queries",
+     "submissions refused because the bounded query queue was full and "
+     "the caller declined to block (backpressure made loud)"),
+    ("serve.completed", COUNTER, "queries",
+     "queries that finished through the serving layer with a result"),
+    ("serve.failed", COUNTER, "queries",
+     "queries that failed in the serving layer — the error lands on the "
+     "query's own handle; batch peers are unaffected"),
+    ("serve.batches", COUNTER, "batches",
+     "batch windows executed by the serve dispatcher"),
+    ("serve.subplan_shared", COUNTER, "subplans",
+     "cross-query subplan reuses inside a batch window: an operator "
+     "whose result another admitted query already produced was served "
+     "from the shared execution memo instead of re-executing (the "
+     "scan/select/shuffle crossed the wire once, fanned out to N "
+     "consumers)"),
+    ("serve.exports_async", COUNTER, "exports",
+     "query exports handed to the async host pipeline (host Arrow "
+     "conversion overlapping the next query's device compute)"),
+    ("serve.queue_depth", GAUGE, "queries",
+     "queries waiting in the serve queue (submitted, not yet admitted "
+     "to a window — deferred queries count until re-admitted)"),
+    ("serve.batch_window_ms", GAUGE, "ms",
+     "the serve session's configured batch-window length: how long the "
+     "dispatcher collects concurrent arrivals before admitting a batch"),
 )
 
 
@@ -476,6 +515,23 @@ def _bytes_of(counters: Dict[str, int]) -> int:
     return sum(counters.get(k, 0) for k in _BYTE_COUNTERS)
 
 
+def counter_delta(before: Dict[str, int],
+                  after: Dict[str, int]) -> Dict[str, int]:
+    """Kind-aware difference of two merged-counter snapshots: counters
+    subtract; a watermark reports the window's NEW PEAK when it moved
+    (a watermark's difference is meaningless); unchanged keys are
+    omitted.  The one definition behind both EXPLAIN ANALYZE's per-node
+    stitching and ``resilience.counter_scope``'s per-query attribution
+    windows — a new metric kind handled here is handled in both."""
+    out: Dict[str, int] = {}
+    for k, v in after.items():
+        v0 = before.get(k, 0)
+        if v == v0:
+            continue
+        out[k] = v if REGISTRY.kind_of(k) == WATERMARK else v - v0
+    return out
+
+
 def _peek_rows(x) -> Optional[int]:
     """Global row count of a DTable / local Table WITHOUT mutating it:
     no pending-mask collapse, no ``_counts_host`` caching — measuring a
@@ -586,14 +642,7 @@ class _AnalyzeState:
             # claimed it — nothing to stitch here
             return
         c1 = trace.counters()
-        delta: Dict[str, int] = {}
-        for k, v in c1.items():
-            if v == c0.get(k, 0):
-                continue
-            # a watermark's difference is meaningless — report the new
-            # peak itself when the window moved it
-            delta[k] = v if REGISTRY.kind_of(k) == WATERMARK else \
-                v - c0.get(k, 0)
+        delta = counter_delta(c0, c1)
         node = nodes[idx]
         node.runtime = {
             "depth": depth,

@@ -18,11 +18,11 @@ from .dist_ops import (dist_aggregate, dist_anti_join, dist_groupby,
                        dist_select, dist_semi_join, dist_sort,
                        dist_sort_multi, dist_subtract, dist_union,
                        dist_with_column, shuffle_table)
-from .streaming import dist_join_streaming
+from .streaming import HostPipeline, HostTask, dist_join_streaming
 
 __all__ = [
     "DColumn", "DTable", "shuffle_leaves", "shuffle_table",
-    "replicate_table",
+    "replicate_table", "HostPipeline", "HostTask",
     "dist_join", "dist_join_streaming", "dist_multiway_join",
     "dist_semi_join", "dist_anti_join",
     "dist_union", "dist_intersect",

@@ -50,6 +50,7 @@ joins" for threshold semantics and the planner matrix.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -170,14 +171,23 @@ def rows_if_small(dt: DTable, threshold: Optional[int],
 
 # Replicated blocks by small-side array identity (see module docstring);
 # an entry holds strong refs to its source arrays, so ids stay unique
-# while cached.  Bounded FIFO like dist_ops._group_cap_hints.
+# while cached.  Bounded FIFO like dist_ops._group_cap_hints.  Guarded
+# by a lock: concurrent queries (the serving layer's export pipeline
+# overlapping the dispatcher, client threads running eager plans) share
+# this module-level dict, and the eviction loop's pop(next(iter(...)))
+# racing a clear_replica_cache() raised RuntimeError before the lock;
+# the gather itself runs OUTSIDE the lock (two racing misses both
+# gather — benign, last insert wins — rather than serializing device
+# work behind a host lock).
 _replica_cache: dict = {}
+_replica_lock = threading.Lock()
 _REPLICA_CACHE_MAX = 64
 
 
 def clear_replica_cache() -> None:
     """Drop every cached replica (frees the pinned source arrays)."""
-    _replica_cache.clear()
+    with _replica_lock:
+        _replica_cache.clear()
 
 
 def _cache_key(dt: DTable, mode: str) -> Tuple:
@@ -227,7 +237,8 @@ def replicate_table(dt: DTable, mode: str = ALL,
         cache = False
     key = _cache_key(dt, mode) if cache else None
     if cache:
-        hit = _replica_cache.get(key)
+        with _replica_lock:
+            hit = _replica_cache.get(key)
         if hit is not None:
             trace.count("join.broadcast_replica_hit")
             plan_check.annotate(decision="replica-cache hit")
@@ -279,9 +290,11 @@ def replicate_table(dt: DTable, mode: str = ALL,
             for i, c in enumerate(dt.columns)]
     rep = DTable(dt.ctx, cols, outcap, counts)
     if cache:
-        while len(_replica_cache) >= _REPLICA_CACHE_MAX:
-            _replica_cache.pop(next(iter(_replica_cache)))
-        # pin the source columns: their ids ARE the key
-        _replica_cache[key] = (dt.columns, rep)
-        trace.gauge("broadcast.replica_cache_size", len(_replica_cache))
+        with _replica_lock:
+            while len(_replica_cache) >= _REPLICA_CACHE_MAX:
+                _replica_cache.pop(next(iter(_replica_cache)))
+            # pin the source columns: their ids ARE the key
+            _replica_cache[key] = (dt.columns, rep)
+            size = len(_replica_cache)
+        trace.gauge("broadcast.replica_cache_size", size)
     return rep

@@ -1,4 +1,4 @@
-"""Streaming (chunked) distributed join: bounded left-side buffers.
+"""Streaming (chunked) distributed join + the async host ingest/export lane.
 
 TPU-native answer to the reference's ``ArrowJoin`` streaming pipeline
 (reference: cpp/src/cylon/arrow/arrow_join.cpp + join tail of
@@ -21,12 +21,21 @@ Semantically identical to ``dist_join`` for INNER/LEFT; RIGHT/FULL_OUTER
 fall back to the one-shot join — a right row is unmatched only with
 respect to ALL left chunks, which a streaming pass cannot decide per
 chunk (the reference's ArrowJoin streams inner joins only).
+
+:class:`HostPipeline` is the second streaming primitive here: a bounded
+FIFO worker lane for HOST-side work — Arrow/pandas conversion of one
+query's result, or pre-ingest of the next query's frames — so the
+host conversion of query N overlaps the device compute of query N+1
+(the serving layer's export path, docs/serving.md).  Device dispatch
+stays on the submitting thread; only the host-boundary tail moves.
 """
 from __future__ import annotations
 
 import functools
 import math
-from typing import List, Tuple
+import queue as _queue
+import threading
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -166,3 +175,123 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
             parts.append(_join_copartitioned(csh, rsh, li_key, ri_key,
                                              how, alg))
     return _concat_compact(parts)
+
+
+# ---------------------------------------------------------------------------
+# async host ingest/export lane (docs/serving.md "pipelined export")
+# ---------------------------------------------------------------------------
+
+class HostTask:
+    """Handle on one submitted host-side task: ``wait()`` blocks until
+    the worker ran it, then returns its result or re-raises its error
+    (the error stays attached — a failed export surfaces at the waiting
+    consumer, never on the worker thread's stderr alone)."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            from ..status import Code, CylonError, Status
+            raise CylonError(Status(Code.ExecutionError,
+                f"host task not finished within {timeout} s"))
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class HostPipeline:
+    """A bounded FIFO lane of worker threads for host-boundary work.
+
+    The serving dispatcher (cylon_tpu/serve) submits each finished
+    query's EXPORT — the device→host gather + Arrow/pandas conversion,
+    the slowest host-side step of a query — here, then immediately
+    starts the next query's device compute: conversion of query N
+    overlaps compute of query N+1, the host-side analogue of the
+    chunked join's bounded in-flight buffers above.  Ingest works the
+    same way (``submit(lambda: DTable.from_pandas(ctx, df))``).
+
+    ``depth`` bounds queued-but-unstarted tasks (backpressure: a
+    producer outrunning the host lane blocks in ``submit`` instead of
+    growing an unbounded pinned-result queue).  FIFO order is
+    guaranteed per pipeline with ``workers=1`` (the default — host
+    conversion parallelism beyond overlap rarely pays while the GIL
+    serializes the numpy copies anyway).
+    """
+
+    def __init__(self, workers: int = 1, depth: int = 16,
+                 name: str = "host-pipeline") -> None:
+        if workers < 1 or depth < 1:
+            from ..status import Code, CylonError, Status
+            raise CylonError(Status(Code.Invalid,
+                f"HostPipeline needs workers >= 1 and depth >= 1, got "
+                f"workers={workers} depth={depth}"))
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=depth)
+        self._closed = False
+        # serializes submit's check-then-put against close's
+        # set-closed: without it a task enqueued between close()'s
+        # drain and its worker-stop sentinels would never run, and its
+        # wait() would block forever
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, name=f"{name}-{i}",
+                             daemon=True)
+            for i in range(workers)]
+        for t in self._threads:
+            t.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            task, fn = item
+            try:
+                with trace.span("serve.export"):
+                    task._value = fn()
+            except BaseException as e:  # graftlint: ok[broad-except] —
+                task._error = e  # delivered to the wait()ing consumer
+            finally:
+                task._event.set()
+                self._q.task_done()
+
+    def submit(self, fn: Callable[[], Any]) -> HostTask:
+        """Enqueue ``fn`` for a worker; returns its :class:`HostTask`.
+        Blocks when ``depth`` tasks are already queued (backpressure —
+        the workers draining guarantee progress while we hold the
+        lock)."""
+        task = HostTask()
+        with self._lock:
+            if self._closed:
+                from ..status import Code, CylonError, Status
+                raise CylonError(Status(Code.Invalid,
+                    "HostPipeline is closed"))
+            self._q.put((task, fn))
+        return task
+
+    def drain(self) -> None:
+        """Block until every submitted task has finished."""
+        self._q.join()
+
+    def close(self) -> None:
+        """Drain outstanding tasks, then stop the workers.  Idempotent.
+        The lock orders this against racing ``submit``s: any task that
+        won the race is in the queue before ``_closed`` flips, so the
+        join below waits for it — nothing lands behind the sentinels."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._q.join()
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join()

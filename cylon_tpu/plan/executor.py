@@ -34,7 +34,9 @@ Execution is additionally memoized per run by content signature
 boundaries — the q11/q15 correlated-aggregate shape — executes once,
 matching what the same code did eagerly.
 
-Counters (observe.METRICS): ``plan.cache_hit`` / ``plan.cache_miss``,
+Counters (observe.METRICS): ``plan.cache_hit`` / ``plan.cache_miss`` /
+``plan.cache_evictions`` (the LRU cap is
+``config.set_plan_cache_capacity`` / ``CYLON_PLAN_CACHE_CAP``),
 ``optimizer.rule_fires`` (the fires embodied in the executed plan —
 replayed on cache hits so bench artifacts see them every rep), and
 ``optimizer.row_bytes_pre`` / ``optimizer.row_bytes_post`` (the
@@ -47,6 +49,7 @@ plan/ir.py) or the tree fails lint.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import trace
@@ -371,10 +374,17 @@ def _config_fingerprint(ctx) -> Tuple:
             bool(jax.config.jax_enable_x64))
 
 
-# root fingerprint -> _Entry.  Bounded FIFO; entries pin schemas (and
-# thus dictionaries) + rule-created runtime, but NO user tables.
+# root fingerprint -> _Entry.  Bounded LRU (capacity from
+# ``config.plan_cache_capacity`` / CYLON_PLAN_CACHE_CAP): entries pin
+# schemas (and thus dictionaries) + rule-created runtime, but NO user
+# tables.  A serving workload (cylon_tpu/serve) pushes many DISTINCT
+# plans through one process — recency eviction keeps the hot working
+# set while ``plan.cache_evictions`` makes the churn observable.
+# Guarded by a lock: concurrent materializations (multi-threaded
+# ctx.optimize callers; the serve dispatcher is serial but not alone)
+# must not race the pop/reinsert recency bump.
 _plan_cache: Dict[Tuple, "_Entry"] = {}
-_PLAN_CACHE_MAX = 128
+_plan_cache_lock = threading.Lock()
 
 
 class _Entry:
@@ -389,11 +399,36 @@ class _Entry:
 
 def clear_plan_cache() -> None:
     """Drop every compiled plan (tests / knob changes mid-session)."""
-    _plan_cache.clear()
+    with _plan_cache_lock:
+        _plan_cache.clear()
 
 
 def plan_cache_len() -> int:
     return len(_plan_cache)
+
+
+def _cache_get(key) -> "Optional[_Entry]":
+    """LRU lookup: a hit is re-inserted at the recency tail (dicts keep
+    insertion order; the oldest entry is ``next(iter(...))``)."""
+    with _plan_cache_lock:
+        entry = _plan_cache.pop(key, None)
+        if entry is not None:
+            _plan_cache[key] = entry
+        return entry
+
+
+def _cache_put(key, entry: "_Entry") -> None:
+    from ..config import plan_cache_capacity
+    cap = plan_cache_capacity()
+    with _plan_cache_lock:
+        _plan_cache.pop(key, None)  # concurrent miss: last store wins
+        evicted = 0
+        while len(_plan_cache) >= cap:
+            _plan_cache.pop(next(iter(_plan_cache)))
+            evicted += 1
+        _plan_cache[key] = entry
+    if evicted:
+        trace.count("plan.cache_evictions", evicted)
 
 
 def _frozen_copy(root: Node) -> Node:
@@ -433,13 +468,11 @@ def materialize(builder, root: Node):
     for i, n in enumerate(pre_nodes):
         n.origin_idx = i
     key = (_config_fingerprint(builder.ctx), fingerprint(root))
-    entry = _plan_cache.get(key)
+    entry = _cache_get(key)
     if entry is None:
         opt_root, fires, pre_b, post_b = rules.optimize(builder, root)
         entry = _Entry(_frozen_copy(opt_root), fires, pre_b, post_b)
-        while len(_plan_cache) >= _PLAN_CACHE_MAX:
-            _plan_cache.pop(next(iter(_plan_cache)))
-        _plan_cache[key] = entry
+        _cache_put(key, entry)
         trace.count("plan.cache_miss")
         builder.stats["cache_misses"] += 1
     else:

@@ -741,13 +741,17 @@ class Builder:
     Installed on the instrument hook via :func:`capture`; thread-local,
     like every other plan_check capture state."""
 
-    def __init__(self, ctx):
+    def __init__(self, ctx, exec_memo: Optional[Dict[Any, Any]] = None):
         self.ctx = ctx
         self.memo: Dict[int, Any] = {}        # id(Node) -> concrete result
         self._memo_pins: List[Node] = []      # keep memo'd nodes alive
         # content-addressed execution memo (plan/executor.py): a subplan
-        # shared by two materialization boundaries executes once per run
-        self.exec_memo: Dict[Any, Any] = {}
+        # shared by two materialization boundaries executes once per run.
+        # The serving layer (cylon_tpu/serve) passes a BATCH-scoped memo
+        # here so subplans shared ACROSS queries admitted to one batch
+        # window execute once and fan out to every consumer.
+        self.exec_memo: Dict[Any, Any] = \
+            {} if exec_memo is None else exec_memo
         self._scans: Dict[int, Node] = {}     # id(DTable) -> scan node
         self._scan_pins: List[Any] = []
         self.stats: Dict[str, Any] = {

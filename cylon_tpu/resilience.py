@@ -32,6 +32,7 @@ re-raises the last transient error.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 from dataclasses import dataclass
@@ -42,8 +43,35 @@ from .status import Code, CylonError, Status
 
 __all__ = [
     "RetryPolicy", "retry_policy", "set_retry_policy", "retry_call",
-    "retrying", "exchange_budget",
+    "retrying", "exchange_budget", "counter_scope",
 ]
+
+
+@contextlib.contextmanager
+def counter_scope(out: dict):
+    """Per-query fault/retry ATTRIBUTION window: fills ``out`` with the
+    merged-counter deltas of the enclosed block (counters subtract;
+    watermarks report the block's new peak when it moved, mirroring
+    EXPLAIN ANALYZE's per-node stitching).
+
+    The serving layer (cylon_tpu/serve) wraps each admitted query's
+    execution in one of these, so a batch's global counter stream
+    decomposes into per-query slices: "this query retried twice, its
+    batch peers retried zero times" becomes an assertable fact
+    (``handle.counters["retry.exhausted"]``) instead of a guess — the
+    isolation contract is that one query's injected fault shows up in
+    ITS window only, while its peers' windows stay clean.  Attribution
+    is exact when the windows do not overlap (the serve dispatcher
+    executes admitted queries serially); overlapping windows — e.g. an
+    async export tail — charge shared bumps to every open window.
+    """
+    from . import trace
+    before = trace.counters()
+    try:
+        yield out
+    finally:
+        from . import observe
+        out.update(observe.counter_delta(before, trace.counters()))
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,39 @@
+"""Multi-query serving layer (docs/serving.md).
+
+The operator-DAG-as-service arm of the engine (arXiv:2212.13732's hybrid
+framing, ROADMAP item 1): many concurrent queries over shared base
+tables, executed through the PR-5 logical planner with
+
+  * a bounded admission queue + batch windows (:class:`ServeSession` /
+    :class:`QueryQueue`) — backpressure instead of OOM;
+  * **cross-query common-subplan sharing** inside a batch window: the
+    same scan/select/shuffle chain crosses the wire once and fans out
+    to every consumer (``serve.subplan_shared``);
+  * **admission control priced against the device-memory budget**
+    (serve/admission.py, the ``shuffle._priced_bytes`` cost math at
+    admission altitude) — queries whose combined exchange transients
+    would exceed the budget wait for a later window;
+  * an async host export lane (``parallel/streaming.HostPipeline``) so
+    Arrow conversion of one query overlaps device compute of the next;
+  * per-query fault isolation: one query's error lands on its own
+    handle (``resilience.counter_scope`` attributes its retries/faults
+    to it alone); batch peers complete.
+
+Quick start::
+
+    from cylon_tpu.serve import ServeSession
+
+    with ServeSession(ctx, tables=dts, batch_window_ms=4.0) as s:
+        handles = [s.submit(lambda t, q=q: q(ctx, t),
+                            export=lambda r: r.to_pandas())
+                   for q in queries]
+        frames = [h.result() for h in handles]
+        print(s.stats())   # p50/p99 latency, admitted/deferred, shares
+"""
+from __future__ import annotations
+
+from .admission import admit, price_query, price_table
+from .session import QueryHandle, QueryQueue, ServeSession, percentile
+
+__all__ = ["ServeSession", "QueryHandle", "QueryQueue", "percentile",
+           "price_query", "price_table", "admit"]

@@ -1,0 +1,96 @@
+"""Admission control: pricing queries against the device-memory budget.
+
+A serving workload runs many queries against one device's memory; the
+failure mode this module prevents is ADDITIVE — each query's exchanges
+are individually budget-guarded (parallel/shuffle.py degrades an
+over-budget exchange to the chunked multi-round path), but a batch of
+queries admitted together keeps earlier queries' result blocks live
+(pinned by the shared execution memo and the async export lane) while
+later queries dispatch their own exchanges.  Admission bounds the SUM:
+a window's co-admitted queries must fit the budget *as priced*, or wait.
+
+The pricing is the existing exchange cost math at admission altitude
+(docs/robustness.md): one exchange over a table with ``P`` shards of
+capacity ``cap`` prices ``(2·P·block + outcap) · row_bytes``
+(``shuffle._priced_bytes`` — grouped send buffer + all_to_all receive
+mirror + compacted output), and at admission time the sync-free
+evidence for ``block``/``outcap`` is exactly what ``rows_if_small``
+uses for the broadcast decision: ingest-cached counts when available,
+else the ``P × cap`` capacity bound.  A query's price is its WORST
+single exchange — the largest base table it reads — because execution
+within a query is serial: two of its exchanges never fly concurrently,
+but its largest one will.
+
+Admission never starves: the window's head-of-line query is admitted
+even when over budget alone (the exchange stack's chunked degrade
+bounds its per-round transient; holding it back forever would turn a
+big query into a deadlock).  Everything else waits for a later window
+and bumps ``serve.deferred``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["price_table", "price_query", "admit"]
+
+
+def price_table(dt) -> int:
+    """Per-device transient price of ONE exchange over ``dt`` — the
+    ``shuffle._priced_bytes`` formula fed with admission-time (sync-
+    free) size evidence.  Static metadata only; never touches device
+    data, so pricing N queued queries costs zero round trips."""
+    from .. import observe
+    from ..ops import compact as ops_compact
+    from ..parallel.shuffle import _priced_bytes
+
+    leaves = [lf for c in dt.columns for lf in (c.data, c.validity)
+              if lf is not None]
+    rbytes = max(observe.row_bytes(leaves), 1)
+    ch = dt._counts_host
+    if ch is not None and dt.pending_mask is None:
+        total = int(np.asarray(ch).sum())
+    else:
+        total = dt.nparts * dt.cap
+    outcap = ops_compact.next_bucket(max(total, 1), minimum=8)
+    return _priced_bytes(dt.nparts, (dt.cap, outcap), rbytes)
+
+
+def price_query(tables) -> int:
+    """A query's admission price: the worst single exchange it can
+    dispatch = the price of the largest base table it reads (``tables``
+    is the dict/table handed to ``submit``).  Within one query,
+    execution is serial, so exchanges do not stack — across queries in
+    a window they effectively do (results stay live), which is what
+    :func:`admit` sums."""
+    from ..parallel.dtable import DTable
+
+    if tables is None:
+        return 0
+    if isinstance(tables, DTable):
+        return price_table(tables)
+    if isinstance(tables, dict):
+        prices = [price_table(t) for t in tables.values()
+                  if isinstance(t, DTable)]
+        return max(prices) if prices else 0
+    return 0
+
+
+def admit(batch: List, budget: int) -> Tuple[List, List]:
+    """Split ``batch`` (arrival order) into ``(admitted, deferred)``:
+    queries admit while the running price total stays within ``budget``;
+    the head-of-line query always admits (progress guarantee — see the
+    module docstring).  Each handle's ``priced_bytes`` must already be
+    set (the session prices at submit time)."""
+    admitted: List = []
+    deferred: List = []
+    total = 0
+    for h in batch:
+        price = h.priced_bytes or 0
+        if not admitted or total + price <= budget:
+            admitted.append(h)
+            total += price
+        else:
+            deferred.append(h)
+    return admitted, deferred

@@ -1,0 +1,471 @@
+"""Multi-query serving: the query queue, batch windows, shared execution.
+
+One :class:`ServeSession` turns the engine from run-one-query-at-a-time
+into an operator-DAG service (docs/serving.md, the arXiv:2212.13732
+framing): client threads ``submit()`` logical plans; a dispatcher thread
+collects arrivals for one **batch window**, prices the batch against the
+device-memory budget (serve/admission.py), and executes the admitted
+queries through the PR-5 planner — each captured via an
+:class:`~cylon_tpu.plan.ir.Builder` whose execution memo is SHARED
+across the batch, so a subplan two queries both need (the same
+scan→select→shuffle chain over a shared base table) crosses the wire
+once and fans out to every consumer (``serve.subplan_shared``).
+
+Threading model — deliberately simple and honest about the hardware:
+
+  * ``submit()`` is thread-safe and cheap (enqueue + sync-free pricing);
+    a full queue blocks the caller (backpressure) or, with
+    ``block=False``, rejects loudly (``serve.rejected``).
+  * ONE dispatcher thread captures and executes queries serially — the
+    device has a single compute stream, so interleaving device dispatch
+    from N threads buys contention, not throughput.  Serial execution
+    is also what makes per-query counter attribution exact
+    (``resilience.counter_scope``) and fault isolation structural: a
+    query's error lands on ITS handle; batch peers never see it.
+  * the host-side tail — Arrow/pandas conversion of a finished result —
+    runs on a :class:`~cylon_tpu.parallel.streaming.HostPipeline`
+    worker, so export of query N overlaps device compute of query N+1
+    (``serve.exports_async``).
+
+Results come back through :class:`QueryHandle` (``result()`` blocks,
+re-raises the query's own error) carrying per-query latency, counter
+deltas, and the list of subplans served from the shared memo — the
+"prove the share" surface the tests and the CI smoke assert on.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import resilience, trace
+from ..status import Code, CylonError, Status
+from . import admission
+
+__all__ = ["QueryHandle", "QueryQueue", "ServeSession", "percentile"]
+
+_UNSET = object()
+
+
+def percentile(sorted_xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of an ALREADY SORTED list (the latency
+    summaries: p50/p99 over completed-query latencies)."""
+    if not sorted_xs:
+        return None
+    if q <= 0:
+        return sorted_xs[0]
+    import math
+    rank = math.ceil(q / 100.0 * len(sorted_xs))
+    return sorted_xs[min(max(rank, 1), len(sorted_xs)) - 1]
+
+
+class QueryHandle:
+    """One submitted query: status, result rendezvous, and the per-query
+    observability slice (latency, counter deltas, shared subplans)."""
+
+    __slots__ = ("id", "label", "op", "tables", "export", "status",
+                 "priced_bytes", "deferrals", "shared_subplans",
+                 "counters", "submitted_at", "started_at", "finished_at",
+                 "execute_ms", "latency_ms", "error", "_value", "_event")
+
+    def __init__(self, qid: int, label: str, op: Callable, tables,
+                 export: Optional[Callable]) -> None:
+        self.id = qid
+        self.label = label
+        self.op = op
+        self.tables = tables
+        self.export = export
+        self.status = "queued"
+        self.priced_bytes: int = 0
+        self.deferrals = 0
+        self.shared_subplans: List[str] = []   # op names served from memo
+        self.counters: Dict[str, int] = {}     # this query's counter slice
+        self.submitted_at = time.perf_counter()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.execute_ms: Optional[float] = None
+        self.latency_ms: Optional[float] = None
+        self.error: Optional[BaseException] = None
+        self._value: Any = None
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the query finished; return its result or re-raise
+        its OWN error (a batch peer's failure never lands here)."""
+        if not self._event.wait(timeout):
+            raise CylonError(Status(Code.ExecutionError,
+                f"serve: query {self.label!r} not finished within "
+                f"{timeout} s (status={self.status})"))
+        if self.error is not None:
+            raise self.error
+        return self._value
+
+    def __repr__(self) -> str:
+        return (f"QueryHandle(#{self.id} {self.label!r} {self.status}, "
+                f"priced={self.priced_bytes}B)")
+
+
+class QueryQueue:
+    """Bounded thread-safe FIFO of :class:`QueryHandle` — the admission
+    queue's front door.  ``put`` blocks when full (backpressure) unless
+    ``block=False``; the dispatcher ``drain()``s whole windows."""
+
+    def __init__(self, capacity: int) -> None:
+        if capacity < 1:
+            raise CylonError(Status(Code.Invalid,
+                f"QueryQueue capacity must be >= 1, got {capacity}"))
+        self.capacity = capacity
+        self._items: deque = deque()
+        self._cv = threading.Condition()
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> bool:
+        with self._cv:
+            if len(self._items) >= self.capacity:
+                if not block:
+                    return False
+                if not self._cv.wait_for(
+                        lambda: len(self._items) < self.capacity, timeout):
+                    return False
+            self._items.append(item)
+            self._cv.notify_all()
+            return True
+
+    def drain(self) -> List:
+        with self._cv:
+            items = list(self._items)
+            self._items.clear()
+            self._cv.notify_all()   # wake blocked producers
+            return items
+
+    def wait_nonempty(self, timeout: Optional[float] = None) -> bool:
+        with self._cv:
+            return self._cv.wait_for(lambda: len(self._items) > 0, timeout)
+
+    def kick(self) -> None:
+        """Wake any waiter (session close)."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+
+class _SharedExecMemo(dict):
+    """Batch-scoped execution memo handed to every admitted query's
+    Builder: keys are the executor's content signatures (op + statics +
+    child signatures + runtime identities — see plan/executor.py), so
+    two queries over the SAME base-table objects produce equal keys for
+    identical subplans and the second is served from the first's result.
+    Tracks which query produced each entry; a hit from a DIFFERENT
+    query is a cross-query share (``serve.subplan_shared``), recorded on
+    the consuming handle as proof."""
+
+    def __init__(self, session: "ServeSession") -> None:
+        super().__init__()
+        self._session = session
+        self._owner: Dict[Any, QueryHandle] = {}
+        self._current: Optional[QueryHandle] = None
+
+    def begin_query(self, handle: QueryHandle) -> None:
+        self._current = handle
+
+    def get(self, key, default=None):
+        hit = dict.get(self, key, default)
+        if hit is not None:
+            owner = self._owner.get(key)
+            if owner is not None and owner is not self._current:
+                trace.count("serve.subplan_shared")
+                self._session._tally("subplan_shared")
+                if self._current is not None:
+                    self._current.shared_subplans.append(hit[0].op)
+        return hit
+
+    def __setitem__(self, key, value) -> None:
+        self._owner.setdefault(key, self._current)
+        dict.__setitem__(self, key, value)
+
+
+class ServeSession:
+    """The serving loop: bounded admission queue + batch-window
+    dispatcher + async export lane.  See the module docstring for the
+    threading model and docs/serving.md for the semantics.
+
+    Parameters:
+      * ``tables`` — the session's shared base tables (a dict of
+        DTables); ``submit`` may override per query.  Sharing REQUIRES
+        submitting queries over the same table objects — the execution
+        memo keys scans by table identity.
+      * ``batch_window_ms`` — how long the dispatcher collects arrivals
+        before admitting a batch: the sharing-vs-latency dial (0 = no
+        wait — every query is its own batch, nothing shares).
+      * ``max_queue`` — the backpressure bound; a full queue blocks
+        submitters (or rejects with ``block=False``).
+      * ``admission_budget`` — bytes co-admitted queries may price in
+        one window; default: the live ``resilience.exchange_budget()``
+        read at every window, so CYLON_MEMORY_BUDGET (and chaos budget
+        perturbations) steer admission exactly as they steer the
+        exchanges themselves.
+      * ``export_workers`` — async export lane width (0 = export
+        inline on the dispatcher; no overlap).
+    """
+
+    def __init__(self, ctx, tables=None, *, batch_window_ms: float = 4.0,
+                 max_queue: int = 64,
+                 admission_budget: Optional[int] = None,
+                 export_workers: int = 1, name: str = "serve") -> None:
+        if batch_window_ms < 0:
+            raise CylonError(Status(Code.Invalid,
+                f"batch_window_ms must be >= 0, got {batch_window_ms}"))
+        self.ctx = ctx
+        self.name = name
+        self._tables = tables
+        self._window_s = batch_window_ms / 1e3
+        self._admission_budget = admission_budget
+        self._queue = QueryQueue(max_queue)
+        self._pipeline = None
+        if export_workers > 0:
+            from ..parallel.streaming import HostPipeline
+            self._pipeline = HostPipeline(workers=export_workers,
+                                          name=f"{name}-export")
+        self._lock = threading.Lock()
+        self._stats: Dict[str, int] = {
+            "submitted": 0, "admitted": 0, "deferred": 0, "rejected": 0,
+            "completed": 0, "failed": 0, "batches": 0,
+            "subplan_shared": 0, "exports_async": 0,
+        }
+        self._latencies: List[float] = []
+        self._ids = 0
+        self._closing = threading.Event()
+        self._closed = False
+        trace.gauge("serve.batch_window_ms", batch_window_ms)
+        self._dispatcher = threading.Thread(
+            target=self._loop, name=f"{name}-dispatch", daemon=True)
+        self._dispatcher.start()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, op: Callable, tables=_UNSET, *,
+               export: Optional[Callable] = None,
+               label: Optional[str] = None, block: bool = True,
+               timeout: Optional[float] = None) -> QueryHandle:
+        """Enqueue one query; returns its :class:`QueryHandle`.
+
+        ``op`` receives the (logically wrapped) tables and composes dist
+        ops — exactly the ``ctx.optimize`` contract; ``tables`` defaults
+        to the session's shared base tables.  ``export`` is an optional
+        host-side finisher (e.g. ``lambda r: r.to_pandas()``) run on the
+        async export lane so its cost overlaps the next query's device
+        compute.  A full queue blocks (backpressure) until space or
+        ``timeout``; ``block=False`` turns that into an immediate
+        CapacityError + ``serve.rejected`` bump."""
+        if self._closed:
+            raise CylonError(Status(Code.Invalid,
+                f"serve session {self.name!r} is closed"))
+        tabs = self._tables if tables is _UNSET else tables
+        with self._lock:
+            self._ids += 1
+            qid = self._ids
+        h = QueryHandle(qid, label or f"q{qid}", op, tabs, export)
+        h.priced_bytes = admission.price_query(tabs)
+        self._tally("submitted")
+        if not self._queue.put(h, block=block, timeout=timeout):
+            trace.count("serve.rejected")
+            self._tally("rejected")
+            h.status = "rejected"
+            raise CylonError(Status(Code.CapacityError,
+                f"serve: queue full ({self._queue.capacity} queries) — "
+                "backpressure; retry, block, or widen max_queue"))
+        trace.gauge("serve.queue_depth", len(self._queue))
+        if self._closed and not self._dispatcher.is_alive():
+            # raced close() AND lost: the dispatcher is gone, so nothing
+            # will ever drain this queue — fail what is stranded (this
+            # handle included) rather than block a result() forever.
+            # While the dispatcher is still alive its exit condition
+            # (empty queue) guarantees it drains us normally, so a
+            # query that merely arrived during shutdown still executes;
+            # drain() hands each handle to exactly one drainer either
+            # way.
+            self._fail_stragglers()
+        if h.error is not None:
+            raise h.error
+        return h
+
+    def _fail_stragglers(self) -> None:
+        for h in self._queue.drain():
+            self._finish(h, error=CylonError(Status(Code.Invalid,
+                f"serve session {self.name!r} closed before this query "
+                "was admitted")))
+
+    def run(self, op: Callable, tables=_UNSET, *,
+            export: Optional[Callable] = None,
+            label: Optional[str] = None,
+            timeout: Optional[float] = None):
+        """``submit`` + ``result`` — the synchronous convenience form."""
+        return self.submit(op, tables, export=export,
+                           label=label).result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        """Session-level tallies + latency percentiles (independent of
+        trace enablement — the serving loop always self-accounts)."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._stats)
+            lat = sorted(self._latencies)
+        out["queue_depth"] = len(self._queue)
+        out["batch_window_ms"] = self._window_s * 1e3
+        out["p50_ms"] = percentile(lat, 50)
+        out["p99_ms"] = percentile(lat, 99)
+        return out
+
+    def close(self) -> None:
+        """Stop accepting queries, drain everything queued, stop the
+        dispatcher and export lane.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._closing.set()
+        self._queue.kick()
+        self._dispatcher.join()
+        # a submit() racing this close can slip a query in AFTER the
+        # dispatcher's final empty-queue check — fail it rather than
+        # leave its result() blocking forever (submit re-checks too;
+        # drain() guarantees exactly one of us finishes each handle)
+        self._fail_stragglers()
+        if self._pipeline is not None:
+            self._pipeline.close()
+
+    def __enter__(self) -> "ServeSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- internals -----------------------------------------------------------
+
+    def _tally(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[key] = self._stats.get(key, 0) + n
+
+    def _budget(self) -> int:
+        if self._admission_budget is not None:
+            return self._admission_budget
+        return resilience.exchange_budget()
+
+    def _loop(self) -> None:
+        pending: List[QueryHandle] = []
+        while True:
+            got = self._queue.wait_nonempty(timeout=0.05)
+            if not got and not pending:
+                if self._closing.is_set() and len(self._queue) == 0:
+                    return
+                continue
+            # the batch window: let concurrent submitters' queries land
+            # in the same batch (the sharing-vs-latency dial; skipped
+            # when draining at close — nothing else is coming)
+            if self._window_s > 0 and got and not self._closing.is_set():
+                time.sleep(self._window_s)
+            batch = pending + self._queue.drain()
+            if not batch:
+                continue
+            pending = []
+            try:
+                admitted, deferred = admission.admit(batch,
+                                                     self._budget())
+            except BaseException as e:  # graftlint: ok[broad-except] —
+                # a pricing/budget error (e.g. a malformed
+                # CYLON_MEMORY_BUDGET read inside _budget()) must fail
+                # THIS window's handles loudly, never kill the
+                # dispatcher thread and strand every future result()
+                for h in batch:
+                    self._finish(h, error=e)
+                continue
+            pending = deferred
+            for h in pending:
+                h.status = "deferred"
+                h.deferrals += 1
+                trace.count("serve.deferred")
+                self._tally("deferred")
+            for h in admitted:
+                h.status = "admitted"
+            trace.count("serve.admitted", len(admitted))
+            self._tally("admitted", len(admitted))
+            trace.count("serve.batches")
+            self._tally("batches")
+            trace.gauge("serve.queue_depth",
+                        len(pending) + len(self._queue))
+            memo = _SharedExecMemo(self)
+            with trace.span("serve.window"):
+                for h in admitted:
+                    self._execute_one(h, memo)
+            # the memo dies with the window: its pinned results stay
+            # live only while still referenced by handles/exports
+
+    def _execute_one(self, h: QueryHandle, memo: _SharedExecMemo) -> None:
+        from ..plan import ir
+        h.status = "running"
+        h.started_at = time.perf_counter()
+        memo.begin_query(h)
+        deltas: Dict[str, int] = {}
+        try:
+            with resilience.counter_scope(deltas):
+                with trace.span("serve.query"):
+                    b = ir.Builder(self.ctx, exec_memo=memo)
+                    wrapped = (b.wrap_tables(h.tables)
+                               if h.tables is not None else None)
+                    with ir.capture(b):
+                        out = (h.op(wrapped) if h.tables is not None
+                               else h.op())
+                        out = b.finish(out)
+        except BaseException as e:  # graftlint: ok[broad-except] —
+            # fault ISOLATION is the serving contract: the error
+            # belongs to THIS query's handle (BaseException included —
+            # an escaping SystemExit must not kill the dispatcher and
+            # strand every queued result()); batch peers keep executing
+            h.counters = deltas
+            self._finish(h, error=e)
+            return
+        h.counters = deltas
+        h.execute_ms = (time.perf_counter() - h.started_at) * 1e3
+        if h.export is not None and self._pipeline is not None:
+            trace.count("serve.exports_async")
+            self._tally("exports_async")
+            h.status = "exporting"
+            self._pipeline.submit(
+                lambda h=h, out=out: self._run_export(h, out))
+        elif h.export is not None:
+            self._run_export(h, out)
+        else:
+            self._finish(h, value=out)
+
+    def _run_export(self, h: QueryHandle, out) -> None:
+        try:
+            self._finish(h, value=h.export(out))
+        except BaseException as e:  # graftlint: ok[broad-except] — a
+            # failed export is the query's own error; BaseException
+            # included, else e.g. a SystemExit from user export code
+            # lands on the discarded HostTask and the handle never
+            # finishes (result() would block forever)
+            self._finish(h, error=e)
+
+    def _finish(self, h: QueryHandle, value=None,
+                error: Optional[BaseException] = None) -> None:
+        h.finished_at = time.perf_counter()
+        h.latency_ms = (h.finished_at - h.submitted_at) * 1e3
+        if error is not None:
+            h.error = error
+            h.status = "failed"
+            trace.count("serve.failed")
+            self._tally("failed")
+        else:
+            h._value = value
+            h.status = "done"
+            trace.count("serve.completed")
+            self._tally("completed")
+            with self._lock:
+                self._latencies.append(h.latency_ms)
+        h._event.set()

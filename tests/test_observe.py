@@ -510,3 +510,90 @@ def test_benchdiff_baseline_smoke():
     tail) must parse and exit 0 — the slow-marked bench-path smoke."""
     baseline = os.path.join(REPO, "BENCH_r05.json")
     assert benchdiff.main(["--baseline", baseline, baseline]) == 0
+
+
+# ---------------------------------------------------------------------------
+# serving-layer observability (docs/serving.md; ISSUE 9 satellites)
+# ---------------------------------------------------------------------------
+
+def test_serve_metrics_catalogued():
+    """Every serving metric is a documented catalogue entry with the
+    right kind — the queue-depth/batch-window gauges included (the
+    catalogue-compliance checks above reject uncatalogued bumps)."""
+    for name in ("serve.admitted", "serve.deferred", "serve.rejected",
+                 "serve.completed", "serve.failed", "serve.batches",
+                 "serve.subplan_shared", "serve.exports_async",
+                 "plan.cache_evictions"):
+        spec = observe.METRICS.get(name)
+        assert spec is not None, name
+        assert spec.kind == observe.COUNTER, name
+        assert spec.doc
+    for name in ("serve.queue_depth", "serve.batch_window_ms"):
+        spec = observe.METRICS.get(name)
+        assert spec is not None, name
+        assert spec.kind == observe.GAUGE, name
+        assert spec.doc
+
+
+def test_serve_workload_counters_catalogue_compliant(dctx, rng):
+    """A serving workload's ENTIRE counter/gauge footprint stays inside
+    the documented catalogue, and the two serving gauges are live in
+    the typed snapshot (the same compliance contract as the TPC-H
+    ANALYZE sweep above)."""
+    from cylon_tpu.parallel import dist_groupby, shuffle_table
+    from cylon_tpu.serve import ServeSession
+
+    lt, rt = _tables(dctx, rng)
+
+    def plan(t):
+        s = shuffle_table(t["l"], ["k"])
+        return dist_groupby(s, ["k"], [("a", "sum")])
+
+    trace.enable_counters()
+    trace.reset()
+    with ServeSession(dctx, tables={"l": lt, "r": rt},
+                      batch_window_ms=30.0) as s:
+        h1 = s.submit(plan)
+        h2 = s.submit(plan)
+        h1.result(timeout=300), h2.result(timeout=300)
+    snap = trace.snapshot()
+    unknown = (set(snap["counters"]) | set(snap["gauges"])) \
+        - set(observe.METRICS)
+    assert not unknown, f"uncatalogued metrics: {sorted(unknown)}"
+    assert "serve.queue_depth" in snap["gauges"]
+    assert snap["gauges"]["serve.batch_window_ms"] == 30.0
+    assert snap["counters"].get("serve.admitted", 0) == 2
+    assert snap["counters"].get("serve.subplan_shared", 0) >= 1
+
+
+def test_benchdiff_gates_serve_qps_down(tmp_path, capsys):
+    """serve_qps gates DOWN: a serving-throughput regression fails CI;
+    an improvement passes clean."""
+    old = _artifact(tmp_path, "old.json", {"serve_qps": 40.0})
+    new = _artifact(tmp_path, "new.json", {"serve_qps": 20.0})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "serve_qps" in out and "REGRESSED" in out
+    better = _artifact(tmp_path, "better.json", {"serve_qps": 80.0})
+    assert benchdiff.main([old, better]) == 0
+
+
+def test_benchdiff_gates_serve_p99_up(tmp_path, capsys):
+    """serve_p99_ms gates UP with the ms absolute floor: a tail-latency
+    regression fails; sub-floor wobble is noise; p50 is reported but
+    never gates."""
+    old = _artifact(tmp_path, "old.json",
+                    {"serve_p99_ms": 50.0, "serve_p50_ms": 20.0})
+    new = _artifact(tmp_path, "new.json",
+                    {"serve_p99_ms": 120.0, "serve_p50_ms": 100.0})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "serve_p99_ms" in out and "REGRESSED" in out
+    # p50 tripled too but is ungated — only p99 carries the gate flag
+    for line in out.splitlines():
+        if line.startswith("serve_p50_ms"):
+            assert "REGRESSED" not in line
+    # sub-floor p99 delta (< 1 ms): noise, not signal
+    t_old = _artifact(tmp_path, "t_old.json", {"serve_p99_ms": 2.0})
+    t_new = _artifact(tmp_path, "t_new.json", {"serve_p99_ms": 2.6})
+    assert benchdiff.main([t_old, t_new]) == 0

@@ -341,6 +341,80 @@ def test_plan_cache_keyed_on_config_fingerprint(dctx, wide, dim):
         cfg.set_broadcast_join_threshold(prev)
 
 
+def _q_cap_a(t):
+    return dist_ops.dist_groupby(t, ["k"], [("v0", "sum")])
+
+
+def _q_cap_b(t):
+    return dist_ops.dist_groupby(t, ["k"], [("v1", "max")])
+
+
+def _q_cap_c(t):
+    return dist_ops.dist_groupby(t, ["k"], [("v2", "min")])
+
+
+def test_plan_cache_lru_cap_and_evictions(dctx, wide):
+    """The serving satellite (ISSUE 9): the compiled-plan cache is a
+    bounded LRU — distinct plans past the capacity evict the LEAST
+    RECENTLY USED entry (a hit refreshes recency), with churn visible
+    as ``plan.cache_evictions``."""
+    prev = cfg.set_plan_cache_capacity(2)
+    try:
+        dctx.optimize(_q_cap_a, wide)
+        dctx.optimize(_q_cap_b, wide)
+        assert planner.plan_cache_len() == 2
+        trace.reset()
+        dctx.optimize(_q_cap_a, wide)    # refresh A's recency
+        assert trace.counters().get("plan.cache_hit", 0) == 1
+        trace.reset()
+        dctx.optimize(_q_cap_c, wide)    # evicts B (LRU), not A
+        c = trace.counters()
+        assert c.get("plan.cache_evictions", 0) == 1
+        assert planner.plan_cache_len() == 2
+        trace.reset()
+        dctx.optimize(_q_cap_a, wide)    # A survived the eviction
+        assert trace.counters().get("plan.cache_hit", 0) == 1
+        trace.reset()
+        dctx.optimize(_q_cap_b, wide)    # B was the victim: re-plans
+        c = trace.counters()
+        assert c.get("plan.cache_miss", 0) == 1
+        assert c.get("plan.cache_evictions", 0) == 1  # evicts C now
+    finally:
+        cfg.set_plan_cache_capacity(prev)
+
+
+def test_set_plan_cache_capacity_validates():
+    for bad in (0, -3, 1.5, True, "64"):
+        with pytest.raises(CylonError):
+            cfg.set_plan_cache_capacity(bad)
+    prev = cfg.set_plan_cache_capacity(7)
+    try:
+        assert cfg.plan_cache_capacity() == 7
+        assert cfg.set_plan_cache_capacity(None) == 7
+        assert cfg.plan_cache_capacity() \
+            == cfg.DEFAULT_PLAN_CACHE_CAPACITY
+    finally:
+        cfg.set_plan_cache_capacity(prev if prev != 7 else None)
+
+
+def test_plan_cache_capacity_env(monkeypatch):
+    prev = cfg.set_plan_cache_capacity(None)
+    try:
+        monkeypatch.setenv("CYLON_PLAN_CACHE_CAP", "3")
+        assert cfg.plan_cache_capacity() == 3
+        monkeypatch.setenv("CYLON_PLAN_CACHE_CAP", "0")
+        with pytest.raises(CylonError):
+            cfg.plan_cache_capacity()
+        monkeypatch.setenv("CYLON_PLAN_CACHE_CAP", "many")
+        with pytest.raises(CylonError):
+            cfg.plan_cache_capacity()
+        # the explicit knob outranks the env var
+        cfg.set_plan_cache_capacity(5)
+        assert cfg.plan_cache_capacity() == 5
+    finally:
+        cfg.set_plan_cache_capacity(prev)
+
+
 # ---------------------------------------------------------------------------
 # the escape hatch
 # ---------------------------------------------------------------------------

@@ -1,0 +1,589 @@
+"""Multi-query serving layer (cylon_tpu/serve; docs/serving.md).
+
+The acceptance contract (ISSUE 9):
+
+  * a mixed workload of ≥ 8 concurrent TPC-H queries through
+    ``ServeSession`` returns row-identical results to serial execution;
+  * at least one cross-query subplan executes exactly ONCE and fans out
+    (counter-proven: ``serve.subplan_shared`` + no extra exchanges);
+  * admission keeps ``shuffle.exchange_bytes_peak`` within a
+    deliberately tightened device budget — no OOM, no
+    ``retry.exhausted``;
+  * one injected fault fails only its OWN query; batch peers complete
+    clean (``retry.exhausted`` == 0 and no fault in THEIR counter
+    slices).
+
+Plus the concurrency-safety satellites: the bounded queue's
+backpressure, the locked broadcast replica cache and ``glog.warn_once``
+registry under thread hammering.
+"""
+import io
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import JoinConfig, observe
+from cylon_tpu import config as cfg
+from cylon_tpu import faults
+from cylon_tpu import logging as glog
+from cylon_tpu import plan as planner
+from cylon_tpu import trace
+from cylon_tpu.parallel import (DTable, broadcast, dist_groupby, dist_join,
+                                shuffle_table)
+from cylon_tpu.serve import (QueryQueue, ServeSession, percentile,
+                             price_query)
+from cylon_tpu.status import CylonError
+from cylon_tpu.tpch import generate, queries
+
+SCALE = 0.002
+
+
+@pytest.fixture(autouse=True)
+def _serve_isolation():
+    """Counter-only tracing + fresh plan cache around every test: the
+    assertions below read counters from exactly this test's runs, and a
+    warm plan cache from a peer test would skew cache-traffic checks."""
+    planner.clear_plan_cache()
+    trace.enable_counters()
+    trace.reset()
+    yield
+    trace.disable_counters()
+    trace.reset()
+    planner.clear_plan_cache()
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate(SCALE, seed=7)
+
+
+@pytest.fixture(scope="module")
+def dtables(dctx, data):
+    return {name: DTable.from_pandas(dctx, df)
+            for name, df in data.items()}
+
+
+@pytest.fixture(scope="module")
+def fact(dctx):
+    rng = np.random.default_rng(5)
+    n = 4000
+    return DTable.from_pandas(dctx, pd.DataFrame({
+        "k": rng.integers(0, 60, n).astype(np.int32),
+        "a": rng.random(n).astype(np.float32),
+        "b": rng.random(n).astype(np.float32)}))
+
+
+@pytest.fixture(scope="module")
+def dim(dctx):
+    return DTable.from_pandas(dctx, pd.DataFrame({
+        "k": np.arange(60, dtype=np.int32),
+        "w": np.arange(60, dtype=np.float32)}))
+
+
+def _frame(res) -> pd.DataFrame:
+    if not hasattr(res, "to_pandas"):
+        res = res.to_table()
+    df = res.to_pandas()
+    for c in df.columns:
+        if isinstance(df[c].dtype, pd.CategoricalDtype):
+            df[c] = df[c].astype(str)
+    return df
+
+
+def _assert_rowset_equal(got: pd.DataFrame, want: pd.DataFrame):
+    assert list(got.columns) == list(want.columns)
+    assert len(got) == len(want)
+    g = got.sort_values(list(got.columns)).reset_index(drop=True)
+    w = want.sort_values(list(want.columns)).reset_index(drop=True)
+    for c in g.columns:
+        if pd.api.types.is_float_dtype(w[c]):
+            np.testing.assert_allclose(g[c].to_numpy(np.float64),
+                                       w[c].to_numpy(np.float64),
+                                       rtol=1e-4, atol=1e-6)
+        else:
+            assert g[c].astype(str).tolist() == w[c].astype(str).tolist(), c
+
+
+# two stable plan callables over the module fixtures: module-level so
+# repeated submissions share predicate/expression identities — the
+# exec-memo contract (plan/ir.py module docstring)
+def _plan_join_groupby(t):
+    j = dist_join(t["fact"], t["dim"], JoinConfig.InnerJoin("k", "k"))
+    return dist_groupby(j, ["lt-k"], [("rt-w", "sum"), ("lt-a", "sum")])
+
+
+def _plan_shuffle_groupby(t):
+    s = shuffle_table(t["fact"], ["k"])
+    return dist_groupby(s, ["k"], [("a", "sum"), ("b", "sum")])
+
+
+def _plan_wide_exchange(t):
+    """A shuffle the optimizer CANNOT absorb (two consumers): the full
+    fact table crosses the wire — the budget-pressure workload."""
+    s = shuffle_table(t["fact"], ["k"])
+    g1 = dist_groupby(s, ["k"], [("a", "sum")])
+    g2 = dist_groupby(s, ["k"], [("b", "max")])
+    return dist_join(g1, g2, JoinConfig.InnerJoin("k", "k"))
+
+
+# ---------------------------------------------------------------------------
+# acceptance: concurrent TPC-H parity
+# ---------------------------------------------------------------------------
+
+# 8 queries with distinct shapes (joins, semi/anti, groupbys, scalar
+# aggregates) — the "≥ 8 concurrent queries" acceptance workload
+_MIX = ("q1", "q3", "q4", "q5", "q6", "q10", "q12", "q14")
+
+
+def test_serve_concurrent_tpch_parity(dctx, dtables):
+    """N client threads, one TPC-H query each, one serve session: every
+    result row-identical to serial planner execution; nothing fails."""
+    serial = {}
+    for name in _MIX:
+        qfn = queries.QUERIES[name]
+        serial[name] = _frame(planner.run(
+            dctx, lambda t, q=qfn: q(dctx, t), dtables))
+    with ServeSession(dctx, tables=dtables, batch_window_ms=60.0) as s:
+        handles = {}
+        hlock = threading.Lock()
+
+        def client(name):
+            qfn = queries.QUERIES[name]
+            h = s.submit(lambda t, q=qfn: q(dctx, t), label=name)
+            with hlock:
+                handles[name] = h
+
+        threads = [threading.Thread(target=client, args=(n,))
+                   for n in _MIX]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        results = {n: h.result(timeout=600) for n, h in handles.items()}
+        stats = s.stats()
+    for name in _MIX:
+        _assert_rowset_equal(_frame(results[name]), serial[name])
+    assert stats["submitted"] == len(_MIX)
+    assert stats["completed"] == len(_MIX)
+    assert stats["failed"] == 0
+    # concurrent TPC-H queries over one tables dict share at least the
+    # base-table scans (counter-proven cross-query reuse)
+    assert stats["subplan_shared"] >= 1
+    assert trace.counters().get("serve.subplan_shared", 0) >= 1
+    # per-query observability rode along
+    for h in handles.values():
+        assert h.latency_ms is not None and h.latency_ms > 0
+        assert h.status == "done"
+
+
+def test_serve_shared_subplan_executes_once(dctx, fact, dim):
+    """The sharing proof at exchange granularity: submitting the SAME
+    plan twice into one batch window adds ZERO exchanges over a single
+    serial run — the scan→shuffle→combine chain crossed the wire once
+    and fanned out to both consumers."""
+    tables = {"fact": fact, "dim": dim}
+    broadcast.clear_replica_cache()
+    want = _frame(planner.run(dctx, _plan_shuffle_groupby, tables))
+    broadcast.clear_replica_cache()
+    trace.reset()
+    planner.run(dctx, _plan_shuffle_groupby, tables)
+    serial_exchanges = observe.exchange_count(trace.counters())
+    assert serial_exchanges >= 1
+
+    broadcast.clear_replica_cache()
+    trace.reset()
+    with ServeSession(dctx, tables=tables, batch_window_ms=80.0) as s:
+        h1 = s.submit(_plan_shuffle_groupby, label="first")
+        h2 = s.submit(_plan_shuffle_groupby, label="second")
+        r1, r2 = h1.result(timeout=300), h2.result(timeout=300)
+        stats = s.stats()
+    c = trace.counters()
+    # both consumers answered, ONE execution paid for
+    _assert_rowset_equal(_frame(r1), want)
+    _assert_rowset_equal(_frame(r2), want)
+    assert observe.exchange_count(c) == serial_exchanges, \
+        "the second query re-ran exchanges the first already paid for"
+    assert stats["subplan_shared"] >= 1
+    assert c.get("serve.subplan_shared", 0) >= 1
+    # the share is recorded on the CONSUMING handle (arrival order —
+    # whichever executed second) as op-level proof
+    shared = h1.shared_subplans + h2.shared_subplans
+    assert shared, "no handle recorded a shared subplan"
+    assert stats["batches"] == 1, "the window split: nothing could share"
+
+
+def test_serve_prefix_shared_across_distinct_queries(dctx, fact, dim):
+    """Two DIFFERENT queries sharing only a prefix (the same fact scan)
+    still share it; their distinct tails both execute."""
+    tables = {"fact": fact, "dim": dim}
+    want_a = _frame(planner.run(dctx, _plan_join_groupby, tables))
+    want_b = _frame(planner.run(dctx, _plan_shuffle_groupby, tables))
+    trace.reset()
+    with ServeSession(dctx, tables=tables, batch_window_ms=80.0) as s:
+        ha = s.submit(_plan_join_groupby, label="a")
+        hb = s.submit(_plan_shuffle_groupby, label="b")
+        ra, rb = ha.result(timeout=300), hb.result(timeout=300)
+        stats = s.stats()
+    _assert_rowset_equal(_frame(ra), want_a)
+    _assert_rowset_equal(_frame(rb), want_b)
+    assert stats["subplan_shared"] >= 1
+    assert "scan" in (ha.shared_subplans + hb.shared_subplans)
+
+
+def test_serve_no_window_no_sharing(dctx, fact, dim):
+    """batch_window_ms=0 + sequential submit→result: every query is its
+    own batch; the memo never spans two queries (the latency end of the
+    sharing-vs-latency dial, docs/serving.md)."""
+    tables = {"fact": fact, "dim": dim}
+    with ServeSession(dctx, tables=tables, batch_window_ms=0.0) as s:
+        s.run(_plan_shuffle_groupby, timeout=300)
+        s.run(_plan_shuffle_groupby, timeout=300)
+        stats = s.stats()
+    assert stats["batches"] >= 2
+    assert stats["subplan_shared"] == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: admission under a tightened budget
+# ---------------------------------------------------------------------------
+
+def test_serve_admission_defers_past_budget(dctx, fact, dim):
+    """With the admission budget pinned to ONE query's price, a window
+    of 4 queries admits the head and defers the rest to later windows;
+    everything still completes with correct rows."""
+    tables = {"fact": fact, "dim": dim}
+    want = _frame(planner.run(dctx, _plan_shuffle_groupby, tables))
+    price = price_query(tables)
+    assert price > 0
+    trace.reset()
+    with ServeSession(dctx, tables=tables, batch_window_ms=60.0,
+                      admission_budget=price) as s:
+        hs = [s.submit(_plan_shuffle_groupby, label=f"n{i}")
+              for i in range(4)]
+        results = [h.result(timeout=300) for h in hs]
+        stats = s.stats()
+    for r in results:
+        _assert_rowset_equal(_frame(r), want)
+    assert stats["completed"] == 4 and stats["failed"] == 0
+    assert stats["deferred"] >= 1
+    assert trace.counters().get("serve.deferred", 0) >= 1
+    assert stats["batches"] >= 2
+    deferred_handles = [h for h in hs if h.deferrals > 0]
+    assert deferred_handles, "no handle recorded its deferral"
+
+
+def test_serve_tight_device_budget_stays_within_peak(dctx, fact, dim):
+    """The end-to-end budget acceptance: a deliberately tightened device
+    memory budget (the CYLON_MEMORY_BUDGET path) both (a) steers
+    admission — the live budget IS the default admission ceiling, so a
+    window of 8 cannot co-admit — and (b) degrades the over-budget fact
+    shuffle to the chunked path, so ``shuffle.exchange_bytes_peak``
+    stays within budget: no OOM, no ``retry.exhausted``."""
+    tables = {"fact": fact, "dim": dim}
+    want = _frame(planner.run(dctx, _plan_wide_exchange, tables))
+    # under the fact shuffle's single-shot runtime price (send block +
+    # receive mirror + compacted output over ~4000×12 B rows) so the
+    # exchange must chunk, and far under the per-query admission price
+    # so co-admission is impossible
+    budget = 32 << 10
+    assert price_query(tables) > budget
+    prev = cfg.set_device_memory_budget(budget)
+    try:
+        planner.clear_plan_cache()  # plans re-decide under the budget
+        trace.reset()
+        with ServeSession(dctx, tables=tables, batch_window_ms=60.0) as s:
+            hs = [s.submit(_plan_wide_exchange, label=f"t{i}")
+                  for i in range(8)]
+            results = [h.result(timeout=600) for h in hs]
+            stats = s.stats()
+        c = trace.counters()
+    finally:
+        cfg.set_device_memory_budget(prev)
+        planner.clear_plan_cache()
+    for r in results:
+        _assert_rowset_equal(_frame(r), want)
+    assert stats["completed"] == 8 and stats["failed"] == 0
+    peak = c.get("shuffle.exchange_bytes_peak", 0)
+    assert 0 < peak <= budget, \
+        f"exchange transient {peak} B blew past the {budget} B budget"
+    assert c.get("shuffle.chunked", 0) >= 1, \
+        "the budget never bit — the test lost its teeth"
+    assert c.get("retry.exhausted", 0) == 0
+    # the budget is tighter than one query's priced exchange, so windows
+    # of 8 could not co-admit everything
+    assert stats["deferred"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# acceptance: fault isolation
+# ---------------------------------------------------------------------------
+
+def test_serve_injected_fault_fails_only_its_query(dctx, fact, dim):
+    """One permanent injected fault at the host count-read boundary:
+    exactly one query fails (the error on ITS handle, the fault in ITS
+    counter slice); batch peers complete with correct rows and CLEAN
+    slices — retry.exhausted == 0 and zero faults attributed to them."""
+    tables = {"fact": fact, "dim": dim}
+    want = _frame(planner.run(dctx, _plan_shuffle_groupby, tables))
+    trace.reset()
+    with faults.active(faults.FaultPlan(seed=3, rules=[
+            faults.FaultRule("compact.read_counts", kind="permanent",
+                             once=True)])):
+        with ServeSession(dctx, tables=tables,
+                          batch_window_ms=60.0) as s:
+            hs = [s.submit(_plan_shuffle_groupby, label=f"c{i}")
+                  for i in range(4)]
+            for h in hs:
+                h._event.wait(600)
+            stats = s.stats()
+    failed = [h for h in hs if h.error is not None]
+    ok = [h for h in hs if h.error is None]
+    assert len(failed) == 1, [h.status for h in hs]
+    assert isinstance(failed[0].error, faults.PermanentFault)
+    with pytest.raises(faults.PermanentFault):
+        failed[0].result(timeout=1)
+    assert failed[0].counters.get("fault.injected", 0) == 1
+    assert len(ok) == 3
+    for h in ok:
+        _assert_rowset_equal(_frame(h.result(timeout=1)), want)
+        # the peers' per-query slices are clean: no fault, no exhausted
+        # retry leaked across the isolation boundary
+        assert h.counters.get("fault.injected", 0) == 0
+        assert h.counters.get("retry.exhausted", 0) == 0
+    assert stats["failed"] == 1 and stats["completed"] == 3
+    assert trace.counters().get("retry.exhausted", 0) == 0
+
+
+def test_serve_transient_fault_retried_inside_query(dctx, fact, dim):
+    """A transient fault at the same boundary is absorbed by the retry
+    machinery INSIDE the query: everything completes, and the retry is
+    attributed to the query that hit it."""
+    tables = {"fact": fact, "dim": dim}
+    want = _frame(planner.run(dctx, _plan_shuffle_groupby, tables))
+    trace.reset()
+    with faults.active(faults.FaultPlan(seed=5, rules=[
+            faults.FaultRule("compact.read_counts", kind="transient",
+                             once=True)])):
+        with ServeSession(dctx, tables=tables,
+                          batch_window_ms=60.0) as s:
+            hs = [s.submit(_plan_shuffle_groupby, label=f"r{i}")
+                  for i in range(2)]
+            results = [h.result(timeout=300) for h in hs]
+            stats = s.stats()
+    for r in results:
+        _assert_rowset_equal(_frame(r), want)
+    assert stats["failed"] == 0 and stats["completed"] == 2
+    c = trace.counters()
+    assert c.get("retry.attempts", 0) >= 1
+    assert c.get("retry.exhausted", 0) == 0
+    attributed = sum(h.counters.get("retry.attempts", 0) for h in hs)
+    assert attributed >= 1
+
+
+# ---------------------------------------------------------------------------
+# queue mechanics: backpressure + rejection
+# ---------------------------------------------------------------------------
+
+def test_query_queue_bounded_backpressure():
+    q = QueryQueue(2)
+    assert q.put("a") and q.put("b")
+    assert len(q) == 2
+    assert not q.put("c", block=False)          # full, non-blocking
+    assert not q.put("c", timeout=0.05)         # full, timed out
+    assert q.drain() == ["a", "b"]
+    assert len(q) == 0
+    assert q.put("c")
+    with pytest.raises(CylonError):
+        QueryQueue(0)
+
+
+def test_serve_rejects_when_queue_full(dctx, fact, dim):
+    """A full bounded queue + block=False is a LOUD CapacityError and a
+    ``serve.rejected`` bump, not silent loss (backpressure contract)."""
+    tables = {"fact": fact, "dim": dim}
+    trace.reset()
+    # a long window: submissions land while the dispatcher is still
+    # collecting, so the 1-deep queue is genuinely full for the second
+    with ServeSession(dctx, tables=tables, batch_window_ms=500.0,
+                      max_queue=1) as s:
+        h1 = s.submit(_plan_shuffle_groupby, label="kept")
+        with pytest.raises(CylonError, match="queue full"):
+            s.submit(_plan_shuffle_groupby, label="shed", block=False)
+        stats_mid = s.stats()
+        h1.result(timeout=300)
+    assert stats_mid["rejected"] == 1
+    assert trace.counters().get("serve.rejected", 0) == 1
+    assert h1.status == "done"
+
+
+def test_serve_submit_after_close_raises(dctx, fact, dim):
+    s = ServeSession(dctx, tables={"fact": fact, "dim": dim})
+    s.close()
+    with pytest.raises(CylonError, match="closed"):
+        s.submit(_plan_shuffle_groupby)
+    s.close()   # idempotent
+
+
+def test_serve_async_export_overlaps(dctx, fact, dim):
+    """Exports run on the host pipeline: the handle's value is the
+    EXPORTED form, and the export counter tallies the handoff."""
+    tables = {"fact": fact, "dim": dim}
+    want = _frame(planner.run(dctx, _plan_shuffle_groupby, tables))
+    trace.reset()
+    with ServeSession(dctx, tables=tables, batch_window_ms=40.0) as s:
+        hs = [s.submit(_plan_shuffle_groupby,
+                       export=lambda r: r.to_table().to_pandas(),
+                       label=f"e{i}") for i in range(3)]
+        frames = [h.result(timeout=300) for h in hs]
+        stats = s.stats()
+    for f in frames:
+        assert isinstance(f, pd.DataFrame)
+        _assert_rowset_equal(f, want)
+    assert stats["exports_async"] == 3
+    assert trace.counters().get("serve.exports_async", 0) == 3
+
+
+def test_serve_export_error_lands_on_handle(dctx, fact, dim):
+    """A failing export is the query's own error — delivered at
+    result(), never lost on the worker thread."""
+    tables = {"fact": fact, "dim": dim}
+
+    def bad_export(r):
+        raise ValueError("export boom")
+
+    with ServeSession(dctx, tables=tables, batch_window_ms=20.0) as s:
+        h = s.submit(_plan_shuffle_groupby, export=bad_export)
+        with pytest.raises(ValueError, match="export boom"):
+            h.result(timeout=300)
+        stats = s.stats()
+    assert stats["failed"] == 1
+
+
+def test_percentile_nearest_rank():
+    xs = sorted(float(i) for i in range(1, 101))
+    assert percentile(xs, 50) == 50.0
+    assert percentile(xs, 99) == 99.0
+    assert percentile(xs, 100) == 100.0
+    assert percentile([], 50) is None
+    assert percentile([7.0], 99) == 7.0
+
+
+def test_serve_stats_latency_percentiles(dctx, fact, dim):
+    tables = {"fact": fact, "dim": dim}
+    with ServeSession(dctx, tables=tables, batch_window_ms=10.0) as s:
+        for i in range(4):
+            s.run(_plan_shuffle_groupby, timeout=300)
+        stats = s.stats()
+    assert stats["completed"] == 4
+    assert stats["p50_ms"] is not None and stats["p50_ms"] > 0
+    assert stats["p99_ms"] >= stats["p50_ms"]
+    assert stats["batch_window_ms"] == 10.0
+
+
+# ---------------------------------------------------------------------------
+# satellites: module-state thread safety under concurrent queries
+# ---------------------------------------------------------------------------
+
+def test_warn_once_concurrent_exactly_once():
+    """N racing threads, one key: exactly ONE emits (and returns True).
+    Pre-lock, the check-then-add race could emit several."""
+    for round_ in range(25):
+        key = ("race-key", round_)
+        sink = io.StringIO()
+        glog.set_sink(sink)
+        barrier = threading.Barrier(8)
+        fired = []
+
+        def hammer():
+            barrier.wait()
+            fired.append(glog.warn_once(key, "raced warning %d", round_))
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            import sys
+            glog.set_sink(sys.stderr)
+        assert sum(fired) == 1, f"round {round_}: {sum(fired)} emissions"
+        assert sink.getvalue().count("raced warning") == 1
+
+
+def test_warn_once_reset_race_does_not_crash():
+    """Concurrent warn_once + reset_warn_once must never raise (the
+    unlocked set could RuntimeError under mutation races)."""
+    stop = threading.Event()
+    errors = []
+
+    def warner(tid):
+        i = 0
+        try:
+            while not stop.is_set():
+                glog.warn_once(("reset-race", tid, i % 7), "x")
+                i += 1
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    def resetter():
+        try:
+            while not stop.is_set():
+                glog.reset_warn_once()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    sink = io.StringIO()
+    glog.set_sink(sink)
+    threads = [threading.Thread(target=warner, args=(t,))
+               for t in range(3)] + [threading.Thread(target=resetter)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join()
+    finally:
+        import sys
+        glog.set_sink(sys.stderr)
+    assert not errors, errors
+
+
+def test_replica_cache_concurrent_hammer(dctx, dim):
+    """Concurrent replicate_table + clear_replica_cache: no exception
+    (the unlocked eviction loop racing a clear raised RuntimeError),
+    and every returned replica is the full table."""
+    broadcast.clear_replica_cache()
+    want = broadcast.replicate_table(dim).num_rows
+    stop = threading.Event()
+    errors = []
+
+    def replicator():
+        try:
+            while not stop.is_set():
+                rep = broadcast.replicate_table(dim)
+                assert rep.num_rows == want
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    def clearer():
+        try:
+            while not stop.is_set():
+                broadcast.clear_replica_cache()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=replicator) for _ in range(3)] \
+        + [threading.Thread(target=clearer)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    stop.set()
+    for t in threads:
+        t.join()
+    broadcast.clear_replica_cache()
+    assert not errors, errors
