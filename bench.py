@@ -1004,6 +1004,17 @@ def main() -> None:
             # fails CI instead of passing silently
             em.detail[f"tpch_{qname}_exchange_bytes_peak"] = \
                 q_counters.get("shuffle.exchange_bytes_peak", 0)
+            # costed-chooser strategy tallies of the timed rep
+            # (docs/tpu_perf_notes.md "Choosing the collective"):
+            # per-lowering counts reported for trend-watching, and the
+            # downgrade total gated UP by benchdiff — a cost-model
+            # regression pushing exchanges off the single-shot fast
+            # path fails CI instead of showing up only as wall-clock
+            for _s in ("single_shot", "chunked", "ring", "allgather"):
+                em.detail[f"tpch_{qname}_strategy_{_s}"] = \
+                    q_counters.get(f"shuffle.strategy.{_s}", 0)
+            em.detail[f"tpch_{qname}_strategy_downgrades"] = \
+                q_counters.get("shuffle.strategy.downgrades", 0)
             # logical-planner activity of the timed rep: cache hits
             # prove the rep skipped rewriting; rule fires are replayed
             # from the cached plan, so every rep reports them

@@ -31,6 +31,11 @@ Gated metrics (relative threshold, default 15%):
   * ``tpch_<q>_groupby_bytes_saved``  groupby-owned exchange bytes the
     fused aggregation exchange keeps off the wire vs the eager tail
     (lower = worse; docs/query_planner.md "groupby pushdown")
+  * ``tpch_<q>_strategy_downgrades``  exchanges the costed
+    redistribution chooser moved off the single-shot fast path
+    (higher = worse — a cost-model regression degrading exchanges that
+    used to run single-shot; docs/tpu_perf_notes.md "Choosing the
+    collective")
   * ``serve_qps``               mixed-workload serving throughput
     (lower = worse) and ``serve_p99_ms`` tail latency (higher = worse)
     — the serving layer's benchdiff family (docs/serving.md); p50 is
@@ -91,10 +96,17 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     # regression re-splitting a fused multiway join back into a binary
     # cascade — clears the relative threshold and fails the gate
     (r"tpch_q\d+_exchange_count$", "up"),
-    # peak exchange transient: the chunked path's memory bound, gated
-    # UP as a first-class family (a regression here previously passed
-    # CI silently — only wall-clock and total bytes were gated)
+    # peak exchange transient: the chooser's memory bound, gated UP as
+    # a first-class family (a regression here previously passed CI
+    # silently — only wall-clock and total bytes were gated); covers
+    # every lowering since all strategies watermark the same counter
     (r"tpch_q\d+_exchange_bytes_peak$", "up"),
+    # exchanges the costed chooser moved off the single-shot fast path
+    # (docs/tpu_perf_notes.md "Choosing the collective"): deterministic
+    # small integers under a fixed budget, so any increase — a pricing
+    # regression degrading exchanges that used to run single-shot —
+    # clears the relative threshold and fails the gate
+    (r"tpch_q\d+_strategy_downgrades$", "up"),
     # groupby-owned bytes the fused aggregation exchange saves
     (r"tpch_q\d+_groupby_bytes_saved$", "down"),
     # serving family (docs/serving.md): mixed-workload throughput gated

@@ -47,8 +47,8 @@ import numpy as np
 from ._abstract import PlanExportReached, is_abstract
 
 __all__ = ["PlanNode", "PlanReport", "PlanValidationError",
-           "explain", "validate", "note", "annotate", "annotate_at",
-           "capture_index", "instrument", "capturing"]
+           "explain", "validate", "note", "annotate", "annotate_append",
+           "annotate_at", "capture_index", "instrument", "capturing"]
 
 
 class PlanValidationError(Exception):
@@ -228,6 +228,21 @@ def annotate(node: Optional[PlanNode] = None, **info) -> None:
     if node is None:
         return
     node.info.update({k: v for k, v in info.items() if v is not None})
+
+
+def annotate_append(key: str, value, sep: str = " | ") -> None:
+    """Append ``value`` to the most recently noted node's ``key`` info
+    (creating it when absent).  For per-call detail that may
+    legitimately occur more than once under one instrumented op —
+    e.g. the two co-partition exchanges of one shuffle join, whose
+    strategy choices would otherwise overwrite each other through
+    ``annotate``'s ``info.update``.  No-op outside a capture."""
+    report: Optional[PlanReport] = getattr(_capture, "report", None)
+    if report is None or not report.nodes:
+        return
+    node = report.nodes[-1]
+    cur = node.info.get(key)
+    node.info[key] = value if cur is None else f"{cur}{sep}{value}"
 
 
 def capture_index() -> Optional[int]:

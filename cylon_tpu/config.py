@@ -151,6 +151,56 @@ def device_memory_budget() -> int:
 
 
 # ---------------------------------------------------------------------------
+# exchange strategy override (docs/tpu_perf_notes.md "Choosing the
+# collective"): the costed redistribution chooser (parallel/cost.py)
+# normally picks the collective sequence per exchange from the live
+# budget + count matrix.  This knob forces ONE lowering session-wide —
+# the A/B escape hatch for parity tests and kernel timing, same idiom
+# as CYLON_OPTIMIZER=0.  Resolution: explicit set_exchange_strategy()
+# > CYLON_EXCHANGE_STRATEGY env > None (costed choice).
+# ---------------------------------------------------------------------------
+
+_exchange_strategy: Optional[str] = None   # None -> env/chooser
+
+
+def _validate_strategy(name, what: str) -> str:
+    # validate against the chooser's OWN catalogue (late import: the
+    # parallel package is heavy and config loads first) — a strategy
+    # added to cost.STRATEGIES is automatically forceable here, no
+    # second hand-maintained list to drift
+    from .parallel.cost import STRATEGIES
+    if not isinstance(name, str) or name not in STRATEGIES:
+        raise CylonError(Status(Code.Invalid,
+            f"{what} must be one of {STRATEGIES} or None to "
+            f"restore the costed chooser, got {name!r}"))
+    return name
+
+
+def set_exchange_strategy(name: "Optional[str]") -> "Optional[str]":
+    """Force every eligible exchange onto one lowering (``None``
+    restores the costed chooser); returns the previous explicit
+    setting.  Combine-spec exchanges (the fused groupby's fold-by-key
+    rounds) ignore a forced staged strategy they cannot implement and
+    stay on the single-shot/chunked pair."""
+    global _exchange_strategy
+    if name is not None:
+        name = _validate_strategy(name, "exchange strategy")
+    prev = _exchange_strategy
+    _exchange_strategy = name
+    return prev
+
+
+def exchange_strategy() -> Optional[str]:
+    """The forced exchange lowering, or None for the costed chooser."""
+    if _exchange_strategy is not None:
+        return _exchange_strategy
+    env = os.environ.get("CYLON_EXCHANGE_STRATEGY", "")
+    if env:
+        return _validate_strategy(env, "CYLON_EXCHANGE_STRATEGY")
+    return None
+
+
+# ---------------------------------------------------------------------------
 # compiled-plan cache capacity (docs/query_planner.md "cache semantics"):
 # the LRU entry cap of plan/executor.py's compiled-plan cache.  One
 # repeated query needs one entry; a SERVING workload (cylon_tpu/serve)

@@ -180,6 +180,26 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "live entries in the broadcast replica cache"),
     # resilience (docs/robustness.md): budget guardrails, degraded
     # exchanges, fault injection, bounded retries, pipeline replays
+    # costed redistribution chooser (parallel/cost.py;
+    # docs/tpu_perf_notes.md "Choosing the collective"): one tally per
+    # budget-priced exchange for the lowering the chooser selected
+    ("shuffle.strategy.single_shot", COUNTER, "exchanges",
+     "exchanges the costed chooser lowered as ONE lax.all_to_all "
+     "(the fast path: single-shot priced within the memory budget)"),
+    ("shuffle.strategy.chunked", COUNTER, "exchanges",
+     "exchanges the chooser lowered as K bounded all_to_all rounds "
+     "(the fewest-rounds strategy fitting the budget)"),
+    ("shuffle.strategy.ring", COUNTER, "exchanges",
+     "exchanges the chooser lowered as the staged ring ppermute "
+     "(P-1 collective-permute rounds, 2-block peak transient)"),
+    ("shuffle.strategy.allgather", COUNTER, "exchanges",
+     "exchanges the chooser lowered as replicate-and-filter "
+     "(all_gather every leaf, keep own rows — beats the all_to_all "
+     "transient under one-hot-cell skew)"),
+    ("shuffle.strategy.downgrades", COUNTER, "exchanges",
+     "exchanges the chooser moved OFF the single-shot fast path (sum "
+     "of the non-single-shot strategy tallies) — bench's per-query "
+     "tpch_*_strategy_downgrades column, gated UP by benchdiff"),
     ("shuffle.chunked", COUNTER, "exchanges",
      "shuffles degraded to the chunked multi-round exchange (single-"
      "shot priced over the device memory budget)"),

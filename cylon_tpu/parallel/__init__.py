@@ -9,6 +9,7 @@ rendezvous/AllToAll protocol collapses into a two-phase static-shape
 ``lax.all_to_all`` under ``shard_map`` (SURVEY.md §2.4).
 """
 from ..ops.compact import run_pipeline
+from . import cost
 from .broadcast import replicate_table
 from .dtable import DColumn, DTable
 from .shuffle import shuffle_leaves
@@ -21,7 +22,7 @@ from .dist_ops import (dist_aggregate, dist_anti_join, dist_groupby,
 from .streaming import HostPipeline, HostTask, dist_join_streaming
 
 __all__ = [
-    "DColumn", "DTable", "shuffle_leaves", "shuffle_table",
+    "cost", "DColumn", "DTable", "shuffle_leaves", "shuffle_table",
     "replicate_table", "HostPipeline", "HostTask",
     "dist_join", "dist_join_streaming", "dist_multiway_join",
     "dist_semi_join", "dist_anti_join",

@@ -153,11 +153,17 @@ def rows_if_small(dt: DTable, threshold: Optional[int],
     if rows is None:
         return None
     from .. import observe, resilience
+    from . import cost
     rbytes = max(observe.row_bytes(
         [lf for c in dt.columns for lf in (c.data, c.validity)
          if lf is not None]), 1)
     outcap = ops_compact.next_bucket(max(rows, 1), minimum=8)
-    priced = (dt.nparts * dt.cap + outcap) * rbytes
+    # the replica is one more exchange-shaped decision priced through
+    # the shared cost model (cost.price_replicate — the all_gathered
+    # [P*cap] blocks plus the compacted replica), so the veto, the
+    # shuffle chooser and admission can never disagree on footprint math
+    priced = cost.price_replicate(dt.nparts, dt.cap, outcap,
+                                  rbytes).peak_bytes
     budget = resilience.exchange_budget()
     if priced > budget:
         if not quiet:

@@ -1,0 +1,317 @@
+"""The shared exchange cost model: price a redistribution STRATEGY, not
+just its chunking.
+
+Every exchange-shaped decision in the engine used to carry its own
+pricing math — ``shuffle._priced_bytes`` for the single-shot budget
+check, ``shuffle._chunk_sizes`` for the degraded rounds,
+``broadcast.rows_if_small`` for the replica veto, and
+``serve/admission.price_table`` re-deriving the first of those at
+admission altitude.  This module is the one place all of them price
+through now (docs/tpu_perf_notes.md "Choosing the collective").
+
+Following arXiv:2112.01075, a resharding is a *sequence* of
+all_gather / all_to_all / collective-permute steps with very different
+peak-memory / latency / wire tradeoffs.  The catalogue priced here:
+
+  ``single-shot``  ONE ``lax.all_to_all`` over [P, block] send/receive
+                   buffers + the compacted [outcap] output.  1 round,
+                   peak ``(2·P·block + outcap) · rbytes`` — the
+                   historical ``shuffle._priced_bytes`` formula.
+  ``chunked``      K bounded all_to_all rounds of ≤ C rows per
+                   (sender, target) cell, receiver-side folded
+                   (docs/robustness.md).  Peak is one round's transient
+                   ``(2·P·bucket(C) + outcap_round) · rbytes``; the
+                   accumulated result block is the shuffle's RESULT,
+                   not a transient the path can shrink.
+  ``ring``         P−1 staged ``lax.ppermute`` rounds: round r moves
+                   each shard's (me → me+r) cell whole — one [block]
+                   send + one [block] receive live at a time, folded
+                   straight into the result block.  Peak
+                   ``2·bucket(maxcell) · rbytes`` (the same
+                   beyond-the-result accounting as the chunked rounds),
+                   P−1 rounds of latency.
+  ``allgather``    replicate the payload (one ``lax.all_gather`` per
+                   leaf) and let every shard keep its own rows: 1
+                   round, peak ``(P·cap + outcap) · rbytes``, wire
+                   ``(P−1)·cap`` rows — the brute-force lowering that
+                   beats the all_to_all's 2·P·block transient exactly
+                   when one sender-side cell dominates (block > cap/2).
+  ``replicate``    the broadcast-join replica (parallel/broadcast.py):
+                   the same gather shape as ``allgather`` priced for
+                   the "small side fits P times over" veto.
+
+Pricing inputs are host-side metadata only — the [P, P] count matrix
+the two-phase shuffle already reads, or the ``P × cap`` capacity bound
+when counts are not available (the same sync-free evidence
+``rows_if_small`` and admission use).  Nothing here touches device
+data.
+
+:func:`choose` picks among the candidates under the live
+``resilience.exchange_budget()``: the cheapest FEASIBLE strategy by
+``(rounds, wire_bytes, peak_bytes)`` — fewest collective rounds first
+(the sync/latency axis dominates on tunneled backends,
+docs/tpu_perf_notes.md "the sync floor"), wire bytes breaking ties,
+peak last.  ``single-shot`` therefore keeps winning whenever it fits
+the budget (1 round, least wire), preserving the fast path; over
+budget, the chooser degrades to the cheapest sequence that fits
+instead of hardcoding the chunked path.  When NOTHING fits, the
+chunked plan at its C = 1 floor runs best-effort — the historical
+behavior, now a documented last resort.
+
+The choice is re-priced on every execution (counts are re-read per
+call), so a compiled/cached plan re-decides under a changed
+``CYLON_MEMORY_BUDGET`` exactly like the multiway join's per-dimension
+replica re-pricing.  ``config.set_exchange_strategy`` /
+``CYLON_EXCHANGE_STRATEGY`` force one lowering session-wide — the
+A/B escape hatch (parity tests, kernel timing), same idiom as
+``CYLON_OPTIMIZER=0``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.compact import next_bucket
+
+__all__ = [
+    "SINGLE_SHOT", "CHUNKED", "RING", "ALLGATHER", "REPLICATE",
+    "STRATEGIES", "StrategyPrice", "exchange_sizes", "single_shot_bytes",
+    "price_single_shot", "price_chunked", "price_ring", "price_allgather",
+    "price_replicate", "chunk_plan", "enumerate_strategies", "choose",
+]
+
+SINGLE_SHOT = "single-shot"
+CHUNKED = "chunked"
+RING = "ring"
+ALLGATHER = "allgather"
+REPLICATE = "replicate"   # broadcast replication (priced, never chosen
+#                           by the shuffle chooser — it changes the
+#                           layout contract, not just the lowering)
+
+# the shuffle chooser's selectable catalogue, in preference order for
+# deterministic tie-breaks (counter names derive from these — see
+# strategy_counter)
+STRATEGIES = (SINGLE_SHOT, ALLGATHER, CHUNKED, RING)
+
+
+def strategy_counter(strategy: str) -> str:
+    """Catalogued counter name for one strategy choice
+    (``shuffle.strategy.single_shot`` etc. — observe.METRICS)."""
+    return "shuffle.strategy." + strategy.replace("-", "_")
+
+
+@dataclass(frozen=True)
+class StrategyPrice:
+    """One candidate lowering, priced.
+
+    ``peak_bytes``  per-device transient footprint of one dispatch (or
+                    one round, for the staged strategies — their result
+                    block is excluded, matching the chunked path's
+                    established accounting).
+    ``wire_bytes``  per-device payload leaving the shard across the
+                    whole exchange (padded block sizes — what actually
+                    crosses the ICI, not just live rows).
+    ``rounds``      collective rounds dispatched (the latency axis).
+    ``sizes``       strategy-specific size classes, enough to dispatch
+                    without re-deriving (single-shot/allgather:
+                    (block, outcap); ring: (cell_block, outcap);
+                    chunked: (rounds, C, block, outcap_round)).
+    """
+
+    strategy: str
+    peak_bytes: int
+    wire_bytes: int
+    rounds: int
+    sizes: Tuple[int, ...]
+
+    def describe(self) -> str:
+        return (f"{self.strategy}: peak {self.peak_bytes} B, "
+                f"{self.rounds} round(s), {self.wire_bytes} B wire")
+
+
+def exchange_sizes(counts: np.ndarray) -> Tuple[int, int, np.ndarray]:
+    """counts [P, P] → (block, outcap, per_recv): THE sizing rule for a
+    single-shot exchange, shared by the optimistic post(), the degraded
+    steady-state branch and every candidate price below, so no two
+    paths can dispatch different size classes for the same counts (the
+    promotion comparison and the compile-reuse claim both rely on
+    that)."""
+    block = next_bucket(max(int(counts.max(initial=0)), 1), minimum=8)
+    per_recv = counts.sum(axis=0)
+    outcap = next_bucket(max(int(per_recv.max(initial=0)), 1), minimum=8)
+    return block, outcap, per_recv
+
+
+def single_shot_bytes(nparts: int, sizes: Sequence[int], rbytes: int) -> int:
+    """Per-device transient of ONE single-shot dispatch: the grouped
+    send buffer ([P, block] rows per leaf) + the all_to_all receive
+    mirror + the compacted [outcap] output block, × the payload width
+    of one row.  The historical ``shuffle._priced_bytes`` — still the
+    single formula behind the budget comparison, the
+    ``shuffle.exchange_bytes_peak`` watermark, and admission's
+    worst-exchange price (serve/admission.py)."""
+    block, outcap = sizes
+    return int((2 * nparts * block + outcap) * rbytes)
+
+
+def price_single_shot(nparts: int, block: int, outcap: int,
+                      rbytes: int) -> StrategyPrice:
+    return StrategyPrice(
+        SINGLE_SHOT,
+        peak_bytes=single_shot_bytes(nparts, (block, outcap), rbytes),
+        wire_bytes=int((nparts - 1) * block * rbytes),
+        rounds=1, sizes=(block, outcap))
+
+
+_RING_ROUTING_BYTES = 10  # per-row routing state of ONE ring round:
+#                           int32 send idx + int32 receive slots (4+4)
+#                           and the two bool validity lanes (1+1).  The
+#                           kernel computes each round's routing inside
+#                           the round loop, so exactly one round's
+#                           worth is live at the payload's side.
+
+
+def price_ring(nparts: int, cell_block: int, outcap: int,
+               rbytes: int) -> StrategyPrice:
+    """P−1 ppermute rounds, each moving one whole (me → me+r) cell:
+    transient = the [cell_block] send + receive payload buffers of the
+    round in flight plus that round's routing state
+    (:data:`_RING_ROUTING_BYTES`/row — received rows fold straight into
+    the result block, so there is no outcap_round compaction term)."""
+    return StrategyPrice(
+        RING,
+        peak_bytes=int(cell_block * (2 * rbytes + _RING_ROUTING_BYTES)),
+        wire_bytes=int((nparts - 1) * cell_block * rbytes),
+        rounds=max(nparts - 1, 1), sizes=(cell_block, outcap))
+
+
+_PID_BYTES = 4  # the int32 routing lane the allgather must replicate
+#                 (the all_to_all pre-routes rows instead of shipping
+#                 their target ids — this term is what keeps allgather
+#                 from tying single-shot when skew drives block to cap)
+
+
+def price_allgather(nparts: int, cap: int, outcap: int,
+                    rbytes: int) -> StrategyPrice:
+    """Replicate-and-filter: gather every shard's [cap] block (payload
+    leaves + the int32 pid lane the receiver filters on), keep own
+    rows.  The gathered [P·cap] intermediates and the compacted output
+    coexist — the same footprint shape as the broadcast replica."""
+    return StrategyPrice(
+        ALLGATHER,
+        peak_bytes=int(nparts * cap * (rbytes + _PID_BYTES)
+                       + outcap * rbytes),
+        wire_bytes=int((nparts - 1) * cap * (rbytes + _PID_BYTES)),
+        rounds=1, sizes=(cap, outcap))
+
+
+def price_replicate(nparts: int, cap: int, outcap: int,
+                    rbytes: int) -> StrategyPrice:
+    """The broadcast-join replica (``broadcast.rows_if_small``'s veto
+    arm): all_gather the small side's [cap] blocks, compact into the
+    [outcap] replica every shard keeps.  Identical footprint math to
+    :func:`price_allgather`; kept as its own strategy name so veto
+    annotations and the chooser's catalogue cannot be conflated."""
+    return StrategyPrice(
+        REPLICATE,
+        peak_bytes=int((nparts * cap + outcap) * rbytes),
+        wire_bytes=int((nparts - 1) * cap * rbytes),
+        rounds=1, sizes=(cap, outcap))
+
+
+def chunk_plan(nparts: int, counts: np.ndarray, rbytes: int,
+               budget: int) -> Tuple[int, int, int, int]:
+    """The chunk math (docs/robustness.md): pick the smallest per-round
+    cell cap C such that a round's transient — send [P, bucket(C)] +
+    receive mirror + compacted [outcap_round] — prices within budget,
+    where outcap_round bounds EVERY round by round 0 (per-cell residues
+    ``clip(count − k·C, 0, C)`` are non-increasing in k).  Returns
+    ``(rounds, C, block, outcap_round)``; C = 1 is the floor — below it
+    the exchange cannot shrink further and the budget is best-effort.
+    (Moved here from ``shuffle._chunk_sizes`` so the chooser and the
+    degraded path share one plan.)"""
+    maxcell = max(int(counts.max(initial=0)), 1)
+    C = maxcell
+    while True:
+        C = max(C // 2, 1)
+        block = next_bucket(C, minimum=8)
+        recv0 = int(np.minimum(counts, C).sum(axis=0).max(initial=0))
+        outcap = next_bucket(max(recv0, 1), minimum=8)
+        if single_shot_bytes(nparts, (block, outcap), rbytes) <= budget \
+                or C <= 1:
+            break
+    return -(-maxcell // C), C, block, outcap
+
+
+def price_chunked(nparts: int, counts: np.ndarray, rbytes: int,
+                  budget: int) -> StrategyPrice:
+    rounds, C, block, outcap_r = chunk_plan(nparts, counts, rbytes, budget)
+    return StrategyPrice(
+        CHUNKED,
+        peak_bytes=single_shot_bytes(nparts, (block, outcap_r), rbytes),
+        wire_bytes=int(rounds * (nparts - 1) * block * rbytes),
+        rounds=rounds, sizes=(rounds, C, block, outcap_r))
+
+
+def enumerate_strategies(nparts: int, cap: int, counts: np.ndarray,
+                         rbytes: int, budget: int,
+                         staged_ok: bool = True) -> List[StrategyPrice]:
+    """Every candidate lowering for one exchange, priced from the count
+    matrix.  ``cap`` is the per-shard row capacity (the allgather
+    payload).  ``staged_ok=False`` restricts the catalogue to
+    single-shot + chunked — the combine-spec (fold-by-key partial
+    aggregation) exchanges, whose receiver-side group fold only the
+    chunked rounds implement."""
+    block, outcap, _ = exchange_sizes(counts)
+    out = [price_single_shot(nparts, block, outcap, rbytes)]
+    if staged_ok and nparts > 1:
+        out.append(price_allgather(nparts, cap, outcap, rbytes))
+        out.append(price_ring(nparts, block, outcap, rbytes))
+    out.append(price_chunked(nparts, counts, rbytes, budget))
+    return out
+
+
+def choose(candidates: Sequence[StrategyPrice], budget: int,
+           forced: Optional[str] = None
+           ) -> Tuple[StrategyPrice, str, bool]:
+    """Pick one strategy under ``budget``.  Returns ``(price, reason,
+    feasible)`` — ``feasible`` False only on the best-effort floor
+    (nothing fits; the chunked plan runs anyway, matching the
+    historical budget-floor warning path).
+
+    Selection: feasible = ``peak_bytes <= budget``; among the feasible,
+    minimize ``(rounds, wire_bytes, catalogue preference)``
+    lexicographically.  Peak bytes deliberately do NOT rank feasible
+    candidates — feasibility already enforced the budget, and ranking
+    on peak would let a residual-footprint difference steal the
+    single-shot fast path on wire ties; the catalogue order
+    (``STRATEGIES``) breaks exact ties deterministically instead.
+    ``forced`` (the ``CYLON_EXCHANGE_STRATEGY`` knob) short-circuits to
+    the named candidate when present in ``candidates`` — feasibility is
+    reported but not enforced for it (it is a diagnostic override)."""
+    by_name = {c.strategy: c for c in candidates}
+    if forced is not None and forced in by_name:
+        c = by_name[forced]
+        return c, f"forced by CYLON_EXCHANGE_STRATEGY ({c.describe()})", \
+            c.peak_bytes <= budget
+    feasible = [c for c in candidates if c.peak_bytes <= budget]
+    if not feasible:
+        c = by_name.get(CHUNKED, min(candidates,
+                                     key=lambda s: s.peak_bytes))
+        return c, (f"budget {budget} B below every strategy's floor — "
+                   f"best-effort {c.describe()}"), False
+    best = min(feasible, key=lambda c: (c.rounds, c.wire_bytes,
+                                        STRATEGIES.index(c.strategy)))
+    if best.strategy == SINGLE_SHOT:
+        reason = f"{best.describe()} <= budget {budget} B"
+    else:
+        ss = by_name.get(SINGLE_SHOT)
+        over = (f"single-shot priced {ss.peak_bytes} B over the "
+                f"{budget} B budget; " if ss is not None
+                and ss.peak_bytes > budget else "")
+        losers = [c.strategy for c in feasible if c is not best]
+        beat = f" (beat {', '.join(losers)})" if losers else ""
+        reason = over + best.describe() + beat
+    return best, reason, True

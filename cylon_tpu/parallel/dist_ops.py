@@ -174,7 +174,11 @@ def _shuffle_by_pids(dt: DTable, pid: jax.Array, combine=None,
                      owner: "str | None" = None) -> DTable:
     """Exchange rows to their target shards; rebuild the DTable.
     ``combine``/``owner`` thread through to :func:`shuffle_leaves` (the
-    partial-group fold spec and the byte-attribution tag)."""
+    partial-group fold spec and the byte-attribution tag).  The
+    COLLECTIVE the exchange lowers to — single-shot all_to_all,
+    chunked rounds, ring ppermute, allgather — is the costed chooser's
+    per-execution decision (parallel/cost.py); every dist op routed
+    through here inherits it without opting in."""
     if dt.pending_mask is not None:
         # ``pid`` was computed against THESE blocks — a deferred select
         # must have been folded into it (dropped-partition routing, via a

@@ -24,10 +24,20 @@ ops/compact.next_bucket) bounds recompilation at ≤25% padding overhead
 the padded send buffer; the FIN protocol, backpressure caps and spin loops
 of the reference (table_api.cpp:260-261) have no equivalent because the
 collective is one program.
+
+Phase 2's COLLECTIVE is a costed decision, not a constant
+(docs/tpu_perf_notes.md "Choosing the collective"): the single-shot
+``lax.all_to_all`` above is the fast path, but every sized exchange is
+priced through the shared cost model (parallel/cost.py) against the
+live memory budget, and the chooser may lower it instead as K bounded
+chunked rounds, a P−1-round staged ring ``lax.ppermute``, or a
+replicate-and-filter ``lax.all_gather`` — identical rows out, choice +
+reason annotated on the plan and tallied in ``shuffle.strategy.*``.
 """
 from __future__ import annotations
 
 import functools
+import threading
 from typing import List, Sequence, Tuple
 
 import jax
@@ -39,6 +49,7 @@ from jax.sharding import PartitionSpec as P
 from .. import trace
 from ..ops import compact as ops_compact
 from ..ops import gather as ops_gather
+from . import cost
 
 
 def _bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
@@ -51,41 +62,57 @@ def _bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
 # the fact; undersized hints re-run with correct sizes.
 _block_hints: dict = {}
 
-# Shuffle signatures whose last sized exchange priced OVER the device
-# memory budget (docs/robustness.md): these skip the optimistic dispatch
-# entirely — blocking on the count matrix is the price of not allocating
-# an over-budget exchange — and run the chunked multi-round path until a
-# call prices back under budget (then they self-promote).
+# The costed chooser's degraded-signature state (docs/robustness.md;
+# the chooser itself is parallel/cost.py): shuffle signatures whose
+# last sized exchange chose a NON-single-shot lowering.  These skip the
+# optimistic dispatch entirely — blocking on the count matrix is the
+# price of not allocating an over-budget exchange — and re-run the
+# chooser per call until single-shot prices back under budget (then
+# they self-promote).  Lock-guarded: the serve layer runs exchanges
+# from a dispatcher thread while clients submit (the same hazard class
+# as the replica-cache/warn_once races fixed in PR 9); membership reads
+# stay lock-free (a stale read only costs one optimistic dispatch or
+# one count block, never correctness).
 _chunked_keys: set = set()
+_chunk_lock = threading.Lock()
 
 
 def clear_chunk_state() -> None:
     """Forget which signatures are degraded (test isolation)."""
-    _chunked_keys.clear()
+    with _chunk_lock:
+        _chunked_keys.clear()
+
+
+def _mark_degraded(hint_key) -> None:
+    with _chunk_lock:
+        _chunked_keys.add(hint_key)
+
+
+def _mark_promoted(hint_key) -> None:
+    with _chunk_lock:
+        _chunked_keys.discard(hint_key)
 
 
 class _OverBudget(Exception):
-    """Raised by the count-protocol post() when the sized single-shot
-    exchange prices over the memory budget — carries the (already-read)
-    count matrix so shuffle_leaves can run the chunked path without a
-    second host read.  Internal control flow, never user-visible."""
+    """Raised by the count-protocol post() when the chooser picks a
+    non-single-shot lowering — carries the (already-read) count matrix
+    and the priced choice so shuffle_leaves can run the degraded
+    strategy without a second host read or a re-choose.  Internal
+    control flow, never user-visible."""
 
-    def __init__(self, counts, need, priced):
-        super().__init__(f"exchange priced {priced} B over budget")
+    def __init__(self, counts, need, choice, reason):
+        super().__init__(f"exchange degraded to {choice.strategy}")
         self.counts = counts
         self.need = need
-        self.priced = priced
+        self.choice = choice
+        self.reason = reason
 
 
-def _priced_bytes(nparts: int, sizes, rbytes: int) -> int:
-    """Per-device transient footprint of ONE exchange dispatch: the
-    grouped send buffer ([P, block] rows per leaf) + the all_to_all
-    receive buffer (same shape) + the compacted [outcap] output block,
-    all × the payload width of one row.  The single pricing rule behind
-    both the budget comparison and the ``shuffle.exchange_bytes_peak``
-    watermark (docs/robustness.md derives the chunk math from it)."""
-    block, outcap = sizes
-    return int((2 * nparts * block + outcap) * rbytes)
+# The single pricing rule behind the budget comparison, the
+# ``shuffle.exchange_bytes_peak`` watermark and admission's
+# worst-exchange price now lives in the shared cost model
+# (cost.single_shot_bytes); the chunk math is cost.chunk_plan.
+_priced_bytes = cost.single_shot_bytes
 
 
 def _account(counts: np.ndarray, rbytes: int, combine=None,
@@ -109,18 +136,11 @@ def _account(counts: np.ndarray, rbytes: int, combine=None,
         trace.count("groupby.partials_rows", int(counts.sum()))
 
 
-def _sizes_from_counts(counts: np.ndarray):
-    """counts [P, P] → (block, outcap, per_recv): THE sizing rule for a
-    single-shot exchange, shared by the optimistic post() and the
-    degraded steady-state branch so the two paths can never dispatch
-    different size classes for the same counts (the promotion
-    comparison and the compile-reuse claim both rely on that)."""
-    block = ops_compact.next_bucket(
-        max(int(counts.max(initial=0)), 1), minimum=8)
-    per_recv = counts.sum(axis=0)
-    outcap = ops_compact.next_bucket(
-        max(int(per_recv.max(initial=0)), 1), minimum=8)
-    return block, outcap, per_recv
+# THE sizing rule for a single-shot exchange, shared by the optimistic
+# post(), the degraded steady-state branch and every candidate price —
+# owned by the cost model so no two paths can dispatch different size
+# classes for the same counts.
+_sizes_from_counts = cost.exchange_sizes
 
 
 def _warn_skew(Pn: int, hint_key, per_recv: np.ndarray,
@@ -228,13 +248,177 @@ def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
 
 
 # ---------------------------------------------------------------------------
-# chunked degraded exchange (docs/robustness.md): when the sized single-
-# shot exchange prices over the device memory budget, the rows of every
-# (sender, target) cell are split into K contiguous rank-slices and moved
-# by K bounded all_to_all rounds reusing _exchange_fn, each round's
-# compacted output folded into the final block receiver-side.  The rounds
-# share ONE (block, outcap) size class, so the whole degraded path costs
-# at most three extra compiles (rank, slice, fold) + one exchange shape.
+# staged lowerings (docs/tpu_perf_notes.md "Choosing the collective"):
+# the two catalogue entries beyond the all_to_all pair.  Both produce
+# the same [P*outcap] result block as the single-shot exchange — the
+# ring up to intra-shard row order (arrival order is me, me-1, … not
+# sender order; no consumer depends on intra-shard order after a
+# shuffle), the allgather byte-identical (gathered order IS sender
+# order).
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _ring_exchange_fn(mesh, axis: str, nparts: int, block: int,
+                      outcap: int):
+    """Staged ring exchange: P−1 ``lax.ppermute`` rounds, round r moving
+    each shard's whole (me → me+r) cell as ONE [block] buffer — the
+    collective-permute decomposition of arXiv:2112.01075.  Only one
+    send + one receive block live per round (vs the all_to_all's
+    [P, block] pair), so the transient is ``2·block`` rows — the shape
+    the cost model prices as ``ring``.  Received rows scatter straight
+    into the result block at the running offset; own rows land first."""
+
+    def kernel(pid_blk, leaves):
+        me = jax.lax.axis_index(axis)
+        iota = jnp.arange(block, dtype=jnp.int32)
+        sel0 = pid_blk == me
+        vidx = ops_compact.compact_indices(sel0, outcap, fill=0)
+        total = jnp.sum(sel0).astype(jnp.int32)
+        keep0 = jnp.arange(outcap, dtype=jnp.int32) < total
+        wide = all(lf.ndim == 1 for lf in leaves)
+        if wide:
+            # width-classed wide path: one ppermute per byte-width group
+            # per round instead of per column (same packing as the
+            # single-shot kernel — the cost model's round count stays an
+            # honest dispatch count on wide tables)
+            groups = ops_gather.pack_columns(leaves)
+            srcs = [M for M, _, _ in groups]
+        else:  # trailing-dim leaves: per-leaf path
+            srcs = [lf.astype(jnp.uint8) if lf.dtype == jnp.bool_ else lf
+                    for lf in leaves]
+        # round 0 (no wire): own rows compact straight into the result
+        accs = []
+        for x in srcs:
+            c0 = jnp.take(x, vidx, axis=0)
+            accs.append(jnp.where(_bcast(keep0, c0), c0,
+                                  jnp.zeros((), c0.dtype)))
+        # rounds 1..P-1: each round's routing state (send index,
+        # receive slots, validity lanes) is computed INSIDE the loop so
+        # only one round's worth is live next to the payload buffers —
+        # the _RING_ROUTING_BYTES term price_ring charges
+        for r in range(1, nparts):
+            sel = pid_blk == ((me + r) % nparts)
+            idx = ops_compact.compact_indices(sel, block, fill=0)
+            cnt = jnp.sum(sel).astype(jnp.int32)
+            valid = iota < cnt
+            perm = [(i, (i + r) % nparts) for i in range(nparts)]
+            rcnt = jax.lax.ppermute(cnt[None], axis, perm)[0]
+            rvalid = iota < rcnt
+            slots = jnp.where(rvalid, total + iota, jnp.int32(outcap))
+            for j, x in enumerate(srcs):
+                S = jnp.take(x, idx, axis=0)
+                S = jnp.where(_bcast(valid, S), S, jnp.zeros((), S.dtype))
+                R = jax.lax.ppermute(S, axis, perm)
+                R = jnp.where(_bcast(rvalid, R), R, jnp.zeros((), R.dtype))
+                accs[j] = accs[j].at[slots].set(R, mode="drop")
+            total = total + rcnt
+        outs = [None] * len(leaves)
+        if wide:
+            for (_, positions, dtypes), A in zip(groups, accs):
+                for col, pos in zip(ops_gather.unpack_columns(A, dtypes),
+                                    positions):
+                    outs[pos] = col
+        else:
+            for pos, (lf, A) in enumerate(zip(leaves, accs)):
+                outs[pos] = (A.astype(jnp.bool_)
+                             if lf.dtype == jnp.bool_ else A)
+        return total[None], tuple(outs)
+
+    f = shard_map(kernel, mesh=mesh,
+                  in_specs=(P(axis), P(axis)),
+                  out_specs=(P(axis), P(axis)))
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _allgather_exchange_fn(mesh, axis: str, nparts: int, outcap: int):
+    """Replicate-and-filter exchange: one ``lax.all_gather`` per leaf
+    (plus the pid lane), each shard keeping the gathered rows targeted
+    at it.  1 round; the gathered [P·cap] intermediates are the price —
+    cheaper than the all_to_all's 2·P·block pair exactly when one
+    sender-side cell dominates (block > cap/2, the hot-target shape).
+    Output rows land in gathered order == sender order, byte-identical
+    to the single-shot exchange."""
+
+    def kernel(pid_blk, leaves):
+        me = jax.lax.axis_index(axis)
+        gpid = jax.lax.all_gather(pid_blk, axis, tiled=True)   # [P*cap]
+        sel = gpid == me
+        vidx = ops_compact.compact_indices(sel, outcap, fill=0)
+        total = jnp.sum(sel).astype(jnp.int32)
+        keep = jnp.arange(outcap, dtype=jnp.int32) < total
+
+        def filter_own(x):
+            g = jax.lax.all_gather(x, axis, tiled=True)
+            C = jnp.take(g, vidx, axis=0)
+            return jnp.where(_bcast(keep, C), C, jnp.zeros((), C.dtype))
+
+        outs = [None] * len(leaves)
+        if all(lf.ndim == 1 for lf in leaves):
+            # width-classed wide path: one all_gather per byte-width
+            # group instead of per column (the single-shot kernel's
+            # packing, shared here so the 1-round latency claim holds
+            # on wide tables too)
+            for M, positions, dtypes in ops_gather.pack_columns(leaves):
+                A = filter_own(M)
+                for col, pos in zip(ops_gather.unpack_columns(A, dtypes),
+                                    positions):
+                    outs[pos] = col
+        else:  # trailing-dim leaves: per-leaf path
+            for pos, leaf in enumerate(leaves):
+                as_bool = leaf.dtype == jnp.bool_
+                A = filter_own(leaf.astype(jnp.uint8) if as_bool else leaf)
+                outs[pos] = A.astype(jnp.bool_) if as_bool else A
+        return total[None], tuple(outs)
+
+    # check_vma=False: the all_gathered intermediates are replicated,
+    # which shard_map cannot statically infer (same note as broadcast.py)
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(P(axis), P(axis)),
+                             out_specs=(P(axis), P(axis)),
+                             check_vma=False))
+
+
+def _staged_exchange(ctx, pid, leaves, choice, outcap_total: int):
+    """Dispatch one ring/allgather exchange (the chooser already sized
+    it: ``choice.sizes`` carries (block|cap, outcap)).  Returns the same
+    ``(leaves, counts, outcap)`` contract as the single-shot dispatch."""
+    mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
+    trace.count_max("shuffle.exchange_bytes_peak", choice.peak_bytes)
+    with trace.span_sync("shuffle.exchange") as sp:
+        if choice.strategy == cost.RING:
+            block = choice.sizes[0]
+            newcounts, outs = _ring_exchange_fn(
+                mesh, axis, Pn, block, outcap_total)(pid, tuple(leaves))
+        else:
+            newcounts, outs = _allgather_exchange_fn(
+                mesh, axis, Pn, outcap_total)(pid, tuple(leaves))
+        sp.sync(outs)
+    return list(outs), newcounts, outcap_total
+
+
+def _note_choice(choice, reason: str) -> None:
+    """Record one chooser decision: the per-strategy tally counter +
+    the plan annotation (static EXPLAIN and ANALYZE both render it —
+    docs/query_planner.md "annotation surface").  Annotations APPEND:
+    an op that runs several exchanges (a shuffle join co-partitions
+    both sides under one node) keeps every choice, not just the
+    last."""
+    from ..analysis import plan_check
+    trace.count(cost.strategy_counter(choice.strategy))
+    if choice.strategy != cost.SINGLE_SHOT:
+        trace.count("shuffle.strategy.downgrades")
+    plan_check.annotate_append("exchange", f"{choice.strategy}: {reason}")
+
+
+# ---------------------------------------------------------------------------
+# chunked degraded exchange (docs/robustness.md): when the chooser picks
+# the chunked lowering, the rows of every (sender, target) cell are
+# split into K contiguous rank-slices and moved by K bounded all_to_all
+# rounds reusing _exchange_fn, each round's compacted output folded into
+# the final block receiver-side.  The rounds share ONE (block, outcap)
+# size class, so the whole degraded path costs at most three extra
+# compiles (rank, slice, fold) + one exchange shape.
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
@@ -383,28 +567,14 @@ def _fold_combine_fn(mesh, axis: str, spec, incap: int, acc_cap: int,
     return jax.jit(f)
 
 
-def _chunk_sizes(Pn: int, counts: np.ndarray, rbytes: int, budget: int):
-    """The chunk math (docs/robustness.md): pick the smallest per-round
-    cell cap C such that a round's transient — send [P, bucket(C)] +
-    receive mirror + compacted [outcap_round] — prices within budget,
-    where outcap_round bounds EVERY round by round 0 (per-cell residues
-    ``clip(count − k·C, 0, C)`` are non-increasing in k).  Returns
-    (rounds, C, block, outcap_round); C = 1 is the floor — below it the
-    exchange cannot shrink further and the budget is best-effort."""
-    maxcell = max(int(counts.max(initial=0)), 1)
-    C = maxcell
-    while True:
-        C = max(C // 2, 1)
-        block = ops_compact.next_bucket(C, minimum=8)
-        recv0 = int(np.minimum(counts, C).sum(axis=0).max(initial=0))
-        outcap = ops_compact.next_bucket(max(recv0, 1), minimum=8)
-        if _priced_bytes(Pn, (block, outcap), rbytes) <= budget or C <= 1:
-            break
-    return -(-maxcell // C), C, block, outcap
+# The chunk math (rounds, C, block, outcap_round) lives in the shared
+# cost model so the chooser prices the SAME plan the degraded path runs.
+_chunk_sizes = cost.chunk_plan
 
 
 def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
-                      budget: int, outcap_total: int, combine=None):
+                      budget: int, outcap_total: int, combine=None,
+                      plan=None):
     """Run the K bounded rounds and fold them into the final
     [P*outcap_total] block.  Peak per-round transient is priced ≤ budget
     (best-effort once the per-cell floor C=1 is reached); the final
@@ -423,7 +593,11 @@ def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
     the previous fold's group count (one small blocking read per round —
     the degraded path already trades syncs for bounded memory)."""
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
-    rounds, C, block, outcap_k = _chunk_sizes(Pn, counts, rbytes, budget)
+    # ``plan`` is the chooser's already-computed (rounds, C, block,
+    # outcap_round) — priced and executed from ONE derivation; the
+    # re-derivation below only serves legacy direct callers
+    rounds, C, block, outcap_k = (plan if plan is not None else
+                                  _chunk_sizes(Pn, counts, rbytes, budget))
     trace.count("shuffle.chunked")
     trace.count("shuffle.chunked_rounds", rounds)
     priced_k = _priced_bytes(Pn, (block, outcap_k), rbytes)
@@ -497,6 +671,29 @@ def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
     return list(acc), acc_cnt, outcap_total
 
 
+def _choose(Pn: int, cap: int, counts: np.ndarray, rbytes: int,
+            budget: int, combine):
+    """Run the costed chooser for one sized exchange: enumerate the
+    candidate lowerings (parallel/cost.py), restrict combine-spec
+    payloads to the single-shot/chunked pair (only the chunked rounds
+    implement the receiver-side fold-by-key), and pick under the live
+    budget — honoring the ``CYLON_EXCHANGE_STRATEGY`` override."""
+    from ..config import exchange_strategy
+    forced = exchange_strategy()
+    if forced is None:
+        # fast path: a feasible single-shot provably wins the
+        # (rounds, wire, catalogue) order — fewest rounds, least wire —
+        # so the common under-budget exchange never pays the chunk-plan
+        # halving loop or the staged pricing
+        block, outcap, _ = cost.exchange_sizes(counts)
+        ss = cost.price_single_shot(Pn, block, outcap, rbytes)
+        if ss.peak_bytes <= budget:
+            return ss, f"{ss.describe()} <= budget {budget} B", True
+    cands = cost.enumerate_strategies(Pn, cap, counts, rbytes, budget,
+                                      staged_ok=combine is None)
+    return cost.choose(cands, budget, forced)
+
+
 def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
                    combine=None, owner: "str | None" = None
                    ) -> Tuple[List[jax.Array], jax.Array, int]:
@@ -509,11 +706,21 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
     reference: cpp/src/cylon/table_api.cpp:214-297 (Shuffle) — here the
     HashPartition+split+AllToAll+concat pipeline is phase1+phase2.
 
-    Memory-budget guardrail (docs/robustness.md): the sized exchange is
-    priced against ``config.device_memory_budget()``; an over-budget
-    exchange (hot-key skew) degrades to a chunked multi-round all_to_all
-    with a bounded per-round transient — identical rows out, with
-    ``shuffle.chunked_rounds`` visible in EXPLAIN ANALYZE.
+    Costed redistribution (docs/tpu_perf_notes.md "Choosing the
+    collective"): every sized exchange runs through the shared cost
+    model (parallel/cost.py), which prices the candidate lowerings —
+    single-shot all_to_all, K-round chunked all_to_all, staged ring
+    ppermute, allgather replicate-and-filter — on (peak device bytes,
+    wire bytes, round count) against the live
+    ``resilience.exchange_budget()`` and picks the cheapest feasible
+    sequence.  Single-shot keeps winning whenever it fits (the fast
+    path is unchanged); over budget the exchange degrades to the
+    cheapest fitting strategy instead of hardcoding the chunked path —
+    identical rows out, the choice + reason annotated on the plan
+    (``exchange=…`` in EXPLAIN / EXPLAIN ANALYZE) and tallied in the
+    ``shuffle.strategy.*`` counters.  The choice is re-priced on every
+    execution, so cached plans re-decide under a changed
+    ``CYLON_MEMORY_BUDGET``.
 
     ``combine`` declares the payload a partial-group table (the fused
     aggregation exchange, dist_groupby_fused): a static leaf-layout spec
@@ -548,6 +755,8 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
     budget = None if (is_abstract(pid) or is_abstract(cnt_dev)) \
         else resilience.exchange_budget()
 
+    cap = pid.shape[0] // max(Pn, 1)
+
     def dispatch(sizes):
         return _exchange_fn(mesh, axis, Pn, *sizes)(pid, tuple(leaves))
 
@@ -569,38 +778,51 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
         # and docs/tpu_perf_notes.md "broadcast vs shuffle joins").
         _warn_skew(Pn, hint_key, per_recv, outcap)
         need = (block, outcap)
-        # memory-budget guardrail (docs/robustness.md): an over-budget
-        # single-shot exchange — the skew case that used to only warn —
-        # degrades to the chunked multi-round path instead of letting
-        # XLA allocate it.  In immediate mode the raise aborts the
-        # dispatch optimistic_dispatch would otherwise launch.  Inside a
-        # deferred flush, raising would corrupt the batch walk: the
-        # hinted dispatch already RAN (its output is valid — hints are
-        # sizes, and over-budget is not undersized), so mark the
-        # signature, fail the flush explicitly, and let the replay
-        # re-enter through the degraded branch below.
-        if budget is not None \
-                and _priced_bytes(Pn, need, rbytes) > budget:
-            _chunked_keys.add(hint_key)
-            if ops_compact.in_flush():
-                ops_compact.invalidate_flush()
-            else:
-                # drop the stale optimism before aborting the dispatch
-                # (in the flush path the caller's update_size_hint
-                # re-records need right after post() returns anyway —
-                # the _chunked_keys gate is what keeps an over-budget
-                # hint from being dispatched; promotion overwrites it)
-                _block_hints.pop(hint_key, None)
-                raise _OverBudget(np.asarray(counts).copy(), need,
-                                  _priced_bytes(Pn, need, rbytes))
+        if budget is None:
+            # abstract plan run: static pricing from zeroed counts —
+            # never degrades; the annotation keeps the strategy surface
+            # visible in static EXPLAIN (docs/query_planner.md)
+            from ..analysis import plan_check
+            plan_check.annotate_append(
+                "exchange", "single-shot (static: priced from zeroed "
+                            "counts; re-chosen per execution)")
+            return need
+        # the costed chooser (docs/tpu_perf_notes.md "Choosing the
+        # collective"): a non-single-shot choice — the skew case that
+        # used to hardcode the chunked path — aborts the optimistic
+        # dispatch instead of letting XLA allocate it.  In immediate
+        # mode the raise carries the choice out.  Inside a deferred
+        # flush, raising would corrupt the batch walk: the hinted
+        # dispatch already RAN (its output is valid — hints are sizes,
+        # and over-budget is not undersized), so mark the signature,
+        # fail the flush explicitly, and let the replay re-enter
+        # through the degraded branch below (which re-chooses).
+        choice, reason, _ = _choose(Pn, cap, counts, rbytes,
+                                    budget, combine)
+        if choice.strategy == cost.SINGLE_SHOT:
+            _note_choice(choice, reason)
+            return need
+        _mark_degraded(hint_key)
+        if ops_compact.in_flush():
+            ops_compact.invalidate_flush()
+        else:
+            # drop the stale optimism before aborting the dispatch
+            # (in the flush path the caller's update_size_hint
+            # re-records need right after post() returns anyway —
+            # the _chunked_keys gate is what keeps an over-budget
+            # hint from being dispatched; promotion overwrites it)
+            _block_hints.pop(hint_key, None)
+            raise _OverBudget(np.asarray(counts).copy(), need, choice,
+                              reason)
         return need
 
     if hint_key in _chunked_keys and budget is not None:
         # degraded steady state: skip the optimistic dispatch (its
         # single-shot program is exactly what blew the budget) and block
         # on the counts — riding the same batched device_get as any
-        # queued validations in deferred mode — then chunk again or
-        # self-promote
+        # queued validations in deferred mode — then re-choose: the
+        # chooser either picks a degraded strategy again or self-
+        # promotes the signature back to single-shot
         if ops_compact.deferred_mode():
             ok, vals = ops_compact.flush_pending_with((cnt_dev,))
             if not ok:
@@ -612,20 +834,26 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
         block, outcap, per_recv = _sizes_from_counts(counts)
         _warn_skew(Pn, hint_key, per_recv, outcap)
         need = (block, outcap)
-        priced = _priced_bytes(Pn, need, rbytes)
-        if priced <= budget:
+        choice, reason, _ = _choose(Pn, cap, counts, rbytes,
+                                    budget, combine)
+        _note_choice(choice, reason)
+        if choice.strategy == cost.SINGLE_SHOT:
             # this call prices back under budget (the data shrank):
             # promote to the single-shot path and reseed the optimism
             # for the NEXT same-signature call
-            _chunked_keys.discard(hint_key)
+            _mark_promoted(hint_key)
             _block_hints[hint_key] = (need, 0)
-            trace.count_max("shuffle.exchange_bytes_peak", priced)
+            trace.count_max("shuffle.exchange_bytes_peak",
+                            choice.peak_bytes)
             with trace.span_sync("shuffle.exchange") as sp:
                 newcounts, outs = dispatch(need)
                 sp.sync(outs)
             return list(outs), newcounts, outcap
-        return _chunked_exchange(ctx, pid, leaves, counts, rbytes,
-                                 budget, outcap, combine)
+        if choice.strategy == cost.CHUNKED:
+            return _chunked_exchange(ctx, pid, leaves, counts, rbytes,
+                                     budget, outcap, combine,
+                                     plan=choice.sizes)
+        return _staged_exchange(ctx, pid, leaves, choice, outcap)
 
     try:
         with trace.span_sync("shuffle.exchange") as sp:
@@ -635,10 +863,14 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
             sp.sync(outs)
     except _OverBudget as ob:
         # the hinted dispatch (if any) was launched before the counts
-        # came back — its result is discarded; the chunked path recovers
-        # with bounded rounds from the counts the exception carries
-        return _chunked_exchange(ctx, pid, leaves, ob.counts, rbytes,
-                                 budget, ob.need[1], combine)
+        # came back — its result is discarded; the chosen degraded
+        # strategy recovers from the counts the exception carries
+        _note_choice(ob.choice, ob.reason)
+        if ob.choice.strategy == cost.CHUNKED:
+            return _chunked_exchange(ctx, pid, leaves, ob.counts, rbytes,
+                                     budget, ob.need[1], combine,
+                                     plan=ob.choice.sizes)
+        return _staged_exchange(ctx, pid, leaves, ob.choice, ob.need[1])
     if budget is not None:
         trace.count_max("shuffle.exchange_bytes_peak",
                         _priced_bytes(Pn, used, rbytes))

@@ -19,7 +19,13 @@ Flow per call:
      ``ir.suspended()`` — so plan_check ``note()`` hooks and EXPLAIN
      ANALYZE instrument windows fire exactly as for hand-written eager
      code, with the optimizer's per-node rule fires attached as
-     ``optimizer=…`` annotations.
+     ``optimizer=…`` annotations.  Because lowering re-enters the
+     eager operators, every execution of a cached plan re-runs the
+     runtime pricing stack — the costed redistribution chooser
+     (parallel/cost.py) re-picks each exchange's collective sequence
+     and the broadcast replica re-prices per dimension — so the budget
+     is NEVER part of the cache key: a cached plan re-decides under a
+     changed ``CYLON_MEMORY_BUDGET`` without re-planning.
 
 Runtime payloads (scan DTables, select ``params``) are REBOUND from the
 current capture on every run via each cached node's ``origin_idx`` — the

@@ -81,6 +81,16 @@ Every fire is recorded on the rewritten node's ``opt_notes``; the
 executor surfaces them as ``optimizer=…`` plan_check annotations, so
 static EXPLAIN and EXPLAIN ANALYZE both show the optimizer's decisions
 next to the runtime planner's (docs/observability.md).
+
+What this layer deliberately does NOT decide: the physical collective
+sequence each exchange lowers to.  That is the costed redistribution
+chooser's call (parallel/cost.py, docs/tpu_perf_notes.md "Choosing
+the collective"), made at EXECUTION time from the live memory budget
+and the real count matrix — evidence that does not exist at plan time
+— and re-made on every run, so a cached plan re-prices under a changed
+``CYLON_MEMORY_BUDGET`` exactly like the multiway rule's per-dimension
+replica re-pricing.  The chooser's ``exchange=…`` annotations land on
+the same nodes as this module's ``optimizer=…`` notes.
 """
 from __future__ import annotations
 

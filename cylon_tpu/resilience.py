@@ -9,16 +9,21 @@ the per-device transient footprint an exchange may price
 allocation pressure).  The consumers:
 
   * ``parallel/shuffle.shuffle_leaves`` prices every sized exchange
-    (send block + receive capacity × ``observe.row_bytes``) against the
-    budget and degrades an over-budget exchange — the hot-key-skew case
-    that previously only WARNED before XLA allocated ~P× the data — to
-    a chunked multi-round exchange with a bounded per-round peak
-    (arXiv:2112.01075's decomposition, adapted to ``lax.all_to_all``).
+    through the SHARED cost model (``parallel/cost.py``) against the
+    budget: the costed chooser enumerates the candidate lowerings —
+    single-shot all_to_all, chunked rounds, staged ring ppermute,
+    allgather replicate-and-filter (arXiv:2112.01075's decomposition)
+    — and degrades an over-budget exchange (the hot-key-skew case that
+    previously only WARNED before XLA allocated ~P× the data) to the
+    cheapest sequence that fits, bounded per-round peak included.
   * ``parallel/broadcast.rows_if_small`` vetoes a broadcast whose
     replica would not fit ("small enough to broadcast" must also mean
     "fits in memory P times over", the budget-aware planner arm of
     arXiv:2212.13732) — the join falls back to the shuffle plan, with
-    the veto recorded via ``plan_check.annotate``.
+    the veto recorded via ``plan_check.annotate``; the replica price
+    is ``cost.price_replicate``, the same model the chooser reads.
+  * ``serve/admission.py`` sums the same single-shot upper bound
+    (``cost.single_shot_bytes``) across a batch window's queries.
 
 **Bounded retry.**  :func:`retrying` / :func:`retry_call` wrap the
 transient-classed failure boundaries (host count reads, the batched

@@ -10,8 +10,8 @@ tables, executed through the PR-5 logical planner with
     same scan/select/shuffle chain crosses the wire once and fans out
     to every consumer (``serve.subplan_shared``);
   * **admission control priced against the device-memory budget**
-    (serve/admission.py, the ``shuffle._priced_bytes`` cost math at
-    admission altitude) — queries whose combined exchange transients
+    (serve/admission.py, the shared ``parallel/cost.py`` exchange cost
+    model at admission altitude) — queries whose combined exchange transients
     would exceed the budget wait for a later window;
   * an async host export lane (``parallel/streaming.HostPipeline``) so
     Arrow conversion of one query overlaps device compute of the next;

@@ -9,17 +9,23 @@ queries admitted together keeps earlier queries' result blocks live
 later queries dispatch their own exchanges.  Admission bounds the SUM:
 a window's co-admitted queries must fit the budget *as priced*, or wait.
 
-The pricing is the existing exchange cost math at admission altitude
-(docs/robustness.md): one exchange over a table with ``P`` shards of
-capacity ``cap`` prices ``(2·P·block + outcap) · row_bytes``
-(``shuffle._priced_bytes`` — grouped send buffer + all_to_all receive
-mirror + compacted output), and at admission time the sync-free
-evidence for ``block``/``outcap`` is exactly what ``rows_if_small``
-uses for the broadcast decision: ingest-cached counts when available,
-else the ``P × cap`` capacity bound.  A query's price is its WORST
-single exchange — the largest base table it reads — because execution
-within a query is serial: two of its exchanges never fly concurrently,
-but its largest one will.
+The pricing is the SHARED exchange cost model at admission altitude
+(``parallel/cost.py``, docs/robustness.md): one exchange over a table
+with ``P`` shards of capacity ``cap`` prices
+``(2·P·block + outcap) · row_bytes`` (``cost.single_shot_bytes`` —
+grouped send buffer + all_to_all receive mirror + compacted output,
+the same formula the runtime chooser prices single-shot candidates
+with), and at admission time the sync-free evidence for
+``block``/``outcap`` is exactly what ``rows_if_small`` uses for the
+broadcast decision: ingest-cached counts when available, else the
+``P × cap`` capacity bound.  A query's price is its WORST single
+exchange — the largest base table it reads — because execution within
+a query is serial: two of its exchanges never fly concurrently, but
+its largest one will.  Admission deliberately prices the single-shot
+UPPER BOUND even when the chooser would later degrade the exchange to
+a cheaper staged lowering: admission runs before any count matrix
+exists, and over-admitting on an optimistic price is the failure mode
+this module exists to prevent.
 
 Admission never starves: the window's head-of-line query is admitted
 even when over budget alone (the exchange stack's chunked degrade
@@ -38,12 +44,13 @@ __all__ = ["price_table", "price_query", "admit"]
 
 def price_table(dt) -> int:
     """Per-device transient price of ONE exchange over ``dt`` — the
-    ``shuffle._priced_bytes`` formula fed with admission-time (sync-
-    free) size evidence.  Static metadata only; never touches device
-    data, so pricing N queued queries costs zero round trips."""
+    shared cost model's single-shot formula (``cost.single_shot_bytes``)
+    fed with admission-time (sync-free) size evidence.  Static metadata
+    only; never touches device data, so pricing N queued queries costs
+    zero round trips."""
     from .. import observe
     from ..ops import compact as ops_compact
-    from ..parallel.shuffle import _priced_bytes
+    from ..parallel import cost
 
     leaves = [lf for c in dt.columns for lf in (c.data, c.validity)
               if lf is not None]
@@ -54,7 +61,7 @@ def price_table(dt) -> int:
     else:
         total = dt.nparts * dt.cap
     outcap = ops_compact.next_bucket(max(total, 1), minimum=8)
-    return _priced_bytes(dt.nparts, (dt.cap, outcap), rbytes)
+    return cost.single_shot_bytes(dt.nparts, (dt.cap, outcap), rbytes)
 
 
 def price_query(tables) -> int:

@@ -384,6 +384,40 @@ def test_groupby_pushdown_metrics_catalogued():
     assert observe.exchange_count({"groupby.psum_combine": 2}) == 2
 
 
+def test_redistribution_strategy_metrics_catalogued():
+    """The costed-chooser strategy tallies are documented catalogue
+    entries (the ANALYZE compliance checks above reject any counter a
+    TPC-H run bumps outside observe.METRICS), and the counter names
+    derive from the strategy catalogue itself so the two cannot
+    drift."""
+    from cylon_tpu.parallel import cost
+    for strategy in cost.STRATEGIES:
+        name = cost.strategy_counter(strategy)
+        spec = observe.METRICS.get(name)
+        assert spec is not None, name
+        assert spec.kind == observe.COUNTER, name
+        assert spec.doc
+    spec = observe.METRICS.get("shuffle.strategy.downgrades")
+    assert spec is not None and spec.kind == observe.COUNTER
+
+
+def test_benchdiff_gates_strategy_downgrades_up(tmp_path, capsys):
+    """tpch_*_strategy_downgrades gates UP: a cost-model regression
+    pushing exchanges off the single-shot fast path fails CI even when
+    wall-clock stayed within threshold (deterministic small integers —
+    0 -> 1 clears the relative gate)."""
+    old = _artifact(tmp_path, "sd_old.json",
+                    {"tpch_q13_strategy_downgrades": 0})
+    new = _artifact(tmp_path, "sd_new.json",
+                    {"tpch_q13_strategy_downgrades": 1})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "tpch_q13_strategy_downgrades" in out and "REGRESSED" in out
+    same = _artifact(tmp_path, "sd_same.json",
+                     {"tpch_q13_strategy_downgrades": 0})
+    assert benchdiff.main([old, same]) == 0
+
+
 def test_benchdiff_gates_exchange_bytes_peak_up(tmp_path, capsys):
     """tpch_*_exchange_bytes_peak gates UP as a first-class family: a
     chunked-path peak-memory regression no longer passes CI silently;

@@ -1,0 +1,407 @@
+"""Costed redistribution lowering (ISSUE 10; parallel/cost.py,
+docs/tpu_perf_notes.md "Choosing the collective").
+
+The acceptance contract:
+
+  * ONE shared cost model prices every exchange-shaped decision —
+    the shuffle chooser, the chunked plan, the broadcast replica veto
+    and serve admission all read parallel/cost.py;
+  * the chooser selects among >= 4 strategies (single-shot, chunked,
+    ring ppermute, allgather) with the choice annotated on the plan
+    and re-priced per execution (cached plans re-decide under a
+    changed CYLON_MEMORY_BUDGET);
+  * every candidate lowering is row-identical to the single-shot
+    exchange across int / dict-string / null / composite keys;
+  * budget boundaries flip the choice exactly at the priced byte.
+"""
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table, config, trace
+from cylon_tpu import plan as planner
+from cylon_tpu.parallel import DTable, cost, dist_groupby, shuffle_table
+from cylon_tpu.parallel import shuffle as shmod
+from cylon_tpu.serve import admission
+from cylon_tpu.status import CylonError
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Counter-only tracing + chooser-state isolation: forced
+    strategies and degraded signatures must never leak across tests."""
+    trace.enable_counters()
+    trace.reset()
+    yield
+    trace.disable_counters()
+    trace.reset()
+    config.set_exchange_strategy(None)
+    shmod.clear_chunk_state()
+
+
+def _mixed_key_frame(n=6000, seed=11):
+    """int / dict-string / nullable / composite key coverage in one
+    frame — the key flavors the strategy-parity suite must hold on."""
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ki": rng.integers(0, 50, n).astype(np.int32),
+        "ks": pd.Categorical.from_codes(
+            rng.integers(0, 7, n), categories=list("abcdefg")),
+        "kn": pd.array(np.where(np.arange(n) % 17 == 0, None,
+                                rng.integers(0, 9, n)), dtype="Int64"),
+        "v": rng.random(n, dtype=np.float32),
+        "b": (rng.integers(0, 2, n) == 1),
+    })
+
+
+def _sorted_frame(dt: DTable) -> pd.DataFrame:
+    df = dt.to_table().to_pandas()
+    for c in df.columns:
+        if isinstance(df[c].dtype, pd.CategoricalDtype):
+            df[c] = df[c].astype(str)
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def _one_hot_dtable(dctx, n=8192):
+    """Every row keyed identically: a deterministic one-hot-target
+    exchange whose count matrix (one 1024-row cell per sender) makes
+    each strategy's price exact — the budget-band fixture (ring peak
+    = 1024·(2·8+10) = 26,624 B incl. routing state)."""
+    df = pd.DataFrame({"k": np.full(n, 7, dtype=np.int32),
+                       "v": np.arange(n, dtype=np.float32)})
+    return DTable.from_table(dctx, Table.from_pandas(dctx, df)), df
+
+
+# ---------------------------------------------------------------------------
+# the cost model itself: catalogue, boundaries, ordering
+# ---------------------------------------------------------------------------
+
+def _counts(P, maxcell, hot_col=0):
+    c = np.zeros((P, P), np.int64)
+    c[:, hot_col] = maxcell
+    return c
+
+
+def test_catalogue_has_at_least_four_strategies():
+    cands = cost.enumerate_strategies(8, 1024, _counts(8, 1024), 8,
+                                      budget=1 << 20)
+    assert {c.strategy for c in cands} >= {
+        cost.SINGLE_SHOT, cost.CHUNKED, cost.RING, cost.ALLGATHER}
+
+
+def test_combine_spec_restricts_to_foldable_strategies():
+    """A combine-spec (fold-by-key) payload can only run the lowerings
+    that implement the receiver-side group fold."""
+    from cylon_tpu.parallel.shuffle import _choose
+    choice, _, _ = _choose(8, 1024, _counts(8, 1024), 8, budget=20_000,
+                           combine=object())
+    assert choice.strategy in (cost.SINGLE_SHOT, cost.CHUNKED)
+
+
+def test_budget_boundary_flips_choice_at_the_priced_byte():
+    """Price exactly AT the budget is feasible; one byte under flips
+    the choice off the single-shot fast path."""
+    P, counts, rbytes = 8, _counts(8, 1024), 8
+    block, outcap, _ = cost.exchange_sizes(counts)
+    ss = cost.single_shot_bytes(P, (block, outcap), rbytes)
+
+    def pick(budget):
+        return cost.choose(
+            cost.enumerate_strategies(P, 1024, counts, rbytes, budget),
+            budget)
+
+    at, _, feas_at = pick(ss)
+    under, _, feas_under = pick(ss - 1)
+    assert at.strategy == cost.SINGLE_SHOT and feas_at
+    assert under.strategy != cost.SINGLE_SHOT and feas_under
+
+
+def test_choice_order_rounds_then_wire_then_catalogue():
+    """The one-hot-target band: allgather (1 round) beats ring beats
+    chunked as the budget tightens, and the best-effort floor is the
+    chunked plan."""
+    P, counts, rbytes = 8, _counts(8, 1024), 8
+
+    def pick(budget):
+        return cost.choose(
+            cost.enumerate_strategies(P, 1024, counts, rbytes, budget),
+            budget)
+
+    by_name = {c.strategy: c for c in cost.enumerate_strategies(
+        P, 1024, counts, rbytes, 20_000)}
+    ss, ag = by_name[cost.SINGLE_SHOT], by_name[cost.ALLGATHER]
+    ring = by_name[cost.RING]
+    assert ring.peak_bytes < ag.peak_bytes < ss.peak_bytes
+    # between allgather and single-shot: 1-round allgather wins
+    choice, reason, feasible = pick(ss.peak_bytes - 1)
+    assert choice.strategy == cost.ALLGATHER and feasible
+    assert "over the" in reason  # names why single-shot lost
+    # between ring and allgather: the 8-round chunked plan loses the
+    # rounds race to the P-1 = 7 round ring
+    choice, _, feasible = pick(30_000)
+    assert choice.strategy == cost.RING and feasible
+    assert choice.rounds == P - 1
+    # below every strategy's floor: best-effort chunked, flagged
+    choice, reason, feasible = pick(10)
+    assert choice.strategy == cost.CHUNKED and not feasible
+    assert "best-effort" in reason
+
+
+def test_replica_price_matches_broadcast_veto_formula():
+    """broadcast.rows_if_small prices through the SAME model: replica
+    price = gathered [P*cap] blocks + compacted [outcap] replica."""
+    p = cost.price_replicate(8, 1024, 2048, 12)
+    assert p.peak_bytes == (8 * 1024 + 2048) * 12
+    assert p.rounds == 1
+
+
+def test_forced_strategy_knob_validation():
+    for bad in ("nope", 1, True):
+        with pytest.raises(CylonError):
+            config.set_exchange_strategy(bad)
+    prev = config.set_exchange_strategy("ring")
+    try:
+        assert config.exchange_strategy() == "ring"
+    finally:
+        config.set_exchange_strategy(prev)
+    assert config.exchange_strategy() is None
+
+
+def test_forced_strategy_env_resolution(monkeypatch):
+    monkeypatch.setenv("CYLON_EXCHANGE_STRATEGY", "allgather")
+    assert config.exchange_strategy() == "allgather"
+    monkeypatch.setenv("CYLON_EXCHANGE_STRATEGY", "bogus")
+    with pytest.raises(CylonError):
+        config.exchange_strategy()
+
+
+# ---------------------------------------------------------------------------
+# strategy parity: every lowering row-identical to single-shot
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("strategy", ["chunked", "ring", "allgather"])
+def test_strategy_parity_mixed_keys(dctx, strategy):
+    """Every candidate lowering produces row-identical results vs the
+    single-shot exchange across int / dict-string / null / composite
+    keys (bool and validity lanes ride along)."""
+    df = _mixed_key_frame()
+    base = _sorted_frame(shuffle_table(
+        DTable.from_table(dctx, Table.from_pandas(dctx, df)),
+        ["ki", "ks", "kn"]))
+    trace.reset()
+    prev = config.set_exchange_strategy(strategy)
+    try:
+        out = shuffle_table(
+            DTable.from_table(dctx, Table.from_pandas(dctx, df)),
+            ["ki", "ks", "kn"])
+        c = trace.counters()
+    finally:
+        config.set_exchange_strategy(prev)
+        shmod.clear_chunk_state()
+    assert c.get(cost.strategy_counter(strategy), 0) >= 1, c
+    pd.testing.assert_frame_equal(_sorted_frame(out), base)
+
+
+def test_ring_selected_naturally_and_row_identical(dctx):
+    """The budget band where the chooser itself picks the ring (no
+    forcing): one-hot-target counts at a 30 kB budget — single-shot
+    ~197 kB and allgather ~164 kB infeasible, chunked needs 8 rounds,
+    ring takes it with P-1 = 7 at a ~27 kB peak."""
+    dt, df = _one_hot_dtable(dctx)
+    base = _sorted_frame(shuffle_table(dt, ["k"]))
+    trace.reset()
+    shmod.clear_chunk_state()
+    prev = config.set_device_memory_budget(30_000)
+    try:
+        dt2, _ = _one_hot_dtable(dctx)
+        out = shuffle_table(dt2, ["k"])
+        c = trace.counters()
+    finally:
+        config.set_device_memory_budget(prev)
+        shmod.clear_chunk_state()
+    assert c.get("shuffle.strategy.ring", 0) >= 1, c
+    assert c.get("shuffle.strategy.downgrades", 0) >= 1
+    pd.testing.assert_frame_equal(_sorted_frame(out), base)
+
+
+def test_allgather_selected_naturally_and_row_identical(dctx):
+    """Between the allgather price and the single-shot price the
+    1-round allgather wins the rounds race against every staged plan."""
+    dt, df = _one_hot_dtable(dctx)
+    base = _sorted_frame(shuffle_table(dt, ["k"]))
+    trace.reset()
+    shmod.clear_chunk_state()
+    prev = config.set_device_memory_budget(180_000)
+    try:
+        dt2, _ = _one_hot_dtable(dctx)
+        out = shuffle_table(dt2, ["k"])
+        c = trace.counters()
+    finally:
+        config.set_device_memory_budget(prev)
+        shmod.clear_chunk_state()
+    assert c.get("shuffle.strategy.allgather", 0) >= 1, c
+    pd.testing.assert_frame_equal(_sorted_frame(out), base)
+
+
+def test_single_shot_fast_path_unchanged_under_big_budget(dctx):
+    """Under an ample budget the chooser keeps the single-shot fast
+    path — no degraded signature, no downgrade counter."""
+    dt, _ = _one_hot_dtable(dctx)
+    trace.reset()
+    shmod.clear_chunk_state()
+    shuffle_table(dt, ["k"])
+    c = trace.counters()
+    assert c.get("shuffle.strategy.single_shot", 0) >= 1, c
+    assert c.get("shuffle.strategy.downgrades", 0) == 0
+    assert not shmod._chunked_keys
+
+
+def test_degraded_signature_repromotes_through_chooser(dctx):
+    """The degrade/promote state machine now lives in the chooser: a
+    ring-degraded signature self-promotes back to single-shot when the
+    budget recovers."""
+    dt, _ = _one_hot_dtable(dctx)
+    shmod.clear_chunk_state()
+    prev = config.set_device_memory_budget(30_000)
+    try:
+        dt2, _ = _one_hot_dtable(dctx)
+        shuffle_table(dt2, ["k"])
+        assert shmod._chunked_keys  # ring-degraded, same state set
+    finally:
+        config.set_device_memory_budget(prev)
+    trace.reset()
+    dt3, _ = _one_hot_dtable(dctx)
+    shuffle_table(dt3, ["k"])
+    assert not shmod._chunked_keys
+    c = trace.counters()
+    assert c.get("shuffle.strategy.single_shot", 0) >= 1, c
+
+
+# ---------------------------------------------------------------------------
+# plan annotation surface + cached-plan re-pricing
+# ---------------------------------------------------------------------------
+
+def test_static_explain_carries_exchange_annotation(dctx, rng):
+    df = pd.DataFrame({"k": rng.integers(0, 50, 500).astype(np.int32)})
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    rep = dt.explain(lambda t: shuffle_table(t, ["k"]), validate=True)
+    assert rep.ok
+    assert "exchange=single-shot (static" in str(rep)
+
+
+def test_analyze_carries_chosen_strategy_annotation(dctx):
+    dt, _ = _one_hot_dtable(dctx)
+    shmod.clear_chunk_state()
+    prev = config.set_device_memory_budget(30_000)
+    try:
+        rep = dt.explain(lambda t: shuffle_table(t, ["k"]).to_table(),
+                         analyze=True)
+    finally:
+        config.set_device_memory_budget(prev)
+        shmod.clear_chunk_state()
+    assert rep.ok
+    assert "exchange=ring:" in str(rep)
+
+
+def test_cached_plan_reprices_under_tightened_budget(dctx):
+    """A compiled/cached plan re-runs the chooser per execution: the
+    same cached plan that ran single-shot under an ample budget
+    degrades (and stays row-identical) when CYLON_MEMORY_BUDGET
+    tightens — no re-plan, plan.cache_hit proves the replay."""
+    dt, _ = _one_hot_dtable(dctx)
+    tables = {"t": dt}
+
+    def q(t):
+        # the shuffle IS the plan root: no downstream groupby for the
+        # optimizer to absorb it into, so the wide exchange survives
+        # rewriting and the chooser prices the full 8192-row one-hot
+        # redistribution on every run
+        return shuffle_table(t["t"], ["k"])
+
+    planner.clear_plan_cache()
+    shmod.clear_chunk_state()
+    want = _sorted_frame(planner.run(dctx, q, tables))
+    trace.reset()
+    prev = config.set_device_memory_budget(30_000)
+    try:
+        got = _sorted_frame(planner.run(dctx, q, tables))
+        c = trace.counters()
+    finally:
+        config.set_device_memory_budget(prev)
+        shmod.clear_chunk_state()
+        planner.clear_plan_cache()
+    assert c.get("plan.cache_hit", 0) >= 1, c  # same compiled plan
+    assert c.get("shuffle.strategy.downgrades", 0) >= 1, c
+    pd.testing.assert_frame_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# admission and the chooser agree (satellite: delete duplicated math)
+# ---------------------------------------------------------------------------
+
+def test_admission_prices_through_shared_cost_model(dctx, rng):
+    from cylon_tpu import observe
+    from cylon_tpu.ops import compact as ops_compact
+    df = pd.DataFrame({"k": rng.integers(0, 99, 3000).astype(np.int32),
+                       "v": rng.random(3000, dtype=np.float32)})
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    leaves = [lf for c in dt.columns for lf in (c.data, c.validity)
+              if lf is not None]
+    rbytes = max(observe.row_bytes(leaves), 1)
+    total = int(np.asarray(dt._counts_host).sum())
+    outcap = ops_compact.next_bucket(max(total, 1), minimum=8)
+    expect = cost.single_shot_bytes(dt.nparts, (dt.cap, outcap), rbytes)
+    assert admission.price_table(dt) == expect
+    assert admission.price_query({"t": dt}) == expect
+
+
+def test_admission_upper_bounds_runtime_choice(dctx):
+    """Admission's capacity-bound single-shot price upper-bounds the
+    peak any chooser-selected lowering actually allocates."""
+    dt, _ = _one_hot_dtable(dctx)
+    priced = admission.price_table(dt)
+    shmod.clear_chunk_state()
+    trace.reset()
+    prev = config.set_device_memory_budget(30_000)
+    try:
+        dt2, _ = _one_hot_dtable(dctx)
+        shuffle_table(dt2, ["k"])
+        peak = trace.snapshot()["watermarks"].get(
+            "shuffle.exchange_bytes_peak", 0)
+    finally:
+        config.set_device_memory_budget(prev)
+        shmod.clear_chunk_state()
+    assert 0 < peak <= priced
+
+
+# ---------------------------------------------------------------------------
+# concurrency: the chooser state is lock-guarded (satellite)
+# ---------------------------------------------------------------------------
+
+def test_chunk_state_thread_hammer():
+    """_chunked_keys is mutated from the serve dispatcher thread while
+    clients submit; hammer mark/promote/clear concurrently — no
+    RuntimeError, deterministic end state."""
+    errs = []
+
+    def worker(i):
+        try:
+            for j in range(500):
+                shmod._mark_degraded(("sig", i, j % 7))
+                shmod._mark_promoted(("sig", i, j % 7))
+                if j % 50 == 0:
+                    shmod.clear_chunk_state()
+        except Exception as e:  # graftlint: ok[broad-except] — the
+            # hammer collects ANY concurrent failure for the assertion
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    shmod.clear_chunk_state()
+    assert not shmod._chunked_keys

@@ -122,8 +122,13 @@ def test_budget_fault_point_shrinks_effective_budget():
 # chunked degraded shuffle (the tentpole acceptance test)
 # ---------------------------------------------------------------------------
 
-BUDGET = 200_000  # between one chunked round (~123 KB) and the
-#                   single-shot skewed exchange (~500 KB) at n=40k
+BUDGET = 230_000  # between one 4-round chunked transient (~229 KB) and
+#                   the single-shot skewed exchange (~852 KB) at n=40k —
+#                   chosen so the costed chooser picks CHUNKED on the
+#                   latency axis (4 all_to_all rounds beat the ring's
+#                   P-1 = 7 ppermute rounds; the allgather replica at
+#                   ~524 KB stays infeasible).  The chooser's other
+#                   lowerings are exercised in test_redistribution.py.
 
 
 def test_chunked_shuffle_parity_and_bounded_peak(dctx):
