@@ -440,6 +440,22 @@ def _exchange_count(counters: dict) -> int:
     return observe.exchange_count(counters)
 
 
+# the serving stages' preferred client mix (framework-strongest first);
+# ONE derivation for the short serve stage and the sustained stage, so
+# the serve_* and serve_sustain_* benchdiff families always measure the
+# same workload
+_SERVE_MIX_PREFER = ["q1", "q6", "q3", "q12", "q14", "q19", "q5", "q10"]
+
+
+def _serve_mix(q_ms: dict, pad_to: int = 0) -> list:
+    mix = [q for q in _SERVE_MIX_PREFER if q in q_ms][:8]
+    if not mix:
+        mix = list(q_ms)[:8]
+    while 0 < len(mix) < pad_to:
+        mix = (mix + mix)[:pad_to]
+    return mix
+
+
 def _progress(msg: str) -> None:
     """Timestamped stage marker on stderr (stdout carries only the JSON
     line).  The run crosses a tunneled TPU backend where a single wedged
@@ -1131,10 +1147,7 @@ def main() -> None:
             import threading as _threading
 
             from cylon_tpu.serve import ServeSession
-            prefer = ["q1", "q6", "q3", "q12", "q14", "q19", "q5", "q10"]
-            mix = [q for q in prefer if q in q_ms][:8]
-            if not mix:
-                mix = list(q_ms)[:8]
+            mix = _serve_mix(q_ms)
             reps = int(os.environ.get("CYLON_BENCH_SERVE_REPS", "2"))
             _progress(f"serving mixed workload: {len(mix)} clients x "
                       f"{reps} reps")
@@ -1182,6 +1195,167 @@ def main() -> None:
                       f"{str(e)[:200]}", file=sys.stderr)
                 em.detail["serve_error"] = str(e)[:200]
             em.emit("serve")
+
+        # run-stats pass (docs/observability.md "the run-stats store"):
+        # one untimed EXPLAIN ANALYZE rep per scored query records
+        # per-node observed rows/bytes/ms + exchange strategies under
+        # the query's plan-cache fingerprints — the cardinality record
+        # a future adaptive planner pass reads back (ROADMAP §4).
+        # Honors CYLON_STATS_PATH (the store persists itself).
+        if (q_ms and use_opt
+                and os.environ.get("CYLON_BENCH_STATS", "1") != "0"):
+            from cylon_tpu import observe
+            from cylon_tpu.parallel import meshprobe
+            _progress("run-stats pass: ANALYZE per query -> stats store")
+            # probe the live mesh once so the ANALYZE reps annotate
+            # predicted-vs-observed ms per exchange (cached per mesh
+            # fingerprint; the coefficients land in the artifact)
+            profile = meshprobe.probe(ctx)
+            em.detail["meshprobe_latency_ms"] = {
+                c: round(v * 1e3, 4)
+                for c, v in profile.latency_s.items()}
+            em.detail["meshprobe_gbytes_per_s"] = {
+                c: round(v / 1e9, 4)
+                for c, v in profile.bytes_per_s.items()}
+            anchor = dts["lineitem"]
+            recorded = 0
+            for qname in list(q_ms):
+                if remaining() < 60:
+                    em.detail["tpch_stats_note"] = \
+                        f"deadline: stats pass stopped before {qname}"
+                    break
+                qfn = queries.QUERIES[qname]
+                try:
+                    rep = anchor.explain(
+                        lambda t, q=qfn: q(ctx, t), tables=dts,
+                        analyze=True, optimize=True)
+                    for d in rep.stats_digests:
+                        observe.STATS_STORE.set_label(d, qname)
+                    recorded += 1 if rep.ok and rep.stats_digests else 0
+                except Exception as e:  # graftlint: ok[broad-except] — one bad ANALYZE must not kill the bench
+                    print(f"stats pass {qname} FAILED: "
+                          f"{type(e).__name__}: {str(e)[:200]}",
+                          file=sys.stderr)
+            _trace.reset()
+            em.detail["tpch_stats_queries"] = recorded
+            em.detail["tpch_stats_fingerprints"] = \
+                len(observe.STATS_STORE.fingerprints())
+            em.emit("stats")
+
+        # sustained-load stage (docs/observability.md "the time-series
+        # sampler"): CYLON_BENCH_SUSTAIN=<seconds> runs 8 closed-loop
+        # client threads against a ServeSession for minutes, sampling
+        # sliding-window QPS / p50/p99 / hit ratios on a ring buffer;
+        # the series lands in the artifact and benchdiff gates the
+        # steady-state roll-up (serve_sustain_qps DOWN,
+        # serve_sustain_p99_ms UP).  Off by default — it deliberately
+        # burns wall-clock to reach steady state.
+        sustain_s = float(os.environ.get("CYLON_BENCH_SUSTAIN", "0"))
+        if q_ms and sustain_s > 0 and remaining() > sustain_s + 60:
+            import threading as _threading
+
+            from cylon_tpu import observe
+            from cylon_tpu.serve import ServeSession
+            mix = _serve_mix(q_ms, pad_to=8)   # always 8 client threads
+            period = max(0.25, sustain_s / 120.0)
+            _progress(f"sustained serving: {len(mix)} clients x "
+                      f"{sustain_s:.0f}s, sampler period {period:.2f}s")
+            try:
+                _trace.enable_counters()
+                _trace.reset()
+                stop_at = time.monotonic() + sustain_s
+                lat_all = []
+                client_errors = []
+                lat_lock = _threading.Lock()
+                with ServeSession(ctx, tables=dts,
+                                  batch_window_ms=8.0) as srv:
+                    sampler = observe.TimeSeriesSampler(
+                        period_s=period, capacity=512, session=srv)
+
+                    def client(qname):
+                        qfn = queries.QUERIES[qname]
+                        while time.monotonic() < stop_at:
+                            # a raise here would silently kill this
+                            # client (threading swallows it to stderr),
+                            # deflating the gated QPS with nothing in
+                            # the artifact explaining why — record the
+                            # failure instead and stop this client
+                            try:
+                                h = srv.submit(
+                                    lambda t, q=qfn: q(ctx, t),
+                                    label=qname,
+                                    export=lambda r: r.to_pandas())
+                                h.result(timeout=600)
+                            except Exception as e:  # graftlint: ok[broad-except] — recorded in the artifact below
+                                with lat_lock:
+                                    client_errors.append(
+                                        f"{qname}: {type(e).__name__}: "
+                                        f"{str(e)[:120]}")
+                                return
+                            with lat_lock:
+                                lat_all.append(h.latency_ms)
+
+                    with sampler:
+                        t0 = time.perf_counter()
+                        threads = [
+                            _threading.Thread(target=client, args=(q,))
+                            for q in mix]
+                        for th in threads:
+                            th.start()
+                        for th in threads:
+                            th.join()
+                        wall = time.perf_counter() - t0
+                from cylon_tpu.serve.session import percentile
+                summary = sampler.summary()
+                lat_sorted = sorted(lat_all)
+
+                def _pct(q):
+                    return percentile(lat_sorted, q)
+
+                em.detail["serve_sustain_s"] = round(wall, 1)
+                em.detail["serve_sustain_queries"] = len(lat_all)
+                em.detail["serve_sustain_qps"] = round(
+                    len(lat_all) / wall, 3)
+                em.detail["serve_sustain_steady_qps"] = \
+                    summary.get("steady_qps")
+                if client_errors:
+                    em.detail["serve_sustain_client_errors"] = \
+                        len(client_errors)
+                    em.detail["serve_sustain_error"] = client_errors[0]
+                    print("sustained stage client errors: "
+                          + "; ".join(client_errors[:3]),
+                          file=sys.stderr)
+                em.detail["serve_sustain_p50_ms"] = round(_pct(50), 2) \
+                    if lat_sorted else None
+                em.detail["serve_sustain_p99_ms"] = round(_pct(99), 2) \
+                    if lat_sorted else None
+                em.detail["serve_sustain_samples"] = summary["samples"]
+                em.detail["serve_sustain_dropped"] = summary["dropped"]
+                em.detail["serve_sustain_cache_hit_ratio"] = \
+                    summary.get("cache_hit_ratio")
+                em.detail["serve_sustain_max_queue_depth"] = \
+                    summary.get("max_queue_depth")
+                # the raw sliding-window series rides the artifact for
+                # trend plots (bounded: the ring held <= 512 samples)
+                em.detail["serve_sustain_series"] = [
+                    {"t": s["t"], "qps": s["qps"],
+                     "p99_ms": s["p99_ms"],
+                     "queue_depth": s["queue_depth"]}
+                    for s in sampler.samples()]
+                _progress(
+                    f"sustained: {em.detail['serve_sustain_qps']} qps "
+                    f"over {wall:.0f}s, p99 "
+                    f"{em.detail['serve_sustain_p99_ms']} ms, "
+                    f"{summary['samples']} samples "
+                    f"({summary['dropped']} dropped)")
+            except Exception as e:  # graftlint: ok[broad-except] — the sustained stage must not kill the bench
+                print(f"sustained stage FAILED: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+                em.detail["serve_sustain_error"] = str(e)[:200]
+            finally:
+                _trace.disable_counters()
+                _trace.reset()
+            em.emit("sustain")
 
     em.detail["bench_wall_s"] = round(time.monotonic() - t_start, 1)
     em.emit("final")

@@ -41,6 +41,12 @@ Gated metrics (relative threshold, default 15%):
     — the serving layer's benchdiff family (docs/serving.md); p50 is
     reported but not gated (the tail is where admission/sharing
     regressions surface first)
+  * ``serve_sustain_qps`` (whole-run completed/wall) and
+    ``serve_sustain_steady_qps`` (the sampler's warm-up-excluded
+    steady-state roll-up) — both lower = worse — plus
+    ``serve_sustain_p99_ms`` tail latency (higher = worse), from the
+    sustained-load stage (CYLON_BENCH_SUSTAIN;
+    docs/observability.md "the time-series sampler")
 
 A gated metric present in OLD but absent from NEW fails the gate
 outright (``MISSING``): a query that crashed or was skipped emits no ms
@@ -115,6 +121,18 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     # per-query tpch numbers are unchanged
     (r"serve_qps$", "down"),
     (r"serve_p99_ms$", "up"),
+    # sustained-load family (docs/observability.md "the time-series
+    # sampler"): minutes-scale traffic, not one batch window — the
+    # whole-run throughput AND the sampler's warm-up-excluded steady
+    # state both gate DOWN (a steady-state-only leak partially masked
+    # by a warm-up improvement fails on the second), sustained tail
+    # p99 gates UP (with the ms absolute floor).  A regression that
+    # only shows after windows of traffic (cache churn, queue growth,
+    # counter-merge contention) fails here even when the short serve
+    # stage is clean.
+    (r"serve_sustain_qps$", "down"),
+    (r"serve_sustain_steady_qps$", "down"),
+    (r"serve_sustain_p99_ms$", "up"),
 )
 
 

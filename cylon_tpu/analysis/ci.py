@@ -26,10 +26,18 @@ Stages:
      sharing machinery silently degrading to
      every-query-executes-everything fails CI here
      (``--no-serve-smoke`` skips);
-  4. **benchdiff** (only when ``--baseline`` and a candidate artifact
+  4. **telemetry smoke** (docs/observability.md): a short sustained
+     mini-run through the serving layer with the time-series sampler,
+     query-lifecycle tracing and the run-stats store all live — the
+     sampler must retain samples, every counter/gauge the run bumped
+     must be in the observe catalogue, the Chrome export must be valid
+     JSON with one track per query trace id, and the stats store must
+     hold per-node observations for at least one plan fingerprint
+     (``--no-telemetry-smoke`` skips);
+  5. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
-     including the serving family (``serve_qps`` down /
-     ``serve_p99_ms`` up).
+     including the serving families (``serve_qps``/``serve_sustain_qps``
+     down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up).
 
 Exit code is the worst across stages under the shared contract: 0 clean,
 1 findings/regressions/plan errors, 2 usage or tooling errors.
@@ -57,14 +65,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/4: graftlint ==")
+    print("== ci stage 1/5: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/4: plan_check pre-flight ==")
+    print("== ci stage 2/5: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -125,7 +133,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/4: serving smoke ==")
+    print("== ci stage 3/5: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -240,10 +248,135 @@ def _stage_serve_smoke(sf: float) -> int:
     return 1 if bad else 0
 
 
+def _stage_telemetry_smoke(sf: float) -> int:
+    """A short sustained mini-run with the full telemetry stack live
+    (docs/observability.md): a few concurrent TPC-H queries through the
+    serving layer under span tracing, the time-series sampler, the mesh
+    bandwidth probe and the run-stats store — then assert the telemetry
+    CONTRACTS rather than the numbers: sampler non-empty, catalogue
+    compliance, export validity (one track per query trace id), stats
+    store populated with per-node observations."""
+    print("== ci stage 4/5: telemetry smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import json
+        import threading
+
+        import jax
+
+        from .. import observe, trace
+        from ..context import CylonContext
+        from ..parallel import meshprobe
+        from ..parallel.dtable import DTable
+        from ..serve import ServeSession
+        from ..tpch import generate
+        from ..tpch.queries import QUERIES
+
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(sf, seed=7)
+        dts = {name: DTable.from_pandas(ctx, df)
+               for name, df in data.items()}
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding —
+        # the same contract as the stages above
+        print(f"telemetry smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    try:
+        profile = meshprobe.probe(ctx, sizes=(1 << 11, 1 << 13), reps=1)
+        # NOTE: the smoke must NOT clear the global stats store — with
+        # CYLON_STATS_PATH set, a cleared store's next flush would
+        # rewrite the user's persisted records away.  The assertions
+        # below check the digests THIS run produced instead.
+        # the ANALYZE rep runs FIRST (it resets trace state as part of
+        # its measurement contract — running it after the serve window
+        # would wipe the spans the export check below asserts on); it
+        # feeds per-node observations into the stats store
+        anchor = dts["lineitem"]
+        rep = anchor.explain(lambda t, q=QUERIES["q1"]: q(ctx, t),
+                             tables=dts, analyze=True, optimize=True)
+        if not rep.ok or not rep.stats_digests:
+            print("telemetry smoke: ANALYZE run failed or recorded no "
+                  "plan fingerprint", file=sys.stderr)
+            bad += 1
+        trace.enable()
+        trace.reset()
+        mix = ["q1", "q6", "q1", "q6"]
+        with ServeSession(ctx, tables=dts, batch_window_ms=40.0) as s:
+            sampler = observe.TimeSeriesSampler(period_s=0.05,
+                                                capacity=256, session=s)
+            with sampler:
+                handles = []
+                lock = threading.Lock()
+
+                def client(qname):
+                    h = s.submit(lambda t, q=QUERIES[qname]: q(ctx, t),
+                                 label=qname,
+                                 export=lambda r: r.to_pandas())
+                    with lock:
+                        handles.append(h)
+
+                threads = [threading.Thread(target=client, args=(q,))
+                           for q in mix]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                for h in handles:
+                    h.result(timeout=600)
+        if not sampler.samples():
+            print("telemetry smoke: sampler retained no samples",
+                  file=sys.stderr)
+            bad += 1
+        snap = trace.snapshot()
+        unknown = (set(snap["counters"]) | set(snap["gauges"])) \
+            - set(observe.METRICS)
+        if unknown:
+            print(f"telemetry smoke: uncatalogued metrics "
+                  f"{sorted(unknown)}", file=sys.stderr)
+            bad += 1
+        doc = trace.export_chrome_trace(None)
+        json.loads(json.dumps(doc))  # valid JSON round trip
+        tracks = {e["args"]["name"] for e in doc["traceEvents"]
+                  if e.get("ph") == "M"}
+        want = {f"query {h.trace_id}" for h in handles}
+        if not want <= tracks:
+            print(f"telemetry smoke: missing query tracks "
+                  f"{sorted(want - tracks)}", file=sys.stderr)
+            bad += 1
+        fps = observe.STATS_STORE.fingerprints()
+        with_nodes = [d for d in getattr(rep, "stats_digests", [])
+                      if (observe.STATS_STORE.get(d) or {}).get("nodes")]
+        if not with_nodes:
+            print("telemetry smoke: stats store holds no per-node "
+                  "observations for this run's fingerprints",
+                  file=sys.stderr)
+            bad += 1
+        print(f"telemetry smoke: {len(handles)} queries, "
+              f"{len(sampler.samples())} samples, "
+              f"{len(fps)} stats fingerprint(s), "
+              f"profile [{profile.describe()}] "
+              f"({time.perf_counter() - t0:.1f}s, sf={sf})")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract and
+        # let the remaining stages run instead of dying with a traceback
+        print(f"telemetry smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        # span tracing was enabled for the export check — a crash
+        # anywhere above must not leave it on for the benchdiff stage
+        # (or an embedding caller) to accumulate spans unboundedly
+        trace.disable()
+        trace.reset()
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 4/4: benchdiff ==")
+    print("== ci stage 5/5: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -267,6 +400,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the plan_check pre-flight stage")
     ap.add_argument("--no-serve-smoke", action="store_true",
                     help="skip the serving smoke stage")
+    ap.add_argument("--no-telemetry-smoke", action="store_true",
+                    help="skip the telemetry smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -276,16 +411,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/4: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/5: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/4: serving smoke == (skipped)")
+        print("== ci stage 3/5: serving smoke == (skipped)")
+    if not args.no_telemetry_smoke:
+        rcs.append(_stage_telemetry_smoke(args.tpch_sf))
+    else:
+        print("== ci stage 4/5: telemetry smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 4/4: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 5/5: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

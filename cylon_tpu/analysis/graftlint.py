@@ -37,6 +37,15 @@ with examples):
                           LOWERING table (cylon_tpu/plan/executor.py) —
                           the op would silently fall off the optimized-
                           plan surface (docs/query_planner.md).
+  counter-not-in-catalogue  a string-literal metric name bumped via
+                          ``trace.count``/``count_max``/``gauge`` that
+                          has no row in the observe catalogue
+                          (cylon_tpu/observe/metrics.py METRICS) — the
+                          catalogue is the docs' source of truth and
+                          the ANALYZE compliance tests reject exactly
+                          these at runtime; lint catches them at commit
+                          time (docs/observability.md).  Dynamic names
+                          (``cost.strategy_counter(...)``) are skipped.
 
 Findings carry ``file:line:col``; suppress a deliberate site with a
 ``# graftlint: ok[rule]`` (or bare ``# graftlint: ok``) comment on any
@@ -68,6 +77,7 @@ RULES = (
     "shard-map-axis-literal",
     "broad-except",
     "dist-op-unlowered",
+    "counter-not-in-catalogue",
 )
 
 # Modules whose job IS the device↔host boundary: ingest, export, the
@@ -81,10 +91,13 @@ DEVICE_GET_ALLOWED = (
     "cylon_tpu/parallel/dtable.py",
     "cylon_tpu/ops/compact.py",
     "cylon_tpu/io/",
-    # observe.py is the EXPLAIN ANALYZE measurement boundary: its row
-    # peeks are deliberate, explicit, per-operator host reads (the
-    # registry/exporter halves of the module touch no device values)
-    "cylon_tpu/observe.py",
+    # observe/analyze.py is the EXPLAIN ANALYZE measurement boundary:
+    # its row peeks are deliberate, explicit, per-operator host reads.
+    # The REST of the observe package (registry, exporter, sampler,
+    # stats store) is deliberately NOT allow-listed — the sampler's
+    # zero-device-sync contract and the registry's host-only claim are
+    # exactly what this lint guards
+    "cylon_tpu/observe/analyze.py",
 )
 
 # Attribute names that hold device arrays throughout this codebase
@@ -240,6 +253,7 @@ class _Linter(ast.NodeVisitor):
         self._check_host_sync(node, target)
         self._check_jit_in_loop(node, target)
         self._check_axis_literal(node, target)
+        self._check_counter_catalogue(node, target)
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -378,6 +392,44 @@ class _Linter(ast.NodeVisitor):
                                f"hardcoded axis name {arg.value!r} in "
                                f"{leaf}(…) — pass the mesh's axis instead")
 
+    # -- counter-not-in-catalogue --------------------------------------------
+
+    def _check_counter_catalogue(self, node: ast.Call,
+                                 target: Optional[str]) -> None:
+        """Every string-literal metric name bumped through the trace
+        API must have a row in the observe catalogue — the catalogue is
+        what docs and the runtime compliance tests read; a name missing
+        from it would tally invisibly.  Dynamic names (derived counter
+        names like ``cost.strategy_counter(...)``) are skipped: their
+        catalogue membership is proven by the runtime compliance sweep
+        instead."""
+        if target is None:
+            return
+        head, _, leaf = target.rpartition(".")
+        if leaf not in _COUNTER_FNS:
+            return
+        norm = self.path.replace(os.sep, "/")
+        if head not in ("trace", "_trace"):
+            # bare count()/count_max()/gauge() are the trace module's
+            # OWN internal spellings; anywhere else a bare name is some
+            # unrelated local function, not a metric bump
+            if head or not norm.endswith("cylon_tpu/trace.py"):
+                return
+        if not node.args:
+            return
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            return  # dynamic name — runtime compliance covers it
+        names = _metric_names(self.path)
+        if names is None or arg.value in names:
+            return
+        self._emit(node, "counter-not-in-catalogue",
+                   f"metric {arg.value!r} is not in the observe "
+                   "catalogue (cylon_tpu/observe/metrics.py METRICS) — "
+                   "add a row documenting its kind/unit/meaning, or "
+                   "derive the name from a catalogued family")
+
     # -- dist-op-unlowered ---------------------------------------------------
 
     def _check_unlowered(self, tree: ast.Module) -> None:
@@ -467,6 +519,60 @@ class _Linter(ast.NodeVisitor):
 
 _INSTRUMENT_DECOS = ("plan_check.instrument", "instrument")
 _DIST_OP_RE = re.compile(r"^(dist|shuffle)_[a-z0-9_]+$")
+
+_COUNTER_FNS = {"count", "count_max", "gauge"}
+
+# path of cylon_tpu/observe/metrics.py -> frozenset of catalogued metric
+# names (or None when unreadable), mtime-keyed like _lowering_keys_cache
+_metric_names_cache: Dict[str, Tuple[float, Optional[frozenset]]] = {}
+
+
+def _metric_names(linted_path: str) -> Optional[frozenset]:
+    """Metric names of the observe catalogue, parsed from the
+    ``METRICS ... = _specs((name, kind, unit, doc), ...)`` literal in
+    cylon_tpu/observe/metrics.py (located relative to the linted file).
+    None when the catalogue cannot be found/parsed — the rule then
+    stays silent (best-effort, like the dist-op-unlowered arm)."""
+    norm = linted_path.replace(os.sep, "/")
+    idx = norm.rfind("cylon_tpu/")
+    if idx < 0:
+        return None
+    cat_path = norm[:idx] + "cylon_tpu/observe/metrics.py"
+    try:
+        mtime = os.path.getmtime(cat_path)
+    except OSError:
+        return None
+    hit = _metric_names_cache.get(cat_path)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    names: Optional[frozenset] = None
+    try:
+        with open(cat_path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=cat_path)
+        for node in tree.body:
+            if isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+                value = node.value
+            elif isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            else:
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "METRICS"
+                       for t in targets):
+                continue
+            if isinstance(value, ast.Call):
+                found = set()
+                for row in value.args:
+                    if (isinstance(row, ast.Tuple) and row.elts
+                            and isinstance(row.elts[0], ast.Constant)
+                            and isinstance(row.elts[0].value, str)):
+                        found.add(row.elts[0].value)
+                names = frozenset(found)
+    except (OSError, SyntaxError):
+        names = None
+    _metric_names_cache[cat_path] = (mtime, names)
+    return names
 
 # path of cylon_tpu/plan/executor.py -> frozenset of LOWERING keys (or
 # None when unreadable), keyed with the file's mtime so an edit during a

@@ -111,6 +111,9 @@ class PlanReport:
     analyzed: bool = False             # runtime-annotated (EXPLAIN ANALYZE)
     totals: Dict[str, Any] = field(default_factory=dict)
     output: Any = None                 # the analyzed run's actual result
+    # plan-cache fingerprints the analyzed run materialized — the
+    # run-stats store keys its record under these (observe.stats)
+    stats_digests: List[str] = field(default_factory=list)
 
     def _exclusive_ms(self) -> List[float]:
         """Per-node exclusive wall-clock: inclusive ms minus the direct

@@ -201,6 +201,45 @@ def exchange_strategy() -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# measured-cost ranking (docs/observability.md "the mesh bandwidth
+# profile"): the costed chooser normally ranks feasible exchange
+# lowerings on the (rounds, wire bytes) proxy.  This knob — explicit
+# set_cost_measured() > CYLON_COST_MEASURED env (default off) — flips
+# it to rank by cost.predicted_ms from the meshprobe-fitted per-
+# collective coefficients, WHEN a profile for the live mesh has been
+# probed (meshprobe.probe; without one the chooser silently keeps the
+# proxy).  An A/B escape hatch like CYLON_EXCHANGE_STRATEGY: the
+# coefficients are reported everywhere, but only steer under this flag.
+# ---------------------------------------------------------------------------
+
+_cost_measured: Optional[bool] = None   # None -> env-resolved
+
+
+def cost_measured_enabled() -> bool:
+    """Whether the chooser ranks exchanges by MEASURED collective time
+    (explicit knob, else ``CYLON_COST_MEASURED`` — any value but
+    ``0``/empty enables)."""
+    if _cost_measured is not None:
+        return _cost_measured
+    return os.environ.get("CYLON_COST_MEASURED", "0") not in ("", "0")
+
+
+def set_cost_measured(on: "Optional[bool]") -> "Optional[bool]":
+    """Set the measured-cost ranking switch (``None`` restores env
+    resolution); returns the previous EXPLICIT setting so callers
+    restore it in a ``finally`` — the same contract as
+    ``set_device_memory_budget``."""
+    global _cost_measured
+    if on is not None and not isinstance(on, bool):
+        raise CylonError(Status(Code.Invalid,
+            "cost-measured switch must be True, False or None "
+            f"(env-resolved), got {type(on).__name__} {on!r}"))
+    prev = _cost_measured
+    _cost_measured = on
+    return prev
+
+
+# ---------------------------------------------------------------------------
 # compiled-plan cache capacity (docs/query_planner.md "cache semantics"):
 # the LRU entry cap of plan/executor.py's compiled-plan cache.  One
 # repeated query needs one entry; a SERVING workload (cylon_tpu/serve)

@@ -1,0 +1,44 @@
+"""Observability: metrics, EXPLAIN ANALYZE, tracing export, telemetry.
+
+The reference's only runtime channel is glog phase lines (reference:
+cpp/src/cylon/join/join.cpp:61-102, table_api.cpp:636-662); trace.py
+reproduces that shape as spans + counters.  This package is the
+subsystem underneath and above it (docs/observability.md):
+
+  * **metrics** — the typed catalogue (``METRICS``) + the process-level
+    :class:`MetricsRegistry` behind ``trace.count``/``count_max``/
+    ``gauge`` (counters sum, watermarks max, gauges last-write;
+    per-thread lock-free cells merged at read time).
+  * **export** — ``export_chrome_trace(path)``: spans + counter series
+    as Chrome trace-event JSON, with per-QUERY tracks for spans carrying
+    a trace id (the serving waterfall view).
+  * **analyze** — EXPLAIN ANALYZE: run the real query once, stitch
+    runtime statistics onto the plan_check ``PlanNode`` DAG.
+  * **timeseries** — the bounded ring-buffer sampler for sustained-load
+    series (sliding-window QPS, tail latency, hit ratios; zero device
+    syncs).
+  * **stats** — the persistent run-stats store: observed per-node
+    cardinalities keyed by plan-cache fingerprint (ROADMAP §4's
+    recording half; ``CYLON_STATS_PATH`` persists it).
+
+Everything the old flat ``observe`` module exported is re-exported here
+unchanged — ``observe.METRICS``, ``observe.analyze``,
+``observe.export_chrome_trace`` and friends keep working.
+"""
+from __future__ import annotations
+
+from . import stats, timeseries
+from .analyze import analyze
+from .export import export_chrome_trace
+from .metrics import (COUNTER, GAUGE, METRICS, REGISTRY, WATERMARK,
+                      MetricSpec, MetricsRegistry, counter_delta,
+                      exchange_count, row_bytes)
+from .stats import STORE as STATS_STORE
+from .timeseries import TimeSeriesSampler
+
+__all__ = [
+    "COUNTER", "WATERMARK", "GAUGE", "MetricSpec", "METRICS",
+    "MetricsRegistry", "REGISTRY", "export_chrome_trace", "analyze",
+    "exchange_count", "counter_delta", "row_bytes", "TimeSeriesSampler",
+    "STATS_STORE", "stats", "timeseries",
+]

@@ -1,0 +1,281 @@
+"""EXPLAIN ANALYZE — the measurement run (docs/observability.md).
+
+``analyze(plan, tables)`` runs the real query ONCE with tracing on and
+stitches runtime statistics (rows in/out, bytes moved per exchange,
+planner decision, span wall-clock) onto the same ``PlanNode`` DAG that
+plan_check's abstract run produces, via the ``plan_check.instrument``
+hooks on every distributed op.  Surfaces: ``DTable.explain(plan,
+tables=..., analyze=True)`` and ``CylonContext.analyze(plan, tables)``.
+
+ANALYZE is a measurement run: it hard-syncs after every operator so the
+wall-clock charged to each node is honest, which on a tunneled TPU
+backend adds one sync floor per node (docs/tpu_perf_notes.md "the sync
+floor").  The per-node SPLIT is the signal; absolute totals of an
+analyzed run sit above a production (fully async) run by design —
+exactly the trade the bench's phase decomposition already makes.
+
+An analyzed OPTIMIZED run additionally feeds the run-stats store
+(observe.stats): the per-node observations are recorded under every
+plan-cache fingerprint the run materialized, so a later planner pass
+can read observed cardinalities back (ROADMAP §4's recording half).
+
+This module is one of the sanctioned device→host boundaries (with
+trace/table/dtable/compact — see graftlint's allow-list): the row peeks
+below read counts explicitly and WITHOUT caching them on the table, so
+measuring a plan never changes what a later planner decision sees.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from . import stats as _stats
+from .metrics import counter_delta
+
+__all__ = ["analyze"]
+
+# byte-volume counters whose per-window delta IS a node's "bytes moved"
+_BYTE_COUNTERS = ("shuffle.bytes_sent", "broadcast.bytes_sent")
+
+
+def _bytes_of(counters: Dict[str, int]) -> int:
+    return sum(counters.get(k, 0) for k in _BYTE_COUNTERS)
+
+
+def _peek_rows(x) -> Optional[int]:
+    """Global row count of a DTable / local Table WITHOUT mutating it:
+    no pending-mask collapse, no ``_counts_host`` caching — measuring a
+    plan must not hand a later broadcast-threshold decision counts the
+    un-measured run would not have had."""
+    import jax
+    import numpy as np
+
+    from ..parallel.dtable import DTable, _replicate_counts_fn
+    from ..table import Table
+
+    if isinstance(x, DTable):
+        if x.pending_mask is not None:
+            pc = x.pending_cnts
+            if pc is None:
+                return None
+            # pending_cnts is the replicated per-shard survivor vector
+            return int(np.asarray(jax.device_get(pc)).sum())
+        ch = x._counts_host
+        if ch is not None:
+            return int(np.asarray(ch).sum())
+        c = x.counts
+        if not c.is_fully_addressable:
+            c = _replicate_counts_fn(x.ctx.mesh, x.ctx.axis)(c)
+        return int(np.asarray(jax.device_get(c)).sum())
+    if isinstance(x, Table):
+        return x.num_rows
+    return None
+
+
+def _rows_in(args, kwargs, peek=_peek_rows) -> Optional[int]:
+    from ..parallel.dtable import DTable
+
+    flat = list(args) + list(kwargs.values())
+    tables = [a for a in flat if isinstance(a, DTable)]
+    for a in flat:
+        if isinstance(a, dict):
+            tables += [v for v in a.values() if isinstance(v, DTable)]
+        elif isinstance(a, (list, tuple)):
+            tables += [v for v in a if isinstance(v, DTable)]
+    if not tables:
+        return None
+    rows = [peek(t) for t in tables]
+    return None if any(r is None for r in rows) else sum(rows)
+
+
+def _sync_result(out) -> None:
+    """Honest per-node wall-clock: block until the op's output arrays
+    have materialized (spans already sync their own phase tails; this
+    catches work dispatched after the last span)."""
+    from .. import trace
+    from ..parallel.dtable import DTable
+    from ..table import Table
+
+    if isinstance(out, (DTable, Table)) and out.columns:
+        trace.hard_sync([c.data for c in out.columns])
+
+
+class _AnalyzeState:
+    """Per-run bookkeeping behind ``plan_check.instrument``: each
+    instrumented distributed op opens a window at entry and, at exit,
+    stitches the window's runtime deltas onto the PlanNode its own
+    ``note()`` created (windows nest; a node's numbers are INCLUSIVE of
+    the operators it triggered — the replica gather inside a broadcast
+    join charges both its own node and the join's)."""
+
+    def __init__(self, report) -> None:
+        self.report = report
+        self.depth = 0
+        # id-keyed row-peek memo for THIS run: a chained plan peeks the
+        # same intermediate table as producer rows_out and consumer
+        # rows_in — one blocking read, not two, per table.  Entries pin
+        # the table so ids stay unique for the run's lifetime; a table's
+        # logical row count never changes in place (collapse swaps the
+        # blocks but keeps the rows), so the memo cannot go stale.
+        self._rows_memo: Dict[int, Tuple[Any, Optional[int]]] = {}
+
+    def _peek(self, t) -> Optional[int]:
+        hit = self._rows_memo.get(id(t))
+        if hit is not None:
+            return hit[1]
+        rows = _peek_rows(t)
+        self._rows_memo[id(t)] = (t, rows)
+        return rows
+
+    def enter(self, name: str, args, kwargs):
+        from .. import trace
+
+        self.depth += 1
+        return (len(self.report.nodes), self.depth,
+                _rows_in(args, kwargs, self._peek), trace.counters(),
+                time.perf_counter())
+
+    def abort(self, token) -> None:
+        self.depth -= 1
+
+    def exit(self, token, out) -> None:
+        from .. import trace
+
+        idx, depth, rows_in, c0, t0 = token
+        _sync_result(out)
+        ms = (time.perf_counter() - t0) * 1e3
+        self.depth -= 1
+        nodes = self.report.nodes
+        if idx >= len(nodes) or nodes[idx].runtime is not None:
+            # no node of its own inside this window (a _local_only
+            # helper), or the node belongs to a nested op that already
+            # claimed it — nothing to stitch here
+            return
+        c1 = trace.counters()
+        delta = counter_delta(c0, c1)
+        node = nodes[idx]
+        node.runtime = {
+            "depth": depth,
+            "ms": ms,
+            "rows_in": rows_in,
+            "rows_out": self._peek(out) if out is not None else None,
+            "bytes_moved": _bytes_of(c1) - _bytes_of(c0),
+            "decision": node.info.get("decision", "local"),
+            "counters": delta,
+        }
+
+
+def analyze(op, *args, **kwargs):
+    """EXPLAIN ANALYZE: run ``op(*args, **kwargs)`` — the real query,
+    once — with tracing on and every distributed operator instrumented;
+    return the runtime-annotated :class:`plan_check.PlanReport`.
+
+    Each node carries ``runtime = {ms, rows_in, rows_out, bytes_moved,
+    decision, counters, depth}``; ``report.totals`` holds the run-level
+    aggregates (wall ms, bytes moved, syncs, the full merged counter
+    map, per-phase span totals) and ``report.output`` the query's actual
+    result.  ``str(report)`` renders the pandas-EXPLAIN-style tree with
+    *HOT* exclusive-ms highlighting; ``trace.export_chrome_trace(path)``
+    right after an analyze run exports the same run's span profile.
+
+    Trace state is reset at entry (the run IS the measurement) and left
+    populated at exit so the Chrome exporter / ``trace.report()`` can
+    read it; the enable flags are restored to what they were.
+
+    A failing plan does NOT raise: the partially-annotated report comes
+    back with ``ok=False`` and ``error`` set — the nodes measured before
+    the failure are diagnostics, and losing them at the moment they
+    matter most would defeat the tool (the same contract as
+    ``plan_check.explain`` without ``validate``); ``str(report)`` then
+    renders the ``[FAILED]`` head and the error line.
+
+    An ok run whose materializations went through the compiled-plan
+    cache is additionally recorded in the run-stats store under every
+    plan fingerprint it touched (``report.stats_digests`` lists them;
+    observe.stats — ROADMAP §4's recording half).
+    """
+    from .. import trace
+    from ..analysis import plan_check
+
+    report = plan_check.PlanReport()
+    report.analyzed = True
+    # counter-only mode (_counters_enabled) is never touched here, so
+    # only the span-enable flag needs saving; an ambient counter-only
+    # session keeps tallying through and after the run
+    prev_enabled = trace.enabled()
+    trace.reset()
+    trace.enable()
+    cap = plan_check._capture
+    prev_cap = (getattr(cap, "report", None),
+                getattr(cap, "validate", False),
+                getattr(cap, "analyze", None))
+    cap.report = report
+    cap.validate = False
+    cap.analyze = _AnalyzeState(report)
+    t0 = time.perf_counter()
+    digests = []
+    try:
+        with _stats.collect_digests() as digests:
+            out = op(*args, **kwargs)
+        report.ok = True
+        report.output = out
+        if report.result is None:
+            report.result = plan_check._schema_of(out)
+    except Exception as e:  # graftlint: ok[broad-except] — ANALYZE's
+        # contract is to RETURN the partially-annotated report with
+        # ok=False/error set, not to lose the measured nodes at the
+        # moment they matter most (see the docstring)
+        report.error = e
+        report.ok = False
+    finally:
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        cap.report, cap.validate, cap.analyze = prev_cap
+        if not prev_enabled:
+            trace.disable()
+        counters = trace.counters()
+        for node in report.nodes:   # a note() outside any instrumented
+            if node.runtime is None:  # window still reports SOMETHING
+                node.runtime = {"depth": 1, "ms": 0.0, "rows_in": None,
+                                "rows_out": None, "bytes_moved": 0,
+                                "decision": node.info.get("decision",
+                                                          "local"),
+                                "counters": {}}
+        report.totals = {
+            "ms": wall_ms,
+            "bytes_moved": _bytes_of(counters),
+            "rows_sent": counters.get("shuffle.rows_sent", 0)
+            + counters.get("broadcast.rows_sent", 0),
+            "syncs": counters.get("trace.sync", 0),
+            "host_reads": counters.get("host.read", 0),
+            # resilience visibility (docs/robustness.md): injected
+            # faults, retried transients, and degraded exchanges of the
+            # analyzed run surface at report altitude
+            "faults": counters.get("fault.injected", 0),
+            "retries": counters.get("retry.attempts", 0),
+            "chunked_rounds": counters.get("shuffle.chunked_rounds", 0),
+            "counters": counters,
+            "phase_ms": trace.phase_totals(),
+        }
+        # optimized-plan runs (ctx.optimize / explain(optimize=True))
+        # surface the planner's work at report altitude: rule fires,
+        # pre/post exchange pricing, plan-cache traffic — the EXPLAIN
+        # ANALYZE head renders these (docs/query_planner.md)
+        if counters.get("plan.cache_hit", 0) \
+                or counters.get("plan.cache_miss", 0):
+            report.totals["optimizer"] = {
+                "rule_fires": counters.get("optimizer.rule_fires", 0),
+                "row_bytes_pre": counters.get("optimizer.row_bytes_pre", 0),
+                "row_bytes_post": counters.get("optimizer.row_bytes_post",
+                                               0),
+                "cache_hits": counters.get("plan.cache_hit", 0),
+                "cache_misses": counters.get("plan.cache_miss", 0),
+            }
+        # run-stats store (observe.stats): an ok analyzed run records
+        # its per-node observations under every plan fingerprint its
+        # materializations touched — the full-cardinality record the
+        # adaptive-execution loop reads back (ROADMAP §4)
+        report.stats_digests = list(digests)
+        if report.ok:
+            for d in digests:
+                _stats.STORE.record_report(d, report)
+    return report

@@ -1,55 +1,30 @@
-"""Observability: the structured metrics registry + EXPLAIN ANALYZE.
+"""The typed metric catalogue + the process-level metrics registry.
 
-The reference's only runtime channel is glog phase lines (reference:
-cpp/src/cylon/join/join.cpp:61-102, table_api.cpp:636-662); trace.py
-reproduces that shape as spans + counters.  This module is the subsystem
-underneath and above it (docs/observability.md):
+One half of the observe package (docs/observability.md): the CATALOGUE
+(``METRICS``) is the source of truth for every metric the engine emits —
+name, kind, unit, meaning — and the REGISTRY is the store behind
+``trace.count``/``count_max``/``gauge``.  graftlint's
+``counter-not-in-catalogue`` rule reads the ``METRICS = _specs(...)``
+literal below straight from this file's AST, so a counter bumped
+anywhere in the tree without a catalogue row fails lint — keep the rows
+literal.
 
-  * **MetricsRegistry** — typed counters (sums), watermarks (maxes) and
-    gauges (last value), each buffered per thread for lock-free bumping
-    and merged into one process-level view at ``snapshot()`` time (a
-    count bumped on a worker thread — the multihost harness, any future
-    async dispatch — lands in the same report as main-thread counts).
-    ``trace.count``/``count_max``/``gauge`` delegate here, so every
-    existing call site feeds the registry unchanged.
-  * **Chrome trace export** — ``export_chrome_trace(path)`` emits the
-    recorded spans as ``X`` (complete) events and the counter bump
-    series as ``C`` (counter) events in Chrome trace-event JSON, so a
-    query's phase profile opens in Perfetto / ``chrome://tracing`` next
-    to the XLA-level profile from ``trace.profile()``.
-  * **EXPLAIN ANALYZE** — ``analyze(plan, tables)`` runs the real query
-    ONCE with tracing on and stitches runtime statistics (rows in/out,
-    bytes moved per exchange, planner decision, span wall-clock) onto
-    the same ``PlanNode`` DAG that plan_check's abstract run produces,
-    via the ``plan_check.instrument`` hooks on every distributed op.
-    Surfaces: ``DTable.explain(plan, tables=..., analyze=True)`` and
-    ``CylonContext.analyze(plan, tables)``.
-
-ANALYZE is a measurement run: it hard-syncs after every operator so the
-wall-clock charged to each node is honest, which on a tunneled TPU
-backend adds one sync floor per node (docs/tpu_perf_notes.md "the sync
-floor").  The per-node SPLIT is the signal; absolute totals of an
-analyzed run sit above a production (fully async) run by design —
-exactly the trade the bench's phase decomposition already makes.
-
-This module is one of the sanctioned device→host boundaries (with
-trace/table/dtable/compact — see graftlint's allow-list): the row peeks
-below read counts explicitly and WITHOUT caching them on the table, so
-measuring a plan never changes what a later planner decision sees.
+Registry semantics: counters sum, watermarks max, gauges last-write;
+each thread writes to its own lock-free cell, reads merge every cell
+under one lock with dead threads' totals folded into a retained
+aggregate (a worker thread's bumps survive its exit).
 """
 from __future__ import annotations
 
-import json
-import os
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
 
 __all__ = [
     "COUNTER", "WATERMARK", "GAUGE", "MetricSpec", "METRICS",
-    "MetricsRegistry", "REGISTRY", "export_chrome_trace", "analyze",
-    "exchange_count", "counter_delta",
+    "MetricsRegistry", "REGISTRY", "exchange_count", "counter_delta",
+    "row_bytes",
 ]
 
 # ---------------------------------------------------------------------------
@@ -93,7 +68,9 @@ def exchange_count(counters: Dict[str, int]) -> int:
 
 # Every metric the engine emits.  Names are ``<subsystem>.<what>``; the
 # registry accepts unknown names too (tests, ad-hoc probes), but a
-# TPC-H run must stay inside this catalogue (tests/test_observe.py).
+# TPC-H run must stay inside this catalogue (tests/test_observe.py) and
+# graftlint's counter-not-in-catalogue rule rejects uncatalogued
+# string-literal bumps anywhere in cylon_tpu/.
 METRICS: Dict[str, MetricSpec] = _specs(
     # planner decisions (one bump per decided join/groupby)
     ("join.broadcast", COUNTER, "joins",
@@ -283,6 +260,18 @@ METRICS: Dict[str, MetricSpec] = _specs(
     ("serve.batch_window_ms", GAUGE, "ms",
      "the serve session's configured batch-window length: how long the "
      "dispatcher collects concurrent arrivals before admitting a batch"),
+    # runtime telemetry 2.0 (this package; docs/observability.md):
+    # the mesh bandwidth probe and the persistent run-stats store
+    ("meshprobe.probes", COUNTER, "probes",
+     "mesh bandwidth microbench runs (parallel/meshprobe.py) — one per "
+     "mesh fingerprint unless forced; the fitted (latency, bytes/s) "
+     "coefficients are cached and surfaced through cost.predicted_ms"),
+    ("stats.records", COUNTER, "records",
+     "run-stats store writes (observe.stats): ANALYZE reports or served "
+     "executions recorded under their plan-cache fingerprint — the "
+     "recording half of the adaptive-execution loop (ROADMAP §4)"),
+    ("stats.fingerprints", GAUGE, "plans",
+     "distinct plan fingerprints currently held by the run-stats store"),
 )
 
 
@@ -466,56 +455,8 @@ REGISTRY = MetricsRegistry()
 
 
 # ---------------------------------------------------------------------------
-# Chrome trace-event export
+# shared pricing / delta helpers
 # ---------------------------------------------------------------------------
-
-def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
-    """Serialize the recorded spans + counter series as Chrome
-    trace-event JSON (the ``chrome://tracing`` / Perfetto format).
-
-    Spans become complete (``"ph": "X"``) events — ``ts``/``dur`` in
-    microseconds on the ``time.perf_counter`` clock, one track per
-    thread, nesting recovered by Perfetto from containment (our recorded
-    span depth rides along in ``args.depth``).  Counter bumps recorded
-    while tracing was enabled become ``"ph": "C"`` events, so exchange
-    volume lines up under the phase spans.  Returns the document (and
-    writes it to ``path`` when given) — load the file via Perfetto's
-    "Open trace file" next to an XLA profile from ``trace.profile()``.
-    """
-    from . import trace
-
-    pid = os.getpid()
-    events: List[Dict[str, Any]] = []
-    for name, depth, ms, t0, tid in trace.get_span_records(
-            all_threads=True):
-        events.append({
-            "name": name, "cat": "phase", "ph": "X",
-            "ts": round(t0 * 1e6, 3), "dur": round(ms * 1e3, 3),
-            "pid": pid, "tid": tid, "args": {"depth": depth},
-        })
-    for t, name, value, tid in REGISTRY.counter_events():
-        events.append({
-            "name": name, "cat": "metric", "ph": "C",
-            "ts": round(t * 1e6, 3), "pid": pid, "tid": tid,
-            "args": {name: value},
-        })
-    events.sort(key=lambda e: e["ts"])
-    doc = {"traceEvents": events, "displayTimeUnit": "ms",
-           "otherData": {"clock": "time.perf_counter",
-                         "producer": "cylon_tpu.observe"}}
-    if path is not None:
-        with open(path, "w") as f:
-            json.dump(doc, f)
-    return doc
-
-
-# ---------------------------------------------------------------------------
-# EXPLAIN ANALYZE
-# ---------------------------------------------------------------------------
-
-# byte-volume counters whose per-window delta IS a node's "bytes moved"
-_BYTE_COUNTERS = ("shuffle.bytes_sent", "broadcast.bytes_sent")
-
 
 def row_bytes(leaves) -> int:
     """Payload width of ONE row across exchanged column leaves: dtype
@@ -529,10 +470,6 @@ def row_bytes(leaves) -> int:
     return sum(
         int(np.dtype(lf.dtype).itemsize)
         * int(np.prod(lf.shape[1:], dtype=np.int64)) for lf in leaves)
-
-
-def _bytes_of(counters: Dict[str, int]) -> int:
-    return sum(counters.get(k, 0) for k in _BYTE_COUNTERS)
 
 
 def counter_delta(before: Dict[str, int],
@@ -550,227 +487,3 @@ def counter_delta(before: Dict[str, int],
             continue
         out[k] = v if REGISTRY.kind_of(k) == WATERMARK else v - v0
     return out
-
-
-def _peek_rows(x) -> Optional[int]:
-    """Global row count of a DTable / local Table WITHOUT mutating it:
-    no pending-mask collapse, no ``_counts_host`` caching — measuring a
-    plan must not hand a later broadcast-threshold decision counts the
-    un-measured run would not have had."""
-    import jax
-    import numpy as np
-
-    from .parallel.dtable import DTable, _replicate_counts_fn
-    from .table import Table
-
-    if isinstance(x, DTable):
-        if x.pending_mask is not None:
-            pc = x.pending_cnts
-            if pc is None:
-                return None
-            # pending_cnts is the replicated per-shard survivor vector
-            return int(np.asarray(jax.device_get(pc)).sum())
-        ch = x._counts_host
-        if ch is not None:
-            return int(np.asarray(ch).sum())
-        c = x.counts
-        if not c.is_fully_addressable:
-            c = _replicate_counts_fn(x.ctx.mesh, x.ctx.axis)(c)
-        return int(np.asarray(jax.device_get(c)).sum())
-    if isinstance(x, Table):
-        return x.num_rows
-    return None
-
-
-def _rows_in(args, kwargs, peek=_peek_rows) -> Optional[int]:
-    from .parallel.dtable import DTable
-
-    flat = list(args) + list(kwargs.values())
-    tables = [a for a in flat if isinstance(a, DTable)]
-    for a in flat:
-        if isinstance(a, dict):
-            tables += [v for v in a.values() if isinstance(v, DTable)]
-        elif isinstance(a, (list, tuple)):
-            tables += [v for v in a if isinstance(v, DTable)]
-    if not tables:
-        return None
-    rows = [peek(t) for t in tables]
-    return None if any(r is None for r in rows) else sum(rows)
-
-
-def _sync_result(out) -> None:
-    """Honest per-node wall-clock: block until the op's output arrays
-    have materialized (spans already sync their own phase tails; this
-    catches work dispatched after the last span)."""
-    from . import trace
-    from .parallel.dtable import DTable
-    from .table import Table
-
-    if isinstance(out, (DTable, Table)) and out.columns:
-        trace.hard_sync([c.data for c in out.columns])
-
-
-class _AnalyzeState:
-    """Per-run bookkeeping behind ``plan_check.instrument``: each
-    instrumented distributed op opens a window at entry and, at exit,
-    stitches the window's runtime deltas onto the PlanNode its own
-    ``note()`` created (windows nest; a node's numbers are INCLUSIVE of
-    the operators it triggered — the replica gather inside a broadcast
-    join charges both its own node and the join's)."""
-
-    def __init__(self, report) -> None:
-        self.report = report
-        self.depth = 0
-        # id-keyed row-peek memo for THIS run: a chained plan peeks the
-        # same intermediate table as producer rows_out and consumer
-        # rows_in — one blocking read, not two, per table.  Entries pin
-        # the table so ids stay unique for the run's lifetime; a table's
-        # logical row count never changes in place (collapse swaps the
-        # blocks but keeps the rows), so the memo cannot go stale.
-        self._rows_memo: Dict[int, Tuple[Any, Optional[int]]] = {}
-
-    def _peek(self, t) -> Optional[int]:
-        hit = self._rows_memo.get(id(t))
-        if hit is not None:
-            return hit[1]
-        rows = _peek_rows(t)
-        self._rows_memo[id(t)] = (t, rows)
-        return rows
-
-    def enter(self, name: str, args, kwargs):
-        from . import trace
-
-        self.depth += 1
-        return (len(self.report.nodes), self.depth,
-                _rows_in(args, kwargs, self._peek), trace.counters(),
-                time.perf_counter())
-
-    def abort(self, token) -> None:
-        self.depth -= 1
-
-    def exit(self, token, out) -> None:
-        from . import trace
-
-        idx, depth, rows_in, c0, t0 = token
-        _sync_result(out)
-        ms = (time.perf_counter() - t0) * 1e3
-        self.depth -= 1
-        nodes = self.report.nodes
-        if idx >= len(nodes) or nodes[idx].runtime is not None:
-            # no node of its own inside this window (a _local_only
-            # helper), or the node belongs to a nested op that already
-            # claimed it — nothing to stitch here
-            return
-        c1 = trace.counters()
-        delta = counter_delta(c0, c1)
-        node = nodes[idx]
-        node.runtime = {
-            "depth": depth,
-            "ms": ms,
-            "rows_in": rows_in,
-            "rows_out": self._peek(out) if out is not None else None,
-            "bytes_moved": _bytes_of(c1) - _bytes_of(c0),
-            "decision": node.info.get("decision", "local"),
-            "counters": delta,
-        }
-
-
-def analyze(op, *args, **kwargs):
-    """EXPLAIN ANALYZE: run ``op(*args, **kwargs)`` — the real query,
-    once — with tracing on and every distributed operator instrumented;
-    return the runtime-annotated :class:`plan_check.PlanReport`.
-
-    Each node carries ``runtime = {ms, rows_in, rows_out, bytes_moved,
-    decision, counters, depth}``; ``report.totals`` holds the run-level
-    aggregates (wall ms, bytes moved, syncs, the full merged counter
-    map, per-phase span totals) and ``report.output`` the query's actual
-    result.  ``str(report)`` renders the pandas-EXPLAIN-style tree with
-    hot-node highlighting; ``trace.export_chrome_trace(path)`` right
-    after an analyze run exports the same run's span profile.
-
-    Trace state is reset at entry (the run IS the measurement) and left
-    populated at exit so the Chrome exporter / ``trace.report()`` can
-    read it; the enable flags are restored to what they were.
-
-    A failing plan does NOT raise: the partially-annotated report comes
-    back with ``ok=False`` and ``error`` set — the nodes measured before
-    the failure are diagnostics, and losing them at the moment they
-    matter most would defeat the tool (the same contract as
-    ``plan_check.explain`` without ``validate``); ``str(report)`` then
-    renders the ``[FAILED]`` head and the error line.
-    """
-    from . import trace
-    from .analysis import plan_check
-
-    report = plan_check.PlanReport()
-    report.analyzed = True
-    # counter-only mode (_counters_enabled) is never touched here, so
-    # only the span-enable flag needs saving; an ambient counter-only
-    # session keeps tallying through and after the run
-    prev_enabled = trace.enabled()
-    trace.reset()
-    trace.enable()
-    cap = plan_check._capture
-    prev_cap = (getattr(cap, "report", None),
-                getattr(cap, "validate", False),
-                getattr(cap, "analyze", None))
-    cap.report = report
-    cap.validate = False
-    cap.analyze = _AnalyzeState(report)
-    t0 = time.perf_counter()
-    try:
-        out = op(*args, **kwargs)
-        report.ok = True
-        report.output = out
-        if report.result is None:
-            report.result = plan_check._schema_of(out)
-    except Exception as e:  # graftlint: ok[broad-except] — ANALYZE's
-        # contract is to RETURN the partially-annotated report with
-        # ok=False/error set, not to lose the measured nodes at the
-        # moment they matter most (see the docstring)
-        report.error = e
-        report.ok = False
-    finally:
-        wall_ms = (time.perf_counter() - t0) * 1e3
-        cap.report, cap.validate, cap.analyze = prev_cap
-        if not prev_enabled:
-            trace.disable()
-        counters = trace.counters()
-        for node in report.nodes:   # a note() outside any instrumented
-            if node.runtime is None:  # window still reports SOMETHING
-                node.runtime = {"depth": 1, "ms": 0.0, "rows_in": None,
-                                "rows_out": None, "bytes_moved": 0,
-                                "decision": node.info.get("decision",
-                                                          "local"),
-                                "counters": {}}
-        report.totals = {
-            "ms": wall_ms,
-            "bytes_moved": _bytes_of(counters),
-            "rows_sent": counters.get("shuffle.rows_sent", 0)
-            + counters.get("broadcast.rows_sent", 0),
-            "syncs": counters.get("trace.sync", 0),
-            "host_reads": counters.get("host.read", 0),
-            # resilience visibility (docs/robustness.md): injected
-            # faults, retried transients, and degraded exchanges of the
-            # analyzed run surface at report altitude
-            "faults": counters.get("fault.injected", 0),
-            "retries": counters.get("retry.attempts", 0),
-            "chunked_rounds": counters.get("shuffle.chunked_rounds", 0),
-            "counters": counters,
-            "phase_ms": trace.phase_totals(),
-        }
-        # optimized-plan runs (ctx.optimize / explain(optimize=True))
-        # surface the planner's work at report altitude: rule fires,
-        # pre/post exchange pricing, plan-cache traffic — the EXPLAIN
-        # ANALYZE head renders these (docs/query_planner.md)
-        if counters.get("plan.cache_hit", 0) \
-                or counters.get("plan.cache_miss", 0):
-            report.totals["optimizer"] = {
-                "rule_fires": counters.get("optimizer.rule_fires", 0),
-                "row_bytes_pre": counters.get("optimizer.row_bytes_pre", 0),
-                "row_bytes_post": counters.get("optimizer.row_bytes_post",
-                                               0),
-                "cache_hits": counters.get("plan.cache_hit", 0),
-                "cache_misses": counters.get("plan.cache_miss", 0),
-            }
-    return report

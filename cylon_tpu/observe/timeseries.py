@@ -1,0 +1,232 @@
+"""Sustained-load time-series metrics: the bounded ring-buffer sampler.
+
+One-window snapshots (``trace.snapshot()``, ``ServeSession.stats()``)
+answer "what happened"; a serving tier needs "what is happening" —
+sliding-window QPS, tail latency, queue depth and cache hit ratios over
+MINUTES of sustained traffic (docs/serving.md; the steady-state framing
+of arXiv:2212.13732).  :class:`TimeSeriesSampler` is that instrument:
+
+  * a background daemon thread samples on a configurable period into a
+    bounded ring buffer (oldest samples drop once ``capacity`` wraps —
+    memory is constant no matter how long the session runs);
+  * every sample reads HOST-side state only — the metrics registry's
+    merged counters/gauges and the serve session's self-accounted
+    tallies/latencies.  **Zero device syncs**: sampling never blocks a
+    dispatch, never touches a device array, and is safe to leave
+    running next to a latency-sensitive serving loop;
+  * per-sample derived fields: window QPS (completed-delta / dt),
+    window p50/p99 (nearest-rank over the latencies that completed in
+    the window), queue depth, plan-cache and subplan-share hit ratios,
+    and the ``shuffle.exchange_bytes_peak`` watermark.
+
+The bench's sustained-load stage (``CYLON_BENCH_SUSTAIN``) drives one of
+these for minutes under 8 client threads and emits the series into the
+BENCH artifact; benchdiff gates the steady-state summary
+(``serve_sustain_qps`` down / ``serve_sustain_p99_ms`` up).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .metrics import REGISTRY
+
+__all__ = ["TimeSeriesSampler"]
+
+
+def _percentile(sorted_xs: List[float], q: float) -> Optional[float]:
+    # THE nearest-rank definition lives in serve/session.py — one
+    # algorithm behind the sampler windows, the serve stats and the
+    # bench roll-ups, so the three can never disagree.  Imported lazily:
+    # observe loads before the serve package exists (trace → observe at
+    # cylon_tpu import time).
+    from ..serve.session import percentile
+    return percentile(sorted_xs, q)
+
+
+class TimeSeriesSampler:
+    """Bounded ring-buffer sampler over registry + serve-session state.
+
+    Parameters:
+      * ``period_s`` — sampling period (default 0.25 s; the thread
+        wakes, samples, sleeps — drift-free enough for trend data).
+      * ``capacity`` — ring size; once full, each new sample evicts the
+        oldest (``dropped`` counts evictions, so retention is visible).
+      * ``session`` — an optional :class:`~cylon_tpu.serve.ServeSession`
+        whose self-accounted stats and latencies feed the serving
+        fields; without one, only registry-derived fields are sampled.
+
+    Use as a context manager (``with TimeSeriesSampler(...) as s:``) or
+    via ``start()``/``stop()``; ``sample_once()`` takes one sample
+    synchronously (tests, ad-hoc probes) without the thread.
+    """
+
+    def __init__(self, period_s: float = 0.25, capacity: int = 512,
+                 session=None) -> None:
+        from ..status import Code, CylonError, Status
+        if period_s <= 0:
+            raise CylonError(Status(Code.Invalid,
+                f"sampler period must be > 0 s, got {period_s}"))
+        if capacity < 1:
+            raise CylonError(Status(Code.Invalid,
+                f"sampler capacity must be >= 1, got {capacity}"))
+        self.period_s = period_s
+        self.capacity = capacity
+        self._session = session
+        self._lock = threading.Lock()
+        self._buf: List[Optional[Dict[str, Any]]] = [None] * capacity
+        self._n = 0                      # samples ever taken
+        self._t0 = time.perf_counter()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # previous-sample state for window deltas
+        self._prev_t = self._t0
+        self._prev_completed = 0
+        self._prev_cache = (0, 0)        # (hits, misses)
+        self._prev_shared = 0
+        self._lat_idx = 0                # session latencies consumed
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "TimeSeriesSampler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="telemetry-sampler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the sampling thread (samples stay readable).  Takes one
+        final sample so short runs never end empty-handed."""
+        t = self._thread
+        self._stop.set()
+        if t is not None:
+            t.join()
+            self._thread = None
+        self.sample_once()
+
+    def __enter__(self) -> "TimeSeriesSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.sample_once()
+
+    # -- sampling -----------------------------------------------------------
+
+    def _session_window(self):
+        """(stats, window latencies) from the attached session — reads
+        the session's self-accounting, never the device."""
+        s = self._session
+        if s is None:
+            return None, []
+        stats, lats, self._lat_idx = s.telemetry_window(self._lat_idx)
+        return stats, lats
+
+    def sample_once(self) -> Dict[str, Any]:
+        """Take one sample now; returns it (and appends to the ring)."""
+        now = time.perf_counter()
+        dt = max(now - self._prev_t, 1e-9)
+        snap = REGISTRY.snapshot()
+        c, marks, gauges = (snap["counters"], snap["watermarks"],
+                            snap["gauges"])
+        stats, lats = self._session_window()
+        if stats is not None:
+            completed = stats.get("completed", 0)
+            failed = stats.get("failed", 0)
+            deferred = stats.get("deferred", 0)
+            shared = stats.get("subplan_shared", 0)
+            queue_depth = stats.get("queue_depth", 0)
+        else:
+            completed = c.get("serve.completed", 0)
+            failed = c.get("serve.failed", 0)
+            deferred = c.get("serve.deferred", 0)
+            shared = c.get("serve.subplan_shared", 0)
+            queue_depth = gauges.get("serve.queue_depth", 0)
+        hits = c.get("plan.cache_hit", 0)
+        misses = c.get("plan.cache_miss", 0)
+        # a registry reset mid-session (trace.reset(), an ANALYZE run)
+        # drops cumulative counters below the previous sample — clamp
+        # the window deltas at zero (and re-baseline below) so the
+        # series never reports negative qps or a nonsense hit ratio
+        dh = max(hits - self._prev_cache[0], 0)
+        dm = max(misses - self._prev_cache[1], 0)
+        dc = max(completed - self._prev_completed, 0)
+        lats_sorted = sorted(lats)
+        sample = {
+            "t": round(now - self._t0, 4),
+            "completed": completed,
+            "failed": failed,
+            "deferred": deferred,
+            "queue_depth": queue_depth,
+            "qps": round(dc / dt, 3),
+            "p50_ms": _percentile(lats_sorted, 50),
+            "p99_ms": _percentile(lats_sorted, 99),
+            "cache_hit_ratio": (round(dh / (dh + dm), 4)
+                                if dh + dm else None),
+            "subplan_shared": shared,
+            "share_delta": max(shared - self._prev_shared, 0),
+            "exchange_bytes_peak":
+                marks.get("shuffle.exchange_bytes_peak", 0),
+        }
+        self._prev_t = now
+        self._prev_completed = completed
+        self._prev_cache = (hits, misses)
+        self._prev_shared = shared
+        with self._lock:
+            self._buf[self._n % self.capacity] = sample
+            self._n += 1
+        return sample
+
+    # -- reads --------------------------------------------------------------
+
+    @property
+    def dropped(self) -> int:
+        """Samples evicted by ring wrap (retention made visible)."""
+        with self._lock:
+            return max(0, self._n - self.capacity)
+
+    def samples(self) -> List[Dict[str, Any]]:
+        """Retained samples, oldest → newest (≤ ``capacity``)."""
+        with self._lock:
+            n = self._n
+            if n <= self.capacity:
+                return [s for s in self._buf[:n] if s is not None]
+            start = n % self.capacity
+            out = self._buf[start:] + self._buf[:start]
+            return [s for s in out if s is not None]
+
+    def summary(self) -> Dict[str, Any]:
+        """Steady-state roll-up of the retained series: median window
+        QPS over the SECOND half (warm-up excluded), the worst window
+        p99, and totals — the benchdiff-gated numbers of the sustained
+        bench stage."""
+        samples = self.samples()
+        out: Dict[str, Any] = {"samples": len(samples),
+                               "dropped": self.dropped}
+        if not samples:
+            return out
+        half = samples[len(samples) // 2:]
+        qps = sorted(s["qps"] for s in half)
+        out["steady_qps"] = _percentile(qps, 50)
+        p99s = [s["p99_ms"] for s in samples if s["p99_ms"] is not None]
+        out["worst_p99_ms"] = max(p99s) if p99s else None
+        p50s = [s["p50_ms"] for s in half if s["p50_ms"] is not None]
+        out["steady_p50_ms"] = (_percentile(sorted(p50s), 50)
+                                if p50s else None)
+        out["final_completed"] = samples[-1]["completed"]
+        out["max_queue_depth"] = max(s["queue_depth"] for s in samples)
+        ratios = [s["cache_hit_ratio"] for s in samples
+                  if s["cache_hit_ratio"] is not None]
+        out["cache_hit_ratio"] = (round(sum(ratios) / len(ratios), 4)
+                                  if ratios else None)
+        out["exchange_bytes_peak"] = max(s["exchange_bytes_peak"]
+                                         for s in samples)
+        return out

@@ -80,6 +80,7 @@ __all__ = [
     "STRATEGIES", "StrategyPrice", "exchange_sizes", "single_shot_bytes",
     "price_single_shot", "price_chunked", "price_ring", "price_allgather",
     "price_replicate", "chunk_plan", "enumerate_strategies", "choose",
+    "COLLECTIVE_OF", "predicted_ms",
 ]
 
 SINGLE_SHOT = "single-shot"
@@ -273,8 +274,34 @@ def enumerate_strategies(nparts: int, cap: int, counts: np.ndarray,
     return out
 
 
+# which measured collective primitive (parallel/meshprobe.py) each
+# strategy's rounds dispatch — the bridge between the priced catalogue
+# and the fitted (latency, bytes/s) coefficients
+COLLECTIVE_OF = {
+    SINGLE_SHOT: "all_to_all",
+    CHUNKED: "all_to_all",
+    RING: "ppermute",
+    ALLGATHER: "all_gather",
+    REPLICATE: "all_gather",
+}
+
+
+def predicted_ms(price: StrategyPrice, profile) -> Optional[float]:
+    """Predicted wall-clock of one exchange lowering from a measured
+    mesh profile (meshprobe.MeshProfile): α·rounds + wire/β of the
+    strategy's underlying collective.  None without a profile (or for
+    an unmeasured collective) — the annotation and the measured-ranking
+    escape hatch both degrade gracefully to 'unmeasured'."""
+    if profile is None:
+        return None
+    s = profile.predicted_s(COLLECTIVE_OF.get(price.strategy, ""),
+                            price.wire_bytes, price.rounds)
+    return None if s is None else s * 1e3
+
+
 def choose(candidates: Sequence[StrategyPrice], budget: int,
-           forced: Optional[str] = None
+           forced: Optional[str] = None, profile=None,
+           measured: bool = False
            ) -> Tuple[StrategyPrice, str, bool]:
     """Pick one strategy under ``budget``.  Returns ``(price, reason,
     feasible)`` — ``feasible`` False only on the best-effort floor
@@ -290,7 +317,15 @@ def choose(candidates: Sequence[StrategyPrice], budget: int,
     (``STRATEGIES``) breaks exact ties deterministically instead.
     ``forced`` (the ``CYLON_EXCHANGE_STRATEGY`` knob) short-circuits to
     the named candidate when present in ``candidates`` — feasibility is
-    reported but not enforced for it (it is a diagnostic override)."""
+    reported but not enforced for it (it is a diagnostic override).
+
+    With ``measured=True`` AND a meshprobe ``profile``
+    (``CYLON_COST_MEASURED=1``, docs/observability.md "the mesh
+    bandwidth profile"), feasible candidates are ranked by
+    :func:`predicted_ms` from the MEASURED per-collective coefficients
+    instead of the (rounds, wire) proxy — the A/B escape hatch for
+    validating the proxy against the live mesh; candidates whose
+    collective was not measured fall to the back."""
     by_name = {c.strategy: c for c in candidates}
     if forced is not None and forced in by_name:
         c = by_name[forced]
@@ -302,6 +337,18 @@ def choose(candidates: Sequence[StrategyPrice], budget: int,
                                      key=lambda s: s.peak_bytes))
         return c, (f"budget {budget} B below every strategy's floor — "
                    f"best-effort {c.describe()}"), False
+    if measured and profile is not None:
+        def meas_key(c):
+            p = predicted_ms(c, profile)
+            return (p is None, p if p is not None else 0.0,
+                    STRATEGIES.index(c.strategy))
+        best = min(feasible, key=meas_key)
+        p = predicted_ms(best, profile)
+        reason = (f"measured ranking: {best.describe()}, predicted "
+                  f"{p:.3f} ms" if p is not None else
+                  f"measured ranking (unmeasured collective): "
+                  f"{best.describe()}")
+        return best, reason, True
     best = min(feasible, key=lambda c: (c.rounds, c.wire_bytes,
                                         STRATEGIES.index(c.strategy)))
     if best.strategy == SINGLE_SHOT:

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import List, Sequence, Tuple
 
 import jax
@@ -385,6 +386,7 @@ def _staged_exchange(ctx, pid, leaves, choice, outcap_total: int):
     ``(leaves, counts, outcap)`` contract as the single-shot dispatch."""
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
     trace.count_max("shuffle.exchange_bytes_peak", choice.peak_bytes)
+    t0 = time.perf_counter()
     with trace.span_sync("shuffle.exchange") as sp:
         if choice.strategy == cost.RING:
             block = choice.sizes[0]
@@ -394,6 +396,7 @@ def _staged_exchange(ctx, pid, leaves, choice, outcap_total: int):
             newcounts, outs = _allgather_exchange_fn(
                 mesh, axis, Pn, outcap_total)(pid, tuple(leaves))
         sp.sync(outs)
+    _note_exchange_ms(ctx, choice, t0)
     return list(outs), newcounts, outcap_total
 
 
@@ -409,6 +412,34 @@ def _note_choice(choice, reason: str) -> None:
     if choice.strategy != cost.SINGLE_SHOT:
         trace.count("shuffle.strategy.downgrades")
     plan_check.annotate_append("exchange", f"{choice.strategy}: {reason}")
+
+
+def _note_exchange_ms(ctx, choice, t0: float) -> None:
+    """Annotate one completed exchange with predicted-vs-observed ms
+    (docs/observability.md "the mesh bandwidth profile").  Predicted
+    comes from the meshprobe-fitted coefficients of THIS mesh
+    (cost.predicted_ms); observed is wall-clock from ``t0`` to now —
+    under ANALYZE the span sync makes it completion-honest, under plain
+    async dispatch it is dispatch-side only.  Silent without a probed
+    profile: the annotation reports measurements, it never invents
+    them.  Early-exits outside a plan capture (annotate_append would be
+    a no-op anyway) so plain production dispatch pays one thread-local
+    read, not a profile lookup."""
+    from ..analysis import plan_check
+    if not plan_check.capturing():
+        return
+    from . import meshprobe
+    profile = meshprobe.get_profile(ctx)
+    if profile is None:
+        return
+    pred = cost.predicted_ms(choice, profile)
+    if pred is None:
+        return
+    observed = (time.perf_counter() - t0) * 1e3
+    plan_check.annotate_append(
+        "exchange_ms",
+        f"{choice.strategy}: predicted {pred:.2f} / observed "
+        f"{observed:.2f} ms")
 
 
 # ---------------------------------------------------------------------------
@@ -574,7 +605,7 @@ _chunk_sizes = cost.chunk_plan
 
 def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
                       budget: int, outcap_total: int, combine=None,
-                      plan=None):
+                      plan=None, choice=None):
     """Run the K bounded rounds and fold them into the final
     [P*outcap_total] block.  Peak per-round transient is priced ≤ budget
     (best-effort once the per-cell floor C=1 is reached); the final
@@ -613,6 +644,7 @@ def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
     plan_check.annotate(
         degraded=f"chunked shuffle: {rounds} rounds of <= {C} rows/cell "
                  f"({priced_k} B/round vs {budget} B budget)")
+    t_ex0 = time.perf_counter()
     with trace.span_sync("shuffle.exchange") as sp:
         rank = _rank_fn(mesh, axis, Pn)(pid)
         exchange = _exchange_fn(mesh, axis, Pn, block, outcap_k)
@@ -666,32 +698,43 @@ def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
                 acc_groups = np.asarray(
                     ops_compact._read_counts(acc_cnt))
         sp.sync(acc)
+    if choice is not None:
+        _note_exchange_ms(ctx, choice, t_ex0)
     if combine is not None:
         return list(acc), acc_cnt, acc_cap
     return list(acc), acc_cnt, outcap_total
 
 
 def _choose(Pn: int, cap: int, counts: np.ndarray, rbytes: int,
-            budget: int, combine):
+            budget: int, combine, ctx=None):
     """Run the costed chooser for one sized exchange: enumerate the
     candidate lowerings (parallel/cost.py), restrict combine-spec
     payloads to the single-shot/chunked pair (only the chunked rounds
     implement the receiver-side fold-by-key), and pick under the live
-    budget — honoring the ``CYLON_EXCHANGE_STRATEGY`` override."""
-    from ..config import exchange_strategy
+    budget — honoring the ``CYLON_EXCHANGE_STRATEGY`` override and,
+    with ``CYLON_COST_MEASURED=1`` and a probed mesh profile for
+    ``ctx``'s mesh, ranking by measured collective time instead of the
+    (rounds, wire) proxy."""
+    from ..config import cost_measured_enabled, exchange_strategy
+    from . import meshprobe
     forced = exchange_strategy()
-    if forced is None:
+    profile = meshprobe.get_profile(ctx) if ctx is not None else None
+    measured = cost_measured_enabled() and profile is not None
+    if forced is None and not measured:
         # fast path: a feasible single-shot provably wins the
         # (rounds, wire, catalogue) order — fewest rounds, least wire —
         # so the common under-budget exchange never pays the chunk-plan
-        # halving loop or the staged pricing
+        # halving loop or the staged pricing.  (Measured ranking must
+        # NOT take it: the measurement may disagree with the proxy —
+        # that disagreement is the point of the A/B.)
         block, outcap, _ = cost.exchange_sizes(counts)
         ss = cost.price_single_shot(Pn, block, outcap, rbytes)
         if ss.peak_bytes <= budget:
             return ss, f"{ss.describe()} <= budget {budget} B", True
     cands = cost.enumerate_strategies(Pn, cap, counts, rbytes, budget,
                                       staged_ok=combine is None)
-    return cost.choose(cands, budget, forced)
+    return cost.choose(cands, budget, forced, profile=profile,
+                       measured=measured)
 
 
 def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
@@ -798,7 +841,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
         # fail the flush explicitly, and let the replay re-enter
         # through the degraded branch below (which re-chooses).
         choice, reason, _ = _choose(Pn, cap, counts, rbytes,
-                                    budget, combine)
+                                    budget, combine, ctx=ctx)
         if choice.strategy == cost.SINGLE_SHOT:
             _note_choice(choice, reason)
             return need
@@ -835,7 +878,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
         _warn_skew(Pn, hint_key, per_recv, outcap)
         need = (block, outcap)
         choice, reason, _ = _choose(Pn, cap, counts, rbytes,
-                                    budget, combine)
+                                    budget, combine, ctx=ctx)
         _note_choice(choice, reason)
         if choice.strategy == cost.SINGLE_SHOT:
             # this call prices back under budget (the data shrank):
@@ -845,17 +888,20 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
             _block_hints[hint_key] = (need, 0)
             trace.count_max("shuffle.exchange_bytes_peak",
                             choice.peak_bytes)
+            t_ex0 = time.perf_counter()
             with trace.span_sync("shuffle.exchange") as sp:
                 newcounts, outs = dispatch(need)
                 sp.sync(outs)
+            _note_exchange_ms(ctx, choice, t_ex0)
             return list(outs), newcounts, outcap
         if choice.strategy == cost.CHUNKED:
             return _chunked_exchange(ctx, pid, leaves, counts, rbytes,
                                      budget, outcap, combine,
-                                     plan=choice.sizes)
+                                     plan=choice.sizes, choice=choice)
         return _staged_exchange(ctx, pid, leaves, choice, outcap)
 
     try:
+        t_ex0 = time.perf_counter()
         with trace.span_sync("shuffle.exchange") as sp:
             (newcounts, outs), used, counts = \
                 ops_compact.optimistic_dispatch(
@@ -869,9 +915,13 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
         if ob.choice.strategy == cost.CHUNKED:
             return _chunked_exchange(ctx, pid, leaves, ob.counts, rbytes,
                                      budget, ob.need[1], combine,
-                                     plan=ob.choice.sizes)
+                                     plan=ob.choice.sizes,
+                                     choice=ob.choice)
         return _staged_exchange(ctx, pid, leaves, ob.choice, ob.need[1])
     if budget is not None:
         trace.count_max("shuffle.exchange_bytes_peak",
                         _priced_bytes(Pn, used, rbytes))
+        _note_exchange_ms(
+            ctx, cost.price_single_shot(Pn, used[0], used[1], rbytes),
+            t_ex0)
     return list(outs), newcounts, used[1]

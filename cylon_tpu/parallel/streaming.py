@@ -253,28 +253,35 @@ class HostPipeline:
             if item is None:
                 self._q.task_done()
                 return
-            task, fn = item
+            task, fn, trace_id = item
             try:
-                with trace.span("serve.export"):
-                    task._value = fn()
+                # the task's query trace id (if any) stamps the export
+                # span onto that QUERY's track — the export leg of the
+                # serving waterfall (docs/observability.md), even
+                # though it runs on this worker thread
+                with trace.trace_context(trace_id):
+                    with trace.span("serve.export"):
+                        task._value = fn()
             except BaseException as e:  # graftlint: ok[broad-except] —
                 task._error = e  # delivered to the wait()ing consumer
             finally:
                 task._event.set()
                 self._q.task_done()
 
-    def submit(self, fn: Callable[[], Any]) -> HostTask:
+    def submit(self, fn: Callable[[], Any],
+               trace_id: Optional[str] = None) -> HostTask:
         """Enqueue ``fn`` for a worker; returns its :class:`HostTask`.
         Blocks when ``depth`` tasks are already queued (backpressure —
         the workers draining guarantee progress while we hold the
-        lock)."""
+        lock).  ``trace_id`` stamps the worker-side span onto that
+        query's lifecycle track."""
         task = HostTask()
         with self._lock:
             if self._closed:
                 from ..status import Code, CylonError, Status
                 raise CylonError(Status(Code.Invalid,
                     "HostPipeline is closed"))
-            self._q.put((task, fn))
+            self._q.put((task, fn, trace_id))
         return task
 
     def drain(self) -> None:

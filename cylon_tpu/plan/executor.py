@@ -474,6 +474,13 @@ def materialize(builder, root: Node):
     for i, n in enumerate(pre_nodes):
         n.origin_idx = i
     key = (_config_fingerprint(builder.ctx), fingerprint(root))
+    # run-stats store (observe.stats, ROADMAP §4): hand the cache key's
+    # digest to the active digest collector — the ANALYZE runner / the
+    # serve dispatcher attribute observed stats to this fingerprint.
+    # A cheap no-op (one thread-local read, no digest computed) when no
+    # collector is open, i.e. on every plain eager materialization.
+    from ..observe import stats as _obstats
+    _obstats.note_plan(key)
     entry = _cache_get(key)
     if entry is None:
         opt_root, fires, pre_b, post_b = rules.optimize(builder, root)

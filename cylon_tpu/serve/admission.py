@@ -35,6 +35,7 @@ and bumps ``serve.deferred``.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -89,13 +90,22 @@ def admit(batch: List, budget: int) -> Tuple[List, List]:
     queries admit while the running price total stays within ``budget``;
     the head-of-line query always admits (progress guarantee — see the
     module docstring).  Each handle's ``priced_bytes`` must already be
-    set (the session prices at submit time)."""
+    set (the session prices at submit time).
+
+    Admission is a point on each query's lifecycle trace: admitted
+    handles get ``admitted_at``/``queue_wait_ms`` stamped here, which
+    the session records as the query's ``serve.queue_wait`` span
+    (price + deferral count in its args) on the query's own track
+    (docs/observability.md "query-lifecycle tracing")."""
     admitted: List = []
     deferred: List = []
     total = 0
+    now = time.perf_counter()
     for h in batch:
         price = h.priced_bytes or 0
         if not admitted or total + price <= budget:
+            h.admitted_at = now
+            h.queue_wait_ms = (now - h.submitted_at) * 1e3
             admitted.append(h)
             total += price
         else:

@@ -62,12 +62,15 @@ def percentile(sorted_xs: List[float], q: float) -> Optional[float]:
 
 class QueryHandle:
     """One submitted query: status, result rendezvous, and the per-query
-    observability slice (latency, counter deltas, shared subplans)."""
+    observability slice (latency, counter deltas, shared subplans, the
+    query-lifecycle trace id)."""
 
     __slots__ = ("id", "label", "op", "tables", "export", "status",
                  "priced_bytes", "deferrals", "shared_subplans",
                  "counters", "submitted_at", "started_at", "finished_at",
-                 "execute_ms", "latency_ms", "error", "_value", "_event")
+                 "execute_ms", "latency_ms", "error", "_value", "_event",
+                 "trace_id", "admitted_at", "queue_wait_ms",
+                 "plan_digests")
 
     def __init__(self, qid: int, label: str, op: Callable, tables,
                  export: Optional[Callable]) -> None:
@@ -81,12 +84,19 @@ class QueryHandle:
         self.deferrals = 0
         self.shared_subplans: List[str] = []   # op names served from memo
         self.counters: Dict[str, int] = {}     # this query's counter slice
+        # the query-lifecycle trace id (docs/observability.md): stamps
+        # every span this query produces — queue wait, execution phases,
+        # the async export — onto ONE Chrome-export track
+        self.trace_id = f"{label}#{qid}"
         self.submitted_at = time.perf_counter()
+        self.admitted_at: Optional[float] = None
+        self.queue_wait_ms: Optional[float] = None
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.execute_ms: Optional[float] = None
         self.latency_ms: Optional[float] = None
         self.error: Optional[BaseException] = None
+        self.plan_digests: List[str] = []  # run-stats store fingerprints
         self._value: Any = None
         self._event = threading.Event()
 
@@ -322,6 +332,19 @@ class ServeSession:
         out["p99_ms"] = percentile(lat, 99)
         return out
 
+    def telemetry_window(self, latency_idx: int = 0):
+        """One consistent cut for the time-series sampler
+        (observe.timeseries): ``(stats tallies, latencies completed
+        since ``latency_idx``, new index)``.  Host-side bookkeeping
+        only — reading it never touches a device or blocks the
+        dispatcher beyond the stats lock."""
+        with self._lock:
+            stats = dict(self._stats)
+            lats = list(self._latencies[latency_idx:])
+            idx = len(self._latencies)
+        stats["queue_depth"] = len(self._queue)
+        return stats, lats, idx
+
     def close(self) -> None:
         """Stop accepting queries, drain everything queued, stop the
         dispatcher and export lane.  Idempotent."""
@@ -392,6 +415,16 @@ class ServeSession:
                 self._tally("deferred")
             for h in admitted:
                 h.status = "admitted"
+                # the queue-wait leg of the query's lifecycle trace:
+                # submit() happened on a client thread, admission on
+                # this one — record the already-elapsed wait as a span
+                # on the query's OWN track, admission evidence in args
+                # (docs/observability.md "query-lifecycle tracing")
+                trace.record_span(
+                    "serve.queue_wait", h.submitted_at,
+                    h.queue_wait_ms or 0.0, trace_id=h.trace_id,
+                    args={"priced_bytes": h.priced_bytes,
+                          "deferrals": h.deferrals})
             trace.count("serve.admitted", len(admitted))
             self._tally("admitted", len(admitted))
             trace.count("serve.batches")
@@ -406,13 +439,22 @@ class ServeSession:
             # live only while still referenced by handles/exports
 
     def _execute_one(self, h: QueryHandle, memo: _SharedExecMemo) -> None:
+        from ..observe import stats as obstats
         from ..plan import ir
         h.status = "running"
         h.started_at = time.perf_counter()
         memo.begin_query(h)
         deltas: Dict[str, int] = {}
         try:
-            with resilience.counter_scope(deltas):
+            # the query's trace id wraps the WHOLE execution: the
+            # serve.query span and every nested operator phase land on
+            # this query's track in the Chrome export (the waterfall
+            # view, docs/observability.md); the digest collector
+            # attributes every plan-cache fingerprint the query
+            # materializes to exactly this query (observe.stats)
+            with trace.trace_context(h.trace_id), \
+                    obstats.collect_digests() as digests, \
+                    resilience.counter_scope(deltas):
                 with trace.span("serve.query"):
                     b = ir.Builder(self.ctx, exec_memo=memo)
                     wrapped = (b.wrap_tables(h.tables)
@@ -431,12 +473,22 @@ class ServeSession:
             return
         h.counters = deltas
         h.execute_ms = (time.perf_counter() - h.started_at) * 1e3
+        # run-stats store (ROADMAP §4's recording half): the served
+        # execution's counter slice lands under every plan fingerprint
+        # it materialized — observed-cardinality nodes come from
+        # ANALYZE runs of the same fingerprints
+        h.plan_digests = list(digests)
+        for d in h.plan_digests:
+            obstats.STORE.record_run(d, counters=deltas,
+                                     latency_ms=h.execute_ms,
+                                     label=h.label)
         if h.export is not None and self._pipeline is not None:
             trace.count("serve.exports_async")
             self._tally("exports_async")
             h.status = "exporting"
             self._pipeline.submit(
-                lambda h=h, out=out: self._run_export(h, out))
+                lambda h=h, out=out: self._run_export(h, out),
+                trace_id=h.trace_id)
         elif h.export is not None:
             self._run_export(h, out)
         else:

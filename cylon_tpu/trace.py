@@ -45,7 +45,7 @@ __all__ = [
     "reset", "enable_counters", "disable_counters", "counters_enabled",
     "get_spans", "get_span_records", "phase_totals", "counters",
     "snapshot", "report", "bench_line", "export_chrome_trace", "profile",
-    "hard_sync",
+    "hard_sync", "trace_context", "current_trace_id", "record_span",
 ]
 
 
@@ -99,7 +99,7 @@ def hard_sync(tree) -> None:
     t0 = time.perf_counter()
     jax.device_get(reads)
     st.spans.append(("sync", st.depth, (time.perf_counter() - t0) * 1e3,
-                     t0, threading.get_ident()))
+                     t0, threading.get_ident(), current_trace_id(), None))
 
 
 class _SpanState:
@@ -109,9 +109,13 @@ class _SpanState:
 
     def __init__(self) -> None:
         self.thread = threading.current_thread()
-        # (name, depth, ms, t0_perf_counter_seconds, thread_id),
-        # appended in completion order
-        self.spans: List[Tuple[str, int, float, float, int]] = []
+        # (name, depth, ms, t0_perf_counter_seconds, thread_id,
+        #  trace_id_or_None, args_dict_or_None), in completion order.
+        # trace_id is the query-lifecycle track (trace_context); args is
+        # extra Chrome-event detail from record_span (admission price,
+        # deferral count) — both None for ordinary spans
+        self.spans: List[Tuple[str, int, float, float, int,
+                               Optional[str], Optional[dict]]] = []
         self.depth = 0
 
 
@@ -140,6 +144,52 @@ def _fold_dead_locked() -> None:
         else:
             _retired_spans.extend(st.spans)
     _span_states = live
+
+
+# ---------------------------------------------------------------------------
+# query-lifecycle trace ids (docs/observability.md "query-lifecycle
+# tracing"): a thread-local trace id stamps every span recorded while it
+# is set, and the Chrome exporter groups stamped spans onto one named
+# track PER QUERY instead of per thread — a served batch window renders
+# as a waterfall of queue-wait / admission / execute / export per query.
+# The serving layer threads one id per submitted query from submit()
+# through the dispatcher and the async export lane; anything else
+# (tests, ad-hoc probes) can scope one with trace_context().
+# ---------------------------------------------------------------------------
+
+def current_trace_id() -> Optional[str]:
+    """The thread's active query trace id (None outside any)."""
+    return getattr(_tls, "trace_id", None)
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str]) -> Iterator[None]:
+    """Stamp every span recorded on this thread inside the block with
+    ``trace_id`` (nested contexts shadow; ``None`` un-stamps)."""
+    prev = getattr(_tls, "trace_id", None)
+    _tls.trace_id = trace_id
+    try:
+        yield
+    finally:
+        _tls.trace_id = prev
+
+
+def record_span(name: str, t0: float, ms: float, depth: int = 0,
+                trace_id: Optional[str] = None,
+                args: Optional[dict] = None) -> None:
+    """Append one ALREADY-MEASURED span record (``t0`` on the
+    ``time.perf_counter`` clock, duration in ms) — for phases whose
+    start predates the code that can observe them, e.g. a served
+    query's queue wait (submit happened on a client thread; admission
+    observes it later on the dispatcher).  ``args`` rides into the
+    Chrome event's args.  No-op while span tracing is disabled, like
+    ``span`` itself."""
+    if not _enabled:
+        return
+    _span_state().spans.append(
+        (name, depth, float(ms), float(t0), threading.get_ident(),
+         trace_id if trace_id is not None else current_trace_id(),
+         dict(args) if args else None))
 
 
 _enabled = os.environ.get("CYLON_TRACE", "") not in ("", "0")
@@ -239,7 +289,8 @@ def span_sync(name: str) -> Iterator[_SyncSpan]:
         if sp._target is not None:
             hard_sync(sp._target)
         st.spans.append((name, depth, (time.perf_counter() - t0) * 1e3,
-                         t0, threading.get_ident()))
+                         t0, threading.get_ident(), current_trace_id(),
+                         None))
         st.depth = depth
 
 
@@ -281,14 +332,16 @@ def reset() -> None:
 
 def get_spans() -> List[Tuple[str, int, float]]:
     """[(name, depth, ms)] in completion order (this thread's spans)."""
-    return [(n, d, ms) for n, d, ms, _, _ in _span_state().spans]
+    return [(n, d, ms) for n, d, ms, *_ in _span_state().spans]
 
 
 def get_span_records(all_threads: bool = False
-                     ) -> List[Tuple[str, int, float, float, int]]:
-    """Full span records ``(name, depth, ms, t0, thread_id)``; with
-    ``all_threads`` the merged process-level list sorted by start time
-    (dead threads' spans included) — the Chrome exporter's input."""
+                     ) -> List[Tuple[str, int, float, float, int,
+                                     Optional[str], Optional[dict]]]:
+    """Full span records ``(name, depth, ms, t0, thread_id, trace_id,
+    args)``; with ``all_threads`` the merged process-level list sorted
+    by start time (dead threads' spans included) — the Chrome
+    exporter's input."""
     if not all_threads:
         return list(_span_state().spans)
     with _span_lock:
@@ -313,30 +366,35 @@ def snapshot() -> Dict[str, Dict[str, int]]:
 
 def phase_totals(sort: bool = True) -> Dict[str, float]:
     """name → total ms across all recorded spans (every thread).
-    Ordered hottest phase first by default; ``sort=False`` keeps
-    completion order (deterministic across runs — what log-diffing
-    consumers like ``bench_line`` need, where a sort keyed on noisy ms
-    would swap near-equal phases between runs)."""
+    Ordered hottest phase first by default, with a STABLE secondary
+    sort by phase name — exact-ms ties (common when worker threads'
+    merged spans quantize alike) order identically across runs, so
+    serve-mode reports diff cleanly.  ``sort=False`` keeps completion
+    order (what log-diffing consumers like ``bench_line`` need, where a
+    sort keyed on noisy ms would swap near-equal phases between runs)."""
     out: Dict[str, float] = {}
-    for name, _, ms, _, _ in get_span_records(all_threads=True):
-        out[name] = out.get(name, 0.0) + ms
+    for rec in get_span_records(all_threads=True):
+        out[rec[0]] = out.get(rec[0], 0.0) + rec[2]
     if not sort:
         return out
-    return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+    return dict(sorted(out.items(), key=lambda kv: (-kv[1], kv[0])))
 
 
 def report() -> str:
     """Human-readable nested span report + counters (watermarks tagged
     ``(max)``, gauges ``(gauge)`` — a peak is not a sum and must not
-    read like one)."""
+    read like one).  Metric ordering is deterministic under multi-
+    thread merge: sorted by (name, tag) alone — never by the merged
+    values, whose arrival order varies run to run — so serve-mode
+    reports diff cleanly across runs."""
     lines = []
-    for name, depth, ms, _, _ in _span_state().spans:
+    for name, depth, ms, *_ in _span_state().spans:
         lines.append(f"{'  ' * depth}{name} {ms:.2f} ms")
     snap = observe.REGISTRY.snapshot()
     tagged = [(name, n, "") for name, n in snap["counters"].items()]
     tagged += [(name, n, " (max)") for name, n in snap["watermarks"].items()]
     tagged += [(name, n, " (gauge)") for name, n in snap["gauges"].items()]
-    for name, n, tag in sorted(tagged):
+    for name, n, tag in sorted(tagged, key=lambda x: (x[0], x[2])):
         lines.append(f"counter {name} = {n}{tag}")
     return "\n".join(lines)
 

@@ -229,6 +229,58 @@ def test_dist_op_unlowered_covers_groupby_fused():
     assert "dist_groupby_fused" in LOWERING
 
 
+def test_counter_not_in_catalogue_fires_on_unknown_literal():
+    pos = ("from .. import trace\n"
+           "def f():\n"
+           "    trace.count('totally.unknown_metric')\n")
+    assert _rules(pos, "cylon_tpu/parallel/fixture.py") \
+        == ["counter-not-in-catalogue"]
+    sup = ("from .. import trace\n"
+           "def f():\n"
+           "    trace.count('totally.unknown_metric')"
+           "  # graftlint: ok[counter-not-in-catalogue]\n")
+    assert _rules(sup, "cylon_tpu/parallel/fixture.py") == []
+
+
+def test_counter_not_in_catalogue_clean_spellings():
+    # a catalogued name is clean, for all three bump kinds
+    clean = ("from .. import trace\n"
+             "def f():\n"
+             "    trace.count('shuffle.exchanges')\n"
+             "    trace.count_max('shuffle.exchange_bytes_peak', 9)\n"
+             "    trace.gauge('serve.queue_depth', 3)\n")
+    assert _rules(clean, "cylon_tpu/parallel/fixture.py") == []
+    # dynamic names are the runtime compliance tests' job, not lint's
+    dyn = ("from .. import trace\n"
+           "from . import cost\n"
+           "def f(choice):\n"
+           "    trace.count(cost.strategy_counter(choice))\n")
+    assert _rules(dyn, "cylon_tpu/parallel/fixture.py") == []
+    # outside the tree (no cylon_tpu/ root to resolve the catalogue
+    # from) the rule stays silent rather than guessing
+    assert _rules("import t as trace\ntrace.count('x.y')\n",
+                  "elsewhere/fixture.py") == []
+
+
+def test_counter_not_in_catalogue_bare_names_only_in_trace_module():
+    bare = "def g():\n    count('nope.metric')\n"
+    assert _rules(bare, "cylon_tpu/trace.py") \
+        == ["counter-not-in-catalogue"]
+    # a bare count() anywhere else is some unrelated local function
+    assert _rules(bare, "cylon_tpu/ops/fixture.py") == []
+
+
+def test_counter_catalogue_parse_matches_runtime():
+    """The AST-parsed catalogue (what lint checks against) must equal
+    the imported METRICS (what the runtime compliance tests check
+    against) — the two views cannot drift."""
+    from cylon_tpu import observe
+    names = graftlint._metric_names(
+        os.path.join(REPO, "cylon_tpu", "parallel", "shuffle.py"))
+    assert names is not None
+    assert set(names) == set(observe.METRICS)
+
+
 def test_ci_entry_point(tmp_path):
     """``python -m cylon_tpu.analysis.ci``: stage aggregation + the
     usage contract (the plan-check stage itself is covered by the

@@ -612,6 +612,58 @@ def test_benchdiff_gates_serve_qps_down(tmp_path, capsys):
     assert benchdiff.main([old, better]) == 0
 
 
+def test_benchdiff_gates_sustain_family(tmp_path, capsys):
+    """The sustained-load family (docs/observability.md "the
+    time-series sampler"): serve_sustain_qps gates DOWN and
+    serve_sustain_p99_ms gates UP — a steady-state-only regression
+    fails CI even when the short serve stage's numbers are clean."""
+    old = _artifact(tmp_path, "old.json",
+                    {"serve_sustain_qps": 30.0,
+                     "serve_sustain_p99_ms": 80.0,
+                     "serve_qps": 40.0, "serve_p99_ms": 60.0})
+    new = _artifact(tmp_path, "new.json",
+                    {"serve_sustain_qps": 15.0,       # halved
+                     "serve_sustain_p99_ms": 200.0,   # 2.5x tail
+                     "serve_qps": 40.0, "serve_p99_ms": 60.0})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "serve_sustain_qps" in out and "REGRESSED" in out
+    assert "serve_sustain_p99_ms" in out
+    better = _artifact(tmp_path, "better.json",
+                       {"serve_sustain_qps": 60.0,
+                        "serve_sustain_p99_ms": 40.0,
+                        "serve_qps": 40.0, "serve_p99_ms": 60.0})
+    assert benchdiff.main([old, better]) == 0
+    # the steady-state roll-up gates independently: a leak masked by a
+    # warm-up improvement in the whole-run average still fails
+    s_old = _artifact(tmp_path, "s_old.json",
+                      {"serve_sustain_qps": 30.0,
+                       "serve_sustain_steady_qps": 30.0})
+    s_new = _artifact(tmp_path, "s_new.json",
+                      {"serve_sustain_qps": 31.0,
+                       "serve_sustain_steady_qps": 12.0})
+    assert benchdiff.main([s_old, s_new]) == 1
+    assert "serve_sustain_steady_qps" in capsys.readouterr().out
+    # sub-floor p99 wobble stays noise (the ms absolute floor applies)
+    t_old = _artifact(tmp_path, "t_old.json",
+                      {"serve_sustain_p99_ms": 2.0})
+    t_new = _artifact(tmp_path, "t_new.json",
+                      {"serve_sustain_p99_ms": 2.6})
+    assert benchdiff.main([t_old, t_new]) == 0
+
+
+def test_telemetry_metrics_catalogued():
+    """The telemetry-2.0 counters/gauges are documented catalogue
+    entries (the compliance sweeps reject uncatalogued bumps)."""
+    for name, kind in (("meshprobe.probes", observe.COUNTER),
+                       ("stats.records", observe.COUNTER),
+                       ("stats.fingerprints", observe.GAUGE)):
+        spec = observe.METRICS.get(name)
+        assert spec is not None, name
+        assert spec.kind == kind, name
+        assert spec.doc
+
+
 def test_benchdiff_gates_serve_p99_up(tmp_path, capsys):
     """serve_p99_ms gates UP with the ms absolute floor: a tail-latency
     regression fails; sub-floor wobble is noise; p50 is reported but
