@@ -970,7 +970,14 @@ def main() -> None:
                 # takes (broadcast vs shuffle) WITHOUT span syncs — the
                 # timed dispatch stays fully async
                 _trace.enable_counters()
+                _trace.reset()
                 run_q()  # compile + seed hints
+                # the warm-up rep's compile tally IS the query's build
+                # cost (docs/observability.md "compile tracking"):
+                # compile_ms is reported ungated (cold builds vary with
+                # the persistent XLA cache), recompiles in the TIMED
+                # rep below gate UP via benchdiff
+                warm_counters = _trace.counters()
                 q_ts = []
                 for _ in range(2):
                     _trace.reset()  # counters from exactly the last rep
@@ -1038,6 +1045,14 @@ def main() -> None:
                 q_counters.get("plan.cache_hit", 0)
             em.detail[f"tpch_{qname}_optimizer_rule_fires"] = \
                 q_counters.get("optimizer.rule_fires", 0)
+            # compile tracking: warm-up build wall (ungated context for
+            # the latency floor) + steady-state recompiles (gated UP —
+            # a warm rep should build NOTHING; any build here is a
+            # cache-key regression re-tracing per call)
+            em.detail[f"tpch_{qname}_compile_ms"] = round(
+                warm_counters.get("compile.build_us", 0) / 1e3, 2)
+            em.detail[f"tpch_{qname}_recompiles"] = \
+                q_counters.get("compile.builds", 0)
             if use_opt and remaining() > 120:
                 # optimizer-off control: untimed optimized + eager legs
                 # record the bytes the SAME query moves with and without
@@ -1187,6 +1202,10 @@ def main() -> None:
                 em.detail["serve_subplan_shared"] = st["subplan_shared"]
                 em.detail["serve_deferred"] = st["deferred"]
                 em.detail["serve_batches"] = st["batches"]
+                # SLO accounting (docs/serving.md "deadlines"): misses
+                # + sampler alerts of this stage; benchdiff gates it UP
+                em.detail["serve_slo_violations"] = \
+                    st.get("slo_violations", 0)
                 _progress(f"serving: {em.detail['serve_qps']} qps, "
                           f"p99 {em.detail['serve_p99_ms']} ms, "
                           f"{st['subplan_shared']} shared subplans")

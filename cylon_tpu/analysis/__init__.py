@@ -12,6 +12,10 @@ Three coordinated layers (docs/static_analysis.md):
   * ``benchdiff`` — the BENCH-artifact regression gate.  CLI:
     ``python -m cylon_tpu.analysis.benchdiff OLD.json NEW.json``
     (docs/observability.md).
+  * ``calibrate`` — the cost-model audit: predicted-vs-observed
+    exchange ms / peak bytes over the run-stats store.  CLI:
+    ``python -m cylon_tpu.analysis.calibrate --stats STATS.json``
+    (docs/observability.md "cost-model calibration").
   * sanitizer mode — ``cylon_tpu.config.sanitize()``, the runtime
     backstop for what graftlint proves statically.
 
@@ -26,12 +30,12 @@ from __future__ import annotations
 
 from ._abstract import PlanExportReached, any_abstract, is_abstract
 
-__all__ = ["graftlint", "plan_check", "benchdiff", "is_abstract",
-           "any_abstract", "PlanExportReached"]
+__all__ = ["graftlint", "plan_check", "benchdiff", "calibrate",
+           "is_abstract", "any_abstract", "PlanExportReached"]
 
 
 def __getattr__(name):
-    if name in ("graftlint", "plan_check", "benchdiff"):
+    if name in ("graftlint", "plan_check", "benchdiff", "calibrate"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

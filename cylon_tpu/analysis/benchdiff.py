@@ -47,6 +47,12 @@ Gated metrics (relative threshold, default 15%):
     ``serve_sustain_p99_ms`` tail latency (higher = worse), from the
     sustained-load stage (CYLON_BENCH_SUSTAIN;
     docs/observability.md "the time-series sampler")
+  * ``tpch_<q>_recompiles``  jit builds inside the TIMED (warm) rep
+    (higher = worse — a compile-cache-key regression re-tracing per
+    call; the warm-up ``tpch_<q>_compile_ms`` column is reported but
+    NOT gated — cold build cost varies with the persistent XLA cache)
+  * ``serve_slo_violations``  deadline misses + sampler anomaly alerts
+    of the serving stage (higher = worse; docs/serving.md "deadlines")
 
 A gated metric present in OLD but absent from NEW fails the gate
 outright (``MISSING``): a query that crashed or was skipped emits no ms
@@ -133,6 +139,17 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     (r"serve_sustain_qps$", "down"),
     (r"serve_sustain_steady_qps$", "down"),
     (r"serve_sustain_p99_ms$", "up"),
+    # compile tracking (docs/observability.md "compile tracking"):
+    # steady-state recompiles per query gate UP — a timed rep is warm,
+    # so any recompile there is a cache-key regression (a thrashing
+    # size class, an identity-keyed callable rebuilt per call).  The
+    # warm-up tpch_<q>_compile_ms column is reported UNGATED: build
+    # cost on a cold process varies with the persistent XLA cache.
+    (r"tpch_q\d+_recompiles$", "up"),
+    # SLO accounting (docs/serving.md "deadlines"): deadline misses +
+    # sampler anomaly alerts of the serving stages — any increase is a
+    # tail-latency regression surfacing as violated promises
+    (r"serve_slo_violations$", "up"),
 )
 
 

@@ -34,10 +34,18 @@ Stages:
      JSON with one track per query trace id, and the stats store must
      hold per-node observations for at least one plan fingerprint
      (``--no-telemetry-smoke`` skips);
-  5. **benchdiff** (only when ``--baseline`` and a candidate artifact
+  5. **doctor smoke** (docs/observability.md "flight recorder"): a
+     permanent fault is injected into ONE served query of a small mixed
+     workload — the victim must fail onto its own handle while its
+     batch peers return row-identical results with clean counter
+     slices, a flight-recorder bundle must be written, and
+     ``python -m cylon_tpu.observe.doctor`` must render it
+     (``--no-doctor-smoke`` skips);
+  6. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
      including the serving families (``serve_qps``/``serve_sustain_qps``
-     down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up).
+     down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up) and the new
+     ``tpch_<q>_recompiles`` / ``serve_slo_violations`` up-gates.
 
 Exit code is the worst across stages under the shared contract: 0 clean,
 1 findings/regressions/plan errors, 2 usage or tooling errors.
@@ -65,14 +73,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/5: graftlint ==")
+    print("== ci stage 1/6: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/5: plan_check pre-flight ==")
+    print("== ci stage 2/6: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -133,7 +141,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/5: serving smoke ==")
+    print("== ci stage 3/6: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -256,7 +264,7 @@ def _stage_telemetry_smoke(sf: float) -> int:
     CONTRACTS rather than the numbers: sampler non-empty, catalogue
     compliance, export validity (one track per query trace id), stats
     store populated with per-node observations."""
-    print("== ci stage 4/5: telemetry smoke ==")
+    print("== ci stage 4/6: telemetry smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -373,10 +381,120 @@ def _stage_telemetry_smoke(sf: float) -> int:
     return 1 if bad else 0
 
 
+def _stage_doctor_smoke(sf: float) -> int:
+    """Inject a permanent fault into one served query and assert the
+    post-mortem machinery end to end: the victim fails onto its own
+    handle, peers stay row-identical to serial execution, a
+    flight-recorder bundle lands on disk, and doctor renders it."""
+    print("== ci stage 5/6: doctor smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import tempfile
+
+        import jax
+
+        from .. import faults, plan as planner
+        from ..context import CylonContext
+        from ..observe import doctor, flightrec
+        from ..parallel.dtable import DTable
+        from ..serve import ServeSession
+        from ..tpch import generate
+        from ..tpch.queries import QUERIES
+
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(sf, seed=7)
+        dts = {name: DTable.from_pandas(ctx, df)
+               for name, df in data.items()}
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding —
+        # the same contract as the stages above
+        print(f"doctor smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    prev_dir = os.environ.get("CYLON_FLIGHTREC_DIR")
+    tmpdir = tempfile.mkdtemp(prefix="cylon-doctor-")
+    os.environ["CYLON_FLIGHTREC_DIR"] = tmpdir
+    try:
+        from ..parallel import dist_groupby, shuffle_table
+
+        def victim_op(t):
+            # an explicit shuffle forces the two-phase count protocol —
+            # the host read the permanent fault below is injected at
+            # (a tiny TPC-H q1 can plan around every blocking read)
+            return dist_groupby(
+                shuffle_table(t["lineitem"], ["l_orderkey"]),
+                ["l_orderkey"], [("l_quantity", "sum")])
+
+        serial = planner.run(
+            ctx, lambda t, q=QUERIES["q6"]: q(ctx, t), dts).to_pandas()
+        plan = faults.FaultPlan(seed=0, rules=[
+            faults.FaultRule("compact.read_counts", kind="permanent",
+                             once=True)])
+        with faults.active(plan), \
+                ServeSession(ctx, tables=dts, batch_window_ms=30.0) as s:
+            # the victim submits FIRST and executes first (the
+            # dispatcher runs a window in arrival order), so the
+            # once-rule's permanent fault lands on it, not the peers
+            victim = s.submit(victim_op, label="victim")
+            peers = [s.submit(lambda t, q=QUERIES["q6"]: q(ctx, t),
+                              label=f"peer{i}",
+                              export=lambda r: r.to_pandas())
+                     for i in range(2)]
+            try:
+                victim.result(timeout=600)
+                print("doctor smoke: the injected permanent fault did "
+                      "not surface on the victim", file=sys.stderr)
+                bad += 1
+            except faults.PermanentFault:
+                pass
+            peer_results = [h.result(timeout=600) for h in peers]
+        for h, got in zip(peers, peer_results):
+            if not got.sort_values(list(got.columns))\
+                    .reset_index(drop=True).equals(
+                        serial.sort_values(list(serial.columns))
+                        .reset_index(drop=True)):
+                print(f"doctor smoke: {h.label} diverged from serial "
+                      "execution", file=sys.stderr)
+                bad += 1
+            if h.counters.get("fault.injected", 0):
+                print(f"doctor smoke: {h.label}'s counter slice shows "
+                      "the victim's fault — attribution leaked",
+                      file=sys.stderr)
+                bad += 1
+        bundles = sorted(f for f in os.listdir(tmpdir)
+                         if f.startswith("flightrec-"))
+        if not bundles:
+            print("doctor smoke: no flight-recorder bundle was written",
+                  file=sys.stderr)
+            bad += 1
+        else:
+            rc = doctor.main([os.path.join(tmpdir, bundles[-1])])
+            if rc != 0:
+                print(f"doctor smoke: doctor exited {rc} on the bundle",
+                      file=sys.stderr)
+                bad += 1
+        print(f"doctor smoke: victim failed in isolation, "
+              f"{len(peers)} peers clean, {len(bundles)} bundle(s) "
+              f"({time.perf_counter() - t0:.1f}s, sf={sf})")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract and
+        # let the remaining stages run instead of dying with a traceback
+        print(f"doctor smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        if prev_dir is None:
+            os.environ.pop("CYLON_FLIGHTREC_DIR", None)
+        else:
+            os.environ["CYLON_FLIGHTREC_DIR"] = prev_dir
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 5/5: benchdiff ==")
+    print("== ci stage 6/6: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -402,6 +520,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the serving smoke stage")
     ap.add_argument("--no-telemetry-smoke", action="store_true",
                     help="skip the telemetry smoke stage")
+    ap.add_argument("--no-doctor-smoke", action="store_true",
+                    help="skip the doctor (flight recorder) smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -411,20 +531,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/5: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/6: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/5: serving smoke == (skipped)")
+        print("== ci stage 3/6: serving smoke == (skipped)")
     if not args.no_telemetry_smoke:
         rcs.append(_stage_telemetry_smoke(args.tpch_sf))
     else:
-        print("== ci stage 4/5: telemetry smoke == (skipped)")
+        print("== ci stage 4/6: telemetry smoke == (skipped)")
+    if not args.no_doctor_smoke:
+        rcs.append(_stage_doctor_smoke(args.tpch_sf))
+    else:
+        print("== ci stage 5/6: doctor smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 5/5: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 6/6: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

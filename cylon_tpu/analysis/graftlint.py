@@ -46,6 +46,16 @@ with examples):
                           these at runtime; lint catches them at commit
                           time (docs/observability.md).  Dynamic names
                           (``cost.strategy_counter(...)``) are skipped.
+  warn-once-key-literal   a ``glog.warn_once`` whose key is neither a
+                          string literal nor a tuple opening with one —
+                          a fully dynamic key makes every call unique,
+                          defeating the once-per-signature rate limit
+                          (the alert would spam) and leaving the alert
+                          family ungreppable.  The sanctioned shapes:
+                          ``warn_once("slo.p99-drift", …)`` and
+                          ``warn_once(("shuffle.skew", hint_key), …)``
+                          — the literal head names the family, dynamic
+                          components scope the signature.
 
 Findings carry ``file:line:col``; suppress a deliberate site with a
 ``# graftlint: ok[rule]`` (or bare ``# graftlint: ok``) comment on any
@@ -78,6 +88,7 @@ RULES = (
     "broad-except",
     "dist-op-unlowered",
     "counter-not-in-catalogue",
+    "warn-once-key-literal",
 )
 
 # Modules whose job IS the device↔host boundary: ingest, export, the
@@ -106,7 +117,7 @@ DEVICE_GET_ALLOWED = (
 _DEVICE_ATTRS = {"data", "counts", "validity", "pending_mask"}
 
 # static metadata reads on a device array — no transfer involved
-_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize",
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "nbytes",
                  "is_fully_addressable", "sharding"}
 
 # jnp dtypes that require the x64 switch to exist at all
@@ -254,6 +265,7 @@ class _Linter(ast.NodeVisitor):
         self._check_jit_in_loop(node, target)
         self._check_axis_literal(node, target)
         self._check_counter_catalogue(node, target)
+        self._check_warn_once_key(node, target)
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -429,6 +441,37 @@ class _Linter(ast.NodeVisitor):
                    "catalogue (cylon_tpu/observe/metrics.py METRICS) — "
                    "add a row documenting its kind/unit/meaning, or "
                    "derive the name from a catalogued family")
+
+    # -- warn-once-key-literal -----------------------------------------------
+
+    def _check_warn_once_key(self, node: ast.Call,
+                             target: Optional[str]) -> None:
+        """``glog.warn_once`` keys must open with a string literal: the
+        literal head is what makes the once-per-signature rate limit a
+        rate limit (a fully dynamic key is unique per call → the alert
+        spams) and what makes the alert family greppable from a log
+        line back to its source (docs/observability.md "SLO rules")."""
+        if target is None or not node.args:
+            return
+        head, _, leaf = target.rpartition(".")
+        if leaf != "warn_once":
+            return
+        if head not in ("glog", "logging") and not (
+                head == "" and self.path.replace(os.sep, "/")
+                .endswith("cylon_tpu/logging.py")):
+            return
+        key = node.args[0]
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return
+        if (isinstance(key, ast.Tuple) and key.elts
+                and isinstance(key.elts[0], ast.Constant)
+                and isinstance(key.elts[0].value, str)):
+            return
+        self._emit(key, "warn-once-key-literal",
+                   "warn_once key must be a string literal or a tuple "
+                   "opening with one — a fully dynamic key defeats the "
+                   "once-per-signature rate limit and makes the alert "
+                   "family ungreppable")
 
     # -- dist-op-unlowered ---------------------------------------------------
 

@@ -144,6 +144,12 @@ class PlanReport:
                            ("faults", "injected faults")):
             if t.get(key, 0):
                 head += f", {t[key]} {label}"
+        # compile tracking (observe.compile): the build cost of this
+        # run, separated from kernel time — the latency-floor
+        # denominator (docs/observability.md "compile tracking")
+        if t.get("compiles", 0):
+            head += (f", {t.get('compile_ms', 0.0):.1f} ms compiling "
+                     f"({t['compiles']} builds)")
         if not self.ok:
             head += " [FAILED]"
         lines = [head]

@@ -20,6 +20,15 @@ subsystem underneath and above it (docs/observability.md):
   * **stats** — the persistent run-stats store: observed per-node
     cardinalities keyed by plan-cache fingerprint (ROADMAP §4's
     recording half; ``CYLON_STATS_PATH`` persists it).
+  * **compile** — compilation observability: the ``kernel_factory``
+    decorator times every jit build, attributes compile-ms per query,
+    and detects recompile storms.
+  * **devmem** — device-truth memory: allocator watermarks (or the
+    portable live-buffer fallback) sampled at exchange boundaries, the
+    measured side of the cost model's peak-bytes predictions.
+  * **flightrec** / **doctor** — the flight recorder's bounded event
+    ring + crash bundles, and the ``python -m cylon_tpu.observe.doctor``
+    renderer for them.
 
 Everything the old flat ``observe`` module exported is re-exported here
 unchanged — ``observe.METRICS``, ``observe.analyze``,
@@ -27,8 +36,9 @@ unchanged — ``observe.METRICS``, ``observe.analyze``,
 """
 from __future__ import annotations
 
-from . import stats, timeseries
+from . import compile, devmem, flightrec, stats, timeseries
 from .analyze import analyze
+from .compile import kernel_factory
 from .export import export_chrome_trace
 from .metrics import (COUNTER, GAUGE, METRICS, REGISTRY, WATERMARK,
                       MetricSpec, MetricsRegistry, counter_delta,
@@ -40,5 +50,6 @@ __all__ = [
     "COUNTER", "WATERMARK", "GAUGE", "MetricSpec", "METRICS",
     "MetricsRegistry", "REGISTRY", "export_chrome_trace", "analyze",
     "exchange_count", "counter_delta", "row_bytes", "TimeSeriesSampler",
-    "STATS_STORE", "stats", "timeseries",
+    "STATS_STORE", "stats", "timeseries", "compile", "devmem",
+    "flightrec", "kernel_factory",
 ]

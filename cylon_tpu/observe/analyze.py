@@ -29,6 +29,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from . import compile as _compile
 from . import stats as _stats
 from .metrics import counter_delta
 
@@ -214,8 +215,14 @@ def analyze(op, *args, **kwargs):
     cap.analyze = _AnalyzeState(report)
     t0 = time.perf_counter()
     digests = []
+    cevents = []
     try:
-        with _stats.collect_digests() as digests:
+        # compile attribution (observe.compile): every kernel build the
+        # measured run triggers is charged to THIS report — the missing
+        # denominator of the small-query latency floor lands in
+        # totals["compile_ms"] instead of hiding inside node wall-clock
+        with _stats.collect_digests() as digests, \
+                _compile.attribute_compiles() as cevents:
             out = op(*args, **kwargs)
         report.ok = True
         report.output = out
@@ -253,6 +260,12 @@ def analyze(op, *args, **kwargs):
             "faults": counters.get("fault.injected", 0),
             "retries": counters.get("retry.attempts", 0),
             "chunked_rounds": counters.get("shuffle.chunked_rounds", 0),
+            # compilation observability (observe.compile): what this
+            # run spent building jit programs, attributed exactly —
+            # the EXPLAIN ANALYZE head renders it when nonzero
+            "compiles": len(cevents),
+            "compile_ms": round(sum(e["compile_ms"] for e in cevents),
+                                3),
             "counters": counters,
             "phase_ms": trace.phase_totals(),
         }

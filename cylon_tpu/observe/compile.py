@@ -1,0 +1,305 @@
+"""Compilation observability — make every jit build a measured event.
+
+The engine's kernel factories (``shuffle._exchange_fn`` and friends)
+are ``lru_cache``-keyed builders of jit/shard_map programs: a factory
+cache hit is free, a miss builds a NEW program whose first dispatch
+pays trace + XLA compile.  That cost is the latency floor's missing
+denominator (docs/tpu_perf_notes.md "the per-query floor"): ROADMAP §5's
+q11-at-0.23x number is meaningless until compile time, retrace storms
+and kernel time can be told apart.  This module is the instrument:
+
+  * :func:`kernel_factory` — a drop-in replacement for
+    ``functools.lru_cache(maxsize=None)`` on kernel factories.  Factory
+    hits/misses tally ``compile.cache_hits`` / ``compile.cache_misses``;
+    the first CONCRETE call of each new shape signature through a built
+    kernel is timed as a build event — ``compile.builds`` +
+    ``compile.build_us`` counters, a ``compile.build`` span whose args
+    carry the factory name, cache key, trace-ms and compile-ms — and
+    attributed to the active per-query collector (the serving layer and
+    EXPLAIN ANALYZE each open one, so ``QueryHandle.compile_ms`` and
+    ``report.totals["compile_ms"]`` are exact, not inferred).
+
+    Timing honesty: jit dispatch is async, so the first call's wall
+    clock is trace + lowering + XLA compile + enqueue — no device
+    execution rides in it.  The pure tracing share is measured
+    separately via one ``jax.eval_shape`` pre-pass (``compile.trace_us``)
+    and ONLY while counters are enabled — plain production dispatch
+    never pays the extra abstract trace.
+
+  * the **recompile-storm detector**: each factory keeps a sliding
+    window of recent distinct cache keys; when one factory builds
+    :data:`STORM_KEYS` distinct keys within :data:`STORM_WINDOW_S`
+    seconds, a ``glog.warn_once`` fires NAMING the key component that
+    varies (the factory's parameter name + the run of values), and
+    ``compile.storms`` tallies.  A shuffle whose size classes thrash,
+    or a predicate rebuilt per call defeating the select cache, becomes
+    one loud line instead of a mystery wall-clock tax.
+
+Abstract plan runs (analysis/plan_check): calls whose leaves are
+tracers build nothing on the device and are passed straight through —
+measuring them would charge abstract-interpretation time to "compile".
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["kernel_factory", "attribute_compiles", "note_build",
+           "clear_state", "STORM_KEYS", "STORM_WINDOW_S"]
+
+# the storm detector's window: this many DISTINCT cache keys built by
+# one factory within this many seconds is a retrace storm worth a warn
+STORM_KEYS = 8
+STORM_WINDOW_S = 30.0
+
+# how much of a cache key / key component run the warn line renders
+_KEY_REPR_LEN = 120
+
+
+# ---------------------------------------------------------------------------
+# per-query attribution (the serving layer / ANALYZE open a collector)
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+@contextmanager
+def attribute_compiles():
+    """Collect every build event on this thread inside the block; yields
+    the (live) list of ``{"factory", "key", "compile_ms", "trace_ms"}``
+    dicts.  Nests like ``stats.collect_digests``: the innermost
+    collector owns the events of its extent.  Zero overhead per build
+    when no collector is open beyond one thread-local read."""
+    stack = getattr(_tls, "collectors", None)
+    if stack is None:
+        stack = _tls.collectors = []
+    out: List[Dict[str, Any]] = []
+    stack.append(out)
+    try:
+        yield out
+    finally:
+        stack.pop()
+
+
+def _attribute(event: Dict[str, Any]) -> None:
+    stack = getattr(_tls, "collectors", None)
+    if stack:
+        stack[-1].append(event)
+
+
+def _observing() -> bool:
+    """Is anyone watching builds right now — counters on, or a
+    per-query collector open on this thread?  The kernel handles use
+    this as their fast-path gate: unobserved production dispatch must
+    cost a couple of attribute reads, not a pytree flatten per call."""
+    from .. import trace
+    if trace.counters_enabled():
+        return True
+    return bool(getattr(_tls, "collectors", None))
+
+
+# ---------------------------------------------------------------------------
+# recompile-storm detection (factory-level, on cache misses)
+# ---------------------------------------------------------------------------
+
+_storm_lock = threading.Lock()
+_recent_keys: Dict[str, deque] = {}   # factory -> deque[(t, key)]
+
+
+def _differing_components(keys, params: Tuple[str, ...]) -> str:
+    """Name the cache-key component(s) that vary across ``keys`` —
+    ``block=64/128/256/…`` reads at the parameter level the factory
+    author thinks in, not as opaque tuples."""
+    keys = [k for k in keys if isinstance(k, tuple)]
+    if not keys or len({len(k) for k in keys}) != 1:
+        return "heterogeneous keys"
+    parts = []
+    for i in range(len(keys[0])):
+        vals = []
+        for k in keys:
+            v = repr(k[i])
+            if v not in vals:
+                vals.append(v)
+        if len(vals) <= 1:
+            continue
+        name = params[i] if i < len(params) else f"arg{i}"
+        run = "/".join(sorted(vals)[:6])
+        if len(vals) > 6:
+            run += f"/… ({len(vals)} values)"
+        parts.append(f"{name}={run}"[:_KEY_REPR_LEN])
+    return ", ".join(parts) if parts else "identical keys re-built"
+
+
+def note_build(factory: str, key: Tuple,
+               params: Tuple[str, ...] = ()) -> None:
+    """Record one factory cache MISS into the storm window (and tally
+    it); fires the storm warning when the window fills with distinct
+    keys.  Public so non-factory caches (a hand-rolled builder) can feed
+    the same detector."""
+    from .. import trace
+    trace.count("compile.cache_misses")
+    now = time.monotonic()
+    with _storm_lock:
+        dq = _recent_keys.setdefault(factory, deque())
+        dq.append((now, key))
+        while dq and now - dq[0][0] > STORM_WINDOW_S:
+            dq.popleft()
+        distinct = {k for _, k in dq}
+    if len(distinct) < STORM_KEYS:
+        return
+    from .. import logging as glog
+    fired = glog.warn_once(
+        ("compile.storm", factory),
+        "recompile storm: factory %s built %d distinct programs within "
+        "%.0f s — differing key component(s): %s. Every build pays trace "
+        "+ XLA compile; a thrashing key component usually means an "
+        "unquantized size or an identity-keyed callable rebuilt per "
+        "call (docs/observability.md \"compile tracking\"). "
+        "(warned once per factory per session)",
+        factory, len(distinct), STORM_WINDOW_S,
+        _differing_components(distinct, params))
+    if fired:
+        # one DETECTION per factory per session (warn_once's first-fire
+        # return) — not one bump per miss while the window stays full,
+        # which would read a single storm as dozens
+        trace.count("compile.storms")
+
+
+def clear_state() -> None:
+    """Forget the storm windows (test isolation).  Factory caches and
+    per-kernel seen-signature sets are untouched — compiled programs
+    stay compiled."""
+    with _storm_lock:
+        _recent_keys.clear()
+
+
+# ---------------------------------------------------------------------------
+# the factory decorator + the per-kernel build timer
+# ---------------------------------------------------------------------------
+
+def _signature(args, kwargs) -> Tuple:
+    """Hashable shape/dtype signature of one call — what jit's own cache
+    keys on, minus shardings (one factory key pins one mesh, so the
+    sharding axis cannot vary under it)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig: List[Any] = [treedef]
+    for lf in leaves:
+        shape = getattr(lf, "shape", None)
+        dtype = getattr(lf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append(("a", tuple(shape), str(dtype)))
+        else:
+            sig.append(("o", lf))
+    return tuple(sig)
+
+
+class _KernelHandle:
+    """Wraps one built kernel: transparent call-through, with the first
+    concrete call of each new shape signature timed as a build event."""
+
+    __slots__ = ("_fn", "factory", "key", "_seen", "fresh")
+
+    def __init__(self, fn, factory: str, key: Tuple) -> None:
+        self._fn = fn
+        self.factory = factory
+        self.key = key
+        self._seen: set = set()
+        self.fresh = True
+
+    def __call__(self, *args, **kwargs):
+        # fast-path gate: unobserved dispatch (counters off, no
+        # collector) goes straight to the kernel — no flatten, no
+        # signature.  A build that happens unobserved is simply not
+        # recorded (its counters would no-op anyway); when observation
+        # starts later, the first observed call of an already-compiled
+        # signature measures as a near-zero "build" — harmless noise
+        # vs. taxing every production dispatch
+        if not _observing():
+            return self._fn(*args, **kwargs)
+        from ..analysis._abstract import is_abstract
+        import jax
+        try:
+            leaves = jax.tree_util.tree_leaves((args, kwargs))
+            if any(is_abstract(lf) for lf in leaves):
+                # abstract plan run: nothing compiles on the device —
+                # charging eval_shape time to "compile" would be a lie
+                return self._fn(*args, **kwargs)
+            sig = _signature(args, kwargs)
+        except TypeError:
+            return self._fn(*args, **kwargs)   # unhashable leaf — skip
+        if sig in self._seen:
+            return self._fn(*args, **kwargs)
+        return self._build_call(sig, args, kwargs)
+
+    def _build_call(self, sig, args, kwargs):
+        from .. import trace
+        trace_ms: Optional[float] = None
+        if trace.counters_enabled():
+            # the pure tracing share, via one abstract pre-pass — only
+            # while someone is watching (production dispatch skips it)
+            try:
+                import jax
+                t0 = time.perf_counter()
+                jax.eval_shape(self._fn, *args, **kwargs)
+                trace_ms = (time.perf_counter() - t0) * 1e3
+            except Exception:  # graftlint: ok[broad-except] — the
+                trace_ms = None  # trace split is best-effort telemetry
+        t1 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        build_ms = (time.perf_counter() - t1) * 1e3
+        # mark seen AFTER a successful dispatch: a failed first call
+        # must re-measure (and re-raise) next time, not go dark
+        self._seen.add(sig)
+        trace.count("compile.builds")
+        trace.count("compile.build_us", int(round(build_ms * 1e3)))
+        if trace_ms is not None:
+            trace.count("compile.trace_us", int(round(trace_ms * 1e3)))
+        trace.record_span(
+            "compile.build", t1, build_ms,
+            args={"factory": self.factory,
+                  "key": repr(self.key)[:_KEY_REPR_LEN],
+                  "trace_ms": (None if trace_ms is None
+                               else round(trace_ms, 3)),
+                  "compile_ms": round(build_ms, 3)})
+        _attribute({"factory": self.factory, "key": self.key,
+                    "compile_ms": build_ms, "trace_ms": trace_ms})
+        return out
+
+
+def kernel_factory(fn):
+    """``functools.lru_cache(maxsize=None)`` for kernel factories, plus
+    compile observability (module docstring).  Drop-in: same positional
+    hashable-args contract, ``cache_clear``/``cache_info`` preserved;
+    graftlint's ``kernel-factory-unkeyed`` rule recognizes it as a cache
+    decorator."""
+    factory = fn.__qualname__
+    try:
+        params = tuple(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):
+        params = ()
+
+    @functools.lru_cache(maxsize=None)
+    def _build(*key) -> _KernelHandle:
+        return _KernelHandle(fn(*key), factory, key)
+
+    @functools.wraps(fn)
+    def wrapper(*key):
+        handle = _build(*key)
+        if handle.fresh:
+            handle.fresh = False
+            note_build(factory, key, params)
+        else:
+            from .. import trace
+            trace.count("compile.cache_hits")
+        return handle
+
+    wrapper.cache_clear = _build.cache_clear
+    wrapper.cache_info = _build.cache_info
+    wrapper.__wrapped__ = fn
+    return wrapper

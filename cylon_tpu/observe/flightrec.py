@@ -1,0 +1,212 @@
+"""Flight recorder: a bounded ring of recent engine events + crash bundles.
+
+When a served query dies at 3am, the evidence is gone by the time a
+human greps the logs — the arXiv:2212.13732 framing says SLO violations
+and post-mortems are first-class OUTPUTS of an operator-DAG service,
+not forensics.  This module is that output:
+
+  * an always-on, bounded, thread-safe **event ring**
+    (:func:`note` / :func:`events`): the serving layer records every
+    query completion (label, status, latency, counter slice, plan
+    digests), deadline misses and SLO alerts; the exchange chooser
+    records its non-fast-path strategy choices.  Constant memory
+    (:data:`CAPACITY` events, oldest drop; ``dropped`` is visible), a
+    dict build + deque append per event — cheap enough to never turn
+    off.
+  * a **diagnostic bundle** (:func:`dump`): one JSON document holding
+    the ring, a typed counter snapshot, the config fingerprint (mesh /
+    budget / knob state / library versions), the last-K query records,
+    and the current Perfetto trace document — everything
+    ``python -m cylon_tpu.observe.doctor`` needs to render a post-
+    mortem without access to the crashed process.
+  * **dump-on-error**: the serving layer calls
+    :func:`maybe_dump_on_error` for any ``CylonError`` escaping a
+    query.  Auto-dumps are written only when ``CYLON_FLIGHTREC_DIR``
+    names a directory (a library must not spray files by default) and
+    are capped at :data:`MAX_AUTO_DUMPS` per process — a crash loop
+    produces a few bundles, not a full disk.
+
+Bundle shape is deterministic (sorted keys, fixed section set), so a
+seeded chaos run reproduces a byte-comparable STRUCTURE — the
+dump-on-chaos determinism contract the tests pin down.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["note", "events", "dropped", "clear", "bundle", "dump",
+           "maybe_dump_on_error", "CAPACITY", "MAX_AUTO_DUMPS",
+           "LAST_K_QUERIES"]
+
+CAPACITY = int(os.environ.get("CYLON_FLIGHTREC_CAP", "256"))
+MAX_AUTO_DUMPS = 3          # per process; a crash loop stays bounded
+LAST_K_QUERIES = 16         # query records replicated into the bundle
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=max(CAPACITY, 1))
+_dropped = 0
+_auto_dumps = 0
+_dump_seq = 0   # monotone per process: two back-to-back dumps (two
+#                 failures in one batch window) must never collide on
+#                 a wall-clock-derived filename and clobber each other
+
+
+# ---------------------------------------------------------------------------
+# the ring
+# ---------------------------------------------------------------------------
+
+def note(kind: str, **payload) -> None:
+    """Append one event (``kind`` + JSON-serializable payload) to the
+    ring.  Never raises — the recorder must not be able to take down
+    the flight it records."""
+    global _dropped
+    ev = {"t": round(time.time(), 3), "kind": kind}
+    ev.update(payload)
+    with _lock:
+        if len(_ring) == _ring.maxlen:
+            _dropped += 1
+        _ring.append(ev)
+
+
+def events() -> List[Dict[str, Any]]:
+    """Retained events, oldest → newest (≤ :data:`CAPACITY`)."""
+    with _lock:
+        return list(_ring)
+
+
+def dropped() -> int:
+    """Events evicted by ring wrap (retention made visible, the same
+    contract as the time-series sampler's ``dropped``)."""
+    with _lock:
+        return _dropped
+
+
+def clear() -> None:
+    """Drop every event and reset the auto-dump cap (test isolation)."""
+    global _dropped, _auto_dumps
+    with _lock:
+        _ring.clear()
+        _dropped = 0
+        _auto_dumps = 0
+
+
+# ---------------------------------------------------------------------------
+# bundles
+# ---------------------------------------------------------------------------
+
+def _config_fingerprint() -> Dict[str, Any]:
+    """The knob/platform state a post-mortem needs to reproduce the
+    run.  Every read is best-effort: a half-torn-down process at crash
+    time must still produce a bundle."""
+    out: Dict[str, Any] = {}
+    try:
+        import sys
+        out["python"] = sys.version.split()[0]
+    except Exception:  # graftlint: ok[broad-except] — best-effort
+        pass
+    try:
+        import jax
+        import numpy
+        out["jax"] = jax.__version__
+        out["numpy"] = numpy.__version__
+        devs = jax.local_devices()
+        out["platform"] = devs[0].platform if devs else None
+        out["local_devices"] = len(devs)
+    except Exception:  # graftlint: ok[broad-except] — best-effort
+        pass
+    try:
+        from .. import config
+        out["memory_budget"] = config.device_memory_budget()
+        out["broadcast_threshold"] = config.broadcast_join_threshold()
+        out["optimizer"] = config.optimizer_enabled()
+        out["exchange_strategy"] = config.exchange_strategy()
+        out["cost_measured"] = config.cost_measured_enabled()
+        out["plan_cache_capacity"] = config.plan_cache_capacity()
+    except Exception:  # graftlint: ok[broad-except] — a malformed env
+        pass            # knob must not block the crash bundle
+    for env in ("CYLON_CHAOS", "CYLON_SANITIZE", "CYLON_MEMORY_BUDGET",
+                "CYLON_STATS_PATH", "CYLON_MESHPROBE_PATH"):
+        v = os.environ.get(env)
+        if v:
+            out[env] = v
+    return out
+
+
+def bundle(reason: str = "on-demand",
+           error: Optional[BaseException] = None) -> Dict[str, Any]:
+    """Build one diagnostic bundle dict (see the module docstring for
+    the section set).  Pure read — records nothing, writes nothing."""
+    from .. import trace
+    evs = events()
+    try:
+        trace_doc = trace.export_chrome_trace(None)
+    except Exception:  # graftlint: ok[broad-except] — a torn trace
+        trace_doc = {"traceEvents": []}  # must not block the bundle
+    try:
+        counters = trace.snapshot()
+    except Exception:  # graftlint: ok[broad-except] — ditto
+        counters = {"counters": {}, "watermarks": {}, "gauges": {}}
+    return {
+        "schema": 1,
+        "reason": reason,
+        "created_s": round(time.time(), 3),
+        "error": (None if error is None else
+                  {"type": type(error).__name__,
+                   "message": str(error)[:500]}),
+        "config": _config_fingerprint(),
+        "counters": counters,
+        "events": evs,
+        "events_dropped": dropped(),
+        "queries": [e for e in evs
+                    if e.get("kind") == "query"][-LAST_K_QUERIES:],
+        "trace": trace_doc,
+    }
+
+
+def dump(path: Optional[str] = None, reason: str = "on-demand",
+         error: Optional[BaseException] = None) -> str:
+    """Write one bundle as JSON and return its path.  ``path`` defaults
+    to ``flightrec-<pid>-<seq>.json`` under ``CYLON_FLIGHTREC_DIR``
+    (or the cwd when that env is unset — explicit dumps are the user
+    asking).  Bumps ``flightrec.dumps``."""
+    global _dump_seq
+    from .. import trace
+    if path is None:
+        base = os.environ.get("CYLON_FLIGHTREC_DIR") or "."
+        with _lock:
+            _dump_seq += 1
+            seq = _dump_seq
+        path = os.path.join(base,
+                            f"flightrec-{os.getpid()}-{seq}.json")
+    doc = bundle(reason, error)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, default=str)
+    os.replace(tmp, path)
+    trace.count("flightrec.dumps")
+    return path
+
+
+def maybe_dump_on_error(reason: str,
+                        error: BaseException) -> Optional[str]:
+    """The serve layer's crash hook: dump a bundle for ``error`` when
+    ``CYLON_FLIGHTREC_DIR`` is configured and the per-process auto-dump
+    cap has room; returns the path (None when not dumped).  Never
+    raises — a failing recorder must not mask the original error."""
+    global _auto_dumps
+    base = os.environ.get("CYLON_FLIGHTREC_DIR")
+    if not base:
+        return None
+    with _lock:
+        if _auto_dumps >= MAX_AUTO_DUMPS:
+            return None
+        _auto_dumps += 1
+    try:
+        return dump(None, reason, error)
+    except Exception:  # graftlint: ok[broad-except] — see docstring:
+        return None     # the bundle is best-effort, the error is not

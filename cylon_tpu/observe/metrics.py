@@ -272,6 +272,56 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "recording half of the adaptive-execution loop (ROADMAP §4)"),
     ("stats.fingerprints", GAUGE, "plans",
      "distinct plan fingerprints currently held by the run-stats store"),
+    # compilation observability (observe/compile.py;
+    # docs/observability.md "compile tracking"): every jit build through
+    # an instrumented kernel factory is a measured event
+    ("compile.builds", COUNTER, "builds",
+     "jit programs built: first concrete dispatch of a new shape "
+     "signature through an instrumented kernel factory (trace + XLA "
+     "compile paid here)"),
+    ("compile.build_us", COUNTER, "us",
+     "wall-clock of compile.builds events (async dispatch: trace + "
+     "lowering + compile + enqueue; device execution excluded) — "
+     "report.totals['compile_ms'] and QueryHandle.compile_ms derive "
+     "from the per-query attribution of the same events"),
+    ("compile.trace_us", COUNTER, "us",
+     "the pure tracing share of builds, measured via one eval_shape "
+     "pre-pass while counters are enabled (production dispatch skips "
+     "the pre-pass, so this is an observability-mode number)"),
+    ("compile.cache_hits", COUNTER, "hits",
+     "kernel-factory cache hits (the program already existed)"),
+    ("compile.cache_misses", COUNTER, "misses",
+     "kernel-factory cache misses (a new program was built for a new "
+     "static key)"),
+    ("compile.storms", COUNTER, "storms",
+     "recompile-storm detections: one factory built STORM_KEYS distinct "
+     "programs inside one sliding window (the warn_once names the "
+     "thrashing key component)"),
+    ("compile.plan_build_us", COUNTER, "us",
+     "wall-clock of compiled-plan cache misses in plan/executor "
+     "(rewrite rules + frozen-copy store) — the plan-altitude sibling "
+     "of compile.build_us"),
+    # device-truth memory (observe/devmem.py): allocator watermarks /
+    # live-buffer accounting sampled at exchange boundaries
+    ("devmem.samples", COUNTER, "samples",
+     "device memory snapshots taken (memory_stats or live-buffer "
+     "accounting; sampled at exchange boundaries under EXPLAIN "
+     "ANALYZE, never on the production hot path)"),
+    ("devmem.peak_bytes", WATERMARK, "bytes",
+     "largest OBSERVED per-exchange memory transient (device-truth "
+     "counterpart of the priced shuffle.exchange_bytes_peak; lower "
+     "bound on CPU — see docs/observability.md 'device-truth memory')"),
+    # flight recorder + SLO alerting (observe/flightrec.py,
+    # observe/timeseries.py anomaly rules, serve deadlines)
+    ("flightrec.dumps", COUNTER, "bundles",
+     "diagnostic bundles written by the flight recorder (on-demand "
+     "dumps + capped auto-dumps on CylonErrors escaping served "
+     "queries)"),
+    ("serve.slo_violations", COUNTER, "violations",
+     "SLO violations: per-query deadline misses "
+     "(submit(deadline_ms=...)) plus rolling-window anomaly alerts "
+     "from the time-series sampler (p99 drift, QPS collapse, cache-hit "
+     "collapse) — bench emits it, benchdiff gates it UP"),
 )
 
 

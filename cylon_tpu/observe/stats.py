@@ -206,9 +206,18 @@ class StatsStore:
             self._save_locked()
 
     def _flush_at_exit(self) -> None:
-        with self._lock:
+        # bounded acquire: at interpreter exit a daemon thread (a serve
+        # dispatcher mid-record, a sampler) can hold the lock and then
+        # be frozen by runtime teardown — a plain acquire would hang
+        # the whole exit inside atexit.  Missing one final flush beats
+        # deadlocking shutdown; explicit save() remains unbounded.
+        if not self._lock.acquire(timeout=2.0):
+            return
+        try:
             if self._dirty:
                 self._save_locked()
+        finally:
+            self._lock.release()
 
     def save(self, path: Optional[str] = None) -> None:
         """Explicit save (to ``path`` or the resolved default)."""
@@ -266,6 +275,11 @@ class StatsStore:
                 "bytes_moved": rt.get("bytes_moved", 0),
                 "decision": rt.get("decision"),
                 "exchange": n.info.get("exchange"),
+                # the predicted-vs-observed audit columns the
+                # calibration CLI (analysis/calibrate.py) consumes:
+                # meshprobe ms and device-truth peak bytes per exchange
+                "exchange_ms": n.info.get("exchange_ms"),
+                "peak": n.info.get("peak"),
             })
         totals = getattr(report, "totals", {}) or {}
         self._record(digest, {

@@ -23,16 +23,43 @@ The bench's sustained-load stage (``CYLON_BENCH_SUSTAIN``) drives one of
 these for minutes under 8 client threads and emits the series into the
 BENCH artifact; benchdiff gates the steady-state summary
 (``serve_sustain_qps`` down / ``serve_sustain_p99_ms`` up).
+
+**SLO anomaly rules** (docs/observability.md "SLO rules"): every sample
+is additionally checked against the retained history — p99 drift
+(current window p99 blows past a multiple of the historical median),
+QPS collapse (throughput drops to a fraction of the historical median
+while demand is queued) and cache-hit collapse (the plan-cache hit
+ratio falls off a healthy baseline).  Each firing raises a structured
+alert: a ``glog.warn_once`` line under a string-literal alert key (the
+lint-enforced once-per-rule rate limit), a ``serve.slo_violations``
+counter bump, a flight-recorder event, and an entry in
+``sampler.alerts`` for programmatic consumers.
 """
 from __future__ import annotations
 
+import atexit
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 from .metrics import REGISTRY
 
 __all__ = ["TimeSeriesSampler"]
+
+# live samplers, stopped at interpreter exit so no daemon thread is
+# still sampling while the runtime tears down (deterministic shutdown —
+# the serve-session satellite of docs/observability.md)
+_live_samplers: "weakref.WeakSet" = weakref.WeakSet()
+_atexit_registered = False
+
+
+def _stop_live_samplers() -> None:
+    for s in list(_live_samplers):
+        try:
+            s.stop()
+        except Exception:  # graftlint: ok[broad-except] — shutdown
+            pass            # must never raise out of atexit
 
 
 def _percentile(sorted_xs: List[float], q: float) -> Optional[float]:
@@ -60,10 +87,19 @@ class TimeSeriesSampler:
     Use as a context manager (``with TimeSeriesSampler(...) as s:``) or
     via ``start()``/``stop()``; ``sample_once()`` takes one sample
     synchronously (tests, ad-hoc probes) without the thread.
+
+    Anomaly-rule knobs (module docstring; all relative to the retained
+    history): ``alerts`` switches the rules off wholesale;
+    ``min_history`` samples must exist before any rule can fire;
+    ``p99_drift_factor`` / ``qps_collapse_frac`` / ``hit_collapse_frac``
+    are the rule thresholds.  Fired alerts land in ``self.alerts``.
     """
 
     def __init__(self, period_s: float = 0.25, capacity: int = 512,
-                 session=None) -> None:
+                 session=None, alerts: bool = True,
+                 min_history: int = 8, p99_drift_factor: float = 3.0,
+                 qps_collapse_frac: float = 0.25,
+                 hit_collapse_frac: float = 0.5) -> None:
         from ..status import Code, CylonError, Status
         if period_s <= 0:
             raise CylonError(Status(Code.Invalid,
@@ -73,6 +109,12 @@ class TimeSeriesSampler:
                 f"sampler capacity must be >= 1, got {capacity}"))
         self.period_s = period_s
         self.capacity = capacity
+        self.alerts_enabled = alerts
+        self.min_history = min_history
+        self.p99_drift_factor = p99_drift_factor
+        self.qps_collapse_frac = qps_collapse_frac
+        self.hit_collapse_frac = hit_collapse_frac
+        self.alerts: List[Dict[str, Any]] = []
         self._session = session
         self._lock = threading.Lock()
         self._buf: List[Optional[Dict[str, Any]]] = [None] * capacity
@@ -92,21 +134,37 @@ class TimeSeriesSampler:
     def start(self) -> "TimeSeriesSampler":
         if self._thread is not None:
             return self
+        global _atexit_registered
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="telemetry-sampler",
                                         daemon=True)
+        _live_samplers.add(self)
+        if not _atexit_registered:
+            # one process-wide hook stopping still-live samplers before
+            # the runtime tears down (deterministic shutdown: no daemon
+            # thread samples a half-destructed registry at exit)
+            atexit.register(_stop_live_samplers)
+            _atexit_registered = True
         self._thread.start()
         return self
 
-    def stop(self) -> None:
-        """Stop the sampling thread (samples stay readable).  Takes one
-        final sample so short runs never end empty-handed."""
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the sampling thread DETERMINISTICALLY (join bounded by
+        ``timeout`` — the loop wakes at most one period later, so the
+        join returns promptly; a wedged thread is warned about, never
+        waited on forever).  Samples stay readable; one final sample is
+        taken so short runs never end empty-handed.  Idempotent."""
         t = self._thread
         self._stop.set()
         if t is not None:
-            t.join()
+            t.join(timeout)
+            if t.is_alive():
+                from .. import logging as glog
+                glog.warning("telemetry sampler thread did not stop "
+                             "within %.1f s", timeout)
             self._thread = None
+        _live_samplers.discard(self)
         self.sample_once()
 
     def __enter__(self) -> "TimeSeriesSampler":
@@ -180,10 +238,95 @@ class TimeSeriesSampler:
         self._prev_completed = completed
         self._prev_cache = (hits, misses)
         self._prev_shared = shared
+        if self.alerts_enabled:
+            # check BEFORE appending: the rules compare the new sample
+            # against the retained history, not against itself
+            try:
+                self._check_anomalies(sample)
+            except Exception:  # graftlint: ok[broad-except] — a rule
+                pass            # bug must never take the sampler down
+        self._append(sample)
+        return sample
+
+    def _append(self, sample: Dict[str, Any]) -> None:
         with self._lock:
             self._buf[self._n % self.capacity] = sample
             self._n += 1
-        return sample
+
+    # -- rolling-window anomaly rules (docs/observability.md) ---------------
+
+    def _check_anomalies(self, sample: Dict[str, Any]) -> None:
+        history = self.samples()
+        if len(history) < self.min_history:
+            return
+        # p99 drift: the current window's tail latency blows past a
+        # multiple of the historical median — an admission, sharing or
+        # retrace regression surfacing in the tail first
+        p99s = sorted(s["p99_ms"] for s in history
+                      if s.get("p99_ms") is not None)
+        base_p99 = _percentile(p99s, 50) if p99s else None
+        cur_p99 = sample.get("p99_ms")
+        if (base_p99 and cur_p99 is not None
+                and cur_p99 > self.p99_drift_factor * base_p99):
+            self._alert("p99-drift", sample,
+                        f"window p99 {cur_p99:.1f} ms > "
+                        f"{self.p99_drift_factor:.1f}x the "
+                        f"{base_p99:.1f} ms historical median")
+        # QPS collapse: completions dropped to a fraction of the
+        # historical median WHILE demand is queued (an idle session is
+        # not a collapse)
+        qs = sorted(s["qps"] for s in history if s.get("qps", 0) > 0)
+        base_qps = _percentile(qs, 50) if qs else None
+        if (base_qps and sample.get("queue_depth", 0) > 0
+                and sample.get("qps", 0.0)
+                < self.qps_collapse_frac * base_qps):
+            self._alert("qps-collapse", sample,
+                        f"window QPS {sample.get('qps', 0.0):.2f} < "
+                        f"{self.qps_collapse_frac:.2f}x the "
+                        f"{base_qps:.2f} historical median with "
+                        f"{sample.get('queue_depth', 0)} queued")
+        # cache-hit collapse: the plan-cache hit ratio fell off a
+        # healthy baseline (eviction churn / fingerprint instability)
+        ratios = [s["cache_hit_ratio"] for s in history
+                  if s.get("cache_hit_ratio") is not None]
+        cur_ratio = sample.get("cache_hit_ratio")
+        if ratios and cur_ratio is not None:
+            base_ratio = sum(ratios) / len(ratios)
+            if (base_ratio >= 0.5
+                    and cur_ratio < self.hit_collapse_frac * base_ratio):
+                self._alert("cache-hit-collapse", sample,
+                            f"window hit ratio {cur_ratio:.2f} < "
+                            f"{self.hit_collapse_frac:.2f}x the "
+                            f"{base_ratio:.2f} baseline")
+
+    def _alert(self, rule: str, sample: Dict[str, Any],
+               detail: str) -> None:
+        """One structured SLO alert: warn_once line (string-literal
+        key per rule — the graftlint-enforced contract), counter bump,
+        session tally, flight-recorder event, local log entry."""
+        from .. import logging as glog
+        from .. import trace
+        from . import flightrec
+        trace.count("serve.slo_violations")
+        if self._session is not None:
+            try:
+                self._session._tally("slo_violations")
+            except Exception:  # graftlint: ok[broad-except] — a
+                pass            # session mid-close must not kill alerts
+        flightrec.note("alert", rule=rule, detail=detail,
+                       sample_t=sample.get("t"))
+        self.alerts.append({"t": sample.get("t"), "rule": rule,
+                            "detail": detail})
+        del self.alerts[:-64]   # bounded like everything else here
+        msg = f"SLO alert [{rule}]: {detail} (logged once per rule " \
+              f"per process — sampler.alerts and the serve tally " \
+              f"record every firing; docs/observability.md 'SLO rules')"
+        if rule == "p99-drift":
+            glog.warn_once("slo.p99-drift", "%s", msg)
+        elif rule == "qps-collapse":
+            glog.warn_once("slo.qps-collapse", "%s", msg)
+        else:
+            glog.warn_once("slo.cache-hit-collapse", "%s", msg)
 
     # -- reads --------------------------------------------------------------
 

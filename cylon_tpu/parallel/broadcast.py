@@ -49,7 +49,6 @@ joins" for threshold semantics and the planner matrix.
 """
 from __future__ import annotations
 
-import functools
 import threading
 from typing import Optional, Tuple
 
@@ -60,6 +59,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import trace
 from ..analysis import plan_check
+from ..observe.compile import kernel_factory
 from ..analysis._abstract import is_abstract
 from ..config import broadcast_join_threshold
 from ..ops import compact as ops_compact
@@ -76,7 +76,7 @@ def _bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
     return mask.reshape(mask.shape + (1,) * (like.ndim - mask.ndim))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _gather_fn(mesh, axis: str, cap: int, outcap: int, head_only: bool):
     """Per shard: all_gather every leaf, drop the per-shard padding, and
     pack the survivors into a [outcap] block — identical on every shard.

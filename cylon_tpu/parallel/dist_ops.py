@@ -21,7 +21,6 @@ splitters.
 """
 from __future__ import annotations
 
-import functools
 from typing import List, Sequence, Tuple, Union
 
 import jax
@@ -34,6 +33,7 @@ from .. import trace
 from ..analysis import plan_check
 from ..config import JoinAlgorithm, JoinConfig
 from ..dtypes import DataType, is_dictionary_encoded
+from ..observe.compile import kernel_factory
 from ..ops import compact as ops_compact
 from ..ops import gather as ops_gather
 from ..ops import groupby as ops_groupby
@@ -54,7 +54,7 @@ _SAMPLES_PER_SHARD = 64  # sample-sort oversampling factor
 # helpers: row masks, partition ids, dictionary unification across DTables
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _mask_fn(mesh, axis: str, cap: int):
     """counts [P] → valid-row mask [P*cap] (True for rows < shard count)."""
 
@@ -91,7 +91,7 @@ def _cleared(dt: DTable) -> DTable:
     return DTable(dt.ctx, dt.columns, dt.cap, dt.counts)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _hash_pids_fn(mesh, axis: str, cap: int, nparts: int, use_pallas: bool):
     def kernel(cnt_blk, cols, valids):
         mask = jnp.arange(cap) < cnt_blk[0]
@@ -252,7 +252,7 @@ def shuffle_table(dt: DTable, key_columns: Sequence[Union[int, str]]
 # distributed join (reference: DistributedJoinTables, table_api.cpp:299-352)
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _join_phase1_fn(mesh, axis: str, how: str, alg: str, carried: bool):
     """Phase 1 per shard: the join "plan" + replicated output counts.
 
@@ -304,7 +304,7 @@ def _join_phase1_fn(mesh, axis: str, how: str, alg: str, carried: bool):
                              check_vma=False))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _join_phase2_fn(mesh, axis: str, how: str, alg: str, capacity: int,
                     fill_left: bool, fill_right: bool, carried: bool):
     def kernel(l_cnt, r_cnt, state, l_leaves, r_leaves):
@@ -405,7 +405,7 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig,
                                config.join_type.value, alg)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _fk_probe_fn(mesh, axis: str, cap_l: int, cap_r: int, lo: int, hi: int,
                  stride: int, has_lv: bool, has_rv: bool,
                  has_lmask: bool = False):
@@ -459,7 +459,7 @@ def _fk_probe_fn(mesh, axis: str, cap_l: int, cap_r: int, lo: int, hi: int,
                              out_specs=(spec, spec, P()), check_vma=False))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _fk_rgather_fn(mesh, axis: str, nleaves: int, fill: bool):
     """Gather the build-side output columns at the per-output build index
     (−1 ⇒ null when ``fill``)."""
@@ -979,7 +979,7 @@ def dist_multiway_join(fact: DTable, dims: Sequence[DTable],
 # table_api.cpp:904-975 — shuffle BOTH tables hashing on ALL columns)
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _setop_fn(mesh, axis: str, op: str, cap_a: int, cap_b: int,
               has_validity: Tuple[bool, ...]):
     capacity = cap_a + cap_b if op == ops_setops.UNION else cap_a
@@ -1057,7 +1057,7 @@ def dist_subtract(a: DTable, b: DTable) -> DTable:
 # distributed groupby-aggregate (BASELINE config 3; absent in reference v0)
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _groupby_phase1_fn(mesh, axis: str, cap: int, has_where: bool):
     """Group structure + replicated per-shard group counts (tiny).
 
@@ -1090,7 +1090,7 @@ def _groupby_phase1_fn(mesh, axis: str, cap: int, has_where: bool):
                              check_vma=False))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _groupby_phase2_fn(mesh, axis: str, aggs: Tuple[str, ...], out_cap: int,
                        slot_map: Tuple[int, ...]):
     """Aggregations + key gather into a bucketed [out_cap] block.
@@ -1126,7 +1126,7 @@ def _groupby_phase2_fn(mesh, axis: str, aggs: Tuple[str, ...], out_cap: int,
                              in_specs=(spec,) * 4, out_specs=(spec,) * 4))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _dense_phase1_fn(mesh, axis: str, cap: int, lo: int, hi: int,
                      has_kvalid: bool, has_where: bool, stride: int):
     """Dense-key phase 1: slot ids + slot counts + replicated
@@ -1151,7 +1151,7 @@ def _dense_phase1_fn(mesh, axis: str, cap: int, lo: int, hi: int,
                              out_specs=(spec, spec, P()), check_vma=False))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _dense_phase2_fn(mesh, axis: str, aggs: Tuple[str, ...], out_cap: int,
                      lo: int, key_dtype_str: str, has_null_slot: bool,
                      slot_map: Tuple[int, ...], stride: int,
@@ -1381,7 +1381,7 @@ def _mod_pids(dt: DTable, key_id: int, lo: int, nparts: int) -> jax.Array:
     return fn(dt.counts, kc.data, kc.validity)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _mod_pids_fn(mesh, axis: str, cap: int, lo: int, nparts: int,
                  has_kv: bool):
     def kernel(cnt_blk, kd, kv):
@@ -1600,7 +1600,7 @@ def _combine_leaf_spec(part: DTable, K: int, partial_ops) -> Tuple:
 _PSUM_SLOT_CAP = 4096
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _psum_combine_fn(mesh, axis: str, cap: int, domains: Tuple,
                      lanes: Tuple[str, ...], out_cap: int,
                      has_where: bool):
@@ -1875,7 +1875,7 @@ def dist_groupby_fused(dt: DTable, key_columns: Sequence[Union[int, str]],
     return _recompose_partials(dt, aggregations, plan, comb, K)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _scalar_agg_fn(mesh, axis: str, cap: int, aggs: Tuple[str, ...],
                    has_where: bool):
     """Whole-table reductions: per-shard masked fold + one psum each —
@@ -1982,7 +1982,7 @@ def dist_aggregate(dt: DTable,
 # distributed sample-sort (BASELINE config 4; absent in reference v0)
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _sample_fn(mesh, axis: str, cap: int, nsamples: int, ascending: bool):
     """Per shard: nsamples evenly-spaced order statistics of the non-null
     valid rows + a per-sample validity flag."""
@@ -2004,7 +2004,7 @@ def _sample_fn(mesh, axis: str, cap: int, nsamples: int, ascending: bool):
                              in_specs=(spec,) * 3, out_specs=(spec, spec)))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _pool_splitters_fn(mesh, axis: str, nsides: int, nparts: int,
                        ascending: bool):
     """Pool every side's per-shard samples (all_gather), sort the pool on
@@ -2318,7 +2318,7 @@ def dist_select(dt: DTable, predicate, params=(), compact: bool = True
                               "select.gather")
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _semi_mask_dense_fn(mesh, axis: str, cap_l: int, cap_r: int,
                         lo: int, hi: int, anti: bool,
                         has_lv: bool, has_rv: bool, stride: int = 1,
@@ -2378,7 +2378,7 @@ def _semi_mask_dense_fn(mesh, axis: str, cap_l: int, cap_r: int,
                              out_specs=(spec, P()), check_vma=False))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _semi_mask_fn(mesh, axis: str, cap_l: int, cap_r: int, anti: bool):
     """Keep-mask for semi/anti join + replicated survivor counts."""
 
@@ -2616,7 +2616,7 @@ def dist_head(dt: DTable, n: int) -> "Table":
     return dt.head(n)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _local_sort_multi_fn(mesh, axis: str, cap: int, nkeys: int,
                          ascending: Tuple[bool, ...]):
     def kernel(cnt, key_leaves, leaves):
@@ -2667,7 +2667,7 @@ def dist_sort_multi(dt: DTable, sort_columns: Sequence[Union[int, str]],
     return DTable(dt.ctx, cols, sh.cap, sh.counts)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _local_sort_fn(mesh, axis: str, cap: int, ascending: bool):
     def kernel(cnt, key_leaf, leaves):
         col, validity = key_leaf

@@ -36,7 +36,6 @@ reason annotated on the plan and tallied in ``shuffle.strategy.*``.
 """
 from __future__ import annotations
 
-import functools
 import threading
 import time
 from typing import List, Sequence, Tuple
@@ -48,6 +47,7 @@ from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import trace
+from ..observe.compile import kernel_factory
 from ..ops import compact as ops_compact
 from ..ops import gather as ops_gather
 from . import cost
@@ -166,7 +166,7 @@ def _warn_skew(Pn: int, hint_key, per_recv: np.ndarray,
         mean_recv, outcap, outcap / mean_recv)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _counts_fn(mesh, axis: str, nparts: int):
     """pid [P*cap] → counts [P, P]; counts[s, t] = rows sender s has for t.
 
@@ -185,7 +185,7 @@ def _counts_fn(mesh, axis: str, nparts: int):
                              check_vma=False))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
     """The exchange program: group-by-target, all_to_all, compact.
 
@@ -258,7 +258,7 @@ def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
 # order).
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _ring_exchange_fn(mesh, axis: str, nparts: int, block: int,
                       outcap: int):
     """Staged ring exchange: P−1 ``lax.ppermute`` rounds, round r moving
@@ -331,7 +331,7 @@ def _ring_exchange_fn(mesh, axis: str, nparts: int, block: int,
     return jax.jit(f)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _allgather_exchange_fn(mesh, axis: str, nparts: int, outcap: int):
     """Replicate-and-filter exchange: one ``lax.all_gather`` per leaf
     (plus the pid lane), each shard keeping the gathered rows targeted
@@ -386,6 +386,7 @@ def _staged_exchange(ctx, pid, leaves, choice, outcap_total: int):
     ``(leaves, counts, outcap)`` contract as the single-shot dispatch."""
     mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
     trace.count_max("shuffle.exchange_bytes_peak", choice.peak_bytes)
+    dm0 = _devmem_before(ctx)
     t0 = time.perf_counter()
     with trace.span_sync("shuffle.exchange") as sp:
         if choice.strategy == cost.RING:
@@ -396,7 +397,7 @@ def _staged_exchange(ctx, pid, leaves, choice, outcap_total: int):
             newcounts, outs = _allgather_exchange_fn(
                 mesh, axis, Pn, outcap_total)(pid, tuple(leaves))
         sp.sync(outs)
-    _note_exchange_ms(ctx, choice, t0)
+    _note_exchange_ms(ctx, choice, t0, dm0)
     return list(outs), newcounts, outcap_total
 
 
@@ -411,35 +412,86 @@ def _note_choice(choice, reason: str) -> None:
     trace.count(cost.strategy_counter(choice.strategy))
     if choice.strategy != cost.SINGLE_SHOT:
         trace.count("shuffle.strategy.downgrades")
+        # a downgrade is exactly the decision a post-mortem wants to
+        # see in context — one bounded ring event, not a log line
+        from ..observe import flightrec
+        flightrec.note("exchange_choice", strategy=choice.strategy,
+                       reason=reason[:200])
     plan_check.annotate_append("exchange", f"{choice.strategy}: {reason}")
 
 
-def _note_exchange_ms(ctx, choice, t0: float) -> None:
-    """Annotate one completed exchange with predicted-vs-observed ms
-    (docs/observability.md "the mesh bandwidth profile").  Predicted
-    comes from the meshprobe-fitted coefficients of THIS mesh
-    (cost.predicted_ms); observed is wall-clock from ``t0`` to now —
-    under ANALYZE the span sync makes it completion-honest, under plain
-    async dispatch it is dispatch-side only.  Silent without a probed
-    profile: the annotation reports measurements, it never invents
-    them.  Early-exits outside a plan capture (annotate_append would be
-    a no-op anyway) so plain production dispatch pays one thread-local
-    read, not a profile lookup."""
+def _mesh_device(ctx):
+    """First device of the context's mesh — the device whose allocator
+    the devmem sampler reads (single-controller: one device's watermark
+    is representative; every shard runs the same program)."""
+    try:
+        return next(iter(ctx.mesh.devices.flat))
+    except Exception:  # graftlint: ok[broad-except] — device layout
+        return None     # varies by jax version; None = default device
+
+
+def _devmem_before(ctx):
+    """Pre-exchange device-memory snapshot (observe.devmem) — taken
+    ONLY under an active plan capture: ``memory_stats`` may be an RPC
+    on tunneled backends and the live-buffer walk is O(live arrays), so
+    production dispatch pays one thread-local read and nothing else."""
+    from ..analysis import plan_check
+    if not plan_check.capturing():
+        return None
+    from ..observe import devmem
+    try:
+        return devmem.snapshot(_mesh_device(ctx))
+    except Exception:  # graftlint: ok[broad-except] — the sample is
+        return None     # telemetry; the exchange must run regardless
+
+
+def _note_exchange_ms(ctx, choice, t0: float, dm0=None) -> None:
+    """Annotate one completed exchange with its predicted-vs-observed
+    measurements — BOTH audit columns of the cost model:
+
+      * ``exchange_ms`` — predicted from the meshprobe-fitted
+        coefficients of THIS mesh (cost.predicted_ms) vs wall-clock
+        from ``t0`` — under ANALYZE the span sync makes the observation
+        completion-honest, under plain async dispatch it is
+        dispatch-side only.  Silent without a probed profile: the
+        annotation reports measurements, it never invents them.
+      * ``peak`` — the strategy's priced ``peak_bytes`` vs the
+        device-truth transient between the ``dm0`` snapshot and now
+        (observe.devmem; allocator watermark where the backend has one,
+        live-buffer delta — a documented lower bound — on CPU).  Also
+        watermarked as ``devmem.peak_bytes``, the measured twin of
+        ``shuffle.exchange_bytes_peak``.
+
+    Early-exits outside a plan capture (annotate_append would be a
+    no-op anyway) so plain production dispatch pays one thread-local
+    read, not a profile lookup or an allocator read."""
     from ..analysis import plan_check
     if not plan_check.capturing():
         return
     from . import meshprobe
     profile = meshprobe.get_profile(ctx)
-    if profile is None:
-        return
-    pred = cost.predicted_ms(choice, profile)
-    if pred is None:
-        return
-    observed = (time.perf_counter() - t0) * 1e3
-    plan_check.annotate_append(
-        "exchange_ms",
-        f"{choice.strategy}: predicted {pred:.2f} / observed "
-        f"{observed:.2f} ms")
+    if profile is not None:
+        pred = cost.predicted_ms(choice, profile)
+        if pred is not None:
+            observed = (time.perf_counter() - t0) * 1e3
+            plan_check.annotate_append(
+                "exchange_ms",
+                f"{choice.strategy}: predicted {pred:.2f} / observed "
+                f"{observed:.2f} ms")
+    if dm0 is not None:
+        from ..observe import devmem
+        try:
+            after = devmem.snapshot(_mesh_device(ctx))
+        except Exception:  # graftlint: ok[broad-except] — telemetry
+            after = None
+        obs = devmem.observed_exchange_bytes(dm0, after)
+        if obs is not None:
+            trace.count_max("devmem.peak_bytes", obs)
+            plan_check.annotate_append(
+                "peak",
+                f"{choice.strategy}: predicted {choice.peak_bytes} / "
+                f"observed {obs} bytes ({after.source})")
+
 
 
 # ---------------------------------------------------------------------------
@@ -452,7 +504,7 @@ def _note_exchange_ms(ctx, choice, t0: float) -> None:
 # compiles (rank, slice, fold) + one exchange shape.
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _rank_fn(mesh, axis: str, nparts: int):
     """pid [P*cap] → per-row rank within its (shard, target) cell.
 
@@ -475,7 +527,7 @@ def _rank_fn(mesh, axis: str, nparts: int):
                              in_specs=P(axis), out_specs=P(axis)))
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _slice_pids_fn(nparts: int):
     """(pid, rank, lo, hi) → pid with rows outside the [lo, hi) rank
     slice retargeted to P (dropped by the exchange).  lo/hi are traced
@@ -489,7 +541,7 @@ def _slice_pids_fn(nparts: int):
     return jax.jit(f)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _fold_fn(mesh, axis: str, incap: int, outcap: int, fresh: bool):
     """Receiver-side concatenation of one round's compacted output into
     the final block: per shard, scatter the round's ``rcnt`` valid rows
@@ -528,7 +580,7 @@ def _fold_fn(mesh, axis: str, incap: int, outcap: int, fresh: bool):
     return jax.jit(f)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _fold_combine_fn(mesh, axis: str, spec, incap: int, acc_cap: int,
                      out_cap: int, fresh: bool):
     """Receiver-side fold of one chunk round that COMBINES partial-group
@@ -644,6 +696,7 @@ def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
     plan_check.annotate(
         degraded=f"chunked shuffle: {rounds} rounds of <= {C} rows/cell "
                  f"({priced_k} B/round vs {budget} B budget)")
+    dm0 = _devmem_before(ctx)
     t_ex0 = time.perf_counter()
     with trace.span_sync("shuffle.exchange") as sp:
         rank = _rank_fn(mesh, axis, Pn)(pid)
@@ -699,7 +752,7 @@ def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
                     ops_compact._read_counts(acc_cnt))
         sp.sync(acc)
     if choice is not None:
-        _note_exchange_ms(ctx, choice, t_ex0)
+        _note_exchange_ms(ctx, choice, t_ex0, dm0)
     if combine is not None:
         return list(acc), acc_cnt, acc_cap
     return list(acc), acc_cnt, outcap_total
@@ -888,11 +941,12 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
             _block_hints[hint_key] = (need, 0)
             trace.count_max("shuffle.exchange_bytes_peak",
                             choice.peak_bytes)
+            dm0 = _devmem_before(ctx)
             t_ex0 = time.perf_counter()
             with trace.span_sync("shuffle.exchange") as sp:
                 newcounts, outs = dispatch(need)
                 sp.sync(outs)
-            _note_exchange_ms(ctx, choice, t_ex0)
+            _note_exchange_ms(ctx, choice, t_ex0, dm0)
             return list(outs), newcounts, outcap
         if choice.strategy == cost.CHUNKED:
             return _chunked_exchange(ctx, pid, leaves, counts, rbytes,
@@ -901,6 +955,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
         return _staged_exchange(ctx, pid, leaves, choice, outcap)
 
     try:
+        dm0 = _devmem_before(ctx)
         t_ex0 = time.perf_counter()
         with trace.span_sync("shuffle.exchange") as sp:
             (newcounts, outs), used, counts = \
@@ -923,5 +978,5 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
                         _priced_bytes(Pn, used, rbytes))
         _note_exchange_ms(
             ctx, cost.price_single_shot(Pn, used[0], used[1], rbytes),
-            t_ex0)
+            t_ex0, dm0)
     return list(outs), newcounts, used[1]

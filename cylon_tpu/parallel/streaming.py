@@ -31,7 +31,6 @@ stays on the submitting thread; only the host-boundary tail moves.
 """
 from __future__ import annotations
 
-import functools
 import math
 import queue as _queue
 import threading
@@ -45,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 from .. import trace
 from ..analysis import plan_check
 from ..config import JoinConfig
+from ..observe.compile import kernel_factory
 from ..ops import compact as ops_compact
 from ..ops import gather as ops_gather
 from .dist_ops import (_copartition, _join_copartitioned, _join_prologue,
@@ -52,7 +52,7 @@ from .dist_ops import (_copartition, _join_copartitioned, _join_prologue,
 from .dtable import DColumn, DTable
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _slice_fn(nparts: int, cap: int, lo: int, hi: int):
     w = hi - lo
 
@@ -74,7 +74,7 @@ def _slice_rows(dt: DTable, lo: int, hi: int) -> DTable:
     return DTable(dt.ctx, cols, w, counts)
 
 
-@functools.lru_cache(maxsize=None)
+@kernel_factory
 def _repack_fn(mesh, axis: str, caps: Tuple[int, ...], outcap: int,
                has_v: Tuple[bool, ...]):
     """Concat per-chunk shard blocks and compact valid rows to the front,
@@ -288,11 +288,15 @@ class HostPipeline:
         """Block until every submitted task has finished."""
         self._q.join()
 
-    def close(self) -> None:
-        """Drain outstanding tasks, then stop the workers.  Idempotent.
-        The lock orders this against racing ``submit``s: any task that
-        won the race is in the queue before ``_closed`` flips, so the
-        join below waits for it — nothing lands behind the sentinels."""
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain outstanding tasks, then stop the workers
+        DETERMINISTICALLY (each worker join bounded by ``timeout``; a
+        worker that fails to stop — which the sentinel protocol should
+        make impossible — is warned about, never waited on forever).
+        Idempotent.  The lock orders this against racing ``submit``s:
+        any task that won the race is in the queue before ``_closed``
+        flips, so the join below waits for it — nothing lands behind
+        the sentinels."""
         with self._lock:
             if self._closed:
                 return
@@ -301,4 +305,8 @@ class HostPipeline:
         for _ in self._threads:
             self._q.put(None)
         for t in self._threads:
-            t.join()
+            t.join(timeout)
+            if t.is_alive():
+                from .. import logging as glog
+                glog.warning("host-pipeline worker %s did not stop "
+                             "within %.1f s", t.name, timeout)

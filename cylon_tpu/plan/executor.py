@@ -483,10 +483,18 @@ def materialize(builder, root: Node):
     _obstats.note_plan(key)
     entry = _cache_get(key)
     if entry is None:
+        # plan-altitude compile tracking (observe.compile): the rewrite
+        # + frozen-copy cost of a cache miss is the plan-level sibling
+        # of a kernel build — compile.plan_build_us separates "this
+        # query re-planned" from "this query was slow"
+        import time as _time
+        t0 = _time.perf_counter()
         opt_root, fires, pre_b, post_b = rules.optimize(builder, root)
         entry = _Entry(_frozen_copy(opt_root), fires, pre_b, post_b)
         _cache_put(key, entry)
         trace.count("plan.cache_miss")
+        trace.count("compile.plan_build_us",
+                    int((_time.perf_counter() - t0) * 1e6))
         builder.stats["cache_misses"] += 1
     else:
         trace.count("plan.cache_hit")

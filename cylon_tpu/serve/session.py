@@ -70,10 +70,12 @@ class QueryHandle:
                  "counters", "submitted_at", "started_at", "finished_at",
                  "execute_ms", "latency_ms", "error", "_value", "_event",
                  "trace_id", "admitted_at", "queue_wait_ms",
-                 "plan_digests")
+                 "plan_digests", "deadline_ms", "deadline_missed",
+                 "compile_ms")
 
     def __init__(self, qid: int, label: str, op: Callable, tables,
-                 export: Optional[Callable]) -> None:
+                 export: Optional[Callable],
+                 deadline_ms: Optional[float] = None) -> None:
         self.id = qid
         self.label = label
         self.op = op
@@ -82,6 +84,14 @@ class QueryHandle:
         self.status = "queued"
         self.priced_bytes: int = 0
         self.deferrals = 0
+        # per-query SLO deadline (submit(deadline_ms=...)): checked at
+        # finish time against the submit→finish latency; a miss stamps
+        # deadline_missed and bumps serve.slo_violations on the session
+        self.deadline_ms = deadline_ms
+        self.deadline_missed = False
+        # jit builds this query triggered, attributed exactly
+        # (observe.compile) — the latency-floor denominator per query
+        self.compile_ms: Optional[float] = None
         self.shared_subplans: List[str] = []   # op names served from memo
         self.counters: Dict[str, int] = {}     # this query's counter slice
         # the query-lifecycle trace id (docs/observability.md): stamps
@@ -248,6 +258,7 @@ class ServeSession:
             "submitted": 0, "admitted": 0, "deferred": 0, "rejected": 0,
             "completed": 0, "failed": 0, "batches": 0,
             "subplan_shared": 0, "exports_async": 0,
+            "slo_violations": 0,
         }
         self._latencies: List[float] = []
         self._ids = 0
@@ -263,7 +274,8 @@ class ServeSession:
     def submit(self, op: Callable, tables=_UNSET, *,
                export: Optional[Callable] = None,
                label: Optional[str] = None, block: bool = True,
-               timeout: Optional[float] = None) -> QueryHandle:
+               timeout: Optional[float] = None,
+               deadline_ms: Optional[float] = None) -> QueryHandle:
         """Enqueue one query; returns its :class:`QueryHandle`.
 
         ``op`` receives the (logically wrapped) tables and composes dist
@@ -273,15 +285,28 @@ class ServeSession:
         async export lane so its cost overlaps the next query's device
         compute.  A full queue blocks (backpressure) until space or
         ``timeout``; ``block=False`` turns that into an immediate
-        CapacityError + ``serve.rejected`` bump."""
+        CapacityError + ``serve.rejected`` bump.
+
+        ``deadline_ms`` stamps a per-query latency SLO (submit→finish,
+        export included): a query finishing past it still returns its
+        result, but ``handle.deadline_missed`` is set, the session's
+        ``slo_violations`` tally and the ``serve.slo_violations``
+        counter bump, and the flight recorder logs the miss — the
+        deadline is an observability contract, not a cancellation
+        (docs/serving.md "deadlines")."""
         if self._closed:
             raise CylonError(Status(Code.Invalid,
                 f"serve session {self.name!r} is closed"))
+        if deadline_ms is not None and not deadline_ms > 0:
+            raise CylonError(Status(Code.Invalid,
+                f"deadline_ms must be a positive latency budget, got "
+                f"{deadline_ms!r}"))
         tabs = self._tables if tables is _UNSET else tables
         with self._lock:
             self._ids += 1
             qid = self._ids
-        h = QueryHandle(qid, label or f"q{qid}", op, tabs, export)
+        h = QueryHandle(qid, label or f"q{qid}", op, tabs, export,
+                        deadline_ms=deadline_ms)
         h.priced_bytes = admission.price_query(tabs)
         self._tally("submitted")
         if not self._queue.put(h, block=block, timeout=timeout):
@@ -439,21 +464,28 @@ class ServeSession:
             # live only while still referenced by handles/exports
 
     def _execute_one(self, h: QueryHandle, memo: _SharedExecMemo) -> None:
+        from ..observe import compile as obcompile
         from ..observe import stats as obstats
         from ..plan import ir
         h.status = "running"
         h.started_at = time.perf_counter()
         memo.begin_query(h)
         deltas: Dict[str, int] = {}
+        cevents: list = []
         try:
             # the query's trace id wraps the WHOLE execution: the
             # serve.query span and every nested operator phase land on
             # this query's track in the Chrome export (the waterfall
             # view, docs/observability.md); the digest collector
             # attributes every plan-cache fingerprint the query
-            # materializes to exactly this query (observe.stats)
+            # materializes to exactly this query (observe.stats); the
+            # compile collector does the same for jit builds, so
+            # handle.compile_ms separates "this query compiled" from
+            # "this query was slow" (docs/observability.md "compile
+            # tracking")
             with trace.trace_context(h.trace_id), \
                     obstats.collect_digests() as digests, \
+                    obcompile.attribute_compiles() as cevents, \
                     resilience.counter_scope(deltas):
                 with trace.span("serve.query"):
                     b = ir.Builder(self.ctx, exec_memo=memo)
@@ -469,9 +501,12 @@ class ServeSession:
             # an escaping SystemExit must not kill the dispatcher and
             # strand every queued result()); batch peers keep executing
             h.counters = deltas
+            h.compile_ms = round(sum(e2["compile_ms"]
+                                     for e2 in cevents), 3)
             self._finish(h, error=e)
             return
         h.counters = deltas
+        h.compile_ms = round(sum(e2["compile_ms"] for e2 in cevents), 3)
         h.execute_ms = (time.perf_counter() - h.started_at) * 1e3
         # run-stats store (ROADMAP §4's recording half): the served
         # execution's counter slice lands under every plan fingerprint
@@ -506,6 +541,7 @@ class ServeSession:
 
     def _finish(self, h: QueryHandle, value=None,
                 error: Optional[BaseException] = None) -> None:
+        from ..observe import flightrec
         h.finished_at = time.perf_counter()
         h.latency_ms = (h.finished_at - h.submitted_at) * 1e3
         if error is not None:
@@ -520,4 +556,33 @@ class ServeSession:
             self._tally("completed")
             with self._lock:
                 self._latencies.append(h.latency_ms)
+        # per-query deadline SLO (submit(deadline_ms=...)): checked on
+        # the submit→finish latency — a failure past its deadline is
+        # both a failure AND an SLO violation, attributed to THIS handle
+        if h.deadline_ms is not None and h.latency_ms > h.deadline_ms:
+            h.deadline_missed = True
+            trace.count("serve.slo_violations")
+            self._tally("slo_violations")
+            flightrec.note("deadline_miss", query=h.label, qid=h.id,
+                           latency_ms=round(h.latency_ms, 3),
+                           deadline_ms=h.deadline_ms)
+        # every query completion is one bounded flight-recorder event —
+        # the "last-K queries" section of a crash bundle
+        flightrec.note("query", label=h.label, qid=h.id,
+                       status=h.status,
+                       latency_ms=round(h.latency_ms, 3),
+                       priced_bytes=h.priced_bytes,
+                       compile_ms=h.compile_ms,
+                       digests=list(h.plan_digests),
+                       counters=dict(h.counters),
+                       error=(None if error is None
+                              else f"{type(error).__name__}: "
+                                   f"{str(error)[:160]}"))
+        if isinstance(error, CylonError):
+            # the post-mortem contract (docs/observability.md "flight
+            # recorder"): a CylonError escaping a served query dumps a
+            # diagnostic bundle when CYLON_FLIGHTREC_DIR is configured
+            # (capped per process; never masks the original error)
+            flightrec.maybe_dump_on_error(
+                f"serve[{self.name}] query {h.label!r} failed", error)
         h._event.set()

@@ -262,6 +262,42 @@ def test_counter_not_in_catalogue_clean_spellings():
                   "elsewhere/fixture.py") == []
 
 
+def test_warn_once_key_literal_fires_on_dynamic_keys():
+    # a bare variable key: every call is unique — the rate limit dies
+    bad = ("from cylon_tpu import logging as glog\n"
+           "def f(key):\n"
+           "    glog.warn_once(key, 'm')\n")
+    assert _rules(bad) == ["warn-once-key-literal"]
+    # a tuple whose HEAD is dynamic is just as ungreppable
+    bad2 = ("from cylon_tpu import logging as glog\n"
+            "def f(rule, sig):\n"
+            "    glog.warn_once((rule, sig), 'm')\n")
+    assert _rules(bad2) == ["warn-once-key-literal"]
+    # f-string keys are the classic spam shape
+    bad3 = ("from cylon_tpu import logging as glog\n"
+            "def f(q):\n"
+            "    glog.warn_once(f'slo.{q}', 'm')\n")
+    assert _rules(bad3) == ["warn-once-key-literal"]
+    sup = ("from cylon_tpu import logging as glog\n"
+           "def f(key):\n"
+           "    glog.warn_once(key, 'm')"
+           "  # graftlint: ok[warn-once-key-literal]\n")
+    assert _rules(sup) == []
+
+
+def test_warn_once_key_literal_clean_shapes():
+    # the two sanctioned shapes: a literal, or a literal-headed tuple
+    clean = ("from cylon_tpu import logging as glog\n"
+             "def f(hint_key):\n"
+             "    glog.warn_once('slo.p99-drift', 'm')\n"
+             "    glog.warn_once(('shuffle.skew', hint_key), 'm')\n")
+    assert _rules(clean) == []
+    # an unrelated warn_once method on some other object is not glog's
+    other = ("def f(log, key):\n"
+             "    log.warn_once(key, 'm')\n")
+    assert _rules(other) == []
+
+
 def test_counter_not_in_catalogue_bare_names_only_in_trace_module():
     bare = "def g():\n    count('nope.metric')\n"
     assert _rules(bare, "cylon_tpu/trace.py") \
