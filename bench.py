@@ -1376,6 +1376,131 @@ def main() -> None:
                 _trace.reset()
             em.emit("sustain")
 
+        # chaos-under-sustained-load stage (docs/robustness.md
+        # "self-healing execution"): CYLON_BENCH_CHAOS=<seed> reruns the
+        # sustained serving workload with a seeded default fault plan
+        # installed — transient host reads, undersized hints, budget
+        # pressure, and mid-query stage faults all firing while 8
+        # clients drive traffic — and emits what the recovery layer
+        # made of it: the recovered-query ratio (completed / admitted;
+        # benchdiff gates it DOWN), the shed count, and p99-under-chaos
+        # (gated UP).  Rides the CYLON_BENCH_SUSTAIN duration knob.
+        chaos_seed = os.environ.get("CYLON_BENCH_CHAOS", "")
+        if q_ms and chaos_seed not in ("", "0") and sustain_s > 0 \
+                and remaining() > sustain_s + 60:
+            import threading as _threading
+
+            from cylon_tpu import faults as _faults
+            from cylon_tpu.serve import Overloaded, Quarantined, \
+                ServeSession
+            mix = _serve_mix(q_ms, pad_to=8)
+            _progress(f"chaos serving: {len(mix)} clients x "
+                      f"{sustain_s:.0f}s under FaultPlan.default"
+                      f"({chaos_seed})")
+            try:
+                _trace.enable_counters()
+                _trace.reset()
+                stop_at = time.monotonic() + sustain_s
+                lat_ok = []
+                failed = [0]
+                lat_lock = _threading.Lock()
+                fplan = _faults.FaultPlan.default(int(chaos_seed))
+                # shed_depth below the client count so depth pressure
+                # is actually reachable by 8 closed-loop clients (the
+                # default 3/4 * max_queue would make serve_chaos_shed
+                # structurally zero under this workload)
+                with _faults.active(fplan), \
+                        ServeSession(ctx, tables=dts,
+                                     batch_window_ms=8.0,
+                                     shed_depth=6) as srv:
+
+                    def chaos_client(qname):
+                        qfn = queries.QUERIES[qname]
+                        while time.monotonic() < stop_at:
+                            # unlike the clean sustain stage, chaos
+                            # clients EXPECT failures: typed overload
+                            # rejections tally as shed, query failures
+                            # tally against the recovered ratio, and
+                            # the client keeps driving load either way
+                            try:
+                                h = srv.submit(
+                                    lambda t, q=qfn: q(ctx, t),
+                                    label=qname,
+                                    export=lambda r: r.to_pandas())
+                                h.result(timeout=600)
+                            except (Overloaded, Quarantined):
+                                # typed overload rejections: the
+                                # SESSION tallies these (shed /
+                                # breaker_rejected); back off briefly
+                                # so an open breaker's cooldown is not
+                                # a µs-scale submit spin inflating the
+                                # gated p99 and the quarantine tally
+                                time.sleep(0.05)
+                                continue
+                            except Exception:  # graftlint: ok[broad-except] — chaos failures are the measurement, not an abort
+                                with lat_lock:
+                                    failed[0] += 1
+                                continue
+                            with lat_lock:
+                                lat_ok.append(h.latency_ms)
+
+                    t0 = time.perf_counter()
+                    threads = [
+                        _threading.Thread(target=chaos_client, args=(q,))
+                        for q in mix]
+                    for th in threads:
+                        th.start()
+                    for th in threads:
+                        th.join()
+                    wall = time.perf_counter() - t0
+                    stats = srv.drain()
+                from cylon_tpu.serve.session import percentile
+                c = _trace.counters()
+                lat_sorted = sorted(lat_ok)
+                done = len(lat_ok)
+                attempted = done + failed[0]
+                em.detail["serve_chaos_s"] = round(wall, 1)
+                em.detail["serve_chaos_seed"] = int(chaos_seed)
+                em.detail["serve_chaos_queries"] = attempted
+                em.detail["serve_chaos_recovered_ratio"] = round(
+                    done / attempted, 4) if attempted else None
+                # the session's own tallies are the authority — the
+                # clients deliberately do not count their Overloaded/
+                # Quarantined catches (same events, would double-count);
+                # quarantines are reported separately from shed: they
+                # are the breaker's work, not depth pressure
+                em.detail["serve_chaos_shed"] = stats.get("shed", 0)
+                em.detail["serve_chaos_quarantined"] = \
+                    stats.get("breaker_rejected", 0)
+                em.detail["serve_chaos_qps"] = round(done / wall, 3)
+                em.detail["serve_chaos_p50_ms"] = round(
+                    percentile(lat_sorted, 50), 2) if lat_sorted else None
+                em.detail["serve_chaos_p99_ms"] = round(
+                    percentile(lat_sorted, 99), 2) if lat_sorted else None
+                em.detail["serve_chaos_faults_injected"] = \
+                    c.get("fault.injected", 0)
+                em.detail["serve_chaos_stage_retries"] = \
+                    c.get("recover.stage_retries", 0)
+                em.detail["serve_chaos_replans"] = \
+                    c.get("recover.replans", 0)
+                em.detail["serve_chaos_healed"] = \
+                    c.get("recover.recovered", 0)
+                _progress(
+                    f"chaos: {em.detail['serve_chaos_recovered_ratio']}"
+                    f" recovered ratio over {attempted} queries "
+                    f"({em.detail['serve_chaos_faults_injected']} faults"
+                    f", {em.detail['serve_chaos_healed']} healed, "
+                    f"{em.detail['serve_chaos_shed']} shed), p99 "
+                    f"{em.detail['serve_chaos_p99_ms']} ms")
+            except Exception as e:  # graftlint: ok[broad-except] — the chaos stage must not kill the bench
+                print(f"chaos stage FAILED: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+                em.detail["serve_chaos_error"] = str(e)[:200]
+            finally:
+                _trace.disable_counters()
+                _trace.reset()
+            em.emit("chaos")
+
     em.detail["bench_wall_s"] = round(time.monotonic() - t_start, 1)
     em.emit("final")
 

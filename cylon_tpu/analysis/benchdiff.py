@@ -53,6 +53,12 @@ Gated metrics (relative threshold, default 15%):
     NOT gated — cold build cost varies with the persistent XLA cache)
   * ``serve_slo_violations``  deadline misses + sampler anomaly alerts
     of the serving stage (higher = worse; docs/serving.md "deadlines")
+  * ``serve_chaos_recovered_ratio``  completed / attempted queries of
+    the chaos-under-sustained-load stage (CYLON_BENCH_CHAOS; lower =
+    worse — the self-healing ladder stopped healing) and
+    ``serve_chaos_p99_ms`` tail latency under chaos (higher = worse);
+    the shed count is reported ungated (docs/robustness.md
+    "self-healing execution")
 
 A gated metric present in OLD but absent from NEW fails the gate
 outright (``MISSING``): a query that crashed or was skipped emits no ms
@@ -150,6 +156,16 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     # sampler anomaly alerts of the serving stages — any increase is a
     # tail-latency regression surfacing as violated promises
     (r"serve_slo_violations$", "up"),
+    # chaos-under-sustained-load family (docs/robustness.md
+    # "self-healing execution", CYLON_BENCH_CHAOS): the recovered-query
+    # ratio gates DOWN — fewer queries healing under the same seeded
+    # fault plan means the escalation ladder or checkpoint layer
+    # regressed — and tail latency UNDER CHAOS gates UP (with the ms
+    # floor): recovery that works but stalls the batch pipeline is a
+    # regression too.  The shed count is reported ungated (shedding
+    # MORE under pressure can be the correct response).
+    (r"serve_chaos_recovered_ratio$", "down"),
+    (r"serve_chaos_p99_ms$", "up"),
 )
 
 
@@ -271,6 +287,10 @@ def diff(old: Dict[str, float], new: Dict[str, float],
                                                          "_bytes_saved",
                                                          "_bytes_peak"))
                      else min_abs_reads if key.endswith("_host_reads")
+                     # ratio family (recovered ratio): a couple of
+                     # queries' worth of jitter on a near-1.0 baseline
+                     # must not fail CI
+                     else 0.02 if key.endswith("_ratio")
                      else 0.0)
             if abs(n - o) < floor:
                 gated = False

@@ -41,11 +41,21 @@ Stages:
      slices, a flight-recorder bundle must be written, and
      ``python -m cylon_tpu.observe.doctor`` must render it
      (``--no-doctor-smoke`` skips);
-  6. **benchdiff** (only when ``--baseline`` and a candidate artifact
+  6. **chaos-recovery smoke** (docs/robustness.md "self-healing
+     execution"): a deterministic mid-query transient is injected at an
+     exchange boundary of ONE served query — the victim must RECOVER
+     (row-identical result, ``recover.stage_retries`` in its own
+     counter slice, fewer stages replayed than the plan has), its batch
+     peers must stay untouched, and the flight-recorder bundle rendered
+     by doctor must show the escalation ladder's events
+     (``--no-chaos-smoke`` skips);
+  7. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
      including the serving families (``serve_qps``/``serve_sustain_qps``
-     down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up) and the new
-     ``tpch_<q>_recompiles`` / ``serve_slo_violations`` up-gates.
+     down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up), the
+     ``tpch_<q>_recompiles`` / ``serve_slo_violations`` up-gates, and
+     the chaos family (``serve_chaos_recovered_ratio`` down,
+     ``serve_chaos_p99_ms`` up).
 
 Exit code is the worst across stages under the shared contract: 0 clean,
 1 findings/regressions/plan errors, 2 usage or tooling errors.
@@ -73,14 +83,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/6: graftlint ==")
+    print("== ci stage 1/7: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/6: plan_check pre-flight ==")
+    print("== ci stage 2/7: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -141,7 +151,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/6: serving smoke ==")
+    print("== ci stage 3/7: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -264,7 +274,7 @@ def _stage_telemetry_smoke(sf: float) -> int:
     CONTRACTS rather than the numbers: sampler non-empty, catalogue
     compliance, export validity (one track per query trace id), stats
     store populated with per-node observations."""
-    print("== ci stage 4/6: telemetry smoke ==")
+    print("== ci stage 4/7: telemetry smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -386,7 +396,7 @@ def _stage_doctor_smoke(sf: float) -> int:
     post-mortem machinery end to end: the victim fails onto its own
     handle, peers stay row-identical to serial execution, a
     flight-recorder bundle lands on disk, and doctor renders it."""
-    print("== ci stage 5/6: doctor smoke ==")
+    print("== ci stage 5/7: doctor smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -491,10 +501,164 @@ def _stage_doctor_smoke(sf: float) -> int:
     return 1 if bad else 0
 
 
+def _stage_chaos_smoke(sf: float) -> int:
+    """Inject a deterministic mid-query transient at an exchange
+    boundary of one served query and assert the self-healing machinery
+    end to end: the victim RECOVERS (row parity, its own counter slice
+    shows the ladder's stage retry with fewer stages replayed than the
+    plan has), peers complete untouched, and the flight-recorder
+    bundle doctor renders shows the ladder's events."""
+    print("== ci stage 6/7: chaos-recovery smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import tempfile
+
+        import jax
+
+        from .. import faults, plan as planner
+        from ..context import CylonContext
+        from ..observe import doctor, flightrec
+        from ..parallel.dtable import DTable
+        from ..serve import ServeSession
+        from ..tpch import generate
+        from ..tpch.queries import QUERIES
+
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(sf, seed=7)
+        dts = {name: DTable.from_pandas(ctx, df)
+               for name, df in data.items()}
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding —
+        # the same contract as the stages above
+        print(f"chaos smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    prev_dir = os.environ.get("CYLON_FLIGHTREC_DIR")
+    tmpdir = tempfile.mkdtemp(prefix="cylon-chaos-")
+    os.environ["CYLON_FLIGHTREC_DIR"] = tmpdir
+    try:
+        from ..config import JoinConfig
+        from ..parallel import dist_groupby, dist_join
+
+        li = dts["lineitem"].column_names.index("l_orderkey")
+        oi = dts["orders"].column_names.index("o_orderkey")
+
+        def victim_op(t):
+            # two exchange stages the planner cannot fuse into one
+            # (join, then groupby over the join output): the nth=2
+            # transient below lands at the SECOND stage boundary, after
+            # stage 1's result was checkpointed — which is what makes
+            # "resume from checkpoint, replay < total stages"
+            # assertable
+            j = dist_join(t["lineitem"], t["orders"],
+                          JoinConfig.InnerJoin(li, oi))
+            return dist_groupby(j, ["lt-l_orderkey"],
+                                [("lt-l_quantity", "sum")])
+
+        serial = planner.run(ctx, victim_op, dts).to_table().to_pandas()
+        q6 = QUERIES["q6"]
+        serial_peer = planner.run(
+            ctx, lambda t: q6(ctx, t), dts).to_pandas()
+        plan = faults.FaultPlan(seed=0, rules=[
+            faults.FaultRule("exec.stage", kind="transient", nth=2)])
+        flightrec.clear()
+        # counter-only mode: the per-query slices the assertions below
+        # read (handle.counters) attribute through the registry, which
+        # records nothing while counters are off
+        from .. import trace as _trace
+        _trace.enable_counters()
+        _trace.reset()
+        with faults.active(plan), \
+                ServeSession(ctx, tables=dts, batch_window_ms=30.0) as s:
+            # the victim submits FIRST and executes first (the window
+            # runs in arrival order), so its second exchange stage is
+            # the plan-wide second exec.stage consult — the nth=2
+            # transient hits the victim mid-query, after stage 1
+            # already checkpointed
+            victim = s.submit(victim_op, label="victim")
+            peers = [s.submit(lambda t, q=q6: q(ctx, t),
+                              label=f"peer{i}",
+                              export=lambda r: r.to_pandas())
+                     for i in range(2)]
+            got = victim.result(timeout=600).to_table().to_pandas()
+            peer_results = [h.result(timeout=600) for h in peers]
+        stages = 2
+        if not got.sort_values(list(got.columns))\
+                .reset_index(drop=True).equals(
+                    serial.sort_values(list(serial.columns))
+                    .reset_index(drop=True)):
+            print("chaos smoke: the recovered victim DIVERGED from "
+                  "serial execution", file=sys.stderr)
+            bad += 1
+        vc = victim.counters
+        if not vc.get("recover.stage_retries", 0):
+            print("chaos smoke: the victim's counter slice shows no "
+                  "ladder stage retry — the fault did not exercise "
+                  "recovery", file=sys.stderr)
+            bad += 1
+        if vc.get("recover.stages_replayed", 0) >= stages:
+            print(f"chaos smoke: recovery replayed "
+                  f"{vc.get('recover.stages_replayed')} stages of a "
+                  f"{stages}-stage plan — the checkpoint resume did "
+                  "not bound the replay", file=sys.stderr)
+            bad += 1
+        for h, gotp in zip(peers, peer_results):
+            if not gotp.sort_values(list(gotp.columns))\
+                    .reset_index(drop=True).equals(
+                        serial_peer.sort_values(
+                            list(serial_peer.columns))
+                        .reset_index(drop=True)):
+                print(f"chaos smoke: {h.label} diverged from serial "
+                      "execution", file=sys.stderr)
+                bad += 1
+            if h.counters.get("fault.injected", 0) \
+                    or h.counters.get("recover.stage_retries", 0):
+                print(f"chaos smoke: {h.label}'s counter slice shows "
+                      "the victim's fault/recovery — attribution "
+                      "leaked", file=sys.stderr)
+                bad += 1
+        if not any(e.get("kind") == "recover"
+                   for e in flightrec.events()):
+            print("chaos smoke: no ladder event reached the flight "
+                  "recorder", file=sys.stderr)
+            bad += 1
+        bundle_path = flightrec.dump(reason="ci chaos-recovery smoke")
+        rc = doctor.main([bundle_path])
+        if rc != 0:
+            print(f"chaos smoke: doctor exited {rc} on the bundle",
+                  file=sys.stderr)
+            bad += 1
+        print(f"chaos smoke: victim recovered "
+              f"(retries={vc.get('recover.stage_retries', 0)}, "
+              f"replayed={vc.get('recover.stages_replayed', 0)}/"
+              f"{stages} stages), {len(peers)} peers clean, ladder in "
+              f"doctor report ({time.perf_counter() - t0:.1f}s, "
+              f"sf={sf})")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract and
+        # let the remaining stages run instead of dying with a traceback
+        print(f"chaos smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        try:
+            from .. import trace as _trace
+            _trace.disable_counters()
+            _trace.reset()
+        except Exception:  # graftlint: ok[broad-except] — best-effort
+            pass           # teardown must not mask the stage verdict
+        if prev_dir is None:
+            os.environ.pop("CYLON_FLIGHTREC_DIR", None)
+        else:
+            os.environ["CYLON_FLIGHTREC_DIR"] = prev_dir
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 6/6: benchdiff ==")
+    print("== ci stage 7/7: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -522,6 +686,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the telemetry smoke stage")
     ap.add_argument("--no-doctor-smoke", action="store_true",
                     help="skip the doctor (flight recorder) smoke stage")
+    ap.add_argument("--no-chaos-smoke", action="store_true",
+                    help="skip the chaos-recovery smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -531,24 +697,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/6: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/7: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/6: serving smoke == (skipped)")
+        print("== ci stage 3/7: serving smoke == (skipped)")
     if not args.no_telemetry_smoke:
         rcs.append(_stage_telemetry_smoke(args.tpch_sf))
     else:
-        print("== ci stage 4/6: telemetry smoke == (skipped)")
+        print("== ci stage 4/7: telemetry smoke == (skipped)")
     if not args.no_doctor_smoke:
         rcs.append(_stage_doctor_smoke(args.tpch_sf))
     else:
-        print("== ci stage 5/6: doctor smoke == (skipped)")
+        print("== ci stage 5/7: doctor smoke == (skipped)")
+    if not args.no_chaos_smoke:
+        rcs.append(_stage_chaos_smoke(args.tpch_sf))
+    else:
+        print("== ci stage 6/7: chaos-recovery smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 6/6: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 7/7: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

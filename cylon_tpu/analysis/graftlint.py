@@ -46,6 +46,16 @@ with examples):
                           these at runtime; lint catches them at commit
                           time (docs/observability.md).  Dynamic names
                           (``cost.strategy_counter(...)``) are skipped.
+  fault-point-not-in-catalogue  a string-literal point name consulted
+                          via ``faults.check``/``faults.perturb`` that
+                          has no row in the fault-point catalogue
+                          (cylon_tpu/faults.py POINTS) — the catalogue
+                          is the complete set of sanctioned failure
+                          boundaries FaultPlan authors and
+                          docs/robustness.md rely on; an uncatalogued
+                          point would be injectable but invisible.
+                          Dynamic names are skipped (mirrors
+                          counter-not-in-catalogue).
   warn-once-key-literal   a ``glog.warn_once`` whose key is neither a
                           string literal nor a tuple opening with one —
                           a fully dynamic key makes every call unique,
@@ -83,6 +93,7 @@ RULES = (
     "implicit-host-sync",
     "kernel-factory-unkeyed",
     "jit-in-loop",
+    "fault-point-not-in-catalogue",
     "raw-float64-literal",
     "shard-map-axis-literal",
     "broad-except",
@@ -266,6 +277,7 @@ class _Linter(ast.NodeVisitor):
         self._check_axis_literal(node, target)
         self._check_counter_catalogue(node, target)
         self._check_warn_once_key(node, target)
+        self._check_fault_catalogue(node, target)
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -473,6 +485,42 @@ class _Linter(ast.NodeVisitor):
                    "once-per-signature rate limit and makes the alert "
                    "family ungreppable")
 
+    # -- fault-point-not-in-catalogue ----------------------------------------
+
+    def _check_fault_catalogue(self, node: ast.Call,
+                               target: Optional[str]) -> None:
+        """Every string-literal point name consulted through
+        ``faults.check``/``faults.perturb`` must have a row in the
+        fault-point catalogue (cylon_tpu/faults.py POINTS) — the
+        catalogue is what docs/robustness.md and the chaos suite treat
+        as the complete set of sanctioned failure boundaries; an
+        uncatalogued point would be injectable but undocumented and
+        invisible to FaultPlan authors.  Mirrors
+        counter-not-in-catalogue; dynamic names are skipped."""
+        if target is None or not node.args:
+            return
+        head, _, leaf = target.rpartition(".")
+        if leaf not in ("check", "perturb"):
+            return
+        norm = self.path.replace(os.sep, "/")
+        if head not in ("faults", "_faults"):
+            # bare check()/perturb() are the faults module's own
+            # internal spellings; anywhere else a bare name is some
+            # unrelated local function, not a fault-point consult
+            if head or not norm.endswith("cylon_tpu/faults.py"):
+                return
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            return  # dynamic point name — runtime coverage owns it
+        names = _fault_point_names(self.path)
+        if names is None or arg.value in names:
+            return
+        self._emit(node, "fault-point-not-in-catalogue",
+                   f"fault point {arg.value!r} is not in the faults "
+                   "catalogue (cylon_tpu/faults.py POINTS) — add a row "
+                   "documenting what a fault there simulates")
+
     # -- dist-op-unlowered ---------------------------------------------------
 
     def _check_unlowered(self, tree: ast.Module) -> None:
@@ -565,27 +613,32 @@ _DIST_OP_RE = re.compile(r"^(dist|shuffle)_[a-z0-9_]+$")
 
 _COUNTER_FNS = {"count", "count_max", "gauge"}
 
-# path of cylon_tpu/observe/metrics.py -> frozenset of catalogued metric
-# names (or None when unreadable), mtime-keyed like _lowering_keys_cache
-_metric_names_cache: Dict[str, Tuple[float, Optional[frozenset]]] = {}
+# One shared mtime-cached "parse a catalogue literal out of a sibling
+# file" helper behind the three catalogue-backed rules.  Cache entries
+# are keyed by the catalogue file's path + mtime, so an edit during a
+# long-lived process invalidates the parse.  Every arm is best-effort:
+# an unlocatable/unparseable catalogue returns None and the rule stays
+# silent (like the symtable arm of kernel-factory-unkeyed).
+_catalogue_cache: Dict[Tuple[str, str],
+                       Tuple[float, Optional[frozenset]]] = {}
 
 
-def _metric_names(linted_path: str) -> Optional[frozenset]:
-    """Metric names of the observe catalogue, parsed from the
-    ``METRICS ... = _specs((name, kind, unit, doc), ...)`` literal in
-    cylon_tpu/observe/metrics.py (located relative to the linted file).
-    None when the catalogue cannot be found/parsed — the rule then
-    stays silent (best-effort, like the dist-op-unlowered arm)."""
+def _sibling_names(linted_path: str, anchor: str, rel_file: str,
+                   var_name: str, extract) -> Optional[frozenset]:
+    """String names extracted from the ``var_name = <literal>``
+    assignment in ``rel_file`` (located relative to ``linted_path`` via
+    its last ``anchor`` component); ``extract(value_node)`` maps the
+    assigned AST literal to a set of names or None."""
     norm = linted_path.replace(os.sep, "/")
-    idx = norm.rfind("cylon_tpu/")
+    idx = norm.rfind(anchor)
     if idx < 0:
         return None
-    cat_path = norm[:idx] + "cylon_tpu/observe/metrics.py"
+    cat_path = norm[:idx] + rel_file
     try:
         mtime = os.path.getmtime(cat_path)
     except OSError:
         return None
-    hit = _metric_names_cache.get(cat_path)
+    hit = _catalogue_cache.get((cat_path, var_name))
     if hit is not None and hit[0] == mtime:
         return hit[1]
     names: Optional[frozenset] = None
@@ -601,65 +654,59 @@ def _metric_names(linted_path: str) -> Optional[frozenset]:
                 value = node.value
             else:
                 continue
-            if not any(isinstance(t, ast.Name) and t.id == "METRICS"
+            if not any(isinstance(t, ast.Name) and t.id == var_name
                        for t in targets):
                 continue
-            if isinstance(value, ast.Call):
-                found = set()
-                for row in value.args:
-                    if (isinstance(row, ast.Tuple) and row.elts
-                            and isinstance(row.elts[0], ast.Constant)
-                            and isinstance(row.elts[0].value, str)):
-                        found.add(row.elts[0].value)
-                names = frozenset(found)
+            names = extract(value)
     except (OSError, SyntaxError):
         names = None
-    _metric_names_cache[cat_path] = (mtime, names)
+    _catalogue_cache[(cat_path, var_name)] = (mtime, names)
     return names
 
-# path of cylon_tpu/plan/executor.py -> frozenset of LOWERING keys (or
-# None when unreadable), keyed with the file's mtime so an edit during a
-# long-lived process invalidates the parse
-_lowering_keys_cache: Dict[str, Tuple[float, Optional[frozenset]]] = {}
+
+def _dict_str_keys(value: ast.AST) -> Optional[frozenset]:
+    if not isinstance(value, ast.Dict):
+        return None
+    return frozenset(k.value for k in value.keys
+                     if isinstance(k, ast.Constant)
+                     and isinstance(k.value, str))
+
+
+def _metric_names(linted_path: str) -> Optional[frozenset]:
+    """Metric names of the observe catalogue, parsed from the
+    ``METRICS ... = _specs((name, kind, unit, doc), ...)`` literal in
+    cylon_tpu/observe/metrics.py (located relative to the linted
+    file)."""
+    def rows(value: ast.AST) -> Optional[frozenset]:
+        if not isinstance(value, ast.Call):
+            return None
+        return frozenset(
+            row.elts[0].value for row in value.args
+            if isinstance(row, ast.Tuple) and row.elts
+            and isinstance(row.elts[0], ast.Constant)
+            and isinstance(row.elts[0].value, str))
+    return _sibling_names(linted_path, "cylon_tpu/",
+                          "cylon_tpu/observe/metrics.py", "METRICS",
+                          rows)
+
+
+def _fault_point_names(linted_path: str) -> Optional[frozenset]:
+    """Fault-point names of the catalogue, parsed from the
+    ``POINTS: Dict[str, str] = {...}`` literal in cylon_tpu/faults.py
+    (located relative to the linted file)."""
+    return _sibling_names(linted_path, "cylon_tpu/",
+                          "cylon_tpu/faults.py", "POINTS",
+                          _dict_str_keys)
 
 
 def _lowering_keys(linted_path: str) -> Optional[frozenset]:
     """String keys of the plan executor's LOWERING dict, located
     relative to the linted file (…/cylon_tpu/parallel/X.py →
-    …/cylon_tpu/plan/executor.py).  None when the executor cannot be
-    found/parsed — the rule then stays silent (best-effort, like the
-    symtable arm of kernel-factory-unkeyed)."""
-    norm = linted_path.replace(os.sep, "/")
-    idx = norm.rfind("cylon_tpu/parallel/")
-    if idx < 0:
-        return None
-    exec_path = norm[:idx] + "cylon_tpu/plan/executor.py"
-    try:
-        mtime = os.path.getmtime(exec_path)
-    except OSError:
-        return None
-    hit = _lowering_keys_cache.get(exec_path)
-    if hit is not None and hit[0] == mtime:
-        return hit[1]
-    keys: Optional[frozenset] = None
-    try:
-        with open(exec_path, "r", encoding="utf-8") as fh:
-            tree = ast.parse(fh.read(), filename=exec_path)
-        for node in tree.body:
-            if not isinstance(node, ast.Assign):
-                continue
-            if not any(isinstance(t, ast.Name) and t.id == "LOWERING"
-                       for t in node.targets):
-                continue
-            if isinstance(node.value, ast.Dict):
-                keys = frozenset(
-                    k.value for k in node.value.keys
-                    if isinstance(k, ast.Constant)
-                    and isinstance(k.value, str))
-    except (OSError, SyntaxError):
-        keys = None
-    _lowering_keys_cache[exec_path] = (mtime, keys)
-    return keys
+    …/cylon_tpu/plan/executor.py) — only parallel-layer files are
+    checked, so the anchor is the parallel/ component."""
+    return _sibling_names(linted_path, "cylon_tpu/parallel/",
+                          "cylon_tpu/plan/executor.py", "LOWERING",
+                          _dict_str_keys)
 
 
 def _has_handler_raise(body) -> bool:

@@ -141,7 +141,10 @@ class PlanReport:
         # (docs/robustness.md; the full map is in totals["counters"])
         for key, label in (("chunked_rounds", "chunked rounds"),
                            ("retries", "retries"),
-                           ("faults", "injected faults")):
+                           ("faults", "injected faults"),
+                           ("stage_retries", "stage retries"),
+                           ("replans", "replans"),
+                           ("stages_replayed", "stages replayed")):
             if t.get(key, 0):
                 head += f", {t[key]} {label}"
         # compile tracking (observe.compile): the build cost of this

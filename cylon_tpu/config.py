@@ -335,6 +335,42 @@ def set_optimizer_enabled(on: "Optional[bool]") -> "Optional[bool]":
 
 
 # ---------------------------------------------------------------------------
+# self-healing recovery switch (docs/robustness.md "the escalation
+# ladder"): governs whether plan/executor.materialize wraps execution in
+# the stage-checkpointed recovery driver (classified stage retry /
+# exchange replan / annotated fail) or propagates the first failure
+# unchanged.  Resolution: explicit set_recovery_enabled() >
+# CYLON_RECOVERY env (default on).  The off switch is the A/B lever for
+# isolating whether a behavior difference comes from recovery itself.
+# ---------------------------------------------------------------------------
+
+_recovery_enabled: Optional[bool] = None    # None -> env-resolved
+
+
+def recovery_enabled() -> bool:
+    """Whether the executor's self-healing recovery ladder is active
+    (explicit knob, else ``CYLON_RECOVERY`` — any value but
+    ``0``/empty enables)."""
+    if _recovery_enabled is not None:
+        return _recovery_enabled
+    return os.environ.get("CYLON_RECOVERY", "1") not in ("", "0")
+
+
+def set_recovery_enabled(on: "Optional[bool]") -> "Optional[bool]":
+    """Set the recovery switch (``None`` restores env resolution);
+    returns the previous EXPLICIT setting so callers restore it in a
+    ``finally`` — the same contract as ``set_optimizer_enabled``."""
+    global _recovery_enabled
+    if on is not None and not isinstance(on, bool):
+        raise CylonError(Status(Code.Invalid,
+            "recovery switch must be True, False or None (env-resolved), "
+            f"got {type(on).__name__} {on!r}"))
+    prev = _recovery_enabled
+    _recovery_enabled = on
+    return prev
+
+
+# ---------------------------------------------------------------------------
 # sanitizer mode (docs/static_analysis.md): the RUNTIME backstop for the
 # invariants graftlint proves statically.  When on:
 #

@@ -9,24 +9,30 @@ engine's sanctioned failure boundaries each host a **named fault point**
 call, whether that point fires.  Two shapes of fault exist:
 
   * **exception points** (``check(name)``) raise a typed
-    :class:`TransientFault` or :class:`PermanentFault` — both are
-    :class:`~cylon_tpu.status.CylonError` subclasses naming the point —
-    exactly where a real host-read / IO failure would surface.  The
-    transient class is what ``resilience.retrying`` retries; the
-    permanent class propagates immediately.
+    :class:`TransientFault`, :class:`ResourceFault` or
+    :class:`PermanentFault` — all :class:`~cylon_tpu.status.CylonError`
+    subclasses naming the point — exactly where a real host-read / IO /
+    allocation failure would surface.  The transient class is what
+    ``resilience.retrying`` retries; the resource class is what the
+    escalation ladder (``resilience.Ladder``) answers with an exchange
+    REPLAN; the permanent class propagates immediately.
   * **value points** (``perturb(name, value)``) mutate an engine-internal
     value in flight: shrink an optimistic-dispatch size hint so the
     undersized-dispatch replay machinery runs, or shrink the memory
     budget mid-query to simulate allocation pressure (degrading shuffles
     to the chunked exchange).
 
-Determinism: one ``random.Random(seed)`` drives every probability draw,
-guarded by a lock, and per-point call counters drive ``nth``/``once``
-triggers — the same seed over the same call sequence fires the same
-faults.  (Multi-threaded callers — the concurrent CSV reader — still
-draw from the one stream, so cross-thread interleaving can reorder
-draws; single-threaded runs, which is what chaos tests are, replay
-exactly.)
+Determinism: every probability draw is a pure function of ``(seed,
+point, per-point call counter, rule index)`` — a keyed blake2b hash
+mapped to [0, 1) — the per-point counters also drive ``nth`` triggers,
+and ``once``/``limit`` caps are scoped per (rule, point), never shared
+across the points of a pattern rule.  The k-th consultation of a point
+therefore decides identically no matter which thread makes it or how
+threads interleave: multi-threaded chaos runs (the concurrent CSV
+reader) replay exactly, not just single-threaded ones.  (Earlier
+versions drew from one shared ``random.Random`` stream and capped
+pattern rules across points, so cross-thread interleaving reordered
+outcomes — the documented nondeterminism this scheme removes.)
 
 Every fire bumps the ``fault.injected`` counter (visible in EXPLAIN
 ANALYZE totals) and the plan's own ``injected`` tally (visible without
@@ -44,7 +50,7 @@ from __future__ import annotations
 
 import contextlib
 import fnmatch
-import random
+import hashlib
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -52,9 +58,9 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 from .status import Code, CylonError, Status
 
 __all__ = [
-    "POINTS", "FaultError", "TransientFault", "PermanentFault",
-    "FaultRule", "FaultPlan", "install", "uninstall", "active", "plan",
-    "check", "perturb", "undersize_hint",
+    "POINTS", "FaultError", "TransientFault", "ResourceFault",
+    "PermanentFault", "FaultRule", "FaultPlan", "install", "uninstall",
+    "active", "plan", "check", "perturb", "undersize_hint",
 ]
 
 # ---------------------------------------------------------------------------
@@ -81,6 +87,28 @@ POINTS: Dict[str, str] = {
         "value point: the device memory budget read — a shrinking "
         "mutation simulates allocation pressure mid-query, degrading "
         "over-budget exchanges to the chunked multi-round path",
+    # recovery seams (docs/robustness.md "the escalation ladder"):
+    # the self-healing executor's own failure surfaces are fault points
+    # too, so the recovery machinery is chaos-testable like everything
+    # it recovers
+    "exec.stage":
+        "the plan executor's per-stage dispatch at an exchange boundary "
+        "(plan/executor._execute) — a mid-query failure between stages; "
+        "transient rules exercise stage retry from checkpoint, resource "
+        "rules exercise the replan arm, permanent rules the annotated "
+        "bundle",
+    "recover.checkpoint_restore":
+        "a stage resume from a retained checkpoint — a failed restore "
+        "drops the checkpoint and re-executes the stage from its "
+        "inputs instead",
+    "recover.replan":
+        "the escalation ladder's replan trigger — a failure here means "
+        "the degraded re-lowering itself could not be set up, and the "
+        "ladder fails the query with the annotated bundle",
+    "serve.breaker_probe":
+        "the circuit breaker's half-open probe admission "
+        "(serve/session.py) — a failure re-opens the breaker for "
+        "another cooldown instead of restoring service",
 }
 
 
@@ -99,6 +127,16 @@ class TransientFault(FaultError):
 
     def __init__(self, point: str):
         super().__init__(point, "transient")
+
+
+class ResourceFault(FaultError):
+    """An injected failure of the resource class (a typed OOM: the
+    allocation a strategy needed did not fit) — the escalation ladder
+    (``resilience.Ladder``) answers these by REPLANNING the exchange
+    onto a degraded catalogue strategy, not by blind retry."""
+
+    def __init__(self, point: str):
+        super().__init__(point, "resource")
 
 
 class PermanentFault(FaultError):
@@ -133,18 +171,25 @@ class FaultRule:
     or a total-fires cap)."""
 
     point: str                      # exact name or fnmatch pattern
-    kind: str = "transient"         # transient | permanent | value
+    kind: str = "transient"         # transient|resource|permanent|value
     probability: float = 1.0        # seeded draw per matching call
     nth: Optional[int] = None       # fire ONLY on the nth call (1-based)
-    once: bool = False              # at most one fire, ever
-    limit: Optional[int] = None     # max total fires
+    once: bool = False              # at most one fire PER POINT
+    limit: Optional[int] = None     # max fires PER POINT
     mutate: Optional[Callable] = None  # kind="value": old -> new
+    # once/limit caps are scoped per (rule, point): for an exact-name
+    # rule that is the historical "once ever", while a PATTERN rule
+    # ("io.*") caps each matching point independently — a shared
+    # cross-point cap would make which point wins the single fire
+    # depend on thread interleaving, breaking the deterministic-replay
+    # contract the per-point draws provide
 
     def __post_init__(self):
-        if self.kind not in ("transient", "permanent", "value"):
+        if self.kind not in ("transient", "resource", "permanent",
+                             "value"):
             raise CylonError(Status(Code.Invalid,
-                f"fault kind must be transient/permanent/value, "
-                f"got {self.kind!r}"))
+                f"fault kind must be transient/resource/permanent/"
+                f"value, got {self.kind!r}"))
         if self.kind == "value" and self.mutate is None:
             raise CylonError(Status(Code.Invalid,
                 f"value fault at {self.point!r} needs a mutate callable"))
@@ -158,11 +203,25 @@ class FaultPlan:
         self.seed = int(seed)
         self.rules: List[FaultRule] = list(rules)
         self.injected = 0               # total fires (no tracing needed)
-        self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
         self._calls: Dict[str, int] = {}       # point -> times consulted
-        self._fires: Dict[int, int] = {}       # rule index -> times fired
+        # (rule index, point) -> times fired: per-point caps keep
+        # once/limit deterministic under pattern rules (see FaultRule)
+        self._fires: Dict[Tuple[int, str], int] = {}
         self.fired: List[Tuple[str, str]] = []  # (point, kind) log
+
+    def _draw(self, point: str, n: int, rule_idx: int) -> float:
+        """The deterministic probability draw for the ``n``-th
+        consultation of ``point`` against rule ``rule_idx``: a keyed
+        hash mapped to [0, 1).  A pure function of its arguments, so
+        the decision is identical no matter which THREAD consults the
+        point or how concurrent consultations of OTHER points
+        interleave — the property the old shared-RNG stream lacked
+        (docs/robustness.md "fault points and plans")."""
+        h = hashlib.blake2b(
+            f"{self.seed}:{point}:{n}:{rule_idx}".encode(),
+            digest_size=8).digest()
+        return int.from_bytes(h, "big") / 2.0 ** 64
 
     @staticmethod
     def default(seed: int = 0) -> "FaultPlan":
@@ -181,6 +240,13 @@ class FaultPlan:
                       mutate=undersize_hint),
             FaultRule("resilience.budget", kind="value", probability=0.02,
                       mutate=lambda b: max(int(b) // 8, 1 << 20)),
+            # mid-query stage failures at the executor's exchange
+            # boundaries: transient ones exercise checkpointed stage
+            # retry, resource ones the replan arm of the escalation
+            # ladder (docs/robustness.md) — both recoverable, so the
+            # chaos gate covers the self-healing path end to end
+            FaultRule("exec.stage", kind="transient", probability=0.02),
+            FaultRule("exec.stage", kind="resource", probability=0.01),
         ])
 
     def _decide(self, point: str, want_value: bool) -> Optional[FaultRule]:
@@ -195,7 +261,7 @@ class FaultPlan:
                     continue
                 if not fnmatch.fnmatchcase(point, rule.point):
                     continue
-                fires = self._fires.get(i, 0)
+                fires = self._fires.get((i, point), 0)
                 if rule.once and fires >= 1:
                     continue
                 if rule.limit is not None and fires >= rule.limit:
@@ -203,9 +269,9 @@ class FaultPlan:
                 if rule.nth is not None:
                     if n != rule.nth:
                         continue
-                elif self._rng.random() >= rule.probability:
+                elif self._draw(point, n, i) >= rule.probability:
                     continue
-                self._fires[i] = fires + 1
+                self._fires[(i, point)] = fires + 1
                 self.injected += 1
                 self.fired.append((point, rule.kind))
                 return rule
@@ -258,8 +324,9 @@ def _count_injection() -> None:
 def check(point: str) -> None:
     """Exception hook: called at a sanctioned failure boundary right
     before the real operation.  No-op without an active plan (one global
-    read — the production cost).  Raises :class:`TransientFault` or
-    :class:`PermanentFault` when the plan fires."""
+    read — the production cost).  Raises :class:`TransientFault`,
+    :class:`ResourceFault` or :class:`PermanentFault` when the plan
+    fires."""
     p = _active_plan
     if p is None:
         return
@@ -269,6 +336,8 @@ def check(point: str) -> None:
     _count_injection()
     if rule.kind == "permanent":
         raise PermanentFault(point)
+    if rule.kind == "resource":
+        raise ResourceFault(point)
     raise TransientFault(point)
 
 

@@ -260,6 +260,14 @@ def analyze(op, *args, **kwargs):
             "faults": counters.get("fault.injected", 0),
             "retries": counters.get("retry.attempts", 0),
             "chunked_rounds": counters.get("shuffle.chunked_rounds", 0),
+            # self-healing visibility (docs/robustness.md): the
+            # escalation ladder's work on the analyzed run — stage
+            # retries, exchange replans, and how many completed stages
+            # recovery had to replay
+            "stage_retries": counters.get("recover.stage_retries", 0),
+            "replans": counters.get("recover.replans", 0),
+            "stages_replayed": counters.get("recover.stages_replayed",
+                                            0),
             # compilation observability (observe.compile): what this
             # run spent building jit programs, attributed exactly —
             # the EXPLAIN ANALYZE head renders it when nonzero

@@ -322,6 +322,71 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "(submit(deadline_ms=...)) plus rolling-window anomaly alerts "
      "from the time-series sampler (p99 drift, QPS collapse, cache-hit "
      "collapse) — bench emits it, benchdiff gates it UP"),
+    # self-healing recovery (docs/robustness.md "self-healing
+    # execution"): the escalation ladder's stage checkpoints, retries,
+    # replans, and outcomes in plan/executor.py
+    ("recover.checkpoints", COUNTER, "checkpoints",
+     "stage results retained at exchange boundaries by the recovery "
+     "checkpoint store (a costed decision against "
+     "RecoveryPolicy.checkpoint_fraction of the memory budget)"),
+    ("recover.checkpoint_bytes", WATERMARK, "bytes",
+     "largest total per-device footprint the checkpoint store priced "
+     "as retained at once (cost.price_retained per entry)"),
+    ("recover.checkpoint_skipped", COUNTER, "stages",
+     "exchange-boundary results NOT checkpointed because their own "
+     "retention price exceeded the checkpoint budget"),
+    ("recover.checkpoint_evictions", COUNTER, "evictions",
+     "older checkpoints evicted to admit a newer one under the "
+     "checkpoint budget (the newest checkpoint is the resume point)"),
+    ("recover.checkpoint_hits", COUNTER, "restores",
+     "stages served from a retained checkpoint during a recovery "
+     "attempt (the work partial replay did NOT redo)"),
+    ("recover.restore_failed", COUNTER, "failures",
+     "checkpoint restores that failed (recover.checkpoint_restore "
+     "fault point) — the checkpoint was dropped and the stage "
+     "recomputed from its inputs"),
+    ("recover.stages_replayed", COUNTER, "stages",
+     "exchange-boundary stages RE-executed by recovery attempts after "
+     "completing in an earlier attempt — the partial-replay proof is "
+     "this staying below the plan's stage count"),
+    ("recover.stage_retries", COUNTER, "retries",
+     "transient-classed stage retries taken by the escalation ladder "
+     "(resume from the last checkpoint, re-run downstream)"),
+    ("recover.replans", COUNTER, "replans",
+     "resource-classed replans: the ladder demoted the costed chooser "
+     "off the failed lowering and resumed from checkpoint with a "
+     "degraded catalogue strategy (chunked / ring)"),
+    ("recover.recovered", COUNTER, "queries",
+     "materializations that COMPLETED after one or more ladder "
+     "attempts — failures that healed instead of killing the query"),
+    ("recover.failures", COUNTER, "failures",
+     "ladders that gave up: an engaged ladder exhausting its rungs, or "
+     "an injected permanent fault — the error propagates annotated "
+     "with the attempt log and the flight recorder holds a "
+     "recover_failed event (organic first failures the ladder never "
+     "engaged with are annotated but NOT booked here)"),
+    # serving-layer overload protection (docs/serving.md): the
+    # per-plan circuit breaker, load shedding, and graceful drain
+    ("serve.shed", COUNTER, "queries",
+     "submissions rejected by load shedding with a typed Overloaded "
+     "error — queue-depth pressure on priority-0 work, or a deadline "
+     "the estimated queue wait already busts"),
+    ("serve.breaker_open", COUNTER, "transitions",
+     "circuit-breaker openings (threshold consecutive failures of one "
+     "plan fingerprint, or a failed half-open probe)"),
+    ("serve.breaker_rejected", COUNTER, "queries",
+     "submissions rejected in O(us) with a typed Quarantined error "
+     "because their plan fingerprint's breaker was open"),
+    ("serve.breaker_probes", COUNTER, "probes",
+     "half-open probe submissions admitted after a breaker cooldown "
+     "(exactly one in flight per fingerprint; its outcome decides "
+     "closed vs re-opened)"),
+    ("serve.breaker_closed", COUNTER, "transitions",
+     "breakers closed by a successful probe — quarantined service "
+     "restored without operator action"),
+    ("serve.drains", COUNTER, "drains",
+     "graceful session drains: admission stopped, in-flight queries "
+     "finished, async exports joined, run-stats store flushed"),
 )
 
 

@@ -79,8 +79,8 @@ __all__ = [
     "SINGLE_SHOT", "CHUNKED", "RING", "ALLGATHER", "REPLICATE",
     "STRATEGIES", "StrategyPrice", "exchange_sizes", "single_shot_bytes",
     "price_single_shot", "price_chunked", "price_ring", "price_allgather",
-    "price_replicate", "chunk_plan", "enumerate_strategies", "choose",
-    "COLLECTIVE_OF", "predicted_ms",
+    "price_replicate", "price_retained", "chunk_plan",
+    "enumerate_strategies", "choose", "COLLECTIVE_OF", "predicted_ms",
 ]
 
 SINGLE_SHOT = "single-shot"
@@ -222,6 +222,18 @@ def price_replicate(nparts: int, cap: int, outcap: int,
         rounds=1, sizes=(cap, outcap))
 
 
+def price_retained(cap: int, rbytes: int) -> int:
+    """Per-device RESIDENT bytes of retaining one materialized stage
+    result as a recovery checkpoint (plan/executor.py): the shard's
+    [cap]-row block × the payload width of one row.  Unlike every
+    transient price above, a checkpoint's footprint persists across
+    attempts — which is exactly why checkpointing is a costed decision
+    against a bounded fraction of the memory budget
+    (``resilience.RecoveryPolicy.checkpoint_fraction``), not a
+    default."""
+    return int(max(cap, 0) * max(rbytes, 1))
+
+
 def chunk_plan(nparts: int, counts: np.ndarray, rbytes: int,
                budget: int) -> Tuple[int, int, int, int]:
     """The chunk math (docs/robustness.md): pick the smallest per-round
@@ -301,12 +313,21 @@ def predicted_ms(price: StrategyPrice, profile) -> Optional[float]:
 
 def choose(candidates: Sequence[StrategyPrice], budget: int,
            forced: Optional[str] = None, profile=None,
-           measured: bool = False
+           measured: bool = False, exclude: Sequence[str] = ()
            ) -> Tuple[StrategyPrice, str, bool]:
     """Pick one strategy under ``budget``.  Returns ``(price, reason,
     feasible)`` — ``feasible`` False only on the best-effort floor
     (nothing fits; the chunked plan runs anyway, matching the
     historical budget-floor warning path).
+
+    ``exclude`` removes named strategies from consideration — the
+    escalation ladder's replan arm
+    (``resilience.demoted_exchanges``): a resource-classed failure
+    demotes the chooser off the lowerings that just failed, so the
+    retry lands on a degraded sequence with a smaller transient.  An
+    exclusion that would empty the candidate list is ignored (the
+    chooser must always answer), and ``forced`` — a diagnostic
+    override — beats it.
 
     Selection: feasible = ``peak_bytes <= budget``; among the feasible,
     minimize ``(rounds, wire_bytes, catalogue preference)``
@@ -331,12 +352,20 @@ def choose(candidates: Sequence[StrategyPrice], budget: int,
         c = by_name[forced]
         return c, f"forced by CYLON_EXCHANGE_STRATEGY ({c.describe()})", \
             c.peak_bytes <= budget
+    demoted = ""
+    if exclude:
+        kept = [c for c in candidates if c.strategy not in exclude]
+        if kept:
+            candidates = kept
+            by_name = {c.strategy: c for c in candidates}
+            demoted = (f"replan demotion excluded "
+                       f"{', '.join(exclude)}; ")
     feasible = [c for c in candidates if c.peak_bytes <= budget]
     if not feasible:
         c = by_name.get(CHUNKED, min(candidates,
                                      key=lambda s: s.peak_bytes))
-        return c, (f"budget {budget} B below every strategy's floor — "
-                   f"best-effort {c.describe()}"), False
+        return c, (demoted + f"budget {budget} B below every strategy's "
+                   f"floor — best-effort {c.describe()}"), False
     if measured and profile is not None:
         def meas_key(c):
             p = predicted_ms(c, profile)
@@ -348,11 +377,12 @@ def choose(candidates: Sequence[StrategyPrice], budget: int,
                   f"{p:.3f} ms" if p is not None else
                   f"measured ranking (unmeasured collective): "
                   f"{best.describe()}")
-        return best, reason, True
+        return best, demoted + reason, True
     best = min(feasible, key=lambda c: (c.rounds, c.wire_bytes,
                                         STRATEGIES.index(c.strategy)))
     if best.strategy == SINGLE_SHOT:
-        reason = f"{best.describe()} <= budget {budget} B"
+        reason = demoted + f"{best.describe()} <= budget {budget} B"
+        return best, reason, True
     else:
         ss = by_name.get(SINGLE_SHOT)
         over = (f"single-shot priced {ss.peak_bytes} B over the "
@@ -361,4 +391,4 @@ def choose(candidates: Sequence[StrategyPrice], budget: int,
         losers = [c.strategy for c in feasible if c is not best]
         beat = f" (beat {', '.join(losers)})" if losers else ""
         reason = over + best.describe() + beat
-    return best, reason, True
+    return best, demoted + reason, True

@@ -409,7 +409,11 @@ def _note_choice(choice, reason: str) -> None:
     both sides under one node) keeps every choice, not just the
     last."""
     from ..analysis import plan_check
+    from ..resilience import note_strategy_choice
     trace.count(cost.strategy_counter(choice.strategy))
+    # the recovery driver's per-attempt record: a resource-classed
+    # failure demotes the chooser off whatever was picked here
+    note_strategy_choice(choice.strategy)
     if choice.strategy != cost.SINGLE_SHOT:
         trace.count("shuffle.strategy.downgrades")
         # a downgrade is exactly the decision a post-mortem wants to
@@ -768,12 +772,17 @@ def _choose(Pn: int, cap: int, counts: np.ndarray, rbytes: int,
     with ``CYLON_COST_MEASURED=1`` and a probed mesh profile for
     ``ctx``'s mesh, ranking by measured collective time instead of the
     (rounds, wire) proxy."""
+    from .. import resilience
     from ..config import cost_measured_enabled, exchange_strategy
     from . import meshprobe
     forced = exchange_strategy()
     profile = meshprobe.get_profile(ctx) if ctx is not None else None
     measured = cost_measured_enabled() and profile is not None
-    if forced is None and not measured:
+    # the escalation ladder's replan arm (docs/robustness.md): inside a
+    # demoted recovery attempt the cheapest catalogue strategies are
+    # excluded — the lowering that just failed must not be re-picked
+    exclude = resilience.exchange_demotions()
+    if forced is None and not measured and not exclude:
         # fast path: a feasible single-shot provably wins the
         # (rounds, wire, catalogue) order — fewest rounds, least wire —
         # so the common under-budget exchange never pays the chunk-plan
@@ -787,7 +796,7 @@ def _choose(Pn: int, cap: int, counts: np.ndarray, rbytes: int,
     cands = cost.enumerate_strategies(Pn, cap, counts, rbytes, budget,
                                       staged_ok=combine is None)
     return cost.choose(cands, budget, forced, profile=profile,
-                       measured=measured)
+                       measured=measured, exclude=exclude)
 
 
 def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
@@ -912,13 +921,17 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
                               reason)
         return need
 
-    if hint_key in _chunked_keys and budget is not None:
-        # degraded steady state: skip the optimistic dispatch (its
-        # single-shot program is exactly what blew the budget) and block
-        # on the counts — riding the same batched device_get as any
-        # queued validations in deferred mode — then re-choose: the
-        # chooser either picks a degraded strategy again or self-
-        # promotes the signature back to single-shot
+    if (hint_key in _chunked_keys or resilience.exchange_demotions()) \
+            and budget is not None:
+        # degraded steady state — or a demoted recovery attempt
+        # (resilience.demoted_exchanges: the replanned re-execution
+        # must not re-dispatch the single-shot program that just
+        # failed): skip the optimistic dispatch (its single-shot
+        # program is exactly what blew the budget) and block on the
+        # counts — riding the same batched device_get as any queued
+        # validations in deferred mode — then re-choose: the chooser
+        # either picks a degraded strategy again or self-promotes the
+        # signature back to single-shot
         if ops_compact.deferred_mode():
             ok, vals = ops_compact.flush_pending_with((cnt_dev,))
             if not ok:

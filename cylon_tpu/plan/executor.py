@@ -56,9 +56,9 @@ plan/ir.py) or the tree fails lint.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
-from .. import trace
+from .. import faults, trace
 from ..analysis import plan_check
 from ..status import Code, CylonError, Status
 from . import ir, rules
@@ -460,6 +460,216 @@ def _frozen_copy(root: Node) -> Node:
 
 
 # ---------------------------------------------------------------------------
+# stage checkpoints + the recovery driver (docs/robustness.md
+# "self-healing execution")
+# ---------------------------------------------------------------------------
+
+_MISS = object()
+
+
+class _CheckpointStore:
+    """Costed retention of stage results across recovery attempts.
+
+    During ONE ``_execute`` attempt every intermediate is live in the
+    walk's ``results`` dict anyway; what a checkpoint buys is survival
+    across a REPLAN — the resource arm of the ladder frees the failed
+    attempt's memo insertions before retrying (recovering from
+    allocation pressure while pinning every unpriced intermediate
+    would be self-defeating), so a fault in stage k then resumes from
+    the last retained exchange output instead of replaying the whole
+    plan.  Retention is priced (``cost.price_retained``: the resident
+    [cap]-row block × row width) against a bounded fraction of the
+    memory budget (``resilience.RecoveryPolicy.checkpoint_fraction``).
+    Admission keeps the NEWEST checkpoints (the resume points) and
+    evicts oldest-first; a result whose own price exceeds the whole
+    budget is skipped (``recover.checkpoint_skipped``)."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = max(int(budget_bytes), 0)
+        self._entries: Dict[Any, Tuple[Any, int]] = {}
+        self._order: List[Any] = []
+        self.total = 0
+
+    def holds(self, esig) -> bool:
+        return esig in self._entries
+
+    def offer(self, esig, out, node: Node) -> None:
+        if esig in self._entries or self.budget <= 0:
+            return
+        cap = getattr(out, "cap", None)
+        if cap is None:
+            return  # local-table stage outputs are not retained
+        from ..parallel import cost
+        price = cost.price_retained(int(cap),
+                                    max(ir.row_width(node.schema), 1))
+        if price > self.budget:
+            trace.count("recover.checkpoint_skipped")
+            return
+        while self.total + price > self.budget and self._order:
+            oldest = self._order.pop(0)
+            _, old_price = self._entries.pop(oldest)
+            self.total -= old_price
+            trace.count("recover.checkpoint_evictions")
+        self._entries[esig] = (out, price)
+        self._order.append(esig)
+        self.total += price
+        trace.count("recover.checkpoints")
+        trace.count_max("recover.checkpoint_bytes", self.total)
+
+    def restore(self, esig):
+        """The retained result for ``esig`` or ``_MISS``.  The
+        ``recover.checkpoint_restore`` fault point fires here: an
+        injected restore failure DROPS the checkpoint and recomputes
+        the stage from its inputs — a bad checkpoint must degrade to
+        replay, never to a wrong answer."""
+        entry = self._entries.get(esig)
+        if entry is None:
+            return _MISS
+        try:
+            faults.check("recover.checkpoint_restore")
+        except faults.FaultError:
+            self._entries.pop(esig, None)
+            if esig in self._order:
+                self._order.remove(esig)
+            self.total -= entry[1]
+            trace.count("recover.restore_failed")
+            return _MISS
+        trace.count("recover.checkpoint_hits")
+        return entry[0]
+
+
+def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node]):
+    """The classified escalation ladder around ``_execute``
+    (docs/robustness.md): transient → bounded stage retry resuming
+    from the INTACT execution memo (completed results are immutable —
+    only the failed stage and downstream re-run); resource → replan:
+    this ladder's memo insertions are dropped to free memory, the next
+    attempt runs under ``resilience.demoted_exchanges`` (the costed
+    chooser re-lowers the failing exchange onto a degraded catalogue
+    strategy) and resumes from the priced checkpoint store; permanent
+    or exhausted → fail, with the ladder's attempt log attached to the
+    error (``e.ladder``) and recorded for the flight recorder's
+    bundle.  ``CYLON_RECOVERY=0`` /
+    ``config.set_recovery_enabled(False)`` bypasses all of it."""
+    from .. import resilience
+    from ..config import recovery_enabled
+    from ..logging import warning as _warn
+    from ..observe import flightrec
+    if not recovery_enabled():
+        return _execute(builder, opt_root, pre_nodes)
+    ladder = resilience.Ladder()
+    ckpt = _CheckpointStore(int(ladder.policy.checkpoint_fraction
+                                * resilience.exchange_budget()))
+    prior: Set[Any] = set()
+    inserted: Set[Any] = set()
+    failed_strategies: Set[str] = set()
+    while True:
+        try:
+            with resilience.demoted_exchanges(
+                    ladder.demote_level,
+                    failed=tuple(sorted(failed_strategies))), \
+                    resilience.collect_strategy_choices() as chosen:
+                out = _execute(builder, opt_root, pre_nodes, ckpt=ckpt,
+                               prior=prior, inserted=inserted)
+            if ladder.attempts:
+                trace.count("recover.recovered")
+                resilience.note_recovery("recovered")
+                flightrec.note("recover", action="recovered",
+                               attempts=ladder.as_dicts(),
+                               stages=ir.stage_count(opt_root))
+            return out
+        except BaseException as e:
+            from ..analysis._abstract import PlanExportReached
+            if isinstance(e, (PlanExportReached, KeyboardInterrupt,
+                              SystemExit, GeneratorExit)):
+                # control flow, not failure: PlanExportReached means
+                # the abstract run REACHED its export boundary (a
+                # success signal, even after an engaged ladder healed
+                # an earlier attempt), and interpreter shutdown must
+                # never be booked as a recovery outcome
+                raise
+            action = ladder.decide(e)
+            if action == "fail":
+                if len(ladder.attempts) == 1 \
+                        and not isinstance(e, (CylonError, MemoryError)):
+                    # plain first-failure user errors pass through
+                    # untouched — the ladder only annotates failures
+                    # it engaged with
+                    raise
+                if not (ladder.retries or ladder.replans) \
+                        and not isinstance(e, faults.FaultError):
+                    # an organic first failure the ladder never engaged
+                    # with: attach the classification as evidence, but
+                    # do not book it — recover.failures must track
+                    # ladders that GAVE UP (or injected permanents),
+                    # not every query error in the process
+                    try:
+                        e.ladder = ladder.as_dicts()
+                    except Exception:  # graftlint: ok[broad-except]
+                        pass           # unannotatable errors still raise
+                    raise
+                trace.count("recover.failures")
+                attempts = ladder.as_dicts()
+                try:
+                    e.ladder = attempts
+                except Exception:  # graftlint: ok[broad-except] — an
+                    pass           # unannotatable error still raises
+                flightrec.note("recover_failed", attempts=attempts,
+                               error=f"{type(e).__name__}: "
+                                     f"{str(e)[:160]}")
+                raise
+            if action == "replan":
+                # the RESOURCE arm frees memory before the degraded
+                # retry: every memo entry this ladder inserted is
+                # dropped, and the priced checkpoint store becomes the
+                # only retained state — pinning unpriced intermediates
+                # while recovering from allocation pressure would be
+                # self-defeating.  (The transient arm below keeps the
+                # memo: completed results are immutable and correct,
+                # so a stage retry resumes exactly, re-running only
+                # the failed stage and downstream.)
+                for esig in inserted:
+                    builder.exec_memo.pop(esig, None)
+                inserted.clear()
+                # never re-pick a lowering the failed attempt chose:
+                # the prefix demotion alone would happily re-run e.g.
+                # the exact allgather that just OOM'd, burning a
+                # bounded replan rung as a no-op (conservative: ALL of
+                # the attempt's choices are excluded, chunked never)
+                failed_strategies |= set(chosen)
+                try:
+                    faults.check("recover.replan")
+                except faults.FaultError as fe:
+                    trace.count("recover.failures")
+                    # the log must say what actually HAPPENED: the
+                    # replan was decided but its setup failed
+                    ladder.attempts.append(resilience.LadderAttempt(
+                        resilience.RESOURCE, "fail",
+                        f"replan setup failed: "
+                        f"{type(fe).__name__}: {str(fe)[:120]}"))
+                    fe.ladder = ladder.as_dicts()
+                    flightrec.note("recover_failed",
+                                   attempts=ladder.as_dicts(),
+                                   error=f"replan setup failed: {fe}")
+                    raise
+                trace.count("recover.replans")
+                _warn("recovery: resource-class failure (%s) — "
+                      "replanning exchanges at demotion level %d and "
+                      "resuming from checkpoint",
+                      type(e).__name__, ladder.demote_level)
+                flightrec.note("recover", action="replan",
+                               level=ladder.demote_level,
+                               error=f"{type(e).__name__}: "
+                                     f"{str(e)[:160]}")
+            else:
+                trace.count("recover.stage_retries")
+                flightrec.note("recover", action="stage_retry",
+                               retries=ladder.retries,
+                               error=f"{type(e).__name__}: "
+                                     f"{str(e)[:160]}")
+
+
+# ---------------------------------------------------------------------------
 # materialize
 # ---------------------------------------------------------------------------
 
@@ -508,7 +718,7 @@ def materialize(builder, root: Node):
     builder.stats["fires"] += entry.fires
     builder.stats["pre_exchange_row_bytes"] += entry.pre_bytes
     builder.stats["post_exchange_row_bytes"] += entry.post_bytes
-    out = _execute(builder, entry.root, pre_nodes)
+    out = _execute_recovering(builder, entry.root, pre_nodes)
     builder.memo_put(root, out)
     return out
 
@@ -523,35 +733,104 @@ def _bound_runtime(node: Node, pre_nodes: List[Node]) -> Dict[str, Any]:
     return node.runtime
 
 
-def _execute(builder, opt_root: Node, pre_nodes: List[Node]):
+def _execute(builder, opt_root: Node, pre_nodes: List[Node],
+             ckpt: Optional[_CheckpointStore] = None,
+             prior: Optional[Set[Any]] = None,
+             inserted: Optional[Set[Any]] = None):
     """Children-first walk of the optimized DAG; each node lowers through
     LOWERING under suspended capture, memoized per run by content
     signature so shared subplans (within and across materialization
-    boundaries) execute once."""
-    results: Dict[int, Any] = {}
+    boundaries) execute once.
+
+    Under the recovery driver (:func:`_execute_recovering`) three extra
+    seams are live: ``ckpt`` serves stage results retained from a prior
+    attempt (and receives new exchange-boundary results, costed);
+    ``prior`` is the set of signatures lowered by EARLIER attempts, so
+    re-lowering one counts ``recover.stages_replayed`` (the partial-
+    replay proof); ``inserted`` records this attempt's exec-memo
+    insertions for rollback.  The ``exec.stage`` fault point fires
+    before each exchange-boundary lowering — the sanctioned mid-query
+    failure surface the chaos suite injects at.
+
+    Signatures are pure structure + runtime identity, so they are
+    computed for the whole DAG up front (no execution); the root-down
+    coverage pass then restores retained checkpoints ON DEMAND and
+    skips every subtree the memo covers — a resumed attempt must not
+    re-dispatch the upstream of a restored boundary only to discard it
+    (re-allocating while recovering from allocation pressure would be
+    exactly wrong)."""
+    order = ir.topo(opt_root)
     esigs: Dict[int, Tuple] = {}
-    for node in ir.topo(opt_root):
-        ins = [results[id(c)] for c in node.inputs]
+    rts: Dict[int, Dict[str, Any]] = {}
+    for node in order:
         rt = _bound_runtime(node, pre_nodes)
-        esig = (node.op, rules._static_sig(node),
-                tuple(esigs[id(c)] for c in node.inputs),
-                tuple(sorted((k, id(v)) for k, v in rt.items())))
-        esigs[id(node)] = esig
+        rts[id(node)] = rt
+        esigs[id(node)] = (node.op, rules._static_sig(node),
+                           tuple(esigs[id(c)] for c in node.inputs),
+                           tuple(sorted((k, id(v))
+                                        for k, v in rt.items())))
+    # root-down coverage: a memo'd node serves its whole subtree —
+    # children of a hit are not walked (membership test only: the
+    # shared memo's get() counts cross-query shares, which must bump
+    # once per CONSUMED hit in the walk below, not here).  Retained
+    # checkpoints restore ON DEMAND during this descent, so a
+    # checkpoint subsumed by a newer downstream one is never
+    # reinstated (its buffers stay unpinned — this is a memory-
+    # pressure recovery path) and recover.checkpoint_hits counts only
+    # restores partial replay actually consumed; a restore failure
+    # (the recover.checkpoint_restore fault point) drops the
+    # checkpoint and the descent continues into the subtree.
+    needed: set = set()
+    stack = [opt_root]
+    while stack:
+        n = stack.pop()
+        if id(n) in needed:
+            continue
+        needed.add(id(n))
+        esig = esigs[id(n)]
+        if esig in builder.exec_memo:
+            continue
+        if ckpt is not None and ckpt.holds(esig):
+            kept = ckpt.restore(esig)
+            if kept is not _MISS:
+                builder.exec_memo[esig] = (n, kept)
+                if inserted is not None:
+                    inserted.add(esig)
+                continue
+        stack.extend(n.inputs)
+    results: Dict[int, Any] = {}
+    for node in order:
+        if id(node) not in needed:
+            continue
+        esig = esigs[id(node)]
         hit = builder.exec_memo.get(esig)
         if hit is not None:
             results[id(node)] = hit[1]
             continue
+        boundary = ir.is_stage_boundary(node)
+        if boundary:
+            faults.check("exec.stage")
+            if prior is not None and esig in prior:
+                trace.count("recover.stages_replayed")
         lower = LOWERING.get(node.op)
         if lower is None:
             raise CylonError(Status(Code.Invalid,
                 f"plan executor: no lowering for {node.op!r} (add a "
                 "LOWERING case — graftlint's dist-op-unlowered rule "
                 "guards this)"))
+        ins = [results[id(c)] for c in node.inputs]
         idx = plan_check.capture_index()
         with ir.suspended():
-            out = lower(builder.ctx, ins, node.static, rt)
+            out = lower(builder.ctx, ins, node.static, rts[id(node)])
         if node.opt_notes:
             plan_check.annotate_at(idx, optimizer="; ".join(node.opt_notes))
         builder.exec_memo[esig] = (node, out)
+        if inserted is not None:
+            inserted.add(esig)
+        if boundary:
+            if prior is not None:
+                prior.add(esig)
+            if ckpt is not None:
+                ckpt.offer(esig, out, node)
         results[id(node)] = out
     return results[id(opt_root)]

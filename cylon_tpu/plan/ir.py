@@ -49,7 +49,8 @@ from ..status import Code, CylonError, Status
 __all__ = ["ColSpec", "Node", "LogicalTable", "Builder", "CAPTURED_OPS",
            "capture", "capturing", "suspended", "referenced_columns",
            "sig_of_schema", "params_sig", "topo", "known_rows",
-           "row_width", "infer_schema", "EXCHANGE_OPS", "ROW_PRESERVING"]
+           "row_width", "infer_schema", "EXCHANGE_OPS", "ROW_PRESERVING",
+           "stage_count", "is_stage_boundary"]
 
 
 # ---------------------------------------------------------------------------
@@ -158,6 +159,26 @@ def topo(root: Node) -> List[Node]:
         for i in node.inputs:
             stack.append((i, False))
     return out
+
+
+def is_stage_boundary(node: Node) -> bool:
+    """Is ``node`` a recovery STAGE boundary?  The exchange-shaped ops
+    are the sanctioned failure points (docs/robustness.md: every
+    injectable host read / collective dispatch lives under one), so
+    they are also where the self-healing executor checkpoints and
+    resumes (plan/executor.py "stage checkpoints"): the materialized
+    output of an exchange is a consistent cut of the plan — everything
+    upstream is embodied in it, everything downstream re-derives from
+    it."""
+    return node.op in EXCHANGE_OPS
+
+
+def stage_count(root: Node) -> int:
+    """Number of stage boundaries in the plan under ``root`` — the
+    denominator of the recovery layer's partial-replay claim
+    (``recover.stages_replayed`` < stage_count proves a resumed query
+    did NOT start over)."""
+    return sum(1 for n in topo(root) if is_stage_boundary(n))
 
 
 def known_rows(node: Node) -> Optional[int]:

@@ -27,28 +27,56 @@ allocation pressure).  The consumers:
 
 **Bounded retry.**  :func:`retrying` / :func:`retry_call` wrap the
 transient-classed failure boundaries (host count reads, the batched
-deferred flush, CSV IO) with an attempt cap and exponential backoff.
-Classification is type-based: :class:`faults.TransientFault` plus
-``ConnectionError``/``TimeoutError``/``InterruptedError`` retry;
-everything else — including :class:`faults.PermanentFault` and
-``FileNotFoundError`` — propagates immediately.  Retries bump
-``retry.attempts``; an exhausted loop bumps ``retry.exhausted`` and
-re-raises the last transient error.
+deferred flush, CSV IO) with an attempt cap and exponential backoff
+under DECORRELATED JITTER — a fixed exponential schedule synchronizes
+concurrent serving retries into a thundering herd, so each sleep is
+drawn uniformly from ``[base, min(max, prev·3)]`` instead (the AWS
+"decorrelated jitter" shape; ``jitter=False`` restores the
+deterministic schedule for tests).  Classification is type-based:
+:class:`faults.TransientFault` plus ``ConnectionError``/``TimeoutError``
+/``InterruptedError`` retry; everything else — including
+:class:`faults.PermanentFault` and ``FileNotFoundError`` — propagates
+immediately.  Retries bump ``retry.attempts``; an exhausted loop bumps
+``retry.exhausted`` and re-raises the last transient error.
+
+**The escalation ladder** (docs/robustness.md "the escalation
+ladder").  :func:`classify` sorts any failure into three classes and
+:class:`Ladder` turns the class into the recovery ACTION the plan
+executor takes between stage attempts (plan/executor.py):
+
+  * ``transient`` → bounded **stage retry** resuming from the last
+    checkpoint (the micro-retries above already absorbed what they
+    could — a transient surfacing here exhausted them);
+  * ``resource`` (:class:`faults.ResourceFault`, ``MemoryError``, a
+    typed OOM ``CylonError``, an XLA ``RESOURCE_EXHAUSTED``) →
+    **replan**: the next attempt runs under :func:`demoted_exchanges`,
+    which excludes the cheapest catalogue strategies so the costed
+    chooser (parallel/cost.py) re-lowers the failing exchange onto a
+    degraded sequence (chunked / ring) with a smaller transient;
+  * ``permanent`` (or an exhausted ladder) → **fail**, with the
+    ladder's attempt log attached to the error and a flight-recorder
+    bundle annotated with it (observe/flightrec.py).
 """
 from __future__ import annotations
 
 import contextlib
 import functools
+import random
+import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional, Tuple, Type
+from typing import Callable, List, Optional, Sequence, Tuple, Type
 
 from . import config, faults
 from .status import Code, CylonError, Status
 
 __all__ = [
     "RetryPolicy", "retry_policy", "set_retry_policy", "retry_call",
-    "retrying", "exchange_budget", "counter_scope",
+    "retrying", "exchange_budget", "counter_scope", "classify",
+    "RecoveryPolicy", "recovery_policy", "set_recovery_policy",
+    "Ladder", "LadderAttempt", "demoted_exchanges", "exchange_demotions",
+    "collect_recoveries", "note_recovery", "collect_strategy_choices",
+    "note_strategy_choice",
 ]
 
 
@@ -81,17 +109,26 @@ def counter_scope(out: dict):
 
 @dataclass(frozen=True)
 class RetryPolicy:
-    """Attempt cap + exponential backoff for one transient boundary.
+    """Attempt cap + jittered exponential backoff for one transient
+    boundary.
 
-    ``max_attempts`` counts TOTAL tries (1 = no retry).  Delays grow
-    ``base_delay_s * multiplier**k`` capped at ``max_delay_s`` — bounded
-    by construction, no unbounded spin (the failure mode the reference's
-    missing fault tolerance would have had nothing to say about)."""
+    ``max_attempts`` counts TOTAL tries (1 = no retry).  With ``jitter``
+    (the default) each sleep is drawn from ``[base_delay_s,
+    min(max_delay_s, prev_sleep * 3)]`` — decorrelated jitter, so N
+    concurrent serving queries tripping over the same transient do not
+    re-arrive in lockstep (the thundering herd a fixed schedule
+    produces).  With ``jitter=False`` delays grow ``base_delay_s *
+    multiplier**k`` capped at ``max_delay_s`` — the deterministic
+    schedule, kept for timing-sensitive tests.  Both shapes are bounded
+    by construction: no unbounded spin (the failure mode the
+    reference's missing fault tolerance would have had nothing to say
+    about)."""
 
     max_attempts: int = 5
     base_delay_s: float = 0.005
     multiplier: float = 2.0
     max_delay_s: float = 0.25
+    jitter: bool = True
     transient_types: Tuple[Type[BaseException], ...] = (
         faults.TransientFault, ConnectionError, TimeoutError,
         InterruptedError)
@@ -109,6 +146,32 @@ class RetryPolicy:
 
 
 _policy = RetryPolicy()
+
+# the decorrelated-jitter draw source: one process-level RNG, OS-seeded
+# (two processes — or two threads — must NOT share a backoff schedule;
+# that is the herd).  Tests may reseed via _jitter_rng.seed(k) to pin a
+# sequence; the lock keeps concurrent draws well-defined.
+_jitter_rng = random.Random()
+_jitter_lock = threading.Lock()
+
+
+def _next_sleep(pol: RetryPolicy, prev_sleep: float,
+                attempt: int) -> float:
+    """One backoff delay.  Jittered: uniform over ``[base,
+    min(max, max(prev, base)*3)]`` (decorrelated — the width tracks
+    the previous ACTUAL sleep, desynchronizing callers that failed
+    together; seeding prev with base keeps the FIRST retry's window
+    ``[base, 3*base]`` wide too, since a degenerate first draw would
+    re-arrive every herd member in lockstep exactly where it
+    matters most).  Deterministic: ``base * multiplier**(attempt-1)``
+    capped at max."""
+    if not pol.jitter:
+        return min(pol.base_delay_s * pol.multiplier ** (attempt - 1),
+                   pol.max_delay_s)
+    hi = min(pol.max_delay_s,
+             max(prev_sleep, pol.base_delay_s) * 3.0)
+    with _jitter_lock:
+        return _jitter_rng.uniform(min(pol.base_delay_s, hi), hi)
 
 
 def retry_policy() -> RetryPolicy:
@@ -141,7 +204,7 @@ def retry_call(fn: Callable, *, point: str = "",
     from . import trace
 
     pol = policy if policy is not None else _policy
-    delay = pol.base_delay_s
+    sleep_s = 0.0
     for attempt in range(1, pol.max_attempts + 1):
         try:
             return fn()
@@ -155,13 +218,13 @@ def retry_call(fn: Callable, *, point: str = "",
                     attempt, point or "<boundary>", e)
                 raise
             trace.count("retry.attempts")
+            sleep_s = _next_sleep(pol, sleep_s, attempt)
             glog.vlog(1, "transient failure at %s (attempt %d/%d), "
                          "retrying in %.0f ms: %s",
                       point or "<boundary>", attempt, pol.max_attempts,
-                      min(delay, pol.max_delay_s) * 1e3, e)
-            if delay > 0:
-                time.sleep(min(delay, pol.max_delay_s))
-            delay *= pol.multiplier
+                      sleep_s * 1e3, e)
+            if sleep_s > 0:
+                time.sleep(sleep_s)
 
 
 def retrying(policy: Optional[RetryPolicy] = None) -> Callable:
@@ -190,3 +253,262 @@ def exchange_budget() -> int:
     mid-query (simulated allocation pressure)."""
     return max(int(faults.perturb("resilience.budget",
                                   config.device_memory_budget())), 1)
+
+
+# ---------------------------------------------------------------------------
+# the classified escalation ladder (docs/robustness.md)
+# ---------------------------------------------------------------------------
+
+TRANSIENT = "transient"
+RESOURCE = "resource"
+PERMANENT = "permanent"
+
+
+def classify(exc: BaseException) -> str:
+    """Sort one failure into the ladder's three classes.
+
+    ``transient`` — the retryable class (the same types
+    :class:`RetryPolicy` retries at the micro boundaries: an injected
+    :class:`faults.TransientFault`, connection/timeout/interrupt).  A
+    transient REACHING the ladder already exhausted the inner retries,
+    so the ladder's answer is a bounded stage retry from checkpoint,
+    not another blind spin at the same boundary.
+
+    ``resource`` — the allocation class: a typed OOM
+    (:class:`faults.ResourceFault`, ``MemoryError``, a ``CylonError``
+    carrying ``Code.OutOfMemory``) or an XLA ``RESOURCE_EXHAUSTED``
+    runtime error (matched by name so jaxlib stays an indirect
+    dependency).  Retrying the same plan would re-request the same
+    allocation; the ladder REPLANS the exchange instead.
+
+    ``permanent`` — everything else, :class:`faults.PermanentFault`
+    included: no recovery action is sound, fail with the evidence."""
+    if isinstance(exc, faults.PermanentFault):
+        return PERMANENT
+    if isinstance(exc, faults.ResourceFault) \
+            or isinstance(exc, MemoryError):
+        return RESOURCE
+    if isinstance(exc, CylonError) \
+            and getattr(getattr(exc, "status", None), "code", None) \
+            == Code.OutOfMemory:
+        return RESOURCE
+    if type(exc).__name__ == "XlaRuntimeError" \
+            and "RESOURCE_EXHAUSTED" in str(exc):
+        return RESOURCE
+    if isinstance(exc, _policy.transient_types):
+        return TRANSIENT
+    return PERMANENT
+
+
+@dataclass(frozen=True)
+class RecoveryPolicy:
+    """Bounds of one recovery ladder (plan/executor.py runs one per
+    materialization).
+
+    ``max_stage_retries``     transient-classed stage retries before the
+                              ladder gives up (each resumes from the
+                              last checkpoint).
+    ``max_replans``           resource-classed replans; each one deepens
+                              the demotion level — replan k excludes the
+                              k cheapest catalogue strategies, so the
+                              chooser lands on progressively smaller
+                              transients (chunked is never excluded:
+                              its C = 1 floor is the engine's
+                              last-resort lowering already).
+    ``checkpoint_fraction``   the share of ``exchange_budget()`` the
+                              stage-checkpoint store may pin across
+                              attempts — checkpointing is a COSTED
+                              decision (cost.price_retained), never a
+                              default (0 disables checkpoints; recovery
+                              then replays whole plans).
+    """
+
+    max_stage_retries: int = 2
+    max_replans: int = 2
+    checkpoint_fraction: float = 0.25
+
+    def __post_init__(self):
+        if self.max_stage_retries < 0 or self.max_replans < 0:
+            raise CylonError(Status(Code.Invalid,
+                "RecoveryPolicy retry/replan caps must be >= 0"))
+        if not 0.0 <= self.checkpoint_fraction <= 1.0:
+            raise CylonError(Status(Code.Invalid,
+                f"checkpoint_fraction must be in [0, 1], got "
+                f"{self.checkpoint_fraction!r}"))
+
+
+_recovery_policy = RecoveryPolicy()
+
+
+def recovery_policy() -> RecoveryPolicy:
+    return _recovery_policy
+
+
+def set_recovery_policy(policy: RecoveryPolicy) -> RecoveryPolicy:
+    """Swap the session recovery policy; returns the previous one (the
+    restore-in-finally A/B idiom, same as :func:`set_retry_policy`)."""
+    global _recovery_policy
+    if not isinstance(policy, RecoveryPolicy):
+        raise CylonError(Status(Code.Invalid,
+            f"expected a RecoveryPolicy, got {type(policy).__name__}"))
+    prev = _recovery_policy
+    _recovery_policy = policy
+    return prev
+
+
+@dataclass
+class LadderAttempt:
+    """One rung taken: what failed, how it was classed, what the ladder
+    did about it.  The list of these is what annotates the error and the
+    flight-recorder bundle when the ladder ultimately fails."""
+
+    klass: str
+    action: str               # retry | replan | fail
+    error: str                # "<Type>: <message prefix>"
+
+    def as_dict(self) -> dict:
+        return {"class": self.klass, "action": self.action,
+                "error": self.error}
+
+
+class Ladder:
+    """The decision state of one recovery session: bounded counts per
+    arm, an attempt log, and the current demotion level.  The caller
+    (plan/executor.py) owns the loop; :meth:`decide` only classifies
+    and books."""
+
+    def __init__(self, policy: Optional[RecoveryPolicy] = None):
+        self.policy = policy if policy is not None else _recovery_policy
+        self.retries = 0
+        self.replans = 0
+        self.attempts: List[LadderAttempt] = []
+
+    @property
+    def demote_level(self) -> int:
+        return self.replans
+
+    def decide(self, exc: BaseException) -> str:
+        """Class ``exc``, record the attempt, return the action:
+        ``"retry"`` (stage retry from checkpoint), ``"replan"``
+        (re-lower the exchange demoted one level), or ``"fail"``."""
+        klass = classify(exc)
+        if klass == TRANSIENT and self.retries < self.policy.max_stage_retries:
+            self.retries += 1
+            action = "retry"
+        elif klass == RESOURCE and self.replans < self.policy.max_replans:
+            self.replans += 1
+            action = "replan"
+        else:
+            action = "fail"
+        self.attempts.append(LadderAttempt(
+            klass, action, f"{type(exc).__name__}: {str(exc)[:160]}"))
+        return action
+
+    def as_dicts(self) -> List[dict]:
+        return [a.as_dict() for a in self.attempts]
+
+
+# ---------------------------------------------------------------------------
+# recovery-outcome attribution (counter-independent)
+# ---------------------------------------------------------------------------
+
+# The serving layer's stats() contract is to self-account INDEPENDENTLY
+# of trace enablement, so "this query healed" cannot ride the counter
+# registry alone: the recovery driver notes outcomes into a thread-local
+# sink the dispatcher opens around each query's execution (the same
+# shape as observe.compile.attribute_compiles).
+_recovery_notes = threading.local()
+
+
+@contextlib.contextmanager
+def collect_recoveries():
+    """Open a per-query recovery-outcome window; yields the list the
+    driver appends outcome strings ("recovered") into."""
+    prev = getattr(_recovery_notes, "sink", None)
+    sink: List[str] = []
+    _recovery_notes.sink = sink
+    try:
+        yield sink
+    finally:
+        _recovery_notes.sink = prev
+
+
+def note_recovery(outcome: str) -> None:
+    """Record one ladder outcome into the open window (no-op without
+    one — plain eager runs pay a single thread-local read)."""
+    sink = getattr(_recovery_notes, "sink", None)
+    if sink is not None:
+        sink.append(outcome)
+
+
+# per-attempt record of which catalogue strategies the costed chooser
+# actually picked (parallel/shuffle._note_choice feeds it): a replan
+# must demote off the lowering that FAILED, not just the cheapest
+# prefix — re-running the identical failed program would burn a
+# bounded replan rung as a no-op
+_strategy_notes = threading.local()
+
+
+@contextlib.contextmanager
+def collect_strategy_choices():
+    """Open a per-attempt window recording the chooser's strategy
+    picks; yields the set (the recovery driver reads it on failure)."""
+    prev = getattr(_strategy_notes, "sink", None)
+    sink: set = set()
+    _strategy_notes.sink = sink
+    try:
+        yield sink
+    finally:
+        _strategy_notes.sink = prev
+
+
+def note_strategy_choice(strategy: str) -> None:
+    """Record one chooser pick into the open window (no-op without
+    one — plain runs pay a single thread-local read)."""
+    sink = getattr(_strategy_notes, "sink", None)
+    if sink is not None:
+        sink.add(strategy)
+
+
+# ---------------------------------------------------------------------------
+# exchange demotion: the replan arm's lever on the costed chooser
+# ---------------------------------------------------------------------------
+
+_demote = threading.local()
+
+
+def exchange_demotions() -> Tuple[str, ...]:
+    """The catalogue strategies the costed chooser must NOT pick on this
+    thread — empty in production, non-empty only inside a replanned
+    recovery attempt (:func:`demoted_exchanges`).  parallel/shuffle.py
+    reads this per exchange: a demoted attempt skips the optimistic
+    single-shot dispatch (its program is exactly what failed) and hands
+    ``exclude=`` to ``cost.choose``."""
+    return getattr(_demote, "excluded", ())
+
+
+@contextlib.contextmanager
+def demoted_exchanges(level: int, failed: Sequence[str] = ()):
+    """Scope one recovery attempt's demotion: exclude the first
+    ``level`` strategies of the catalogue preference order (single-shot
+    first, then allgather, …) PLUS ``failed`` — the strategies the
+    chooser picked during attempts that then failed resource-class
+    (collect_strategy_choices), so a replan never re-runs the exact
+    lowering that just OOM'd even when it sat outside the cheap
+    prefix.  The chunked lowering is never excluded — its C = 1 floor
+    is the engine's established best-effort last resort, so a demoted
+    chooser always has a candidate.  Level 0 with no failed set is a
+    no-op (the first attempt of every ladder runs undemoted)."""
+    from .parallel import cost
+    excluded = tuple(dict.fromkeys(
+        s for s in tuple(cost.STRATEGIES[:max(level, 0)]) + tuple(failed)
+        if s != cost.CHUNKED))
+    if not excluded:
+        yield
+        return
+    prev = getattr(_demote, "excluded", ())
+    _demote.excluded = excluded
+    try:
+        yield
+    finally:
+        _demote.excluded = prev

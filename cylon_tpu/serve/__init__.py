@@ -18,6 +18,14 @@ tables, executed through the PR-5 logical planner with
   * per-query fault isolation: one query's error lands on its own
     handle (``resilience.counter_scope`` attributes its retries/faults
     to it alone); batch peers complete.
+  * overload protection (docs/serving.md): a per-plan-fingerprint
+    **circuit breaker** quarantines poison queries with typed
+    :class:`Quarantined` rejections in O(µs) (half-open probes restore
+    service automatically), queue-depth / SLO-pressure **load
+    shedding** rejects low-priority work with a typed
+    :class:`Overloaded` instead of letting it time out, and graceful
+    ``drain()`` finishes in-flight work, flushes the async export lane
+    and the run-stats store, then returns the final stats snapshot.
 
 Quick start::
 
@@ -33,7 +41,9 @@ Quick start::
 from __future__ import annotations
 
 from .admission import admit, price_query, price_table
-from .session import QueryHandle, QueryQueue, ServeSession, percentile
+from .session import (CircuitBreaker, Overloaded, QueryHandle,
+                      QueryQueue, Quarantined, ServeSession, percentile)
 
 __all__ = ["ServeSession", "QueryHandle", "QueryQueue", "percentile",
-           "price_query", "price_table", "admit"]
+           "price_query", "price_table", "admit", "CircuitBreaker",
+           "Overloaded", "Quarantined"]

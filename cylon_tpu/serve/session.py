@@ -37,15 +37,38 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from .. import resilience, trace
+from .. import faults, resilience, trace
 from ..status import Code, CylonError, Status
 from . import admission
 
-__all__ = ["QueryHandle", "QueryQueue", "ServeSession", "percentile"]
+__all__ = ["QueryHandle", "QueryQueue", "ServeSession", "percentile",
+           "Overloaded", "Quarantined", "CircuitBreaker"]
 
 _UNSET = object()
+
+
+class Overloaded(CylonError):
+    """Typed load-shed rejection (docs/serving.md "overload
+    protection"): the session is under queue-depth or SLO pressure and
+    refused this submission IMMEDIATELY rather than letting it queue
+    toward a timeout.  Callers catch this type to back off / retry
+    elsewhere; it never means the query was wrong."""
+
+    def __init__(self, msg: str):
+        super().__init__(Status(Code.CapacityError, msg))
+
+
+class Quarantined(CylonError):
+    """Typed circuit-breaker rejection: this submission's plan
+    fingerprint has failed repeatedly and is quarantined (breaker open).
+    Rejection happens at submit time in O(µs) — a poison query must not
+    burn another batch window.  Service restores automatically via the
+    half-open probe once the cooldown elapses."""
+
+    def __init__(self, msg: str):
+        super().__init__(Status(Code.CapacityError, msg))
 
 
 def percentile(sorted_xs: List[float], q: float) -> Optional[float]:
@@ -71,11 +94,13 @@ class QueryHandle:
                  "execute_ms", "latency_ms", "error", "_value", "_event",
                  "trace_id", "admitted_at", "queue_wait_ms",
                  "plan_digests", "deadline_ms", "deadline_missed",
-                 "compile_ms")
+                 "compile_ms", "priority", "breaker_key", "probe",
+                 "recovered")
 
     def __init__(self, qid: int, label: str, op: Callable, tables,
                  export: Optional[Callable],
-                 deadline_ms: Optional[float] = None) -> None:
+                 deadline_ms: Optional[float] = None,
+                 priority: int = 0) -> None:
         self.id = qid
         self.label = label
         self.op = op
@@ -84,6 +109,18 @@ class QueryHandle:
         self.status = "queued"
         self.priced_bytes: int = 0
         self.deferrals = 0
+        # overload-protection state: the priority class load shedding
+        # reads (0 = sheddable default; >= 1 rides out pressure), the
+        # breaker fingerprint this query reports its outcome under, and
+        # whether it is a half-open probe (its outcome alone decides
+        # the breaker's next state)
+        self.priority = priority
+        self.breaker_key: Optional[Tuple] = None
+        self.probe = False
+        # True when the executor's escalation ladder healed this query
+        # mid-flight (attributed directly, NOT via the counter
+        # registry — stats() self-accounts with counters off)
+        self.recovered = False
         # per-query SLO deadline (submit(deadline_ms=...)): checked at
         # finish time against the submit→finish latency; a miss stamps
         # deadline_missed and bumps serve.slo_violations on the session
@@ -195,6 +232,14 @@ class _SharedExecMemo(dict):
     def begin_query(self, handle: QueryHandle) -> None:
         self._current = handle
 
+    def pop(self, key, *default):
+        # the recovery ladder's replan arm rolls entries back — the
+        # owner record must go too, or a peer's later re-insert keeps
+        # the stale owner and its own hits miscount as cross-query
+        # shares
+        self._owner.pop(key, None)
+        return dict.pop(self, key, *default)
+
     def get(self, key, default=None):
         hit = dict.get(self, key, default)
         if hit is not None:
@@ -209,6 +254,215 @@ class _SharedExecMemo(dict):
     def __setitem__(self, key, value) -> None:
         self._owner.setdefault(key, self._current)
         dict.__setitem__(self, key, value)
+
+
+class _BreakerEntry:
+    """One fingerprint's breaker state.  ``op`` pins the keyed
+    callable (and everything it captures) so identity-based key
+    components stay unique while the entry carries state."""
+
+    __slots__ = ("state", "fails", "opened_at", "probe_inflight", "op")
+
+    def __init__(self, op: Callable):
+        self.state = CircuitBreaker.CLOSED
+        self.fails = 0              # consecutive failures while closed
+        self.opened_at = 0.0
+        self.probe_inflight = False
+        self.op = op
+
+
+class CircuitBreaker:
+    """Per-plan-fingerprint circuit breaker (docs/serving.md "overload
+    protection"): the serving queue must stop feeding a poison plan
+    back into batch windows.
+
+    State machine per fingerprint (the submitted op's code +
+    captured-value identities — see :meth:`key_of` — so a fresh lambda
+    per resubmission still collides on one entry):
+
+      * **closed** — failures count; ``threshold`` CONSECUTIVE failures
+        open the breaker (any success resets the count).
+      * **open** — submissions are rejected with a typed
+        :class:`Quarantined` error at submit time, before pricing or
+        enqueue (``serve.breaker_rejected``).  After ``cooldown_s`` the
+        breaker half-opens.
+      * **half-open** — exactly ONE probe submission is admitted
+        (``serve.breaker_probes``; the ``serve.breaker_probe`` fault
+        point fires at its admission); peers keep being rejected until
+        the probe resolves.  Probe success closes the breaker
+        (``serve.breaker_closed``), failure re-opens it for another
+        cooldown.
+
+    Entries are bounded (``max_entries``, oldest-evicted) and pin their
+    op callables so identity keys stay unique while tracked.  All
+    methods are called under the session lock's absence — the breaker
+    carries its own lock (submit threads + the dispatcher both touch
+    it)."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 5.0,
+                 max_entries: int = 256):
+        if threshold < 1:
+            raise CylonError(Status(Code.Invalid,
+                f"breaker threshold must be >= 1, got {threshold}"))
+        if cooldown_s <= 0:
+            raise CylonError(Status(Code.Invalid,
+                f"breaker cooldown_s must be > 0, got {cooldown_s}"))
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple, _BreakerEntry] = {}
+
+    @staticmethod
+    def key_of(op: Callable) -> Tuple:
+        """The plan fingerprint at submit altitude: the op's CODE
+        identity plus the identities of its captured values (closure
+        cells and argument defaults).  A client resubmitting a poison
+        plan typically builds a FRESH lambda per submission
+        (``submit(lambda t: q(ctx, t))`` in a loop) — raw callable
+        identity would give every resubmission a fresh fingerprint and
+        the breaker could never accumulate failures — while the same
+        code object parameterized by a different captured plan
+        (``lambda t, q=qfn: ...`` over q1 vs q6) is a different plan
+        and must not share a breaker.  Non-function callables fall
+        back to object identity (the plan cache's stable-callable
+        contract, docs/query_planner.md)."""
+        import functools
+        if isinstance(op, functools.partial):
+            # a fresh partial per resubmission is the same pattern as
+            # a fresh lambda: fingerprint the wrapped callable plus
+            # the bound-argument identities, not the wrapper object
+            return ("partial", CircuitBreaker.key_of(op.func),
+                    tuple(id(a) for a in op.args),
+                    tuple(sorted((k, id(v))
+                                 for k, v in op.keywords.items())))
+        code = getattr(op, "__code__", None)
+        if code is None:
+            return (getattr(op, "__qualname__", type(op).__name__),
+                    id(op))
+        cells = []
+        for cell in (getattr(op, "__closure__", None) or ()):
+            try:
+                cells.append(id(cell.cell_contents))
+            except ValueError:      # unbound cell — still a stable key
+                cells.append(0)
+        defaults = tuple(id(d) for d in
+                         (getattr(op, "__defaults__", None) or ()))
+        # bound methods share one __code__ across instances — the
+        # receiver is a captured value too, or runner_a's failures
+        # would quarantine runner_b's identical-code-but-healthy plan
+        bound_to = getattr(op, "__self__", None)
+        return (getattr(op, "__qualname__", "<callable>"), id(code),
+                defaults, tuple(cells),
+                0 if bound_to is None else id(bound_to))
+
+    def _entry(self, key: Tuple, op: Callable) -> "_BreakerEntry":
+        e = self._entries.get(key)
+        if e is None:
+            while len(self._entries) >= self.max_entries:
+                # only CLOSED entries are evictable: an OPEN/HALF_OPEN
+                # entry IS the quarantine — dropping one would silently
+                # lift it and let the poison plan back into batch
+                # windows.  When every tracked entry is a live
+                # quarantine (table saturated), the NEW fingerprint
+                # goes untracked instead: it behaves closed (admits;
+                # failures do not accumulate) until capacity frees —
+                # the safe direction, since an existing quarantine is
+                # proven poison and the newcomer is merely unknown.
+                victim = next(
+                    (k for k, v in self._entries.items()
+                     if v.state == self.CLOSED), None)
+                if victim is None:
+                    return _BreakerEntry(op)
+                self._entries.pop(victim)
+            e = _BreakerEntry(op)
+            self._entries[key] = e
+        return e
+
+    def check(self, key: Tuple, op: Callable) -> str:
+        """Gate one submission: ``"admit"``, ``"probe"`` (half-open —
+        the caller marks the handle as the probe), or ``"reject"``.
+        Never CREATES an entry: a fingerprint with no failure history
+        is the default state, and storing it would pin every healthy
+        op (and its captured payloads) for the session's lifetime."""
+        now = time.monotonic()
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.state == self.CLOSED:
+                return "admit"
+            if e.state == self.OPEN \
+                    and now - e.opened_at >= self.cooldown_s:
+                e.state = self.HALF_OPEN
+                e.probe_inflight = False
+            if e.state == self.HALF_OPEN and not e.probe_inflight:
+                e.probe_inflight = True
+                return "probe"
+            return "reject"
+
+    def on_probe_abort(self, key: Tuple) -> None:
+        """The admitted probe never EXECUTED (queue rejection, session
+        close): release the half-open slot so the next submission can
+        probe instead of every caller being rejected forever."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.state == self.HALF_OPEN:
+                e.probe_inflight = False
+
+    def on_success(self, key: Tuple, probe: bool = False) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            if e.state != self.CLOSED and not probe:
+                # a STALE success: this query was admitted before the
+                # failures that opened the breaker (async exports can
+                # outlast a whole cooldown) — letting it lift the
+                # quarantine would bypass the cooldown/probe state
+                # machine.  ONLY the half-open probe's own outcome
+                # decides.
+                return
+            if e.state == self.HALF_OPEN:
+                trace.count("serve.breaker_closed")
+            # a closed zero-failure entry IS the default state: drop it
+            # so recovered/healthy fingerprints stop pinning their ops
+            self._entries.pop(key, None)
+
+    def on_failure(self, key: Tuple, op: Callable,
+                   probe: bool = False) -> bool:
+        """Record one execution failure; returns True when this failure
+        OPENED (or re-opened) the breaker.  An untracked entry (table
+        saturated with live quarantines) reports False — telemetry
+        must not claim a quarantine check() will not enforce.  During
+        HALF_OPEN only the PROBE's failure re-opens: a stale pre-open
+        query failing while the probe is queued must not pre-empt the
+        probe's verdict (mirror of ``on_success``'s stale guard)."""
+        now = time.monotonic()
+        with self._lock:
+            e = self._entry(key, op)
+            tracked = self._entries.get(key) is e
+            if e.state == self.HALF_OPEN:
+                if not probe:
+                    return False    # stale evidence; the probe decides
+                # the probe failed: straight back to open (half-open
+                # entries are always tracked — they came from check())
+                e.state, e.opened_at = self.OPEN, now
+                e.probe_inflight = False
+                trace.count("serve.breaker_open")
+                return True
+            e.fails += 1
+            if e.state == self.CLOSED and e.fails >= self.threshold:
+                e.state, e.opened_at = self.OPEN, now
+                if tracked:
+                    trace.count("serve.breaker_open")
+                    return True
+        return False
+
+    def state_of(self, key: Tuple) -> str:
+        with self._lock:
+            e = self._entries.get(key)
+            return e.state if e is not None else self.CLOSED
 
 
 class ServeSession:
@@ -233,12 +487,27 @@ class ServeSession:
         exchanges themselves.
       * ``export_workers`` — async export lane width (0 = export
         inline on the dispatcher; no overlap).
+      * ``breaker_threshold`` / ``breaker_cooldown_s`` — the per-plan
+        circuit breaker (docs/serving.md "overload protection"):
+        threshold consecutive failures of one plan fingerprint open
+        its breaker (typed :class:`Quarantined` rejections in O(µs));
+        after the cooldown a single half-open probe decides whether
+        service restores.  ``breaker_threshold=None`` disables.
+      * ``shed_depth`` — queue-depth load shedding: once this many
+        queries are waiting, priority-0 submissions are rejected with
+        a typed :class:`Overloaded` instead of queueing toward a
+        timeout (``submit(priority=1)`` and above ride out pressure
+        until the queue is genuinely full).  Defaults to 3/4 of
+        ``max_queue``; ``None`` keeps the default, 0 disables.
     """
 
     def __init__(self, ctx, tables=None, *, batch_window_ms: float = 4.0,
                  max_queue: int = 64,
                  admission_budget: Optional[int] = None,
-                 export_workers: int = 1, name: str = "serve") -> None:
+                 export_workers: int = 1, name: str = "serve",
+                 breaker_threshold: Optional[int] = 3,
+                 breaker_cooldown_s: float = 5.0,
+                 shed_depth: Optional[int] = None) -> None:
         if batch_window_ms < 0:
             raise CylonError(Status(Code.Invalid,
                 f"batch_window_ms must be >= 0, got {batch_window_ms}"))
@@ -247,6 +516,26 @@ class ServeSession:
         self._tables = tables
         self._window_s = batch_window_ms / 1e3
         self._admission_budget = admission_budget
+        self._breaker = (None if not breaker_threshold else
+                         CircuitBreaker(breaker_threshold,
+                                        breaker_cooldown_s))
+        if shed_depth is None:
+            shed_depth = max(2, (3 * max_queue) // 4)
+        elif shed_depth < 0:
+            raise CylonError(Status(Code.Invalid,
+                f"shed_depth must be >= 0 (0 disables), got {shed_depth}"))
+        self._shed_depth = shed_depth
+        # EWMA of completed-query SERVICE time (execute only, queue
+        # wait excluded — the shed check multiplies by depth itself):
+        # the SLO-pressure shed's estimate of what one queued query
+        # costs (host bookkeeping, updated in _finish under the lock)
+        self._ewma_ms: Optional[float] = None
+        # the dispatcher's deferred backlog size (admission-budget
+        # deferrals live in the dispatcher's private pending list, not
+        # the queue — the shed depth must see BOTH, or budget pressure
+        # never engages overload protection).  Plain int, written by
+        # the dispatcher only; readers tolerate one-window staleness.
+        self._pending_count = 0
         self._queue = QueryQueue(max_queue)
         self._pipeline = None
         if export_workers > 0:
@@ -258,12 +547,14 @@ class ServeSession:
             "submitted": 0, "admitted": 0, "deferred": 0, "rejected": 0,
             "completed": 0, "failed": 0, "batches": 0,
             "subplan_shared": 0, "exports_async": 0,
-            "slo_violations": 0,
+            "slo_violations": 0, "shed": 0, "breaker_rejected": 0,
+            "breaker_probes": 0, "recovered": 0,
         }
         self._latencies: List[float] = []
         self._ids = 0
         self._closing = threading.Event()
         self._closed = False
+        self._drained = False
         trace.gauge("serve.batch_window_ms", batch_window_ms)
         self._dispatcher = threading.Thread(
             target=self._loop, name=f"{name}-dispatch", daemon=True)
@@ -275,7 +566,8 @@ class ServeSession:
                export: Optional[Callable] = None,
                label: Optional[str] = None, block: bool = True,
                timeout: Optional[float] = None,
-               deadline_ms: Optional[float] = None) -> QueryHandle:
+               deadline_ms: Optional[float] = None,
+               priority: int = 0) -> QueryHandle:
         """Enqueue one query; returns its :class:`QueryHandle`.
 
         ``op`` receives the (logically wrapped) tables and composes dist
@@ -293,7 +585,16 @@ class ServeSession:
         ``slo_violations`` tally and the ``serve.slo_violations``
         counter bump, and the flight recorder logs the miss — the
         deadline is an observability contract, not a cancellation
-        (docs/serving.md "deadlines")."""
+        (docs/serving.md "deadlines").
+
+        Overload protection (docs/serving.md) runs BEFORE pricing or
+        enqueue, in O(µs): a quarantined plan fingerprint (circuit
+        breaker open) raises :class:`Quarantined`; under queue-depth
+        pressure (``shed_depth``) a ``priority=0`` submission — or any
+        deadline the queue's estimated wait already busts — raises
+        :class:`Overloaded` instead of queueing toward a timeout.
+        ``priority >= 1`` classes ride out depth pressure until the
+        queue is genuinely full."""
         if self._closed:
             raise CylonError(Status(Code.Invalid,
                 f"serve session {self.name!r} is closed"))
@@ -302,34 +603,93 @@ class ServeSession:
                 f"deadline_ms must be a positive latency budget, got "
                 f"{deadline_ms!r}"))
         tabs = self._tables if tables is _UNSET else tables
-        with self._lock:
-            self._ids += 1
-            qid = self._ids
-        h = QueryHandle(qid, label or f"q{qid}", op, tabs, export,
-                        deadline_ms=deadline_ms)
-        h.priced_bytes = admission.price_query(tabs)
-        self._tally("submitted")
-        if not self._queue.put(h, block=block, timeout=timeout):
-            trace.count("serve.rejected")
-            self._tally("rejected")
-            h.status = "rejected"
-            raise CylonError(Status(Code.CapacityError,
-                f"serve: queue full ({self._queue.capacity} queries) — "
-                "backpressure; retry, block, or widen max_queue"))
-        trace.gauge("serve.queue_depth", len(self._queue))
-        if self._closed and not self._dispatcher.is_alive():
-            # raced close() AND lost: the dispatcher is gone, so nothing
-            # will ever drain this queue — fail what is stranded (this
-            # handle included) rather than block a result() forever.
-            # While the dispatcher is still alive its exit condition
-            # (empty queue) guarantees it drains us normally, so a
-            # query that merely arrived during shutdown still executes;
-            # drain() hands each handle to exactly one drainer either
-            # way.
-            self._fail_stragglers()
-        if h.error is not None:
-            raise h.error
-        return h
+        bkey = probe = None
+        if self._breaker is not None:
+            bkey = CircuitBreaker.key_of(op)
+            verdict = self._breaker.check(bkey, op)
+            if verdict == "reject":
+                trace.count("serve.breaker_rejected")
+                self._tally("breaker_rejected")
+                raise Quarantined(
+                    f"serve: plan {bkey[0]!r} is quarantined (circuit "
+                    f"breaker open after repeated failures); a "
+                    f"half-open probe will retry it after the "
+                    f"{self._breaker.cooldown_s:.1f}s cooldown")
+            probe = verdict == "probe"
+            if probe:
+                trace.count("serve.breaker_probes")
+                self._tally("breaker_probes")
+                try:
+                    # the probe's own fault point (chaos: a probe that
+                    # cannot even be admitted re-opens the breaker)
+                    faults.check("serve.breaker_probe")
+                except faults.FaultError:
+                    self._breaker.on_failure(bkey, op, probe=True)
+                    raise
+        try:
+            # overload depth = queued + the dispatcher's deferred
+            # backlog (admission-budget deferrals left the queue but
+            # are still ahead of this submission)
+            depth = len(self._queue) + self._pending_count
+            if self._shed_depth and depth >= self._shed_depth \
+                    and priority <= 0 and not probe:
+                trace.count("serve.shed")
+                self._tally("shed")
+                raise Overloaded(
+                    f"serve: shedding priority-{priority} work at queue "
+                    f"depth {depth} (shed_depth={self._shed_depth}) — "
+                    "retry later or submit with priority>=1")
+            if deadline_ms is not None and self._ewma_ms and not probe:
+                est_wait = depth * self._ewma_ms
+                if est_wait > deadline_ms:
+                    trace.count("serve.shed")
+                    self._tally("shed")
+                    raise Overloaded(
+                        f"serve: estimated queue wait {est_wait:.0f} ms "
+                        f"({depth} queued x ~{self._ewma_ms:.0f} ms "
+                        "service EWMA) already exceeds the "
+                        f"{deadline_ms:.0f} ms deadline — rejecting now "
+                        "instead of timing out later")
+            with self._lock:
+                self._ids += 1
+                qid = self._ids
+            h = QueryHandle(qid, label or f"q{qid}", op, tabs, export,
+                            deadline_ms=deadline_ms, priority=priority)
+            h.breaker_key = bkey
+            h.probe = bool(probe)
+            h.priced_bytes = admission.price_query(tabs)
+            self._tally("submitted")
+            if not self._queue.put(h, block=block, timeout=timeout):
+                trace.count("serve.rejected")
+                self._tally("rejected")
+                h.status = "rejected"
+                raise CylonError(Status(Code.CapacityError,
+                    f"serve: queue full ({self._queue.capacity} queries)"
+                    " — backpressure; retry, block, or widen max_queue"))
+            trace.gauge("serve.queue_depth", len(self._queue))
+            if self._closed and not self._dispatcher.is_alive():
+                # raced close() AND lost: the dispatcher is gone, so
+                # nothing will ever drain this queue — fail what is
+                # stranded (this handle included) rather than block a
+                # result() forever.  While the dispatcher is still
+                # alive its exit condition (empty queue) guarantees it
+                # drains us normally, so a query that merely arrived
+                # during shutdown still executes; drain() hands each
+                # handle to exactly one drainer either way.
+                self._fail_stragglers()
+            if h.error is not None:
+                raise h.error
+            return h
+        except BaseException:
+            # an admitted PROBE that never reached execution (queue
+            # rejection, pricing error, close race) must release its
+            # half-open slot, or the fingerprint stays quarantined
+            # forever with no probe ever runnable.  Idempotent with
+            # the _finish never-started release — double-abort is a
+            # no-op.
+            if probe and self._breaker is not None:
+                self._breaker.on_probe_abort(bkey)
+            raise
 
     def _fail_stragglers(self) -> None:
         for h in self._queue.drain():
@@ -387,6 +747,37 @@ class ServeSession:
         if self._pipeline is not None:
             self._pipeline.close()
 
+    def drain(self) -> Dict[str, Any]:
+        """Graceful shutdown (docs/serving.md "drain"): stop admitting
+        new queries, let the dispatcher finish everything already
+        queued or deferred, join the async export lane (every in-flight
+        export delivers to its handle), flush the run-stats store to
+        its configured path, and record the drain in the flight
+        recorder.  Returns the session's final :meth:`stats` snapshot.
+        Idempotent, and ``close()``-compatible: a drained session is a
+        closed session."""
+        from ..observe import flightrec
+        from ..observe import stats as obstats
+        with self._lock:   # atomic claim: concurrent drain() calls
+            already = self._drained    # must not both take the
+            self._drained = True       # first-drain accounting path
+        self.close()   # close() IS the in-flight completion barrier:
+        #                the dispatcher only exits on an empty queue,
+        #                and pipeline.close() joins the export workers
+        out = self.stats()
+        if not already:
+            # idempotence covers the accounting too: a SECOND drain()
+            # neither re-counts nor re-flushes — but the first drain
+            # always flushes, even on a session close() already shut
+            # down (the flush is what the caller asked for by name)
+            obstats.STORE.save()
+            trace.count("serve.drains")
+            flightrec.note("drain", session=self.name,
+                           completed=out.get("completed", 0),
+                           failed=out.get("failed", 0),
+                           shed=out.get("shed", 0))
+        return out
+
     def __enter__(self) -> "ServeSession":
         return self
 
@@ -421,6 +812,7 @@ class ServeSession:
             if not batch:
                 continue
             pending = []
+            self._pending_count = 0
             try:
                 admitted, deferred = admission.admit(batch,
                                                      self._budget())
@@ -433,6 +825,7 @@ class ServeSession:
                     self._finish(h, error=e)
                 continue
             pending = deferred
+            self._pending_count = len(pending)
             for h in pending:
                 h.status = "deferred"
                 h.deferrals += 1
@@ -472,6 +865,7 @@ class ServeSession:
         memo.begin_query(h)
         deltas: Dict[str, int] = {}
         cevents: list = []
+        recoveries: list = []
         try:
             # the query's trace id wraps the WHOLE execution: the
             # serve.query span and every nested operator phase land on
@@ -486,6 +880,7 @@ class ServeSession:
             with trace.trace_context(h.trace_id), \
                     obstats.collect_digests() as digests, \
                     obcompile.attribute_compiles() as cevents, \
+                    resilience.collect_recoveries() as recoveries, \
                     resilience.counter_scope(deltas):
                 with trace.span("serve.query"):
                     b = ir.Builder(self.ctx, exec_memo=memo)
@@ -507,6 +902,7 @@ class ServeSession:
             return
         h.counters = deltas
         h.compile_ms = round(sum(e2["compile_ms"] for e2 in cevents), 3)
+        h.recovered = "recovered" in recoveries
         h.execute_ms = (time.perf_counter() - h.started_at) * 1e3
         # run-stats store (ROADMAP §4's recording half): the served
         # execution's counter slice lands under every plan fingerprint
@@ -556,6 +952,42 @@ class ServeSession:
             self._tally("completed")
             with self._lock:
                 self._latencies.append(h.latency_ms)
+                # SLO-pressure estimate: EWMA of SERVICE time (execute
+                # only).  Full submit→finish latency already contains
+                # queue wait, and the shed check multiplies by depth —
+                # an EWMA of latency would double-count queueing and
+                # spiral into shedding feasible deadlines under load
+                svc = (h.execute_ms if h.execute_ms is not None
+                       else h.latency_ms)
+                self._ewma_ms = (svc if self._ewma_ms is None
+                                 else 0.8 * self._ewma_ms + 0.2 * svc)
+            if h.recovered:
+                # the executor's ladder healed this query mid-flight
+                # (docs/robustness.md) — attributed directly via
+                # resilience.collect_recoveries, so stats() keeps its
+                # counters-off self-accounting contract
+                self._tally("recovered")
+        # circuit-breaker bookkeeping: only queries that actually RAN
+        # report an outcome (a straggler failed by session close must
+        # not poison its fingerprint); a probe that never ran releases
+        # its half-open slot instead.  Only EXECUTION failures count
+        # against the plan (h.execute_ms is stamped exactly when
+        # execution succeeded): a failing user export callable is the
+        # export's problem, not the plan's — quarantining a healthy
+        # plan over a flaky sink would be a false positive
+        if self._breaker is not None and h.breaker_key is not None:
+            if h.started_at is None:
+                if h.probe:
+                    self._breaker.on_probe_abort(h.breaker_key)
+            elif error is not None and h.execute_ms is None:
+                opened = self._breaker.on_failure(h.breaker_key, h.op,
+                                                  probe=h.probe)
+                if opened:
+                    flightrec.note("breaker_open", query=h.label,
+                                   key=str(h.breaker_key[0]),
+                                   probe=h.probe)
+            else:
+                self._breaker.on_success(h.breaker_key, probe=h.probe)
         # per-query deadline SLO (submit(deadline_ms=...)): checked on
         # the submit→finish latency — a failure past its deadline is
         # both a failure AND an SLO violation, attributed to THIS handle

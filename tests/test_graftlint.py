@@ -317,6 +317,51 @@ def test_counter_catalogue_parse_matches_runtime():
     assert set(names) == set(observe.METRICS)
 
 
+def test_fault_point_not_in_catalogue_fires_on_unknown_literal():
+    pos = ("from .. import faults\n"
+           "def f():\n"
+           "    faults.check('totally.unknown_point')\n")
+    assert _rules(pos, "cylon_tpu/parallel/fixture.py") \
+        == ["fault-point-not-in-catalogue"]
+    pos2 = ("from .. import faults\n"
+            "def f(v):\n"
+            "    return faults.perturb('nope.point', v)\n")
+    assert _rules(pos2, "cylon_tpu/parallel/fixture.py") \
+        == ["fault-point-not-in-catalogue"]
+    sup = ("from .. import faults\n"
+           "def f():\n"
+           "    faults.check('totally.unknown_point')"
+           "  # graftlint: ok[fault-point-not-in-catalogue]\n")
+    assert _rules(sup, "cylon_tpu/parallel/fixture.py") == []
+
+
+def test_fault_point_not_in_catalogue_clean_spellings():
+    clean = ("from .. import faults\n"
+             "def f(v):\n"
+             "    faults.check('exec.stage')\n"
+             "    faults.check('compact.read_counts')\n"
+             "    return faults.perturb('resilience.budget', v)\n")
+    assert _rules(clean, "cylon_tpu/parallel/fixture.py") == []
+    # dynamic names are runtime coverage's job, not lint's
+    dyn = ("from .. import faults\n"
+           "def f(name):\n"
+           "    faults.check(name)\n")
+    assert _rules(dyn, "cylon_tpu/parallel/fixture.py") == []
+    # an unrelated check() method on some other object is not faults'
+    other = "def f(guard):\n    guard.check('whatever.point')\n"
+    assert _rules(other, "cylon_tpu/parallel/fixture.py") == []
+
+
+def test_fault_point_catalogue_parse_matches_runtime():
+    """The AST-parsed POINTS (what lint checks against) must equal the
+    imported faults.POINTS — the two views cannot drift."""
+    from cylon_tpu import faults
+    names = graftlint._fault_point_names(
+        os.path.join(REPO, "cylon_tpu", "plan", "executor.py"))
+    assert names is not None
+    assert set(names) == set(faults.POINTS)
+
+
 def test_ci_entry_point(tmp_path):
     """``python -m cylon_tpu.analysis.ci``: stage aggregation + the
     usage contract (the plan-check stage itself is covered by the

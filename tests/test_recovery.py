@@ -1,0 +1,972 @@
+"""Self-healing execution (docs/robustness.md "self-healing execution",
+docs/serving.md "overload protection"): stage-checkpointed recovery,
+the classified retry/replan escalation ladder, deterministic
+multi-threaded fault draws, jittered retry backoff, and the serving
+layer's circuit breaker / load shedding / drain.
+
+The acceptance shape: a transient fault at a checkpointed exchange
+boundary recovers with only downstream stages replayed
+(``recover.stages_replayed`` < the plan's stage count); a resource
+fault replans the exchange onto a degraded catalogue strategy and the
+query completes correctly; a permanent fault fails annotated with the
+ladder's attempts; a poison plan fingerprint trips the breaker into
+typed O(µs) rejections while batch peers complete untouched, and a
+half-open probe restores service once the fault rule expires.
+"""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import CylonError, Table, config, faults, resilience, trace
+from cylon_tpu import logging as glog
+from cylon_tpu import plan as planner
+from cylon_tpu.config import JoinConfig
+from cylon_tpu.observe import flightrec
+from cylon_tpu.parallel import DTable, cost
+from cylon_tpu.parallel import dist_ops as dops
+from cylon_tpu.parallel import shuffle as shmod
+from cylon_tpu.plan import executor, ir
+from cylon_tpu.resilience import Ladder, RecoveryPolicy, RetryPolicy
+from cylon_tpu.serve import (CircuitBreaker, Overloaded, Quarantined,
+                             ServeSession)
+
+
+@pytest.fixture(autouse=True)
+def _counters_and_clean_state():
+    """Counter-only tracing + teardown of module-level state (fault
+    plans, degraded signatures, warn-once keys, recovery policy must
+    never leak across tests).  A session-wide CYLON_CHAOS plan is
+    restored, not dropped."""
+    session_plan = faults.plan()
+    prev_policy = resilience.recovery_policy()
+    trace.enable_counters()
+    trace.reset()
+    yield
+    trace.disable_counters()
+    trace.reset()
+    shmod.clear_chunk_state()
+    glog.reset_warn_once()
+    resilience.set_recovery_policy(prev_policy)
+    config.set_recovery_enabled(None)
+    if session_plan is not None:
+        faults.install(session_plan)
+    else:
+        faults.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# the two-stage workload every ladder test drives
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def two_stage(dctx):
+    """A join + groupby plan with TWO exchange-boundary stages the
+    planner cannot fuse into one (the groupby consumes the join's
+    output), its base tables, and the expected result."""
+    rng = np.random.default_rng(5)
+    fact = pd.DataFrame({
+        "k": rng.integers(0, 400, 5000).astype(np.int64),
+        "v": rng.random(5000)})
+    dim = pd.DataFrame({
+        "k": np.arange(400, dtype=np.int64),
+        "w": rng.random(400)})
+    tables = {
+        "fact": DTable.from_table(dctx, Table.from_pandas(dctx, fact)),
+        "dim": DTable.from_table(dctx, Table.from_pandas(dctx, dim)),
+    }
+
+    def op(t):
+        j = dops.dist_join(t["fact"], t["dim"], JoinConfig.InnerJoin(0, 0))
+        return dops.dist_groupby(j, ["lt-k"], [("rt-w", "sum")])
+
+    # force the shuffle join so stage 1 genuinely exchanges (and the
+    # replan tests have a shuffle to demote)
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        expect = (planner.run(dctx, op, tables).to_table().to_pandas()
+                  .sort_values("lt-k").reset_index(drop=True))
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    return op, tables, expect
+
+
+def _run_two_stage(dctx, two_stage, fault_plan=None):
+    op, tables, expect = two_stage
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        if fault_plan is None:
+            out = planner.run(dctx, op, tables)
+        else:
+            with faults.active(fault_plan):
+                out = planner.run(dctx, op, tables)
+        got = (out.to_table().to_pandas()
+               .sort_values("lt-k").reset_index(drop=True))
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    return got, expect
+
+
+# ---------------------------------------------------------------------------
+# satellite: deterministic multi-threaded fault draws
+# ---------------------------------------------------------------------------
+
+_DRAW_RULES = [
+    faults.FaultRule("compact.read_counts", kind="transient",
+                     probability=0.3),
+    faults.FaultRule("io.csv.read", kind="transient", probability=0.3),
+]
+
+
+def _fires(plan_obj, sequence):
+    """Consult ``sequence`` of points under ``plan_obj``; True where a
+    fault fired."""
+    out = []
+    with faults.active(plan_obj):
+        for point in sequence:
+            try:
+                faults.check(point)
+                out.append((point, False))
+            except faults.FaultError:
+                out.append((point, True))
+    return out
+
+
+def _per_point(fired):
+    by = {}
+    for point, hit in fired:
+        by.setdefault(point, []).append(hit)
+    return by
+
+
+def test_fault_draws_independent_of_interleaving():
+    """The k-th consultation of a point decides identically no matter
+    how consultations of OTHER points interleave — the old shared-RNG
+    stream reordered under concurrency; the per-point keyed draw does
+    not."""
+    seq_a = ["compact.read_counts"] * 60 + ["io.csv.read"] * 60
+    seq_b = ["compact.read_counts", "io.csv.read"] * 60
+    a = _per_point(_fires(faults.FaultPlan(7, _DRAW_RULES), seq_a))
+    b = _per_point(_fires(faults.FaultPlan(7, _DRAW_RULES), seq_b))
+    assert a == b
+    assert any(a["compact.read_counts"])  # the plan actually fires
+    assert not all(a["compact.read_counts"])
+
+
+def test_fault_draws_deterministic_across_threads():
+    """Two threads hammering distinct points concurrently reproduce the
+    single-threaded per-point fire pattern exactly (the multi-threaded
+    chaos replay contract, docs/robustness.md)."""
+    single = _per_point(_fires(
+        faults.FaultPlan(11, _DRAW_RULES),
+        ["compact.read_counts"] * 80 + ["io.csv.read"] * 80))
+
+    plan_obj = faults.FaultPlan(11, _DRAW_RULES)
+    results = {}
+
+    def worker(point):
+        hits = []
+        for _ in range(80):
+            try:
+                faults.check(point)
+                hits.append(False)
+            except faults.FaultError:
+                hits.append(True)
+        results[point] = hits
+
+    with faults.active(plan_obj):
+        ts = [threading.Thread(target=worker, args=(p,))
+              for p in ("compact.read_counts", "io.csv.read")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert results == single
+
+
+def test_fault_draws_seed_sensitivity():
+    seq = ["io.csv.read"] * 100
+    a = _fires(faults.FaultPlan(1, _DRAW_RULES), seq)
+    b = _fires(faults.FaultPlan(2, _DRAW_RULES), seq)
+    assert a != b  # different seeds, different pattern
+
+
+def test_resource_fault_kind_and_classification():
+    plan_obj = faults.FaultPlan(0, [
+        faults.FaultRule("exec.stage", kind="resource", nth=1)])
+    with faults.active(plan_obj):
+        with pytest.raises(faults.ResourceFault) as ei:
+            faults.check("exec.stage")
+    assert resilience.classify(ei.value) == resilience.RESOURCE
+    assert resilience.classify(MemoryError()) == resilience.RESOURCE
+    assert resilience.classify(
+        faults.TransientFault("x")) == resilience.TRANSIENT
+    assert resilience.classify(
+        faults.PermanentFault("x")) == resilience.PERMANENT
+    assert resilience.classify(ValueError("x")) == resilience.PERMANENT
+    with pytest.raises(CylonError):
+        faults.FaultRule("exec.stage", kind="bogus")
+
+
+# ---------------------------------------------------------------------------
+# satellite: decorrelated retry jitter
+# ---------------------------------------------------------------------------
+
+def test_retry_jitter_bounds(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(resilience.time, "sleep",
+                        lambda s: sleeps.append(s))
+    pol = RetryPolicy(max_attempts=5, base_delay_s=0.01,
+                      max_delay_s=0.05)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 5:
+            raise faults.TransientFault("io.csv.read")
+        return "ok"
+
+    assert resilience.retry_call(flaky, policy=pol) == "ok"
+    assert len(sleeps) == 4
+    for s in sleeps:
+        assert 0.01 <= s <= 0.05
+
+
+def test_retry_jitter_desynchronizes():
+    """Two retry schedules under the same policy must NOT be identical
+    (the thundering-herd fix), while the jitter=False escape hatch
+    reproduces the exact historical exponential schedule."""
+    pol = RetryPolicy(base_delay_s=0.01, max_delay_s=1.0)
+    resilience._jitter_rng.seed(123)
+    seq1 = []
+    prev = 0.0
+    for i in range(1, 6):
+        prev = resilience._next_sleep(pol, prev, i)
+        seq1.append(prev)
+    seq2 = []
+    prev = 0.0
+    for i in range(1, 6):
+        prev = resilience._next_sleep(pol, prev, i)
+        seq2.append(prev)
+    assert seq1 != seq2
+    fixed = RetryPolicy(base_delay_s=0.01, multiplier=2.0,
+                        max_delay_s=1.0, jitter=False)
+    got = [resilience._next_sleep(fixed, 0.0, i) for i in range(1, 5)]
+    assert got == [0.01, 0.02, 0.04, 0.08]
+    # the FIRST retry's window is [base, 3*base], not a degenerate
+    # point — the herd desynchronizes where it matters most
+    firsts = {round(resilience._next_sleep(pol, 0.0, 1), 6)
+              for _ in range(32)}
+    assert len(firsts) > 1
+    assert all(0.01 <= f <= 0.03 + 1e-9 for f in firsts)
+
+
+# ---------------------------------------------------------------------------
+# the ladder decision table (unit)
+# ---------------------------------------------------------------------------
+
+def test_ladder_decisions_and_caps():
+    ladder = Ladder(RecoveryPolicy(max_stage_retries=2, max_replans=1))
+    assert ladder.decide(faults.TransientFault("x")) == "retry"
+    assert ladder.decide(faults.TransientFault("x")) == "retry"
+    assert ladder.decide(faults.TransientFault("x")) == "fail"
+    ladder2 = Ladder(RecoveryPolicy(max_stage_retries=0, max_replans=2))
+    assert ladder2.decide(faults.ResourceFault("x")) == "replan"
+    assert ladder2.demote_level == 1
+    assert ladder2.decide(faults.ResourceFault("x")) == "replan"
+    assert ladder2.demote_level == 2
+    assert ladder2.decide(faults.ResourceFault("x")) == "fail"
+    assert ladder2.decide(faults.PermanentFault("x")) == "fail"
+    assert [a.action for a in ladder2.attempts] == \
+        ["replan", "replan", "fail", "fail"]
+    with pytest.raises(CylonError):
+        RecoveryPolicy(max_stage_retries=-1)
+    with pytest.raises(CylonError):
+        RecoveryPolicy(checkpoint_fraction=1.5)
+    with pytest.raises(CylonError):
+        resilience.set_recovery_policy("nope")
+
+
+def test_demoted_exchanges_excludes_but_keeps_chunked():
+    assert resilience.exchange_demotions() == ()
+    with resilience.demoted_exchanges(1):
+        assert resilience.exchange_demotions() == (cost.SINGLE_SHOT,)
+        with resilience.demoted_exchanges(3):
+            assert cost.CHUNKED not in resilience.exchange_demotions()
+            assert cost.SINGLE_SHOT in resilience.exchange_demotions()
+        assert resilience.exchange_demotions() == (cost.SINGLE_SHOT,)
+    assert resilience.exchange_demotions() == ()
+    # the FAILED attempt's picks are excluded even outside the cheap
+    # prefix (a replan must not re-run the lowering that just OOM'd);
+    # chunked stays selectable regardless
+    with resilience.demoted_exchanges(1, failed=(cost.ALLGATHER,
+                                                 cost.CHUNKED)):
+        ex = resilience.exchange_demotions()
+        assert cost.ALLGATHER in ex and cost.SINGLE_SHOT in ex
+        assert cost.CHUNKED not in ex
+    # the per-attempt choice collector feeding that exclusion
+    with resilience.collect_strategy_choices() as chosen:
+        resilience.note_strategy_choice(cost.ALLGATHER)
+    assert chosen == {cost.ALLGATHER}
+    resilience.note_strategy_choice(cost.RING)  # no window: no-op
+    assert chosen == {cost.ALLGATHER}
+
+
+def test_cost_choose_exclude():
+    counts = np.full((4, 4), 64, dtype=np.int64)
+    cands = cost.enumerate_strategies(4, 256, counts, 8, 1 << 30)
+    best, reason, ok = cost.choose(cands, 1 << 30)
+    assert best.strategy == cost.SINGLE_SHOT and ok
+    best2, reason2, ok2 = cost.choose(cands, 1 << 30,
+                                      exclude=(cost.SINGLE_SHOT,))
+    assert best2.strategy != cost.SINGLE_SHOT and ok2
+    assert "replan demotion excluded" in reason2
+    # excluding everything is ignored — the chooser must always answer
+    best3, _, _ = cost.choose(cands, 1 << 30,
+                              exclude=tuple(cost.STRATEGIES))
+    assert best3.strategy in cost.STRATEGIES
+    assert cost.price_retained(128, 16) == 128 * 16
+
+
+# ---------------------------------------------------------------------------
+# the escalation ladder end to end (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+def test_transient_stage_fault_resumes_exactly(dctx, two_stage):
+    """Acceptance (1): a transient at the SECOND stage boundary resumes
+    from the intact execution memo — correct rows, one stage retry,
+    and ZERO completed stages replayed (strictly fewer than the plan
+    has — the partial-replay proof)."""
+    fp = faults.FaultPlan(seed=1, rules=[
+        faults.FaultRule("exec.stage", kind="transient", nth=2)])
+    got, expect = _run_two_stage(dctx, two_stage, fp)
+    assert got.equals(expect)
+    c = trace.counters()
+    assert c.get("recover.stage_retries", 0) == 1
+    assert c.get("recover.recovered", 0) == 1
+    assert c.get("recover.checkpoints", 0) >= 1   # offered regardless
+    assert c.get("recover.stages_replayed", 0) == 0  # exact resume
+    assert c.get("recover.failures", 0) == 0
+
+
+def test_resource_fault_replans_to_degraded_strategy(dctx, two_stage):
+    """Acceptance (2): a resource-class fault replans the exchange —
+    the retry runs demoted off the single-shot fast path onto a
+    degraded catalogue strategy — and completes correctly."""
+    fp = faults.FaultPlan(seed=2, rules=[
+        faults.FaultRule("exec.stage", kind="resource", nth=2)])
+    got, expect = _run_two_stage(dctx, two_stage, fp)
+    assert got.equals(expect)
+    c = trace.counters()
+    assert c.get("recover.replans", 0) == 1
+    assert c.get("recover.recovered", 0) == 1
+    # the replanned attempt's exchange left the fast path
+    assert c.get("shuffle.strategy.downgrades", 0) >= 1
+    # the resource arm dropped the memo and resumed from the priced
+    # checkpoint store (stage 1 restored, not re-executed)
+    assert c.get("recover.checkpoint_hits", 0) >= 1
+    assert c.get("recover.stages_replayed", 0) < 2
+    assert c.get("recover.failures", 0) == 0
+
+
+def test_permanent_fault_fails_annotated(dctx, two_stage):
+    """Acceptance (3, executor half): permanent → fail, with the
+    ladder's attempts attached to the error and a recover_failed event
+    in the flight recorder."""
+    flightrec.clear()
+    fp = faults.FaultPlan(seed=3, rules=[
+        faults.FaultRule("exec.stage", kind="permanent", nth=1)])
+    with pytest.raises(faults.PermanentFault) as ei:
+        _run_two_stage(dctx, two_stage, fp)
+    attempts = getattr(ei.value, "ladder", None)
+    assert attempts and attempts[-1]["action"] == "fail"
+    assert attempts[-1]["class"] == "permanent"
+    assert trace.counters().get("recover.failures", 0) == 1
+    kinds = [e["kind"] for e in flightrec.events()]
+    assert "recover_failed" in kinds
+
+
+def test_organic_first_failure_not_booked_as_recovery_failure(
+        dctx, two_stage):
+    """A plain user error the ladder never engaged with is annotated
+    (evidence is cheap) but NOT booked as recover.failures — the
+    counter tracks ladders that gave up, not every query error."""
+    from cylon_tpu.status import Code, Status
+    _op, tables, _ = two_stage
+
+    def bad_pred(env):
+        raise CylonError(Status(Code.Invalid, "user bug"))
+
+    def op(t):
+        return dops.dist_select(t["fact"], bad_pred)
+
+    with pytest.raises(CylonError) as ei:
+        planner.run(dctx, op, tables)
+    assert trace.counters().get("recover.failures", 0) == 0
+    attempts = getattr(ei.value, "ladder", None)
+    assert attempts and attempts[-1]["class"] == "permanent"
+
+
+def test_exhausted_transient_ladder_fails_annotated(dctx, two_stage):
+    pol = resilience.set_recovery_policy(
+        RecoveryPolicy(max_stage_retries=1))
+    try:
+        fp = faults.FaultPlan(seed=4, rules=[
+            faults.FaultRule("exec.stage", kind="transient",
+                             probability=1.0)])
+        with pytest.raises(faults.TransientFault) as ei:
+            _run_two_stage(dctx, two_stage, fp)
+    finally:
+        resilience.set_recovery_policy(pol)
+    attempts = getattr(ei.value, "ladder", None)
+    assert attempts is not None
+    assert [a["action"] for a in attempts] == ["retry", "fail"]
+    assert trace.counters().get("recover.failures", 0) == 1
+
+
+def test_checkpoint_restore_fault_degrades_to_replay(dctx, two_stage):
+    """A failed checkpoint restore drops the checkpoint and recomputes
+    the stage — recovery still correct, the dropped restore visible.
+    (Resource-classed fault: only the replan arm consults the
+    checkpoint store — transient retries resume from the memo.)"""
+    fp = faults.FaultPlan(seed=5, rules=[
+        faults.FaultRule("exec.stage", kind="resource", nth=2),
+        faults.FaultRule("recover.checkpoint_restore", kind="transient",
+                         probability=1.0)])
+    got, expect = _run_two_stage(dctx, two_stage, fp)
+    assert got.equals(expect)
+    c = trace.counters()
+    assert c.get("recover.restore_failed", 0) >= 1
+    # without its checkpoint the completed stage had to replay
+    assert c.get("recover.stages_replayed", 0) >= 1
+    assert c.get("recover.recovered", 0) == 1
+
+
+def test_replan_trigger_fault_escalates_to_failure(dctx, two_stage):
+    fp = faults.FaultPlan(seed=6, rules=[
+        faults.FaultRule("exec.stage", kind="resource", nth=2),
+        faults.FaultRule("recover.replan", kind="transient",
+                         probability=1.0)])
+    with pytest.raises(faults.TransientFault) as ei:
+        _run_two_stage(dctx, two_stage, fp)
+    attempts = getattr(ei.value, "ladder", None)
+    assert attempts
+    # the log says what HAPPENED: the replan was decided, then its
+    # setup failed — the last rung is a fail, not a phantom replan
+    assert attempts[-1]["action"] == "fail"
+    assert "replan setup failed" in attempts[-1]["error"]
+    assert trace.counters().get("recover.failures", 0) == 1
+
+
+def test_checkpoint_budget_prices_retention(dctx, two_stage):
+    """Checkpointing is costed, not default: a checkpoint budget too
+    small for any stage result skips retention — a replanning recovery
+    still works, it just replays the completed stage."""
+    prev_budget = config.set_device_memory_budget(64 << 20)
+    prev_pol = resilience.set_recovery_policy(
+        RecoveryPolicy(checkpoint_fraction=1e-7))  # ~6 bytes
+    try:
+        fp = faults.FaultPlan(seed=7, rules=[
+            faults.FaultRule("exec.stage", kind="resource", nth=2)])
+        got, expect = _run_two_stage(dctx, two_stage, fp)
+    finally:
+        resilience.set_recovery_policy(prev_pol)
+        config.set_device_memory_budget(prev_budget)
+    assert got.equals(expect)
+    c = trace.counters()
+    assert c.get("recover.checkpoint_skipped", 0) >= 1
+    assert c.get("recover.checkpoints", 0) == 0
+    assert c.get("recover.stages_replayed", 0) >= 1  # no resume point
+    assert c.get("recover.recovered", 0) == 1
+
+
+def test_recovery_disabled_propagates_first_failure(dctx, two_stage):
+    prev = config.set_recovery_enabled(False)
+    try:
+        fp = faults.FaultPlan(seed=8, rules=[
+            faults.FaultRule("exec.stage", kind="transient", nth=1)])
+        with pytest.raises(faults.TransientFault):
+            _run_two_stage(dctx, two_stage, fp)
+    finally:
+        config.set_recovery_enabled(prev)
+    c = trace.counters()
+    assert c.get("recover.stage_retries", 0) == 0
+    assert c.get("recover.failures", 0) == 0
+    with pytest.raises(CylonError):
+        config.set_recovery_enabled("yes")
+
+
+def test_recovery_knob_env(monkeypatch):
+    prev = config.set_recovery_enabled(None)
+    try:
+        monkeypatch.setenv("CYLON_RECOVERY", "0")
+        assert not config.recovery_enabled()
+        monkeypatch.setenv("CYLON_RECOVERY", "1")
+        assert config.recovery_enabled()
+    finally:
+        config.set_recovery_enabled(prev)
+
+
+def test_stage_count_and_boundaries(dctx, two_stage):
+    op, tables, _ = two_stage
+    b = ir.Builder(dctx)
+    wrapped = b.wrap_tables(tables)
+    with ir.capture(b):
+        out = op(wrapped)
+    root = out._node
+    assert ir.stage_count(root) == 2
+    assert not ir.is_stage_boundary(root.inputs[0]) \
+        or root.inputs[0].op in ir.EXCHANGE_OPS
+
+
+def test_recovery_through_serving_layer(dctx, two_stage):
+    """A served query heals in place: the victim's OWN counter slice
+    shows the ladder, peers stay clean, and the session tallies the
+    recovery."""
+    op, tables, expect = two_stage
+    fp = faults.FaultPlan(seed=9, rules=[
+        faults.FaultRule("exec.stage", kind="transient", nth=2)])
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with faults.active(fp), \
+                ServeSession(dctx, tables=tables,
+                             batch_window_ms=30.0) as s:
+            victim = s.submit(op, label="victim")
+            peer = s.submit(lambda t: dops.dist_aggregate(
+                t["fact"], [("v", "sum")]), label="peer")
+            got = (victim.result(timeout=600).to_table().to_pandas()
+                   .sort_values("lt-k").reset_index(drop=True))
+            peer.result(timeout=600)
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    assert got.equals(expect)
+    assert victim.counters.get("recover.stage_retries", 0) == 1
+    assert victim.counters.get("recover.recovered", 0) == 1
+    assert peer.counters.get("recover.stage_retries", 0) == 0
+    assert peer.counters.get("fault.injected", 0) == 0
+    assert s.stats()["recovered"] == 1
+
+
+def test_recovery_stat_self_accounts_with_counters_off(dctx, two_stage):
+    """stats() self-accounts independently of trace enablement
+    (docs/serving.md): a healed query tallies ``recovered`` even with
+    the counter registry off."""
+    op, tables, expect = two_stage
+    trace.disable_counters()
+    fp = faults.FaultPlan(seed=9, rules=[
+        faults.FaultRule("exec.stage", kind="transient", nth=2)])
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with faults.active(fp), \
+                ServeSession(dctx, tables=tables,
+                             batch_window_ms=0.0) as s:
+            h = s.submit(op, label="victim")
+            got = (h.result(timeout=600).to_table().to_pandas()
+                   .sort_values("lt-k").reset_index(drop=True))
+    finally:
+        config.set_broadcast_join_threshold(prev)
+        trace.enable_counters()
+    assert got.equals(expect)
+    assert h.recovered
+    assert s.stats()["recovered"] == 1
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (unit + served)
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine_unit():
+    br = CircuitBreaker(threshold=2, cooldown_s=0.05)
+
+    def op():
+        pass
+    key = CircuitBreaker.key_of(op)
+    assert br.check(key, op) == "admit"
+    assert not br.on_failure(key, op)
+    assert br.on_failure(key, op)          # threshold hit -> open
+    assert br.state_of(key) == br.OPEN
+    assert br.check(key, op) == "reject"
+    time.sleep(0.06)
+    assert br.check(key, op) == "probe"    # half-open, one probe
+    assert br.check(key, op) == "reject"   # probe in flight
+    br.on_success(key)                     # stale non-probe success...
+    assert br.state_of(key) == br.HALF_OPEN   # ...cannot close it
+    br.on_success(key, probe=True)         # the probe's own outcome
+    assert br.state_of(key) == br.CLOSED
+    assert br.check(key, op) == "admit"
+    # success resets the consecutive count
+    br.on_failure(key, op)
+    br.on_success(key)
+    assert not br.on_failure(key, op)
+    with pytest.raises(CylonError):
+        CircuitBreaker(threshold=0)
+    with pytest.raises(CylonError):
+        CircuitBreaker(cooldown_s=0)
+
+
+def test_breaker_key_collides_across_fresh_lambdas():
+    """The realistic poison pattern is a FRESH lambda per resubmission
+    — those must land on ONE breaker entry (code + captured-value
+    identities), while the same lambda line parameterized by a
+    different captured plan callable must not."""
+    def make(qfn):
+        return lambda t, q=qfn: q
+
+    a, b = make(min), make(min)
+    assert a is not b
+    assert CircuitBreaker.key_of(a) == CircuitBreaker.key_of(b)
+    assert CircuitBreaker.key_of(a) != CircuitBreaker.key_of(make(max))
+
+    class NotAFunction:
+        def __call__(self, t):
+            return t
+    x, y = NotAFunction(), NotAFunction()
+    assert CircuitBreaker.key_of(x) != CircuitBreaker.key_of(y)
+    # fresh functools.partial wrappers over the same bound call are
+    # the same plan; different bound args are not
+    import functools
+    pa = functools.partial(min, 1)
+    pb = functools.partial(min, 1)
+    pc = functools.partial(min, 2)
+    assert CircuitBreaker.key_of(pa) == CircuitBreaker.key_of(pb)
+    assert CircuitBreaker.key_of(pa) != CircuitBreaker.key_of(pc)
+    # bound methods of different instances are different plans
+    class Runner:
+        def q(self, t):
+            return t
+    ra, rb = Runner(), Runner()
+    assert CircuitBreaker.key_of(ra.q) != CircuitBreaker.key_of(rb.q)
+    assert CircuitBreaker.key_of(ra.q) == CircuitBreaker.key_of(ra.q)
+
+
+def test_breaker_eviction_never_lifts_a_quarantine():
+    br = CircuitBreaker(threshold=1, cooldown_s=60.0, max_entries=4)
+
+    def poison():
+        pass
+    pkey = CircuitBreaker.key_of(poison)
+    assert br.on_failure(pkey, poison)      # open: quarantined
+    fillers = []
+    for i in range(8):                      # churn way past max_entries
+        fn = eval(f"lambda: {i}")           # distinct code objects
+        fillers.append(fn)
+        br.check(CircuitBreaker.key_of(fn), fn)
+    assert br.state_of(pkey) == br.OPEN     # the quarantine survived
+    assert br.check(pkey, poison) == "reject"
+    # saturation: every tracked entry a live quarantine -> the NEW
+    # fingerprint goes untracked (admits) rather than lifting one
+    sat = CircuitBreaker(threshold=1, cooldown_s=60.0, max_entries=2)
+    opens = [eval(f"lambda: {i} + 100") for i in range(2)]
+    for fn in opens:
+        assert sat.on_failure(CircuitBreaker.key_of(fn), fn)
+    extra = eval("lambda: 999")
+    ekey = CircuitBreaker.key_of(extra)
+    assert sat.check(ekey, extra) == "admit"
+    # untracked: the failure neither accumulates NOR reports an
+    # opening check() will not enforce (no ghost-quarantine telemetry)
+    assert sat.on_failure(ekey, extra) is False
+    assert sat.check(ekey, extra) == "admit"
+    for fn in opens:                        # both quarantines intact
+        assert sat.state_of(CircuitBreaker.key_of(fn)) == sat.OPEN
+
+
+def test_breaker_ignores_export_failures(dctx, two_stage):
+    """A failing user EXPORT must not quarantine a healthy plan: only
+    execution failures feed the breaker."""
+    _op, tables, _ = two_stage
+
+    def good(t):
+        return dops.dist_aggregate(t["fact"], [("v", "sum")])
+
+    def bad_export(r):
+        raise ValueError("flaky sink")
+
+    with ServeSession(dctx, tables=tables, batch_window_ms=0.0,
+                      breaker_threshold=2, breaker_cooldown_s=60.0) as s:
+        for i in range(3):
+            h = s.submit(good, label=f"e{i}", export=bad_export)
+            with pytest.raises(ValueError):
+                h.result(timeout=600)
+        # the plan is healthy — still admitted, and works sans export
+        h_ok = s.submit(good, label="fine")
+        h_ok.result(timeout=600)
+    assert trace.counters().get("serve.breaker_open", 0) == 0
+
+
+def test_chaos_during_abstract_explain_not_booked_as_failure(dctx,
+                                                             two_stage):
+    """An exec.stage transient during an abstract plan_check run heals
+    via the ladder WITHOUT booking a recovery failure — control-flow
+    exceptions after an engaged ladder stay control flow."""
+    from cylon_tpu.analysis import plan_check
+    op, tables, _ = two_stage
+    fp = faults.FaultPlan(seed=4, rules=[
+        faults.FaultRule("exec.stage", kind="transient", nth=1)])
+    with faults.active(fp):
+        # the OPTIMIZED form routes through plan/executor.materialize
+        # (the recovery seam); the eager form never consults exec.stage
+        plan_check.validate(
+            lambda t: planner.run(dctx, op, t), tables)
+    c = trace.counters()
+    assert c.get("recover.failures", 0) == 0
+    assert c.get("recover.stage_retries", 0) == 1
+
+
+def test_breaker_probe_slot_released_on_submit_error(dctx, two_stage,
+                                                     monkeypatch):
+    """A probe admission whose submission dies before execution (e.g.
+    pricing raises) must release the half-open slot — otherwise the
+    fingerprint is quarantined forever with no probe ever runnable."""
+    _op, tables, _ = two_stage
+
+    def poison(t):
+        raise _Poison()
+
+    with ServeSession(dctx, tables=tables, batch_window_ms=0.0,
+                      breaker_threshold=1, breaker_cooldown_s=0.05) as s:
+        h = s.submit(poison, label="p0")
+        with pytest.raises(_Poison):
+            h.result(timeout=600)
+        time.sleep(0.06)
+        from cylon_tpu.serve import session as sess_mod
+
+        def boom(tabs):
+            raise RuntimeError("pricing exploded")
+        monkeypatch.setattr(sess_mod.admission, "price_query", boom)
+        with pytest.raises(RuntimeError):
+            s.submit(poison, label="probe-dies")
+        monkeypatch.undo()
+        # the slot was released: the NEXT submission probes again
+        hp = s.submit(poison, label="probe-2")
+        assert hp.probe
+
+
+def test_breaker_stale_success_cannot_lift_quarantine_unit():
+    """A success from a query admitted BEFORE the breaker opened must
+    not close it — only the half-open probe restores service."""
+    br = CircuitBreaker(threshold=1, cooldown_s=60.0)
+
+    def op():
+        pass
+    key = CircuitBreaker.key_of(op)
+    assert br.on_failure(key, op)           # open
+    br.on_success(key)                      # stale pre-open success
+    assert br.state_of(key) == br.OPEN      # quarantine stands
+    assert br.check(key, op) == "reject"
+
+
+def test_breaker_stale_failure_cannot_preempt_probe_unit():
+    """A stale (non-probe) failure during HALF_OPEN neither re-opens
+    the breaker nor consumes the probe's verdict."""
+    br = CircuitBreaker(threshold=1, cooldown_s=0.05)
+
+    def op():
+        pass
+    key = CircuitBreaker.key_of(op)
+    assert br.on_failure(key, op)
+    time.sleep(0.06)
+    assert br.check(key, op) == "probe"     # the probe is in flight
+    assert br.on_failure(key, op, probe=False) is False  # stale noise
+    assert br.state_of(key) == br.HALF_OPEN
+    br.on_success(key, probe=True)          # the probe's own verdict
+    assert br.state_of(key) == br.CLOSED
+
+
+def test_breaker_probe_failure_reopens_unit():
+    br = CircuitBreaker(threshold=1, cooldown_s=0.05)
+
+    def op():
+        pass
+    key = CircuitBreaker.key_of(op)
+    assert br.on_failure(key, op)
+    time.sleep(0.06)
+    assert br.check(key, op) == "probe"
+    # the probe itself failed -> open again
+    assert br.on_failure(key, op, probe=True)
+    assert br.check(key, op) == "reject"
+
+
+class _Poison(CylonError):
+    def __init__(self):
+        from cylon_tpu.status import Code, Status
+        super().__init__(Status(Code.ExecutionError, "poison plan"))
+
+
+def test_breaker_quarantines_poison_served_plan(dctx, two_stage):
+    """Acceptance (3): N failures trip the breaker; subsequent
+    submissions get typed O(µs) rejections without entering a batch
+    window; peers complete untouched; a half-open probe restores
+    service once the fault condition expires."""
+    _op, tables, _ = two_stage
+    state = {"broken": True}
+
+    def poison(t):
+        if state["broken"]:
+            raise _Poison()
+        return dops.dist_aggregate(t["fact"], [("v", "sum")])
+
+    def good(t):
+        return dops.dist_aggregate(t["fact"], [("v", "sum")])
+
+    with ServeSession(dctx, tables=tables, batch_window_ms=0.0,
+                      breaker_threshold=2, breaker_cooldown_s=0.2) as s:
+        for i in range(2):
+            h = s.submit(poison, label=f"p{i}")
+            with pytest.raises(_Poison):
+                h.result(timeout=600)
+        batches_before = s.stats()["batches"]
+        t0 = time.perf_counter()
+        with pytest.raises(Quarantined):
+            s.submit(poison, label="rejected")
+        reject_s = time.perf_counter() - t0
+        assert reject_s < 0.05          # no batch window was burned
+        assert s.stats()["batches"] == batches_before
+        # batch peers of the quarantined fingerprint are untouched
+        hg = s.submit(good, label="peer")
+        hg.result(timeout=600)
+        # the "fault rule" expires: the plan works again; after the
+        # cooldown ONE probe is admitted and restores service
+        state["broken"] = False
+        time.sleep(0.25)
+        hp = s.submit(poison, label="probe")
+        assert hp.probe
+        hp.result(timeout=600)
+        h_ok = s.submit(poison, label="healed")
+        h_ok.result(timeout=600)
+        st = s.stats()
+    assert st["breaker_rejected"] == 1
+    assert st["breaker_probes"] == 1
+    c = trace.counters()
+    assert c.get("serve.breaker_open", 0) >= 1
+    assert c.get("serve.breaker_closed", 0) >= 1
+
+
+def test_breaker_probe_fault_point(dctx, two_stage):
+    _op, tables, _ = two_stage
+
+    def poison(t):
+        raise _Poison()
+
+    fp = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("serve.breaker_probe", kind="transient",
+                         probability=1.0)])
+    with ServeSession(dctx, tables=tables, batch_window_ms=0.0,
+                      breaker_threshold=1, breaker_cooldown_s=0.05) as s:
+        h = s.submit(poison, label="p0")
+        with pytest.raises(_Poison):
+            h.result(timeout=600)
+        time.sleep(0.06)
+        with faults.active(fp):
+            # the probe's admission itself faults -> breaker re-opens
+            with pytest.raises(faults.TransientFault):
+                s.submit(poison, label="probe")
+        with pytest.raises(Quarantined):
+            s.submit(poison, label="still-quarantined")
+
+
+# ---------------------------------------------------------------------------
+# load shedding + drain
+# ---------------------------------------------------------------------------
+
+def test_load_shedding_by_depth_and_priority(dctx, two_stage):
+    _op, tables, _ = two_stage
+
+    def good(t):
+        return dops.dist_aggregate(t["fact"], [("v", "sum")])
+
+    with ServeSession(dctx, tables=tables, batch_window_ms=500.0,
+                      shed_depth=2) as s:
+        held = [s.submit(good, label=f"q{i}") for i in range(2)]
+        with pytest.raises(Overloaded):
+            s.submit(good, label="shed-me")
+        vip = s.submit(good, label="vip", priority=1)
+        for h in held + [vip]:
+            h.result(timeout=600)
+        st = s.stats()
+    assert st["shed"] == 1
+    assert st["completed"] == 3
+    assert trace.counters().get("serve.shed", 0) == 1
+
+
+def test_shed_sees_deferred_backlog(dctx, two_stage):
+    """Admission-budget deferrals leave the queue for the dispatcher's
+    private pending list — the shed depth must count them, or budget
+    pressure never engages overload protection."""
+    _op, tables, _ = two_stage
+
+    def good(t):
+        return dops.dist_aggregate(t["fact"], [("v", "sum")])
+
+    with ServeSession(dctx, tables=tables, batch_window_ms=150.0,
+                      admission_budget=1, shed_depth=2) as s:
+        # priority 1: the held queries ride past depth shedding, so
+        # the rejection below can only come from the DEFERRED backlog
+        held = [s.submit(good, label=f"q{i}", priority=1)
+                for i in range(4)]
+        deadline = time.time() + 10
+        while (s._pending_count < 2 or len(s._queue) > 0) \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert s._pending_count >= 2   # deferred backlog built up
+        assert len(s._queue) == 0      # ...and the queue is empty
+        with pytest.raises(Overloaded):
+            s.submit(good, label="shed-me")
+        for h in held:
+            h.result(timeout=600)      # head-of-line admission drains
+
+
+def test_slo_pressure_shed_on_hopeless_deadline(dctx, two_stage):
+    _op, tables, _ = two_stage
+
+    def good(t):
+        return dops.dist_aggregate(t["fact"], [("v", "sum")])
+
+    with ServeSession(dctx, tables=tables, batch_window_ms=500.0,
+                      shed_depth=0) as s:
+        s._ewma_ms = 200.0              # the estimate a warm session has
+        held = s.submit(good, label="held")
+        with pytest.raises(Overloaded):
+            s.submit(good, label="hopeless", deadline_ms=50.0)
+        ok = s.submit(good, label="roomy", deadline_ms=60_000.0)
+        held.result(timeout=600)
+        ok.result(timeout=600)
+        assert s.stats()["shed"] == 1
+
+
+def test_drain_finishes_in_flight_and_flushes(dctx, two_stage, tmp_path):
+    _op, tables, _ = two_stage
+
+    def good(t):
+        return dops.dist_aggregate(t["fact"], [("v", "sum")])
+
+    flightrec.clear()
+    s = ServeSession(dctx, tables=tables, batch_window_ms=5.0)
+    handles = [s.submit(good, label=f"q{i}",
+                        export=lambda r: r.to_pandas())
+               for i in range(3)]
+    stats = s.drain()
+    assert all(h.done() for h in handles)
+    for h in handles:
+        h.result(timeout=1)             # exports delivered, no error
+    assert stats["completed"] == 3
+    with pytest.raises(CylonError):
+        s.submit(good, label="late")
+    # idempotent
+    stats2 = s.drain()
+    assert stats2["completed"] == 3
+    assert any(e["kind"] == "drain" for e in flightrec.events())
+    assert trace.counters().get("serve.drains", 0) == 1
+    # drain() AFTER close() still flushes once (the flush is what the
+    # caller asked for by name)
+    s2 = ServeSession(dctx, tables=tables, batch_window_ms=0.0)
+    s2.close()
+    s2.drain()
+    assert trace.counters().get("serve.drains", 0) == 2
+
+
+def test_shed_knob_validation(dctx, two_stage):
+    _op, tables, _ = two_stage
+    with pytest.raises(CylonError):
+        ServeSession(dctx, tables=tables, shed_depth=-1)
