@@ -48,7 +48,9 @@ past half of HBM; SF-10 is the default the chip holds with headroom.
 
 Env knobs: CYLON_BENCH_ROWS (rows per device per side),
 CYLON_BENCH_REPS (timed repetitions, default 3), CYLON_BENCH_TPCH_SF
-(0 disables), CYLON_BENCH_PIPELINE_K (default 4).
+(0 disables), CYLON_BENCH_PIPELINE_K (default 4), CYLON_BENCH_OOC
+(default on: the pinned-budget out-of-core stage — spill-path row
+parity on a small query set; 0 skips).
 """
 from __future__ import annotations
 
@@ -1033,11 +1035,25 @@ def main() -> None:
             # downgrade total gated UP by benchdiff — a cost-model
             # regression pushing exchanges off the single-shot fast
             # path fails CI instead of showing up only as wall-clock
-            for _s in ("single_shot", "chunked", "ring", "allgather"):
+            for _s in ("single_shot", "chunked", "ring", "allgather",
+                       "staged_spill"):
                 em.detail[f"tpch_{qname}_strategy_{_s}"] = \
                     q_counters.get(f"shuffle.strategy.{_s}", 0)
             em.detail[f"tpch_{qname}_strategy_downgrades"] = \
                 q_counters.get("shuffle.strategy.downgrades", 0)
+            # out-of-core accounting of the timed rep
+            # (docs/out_of_core.md): the bench runs at AMPLE budget, so
+            # every one of these must be 0 — benchdiff gates spill_bytes
+            # UP (spilling when memory is ample is a regression: the
+            # morsel pricing or the chooser's spill tier fired when the
+            # resident path fit)
+            em.detail[f"tpch_{qname}_spill_bytes"] = \
+                q_counters.get("spill.stage_out_bytes", 0) \
+                + q_counters.get("spill.stage_in_bytes", 0)
+            em.detail[f"tpch_{qname}_morsels"] = \
+                q_counters.get("spill.morsels", 0)
+            em.detail[f"tpch_{qname}_faultins"] = \
+                q_counters.get("spill.faultins", 0)
             # logical-planner activity of the timed rep: cache hits
             # prove the rep skipped rewriting; rule fires are replayed
             # from the cached plan, so every rep reports them
@@ -1146,6 +1162,101 @@ def main() -> None:
         if ratios:
             em.detail["tpch_geomean_vs_pandas"] = round(
                 float(np.exp(np.mean(np.log(ratios)))), 3)
+
+        # out-of-core stage (docs/out_of_core.md): CYLON_BENCH_OOC
+        # (default on; 0 skips) pins a device budget a fraction of the
+        # biggest scan's priced bytes and re-runs a small query set so
+        # the spill path MUST engage (morsel scan + host staging),
+        # asserting row parity against the ample-budget run.  Emits
+        # tpch_ooc_<q>_spill_bytes/_morsels/_faultins/_ms;
+        # tpch_ooc_ok_ratio (ok / attempted) is benchdiff-gated DOWN (a
+        # spilled query that stops completing row-identically is a
+        # regression; truncation only shrinks the attempted count).
+        ooc_on = os.environ.get("CYLON_BENCH_OOC", "1") not in ("", "0")
+        if q_ms and ooc_on and remaining() > 150:
+            from cylon_tpu import config as _cfg
+            from cylon_tpu import plan as _planner
+            from cylon_tpu.analysis.parity import \
+                frames_rowset_equal as _frames_rowset_equal
+            from cylon_tpu.spill import morsel as _spill_morsel
+            from cylon_tpu.spill import pool as _spill_pool
+            ooc_queries = [q for q in ("q1", "q18", "q11") if q in q_ms]
+            li = dts["lineitem"]
+            priced = _spill_morsel.table_priced_bytes(
+                world, li.cap, _spill_morsel._spilled_rbytes(li))
+            # well below the PRUNED scan widths the morsel planner
+            # prices (projection pruning narrows lineitem to ~1/8 of
+            # its full width), so the spill path engages on several
+            # queries, not just the widest scan
+            ooc_budget = max(192 << 10, priced // 48)
+            em.detail["tpch_ooc_budget"] = ooc_budget
+            ooc_ok = 0
+            ooc_attempted = 0
+            for qname in ooc_queries:
+                if remaining() < 90:
+                    break
+                ooc_attempted += 1
+                _progress(f"TPC-H OOC {qname} at {ooc_budget} B budget")
+                qfn = queries.QUERIES[qname]
+                try:
+                    ample = ctx.optimize(
+                        lambda t, q=qfn: q(ctx, t), dts).to_pandas()
+                    _trace.enable_counters()
+                    _trace.reset()
+                    _planner.clear_plan_cache()
+                    _spill_pool.clear_pool()
+                    prev_b = _cfg.set_device_memory_budget(ooc_budget)
+                    try:
+                        t0 = time.perf_counter()
+                        got = ctx.optimize(
+                            lambda t, q=qfn: q(ctx, t), dts).to_pandas()
+                        ooc_t = time.perf_counter() - t0
+                        oc = dict(_trace.counters())
+                    finally:
+                        _cfg.set_device_memory_budget(prev_b)
+                        _planner.clear_plan_cache()
+                        _spill_pool.clear_pool()
+                        _trace.disable_counters()
+                        _trace.reset()
+
+                    same = _frames_rowset_equal(got, ample)
+                    em.detail[f"tpch_ooc_{qname}_ms"] = round(
+                        ooc_t * 1e3, 2)
+                    em.detail[f"tpch_ooc_{qname}_spill_bytes"] = \
+                        oc.get("spill.stage_out_bytes", 0) \
+                        + oc.get("spill.stage_in_bytes", 0)
+                    em.detail[f"tpch_ooc_{qname}_morsels"] = \
+                        oc.get("spill.morsels", 0)
+                    em.detail[f"tpch_ooc_{qname}_faultins"] = \
+                        oc.get("spill.faultins", 0)
+                    em.detail[f"tpch_ooc_{qname}_exchange_bytes_peak"] \
+                        = oc.get("shuffle.exchange_bytes_peak", 0)
+                    if same:
+                        ooc_ok += 1
+                    else:
+                        em.detail[f"tpch_ooc_{qname}_error"] = \
+                            "diverged from ample-budget run"
+                        print(f"tpch OOC {qname} DIVERGED",
+                              file=sys.stderr)
+                    _progress(
+                        f"TPC-H OOC {qname}: {ooc_t * 1e3:.0f} ms, "
+                        f"{oc.get('spill.morsels', 0)} morsels, "
+                        f"parity={'ok' if same else 'FAIL'}")
+                except Exception as e:  # graftlint: ok[broad-except] — one bad OOC query must not kill the bench
+                    print(f"tpch OOC {qname} FAILED: "
+                          f"{type(e).__name__}: {str(e)[:200]}",
+                          file=sys.stderr)
+                    em.detail[f"tpch_ooc_{qname}_error"] = str(e)[:200]
+            em.detail["tpch_ooc_queries_ok"] = ooc_ok
+            em.detail["tpch_ooc_queries_attempted"] = ooc_attempted
+            # the GATED form is the ratio over attempted queries: a
+            # deadline-truncated run (fewer attempts) must not read as
+            # an out-of-core regression; a query that ran and diverged
+            # or crashed still drags the ratio down
+            if ooc_attempted:
+                em.detail["tpch_ooc_ok_ratio"] = round(
+                    ooc_ok / ooc_attempted, 3)
+            em.emit("ooc")
 
         # serving stage (docs/serving.md): a mixed workload of
         # concurrent TPC-H queries through cylon_tpu/serve — one client

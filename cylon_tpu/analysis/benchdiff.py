@@ -59,6 +59,15 @@ Gated metrics (relative threshold, default 15%):
     ``serve_chaos_p99_ms`` tail latency under chaos (higher = worse);
     the shed count is reported ungated (docs/robustness.md
     "self-healing execution")
+  * ``tpch_<q>_spill_bytes``  host-tier staging bytes of the timed rep
+    (higher = worse — the main stage runs at AMPLE budget, so spilling
+    there means the out-of-core machinery engaged when the resident
+    path fit; docs/out_of_core.md) and ``tpch_ooc_ok_ratio`` — the
+    pinned-budget OOC stage's row-identical fraction of ATTEMPTED
+    queries (lower = worse: the spill path stopped answering
+    correctly; the ratio form keeps deadline-truncated runs from
+    reading as regressions — the absolute ``tpch_ooc_queries_ok``
+    count is reported ungated)
 
 A gated metric present in OLD but absent from NEW fails the gate
 outright (``MISSING``): a query that crashed or was skipped emits no ms
@@ -166,6 +175,21 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     # MORE under pressure can be the correct response).
     (r"serve_chaos_recovered_ratio$", "down"),
     (r"serve_chaos_p99_ms$", "up"),
+    # out-of-core family (docs/out_of_core.md): the main TPC-H stage
+    # runs at AMPLE budget, so per-query spill bytes must stay 0 —
+    # spilling when memory is ample means the morsel pricing or the
+    # chooser's host tier fired when the resident path fit, paying
+    # PCIe round trips for nothing (gated UP; the byte floor keeps a
+    # trivial staging blip from failing CI).  The OOC stage's
+    # queries-ok count gates DOWN: a pinned-budget query that stops
+    # completing row-identically through the spill path is the
+    # out-of-core capability regressing outright.
+    (r"tpch_q\d+_spill_bytes$", "up"),
+    # the RATIO form (ok / attempted) gates, not the absolute count: a
+    # deadline-truncated run attempts fewer queries and must not read
+    # as a regression, while a query that ran and diverged still drags
+    # the ratio down (the absolute count is reported ungated)
+    (r"tpch_ooc_ok_ratio$", "down"),
 )
 
 
@@ -285,7 +309,8 @@ def diff(old: Dict[str, float], new: Dict[str, float],
             floor = (min_abs_ms if key.endswith("_ms")
                      else min_abs_bytes if key.endswith(("_bytes_moved",
                                                          "_bytes_saved",
-                                                         "_bytes_peak"))
+                                                         "_bytes_peak",
+                                                         "_spill_bytes"))
                      else min_abs_reads if key.endswith("_host_reads")
                      # ratio family (recovered ratio): a couple of
                      # queries' worth of jitter on a near-1.0 baseline

@@ -49,7 +49,13 @@ Stages:
      peers must stay untouched, and the flight-recorder bundle rendered
      by doctor must show the escalation ladder's events
      (``--no-chaos-smoke`` skips);
-  7. **benchdiff** (only when ``--baseline`` and a candidate artifact
+  7. **out-of-core smoke** (docs/out_of_core.md): one TPC-H query
+     forced through the spill path at a tiny pinned device budget —
+     the planner must insert a morsel scan (``spill.morsels >= 2``),
+     the result must be row-identical to the resident run, and on
+     failure a doctor bundle renders the evidence
+     (``--no-ooc-smoke`` skips);
+  8. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
      including the serving families (``serve_qps``/``serve_sustain_qps``
      down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up), the
@@ -83,14 +89,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/7: graftlint ==")
+    print("== ci stage 1/8: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/7: plan_check pre-flight ==")
+    print("== ci stage 2/8: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -151,7 +157,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/7: serving smoke ==")
+    print("== ci stage 3/8: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -274,7 +280,7 @@ def _stage_telemetry_smoke(sf: float) -> int:
     CONTRACTS rather than the numbers: sampler non-empty, catalogue
     compliance, export validity (one track per query trace id), stats
     store populated with per-node observations."""
-    print("== ci stage 4/7: telemetry smoke ==")
+    print("== ci stage 4/8: telemetry smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -396,7 +402,7 @@ def _stage_doctor_smoke(sf: float) -> int:
     post-mortem machinery end to end: the victim fails onto its own
     handle, peers stay row-identical to serial execution, a
     flight-recorder bundle lands on disk, and doctor renders it."""
-    print("== ci stage 5/7: doctor smoke ==")
+    print("== ci stage 5/8: doctor smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -508,7 +514,7 @@ def _stage_chaos_smoke(sf: float) -> int:
     shows the ladder's stage retry with fewer stages replayed than the
     plan has), peers complete untouched, and the flight-recorder
     bundle doctor renders shows the ladder's events."""
-    print("== ci stage 6/7: chaos-recovery smoke ==")
+    print("== ci stage 6/8: chaos-recovery smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -655,10 +661,111 @@ def _stage_chaos_smoke(sf: float) -> int:
     return 1 if bad else 0
 
 
+def _stage_ooc_smoke(sf: float) -> int:
+    """Force one TPC-H query through the out-of-core spill path at a
+    tiny pinned device budget (docs/out_of_core.md): the planner must
+    insert a morsel scan (``spill.morsels >= 2`` — the scan genuinely
+    streamed), the spilled run must be row-identical to the resident
+    run, and the exchange transient must stay within the pinned
+    budget.  On failure a flight-recorder bundle is dumped and doctor
+    renders it, so the evidence ships with the red CI run."""
+    print("== ci stage 7/8: out-of-core smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        from .. import config as cfg, plan as planner, trace
+        from ..context import CylonContext
+        from ..parallel.dtable import DTable
+        from ..spill import pool as spill_pool
+        from ..tpch import generate
+        from ..tpch.queries import QUERIES
+
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(max(sf, 0.005), seed=7)
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding —
+        # the same contract as the stages above
+        print(f"ooc smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    budget = 200 << 10
+    try:
+        from .parity import frames_rowset_equal
+
+        q1 = QUERIES["q1"]
+
+        resident = planner.run(
+            ctx, lambda t: q1(ctx, t),
+            {n: DTable.from_pandas(ctx, df)
+             for n, df in data.items()}).to_pandas()
+        trace.enable_counters()
+        trace.reset()
+        planner.clear_plan_cache()
+        spill_pool.clear_pool()
+        prev = cfg.set_device_memory_budget(budget)
+        try:
+            spilled = planner.run(
+                ctx, lambda t: q1(ctx, t),
+                {n: DTable.from_pandas(ctx, df)
+                 for n, df in data.items()}).to_pandas()
+            c = dict(trace.counters())
+        finally:
+            cfg.set_device_memory_budget(prev)
+            planner.clear_plan_cache()
+            spill_pool.clear_pool()
+        if not frames_rowset_equal(spilled, resident):
+            print("ooc smoke: the spilled run DIVERGED from the "
+                  "resident run", file=sys.stderr)
+            bad += 1
+        morsels = c.get("spill.morsels", 0)
+        if morsels < 2:
+            print(f"ooc smoke: spill.morsels = {morsels} < 2 — the "
+                  "scan never streamed (morsel insertion or the "
+                  "spilled-input routing regressed)", file=sys.stderr)
+            bad += 1
+        peak = c.get("shuffle.exchange_bytes_peak", 0)
+        if peak > budget:
+            print(f"ooc smoke: exchange transient {peak} B blew past "
+                  f"the {budget} B pinned budget", file=sys.stderr)
+            bad += 1
+        if bad:
+            try:
+                from ..observe import doctor, flightrec
+                bundle = flightrec.dump(reason="ci out-of-core smoke "
+                                               "failure")
+                doctor.main([bundle])
+            except Exception as e:  # graftlint: ok[broad-except] — the
+                # bundle is evidence, not the verdict; a dump failure
+                # must not mask the smoke failure above
+                print(f"ooc smoke: bundle dump failed: {e}",
+                      file=sys.stderr)
+        else:
+            print(f"ooc smoke: q1 spilled run row-identical, "
+                  f"{morsels} morsels, peak {peak} B <= {budget} B "
+                  f"({time.perf_counter() - t0:.1f}s, "
+                  f"sf={max(sf, 0.005)})")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract and
+        # let the remaining stages run instead of dying with a traceback
+        print(f"ooc smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        try:
+            from .. import trace as _trace
+            _trace.disable_counters()
+            _trace.reset()
+        except Exception:  # graftlint: ok[broad-except] — best-effort
+            pass           # teardown must not mask the stage verdict
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 7/7: benchdiff ==")
+    print("== ci stage 8/8: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -688,6 +795,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the doctor (flight recorder) smoke stage")
     ap.add_argument("--no-chaos-smoke", action="store_true",
                     help="skip the chaos-recovery smoke stage")
+    ap.add_argument("--no-ooc-smoke", action="store_true",
+                    help="skip the out-of-core (spill) smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -697,28 +806,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/7: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/8: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/7: serving smoke == (skipped)")
+        print("== ci stage 3/8: serving smoke == (skipped)")
     if not args.no_telemetry_smoke:
         rcs.append(_stage_telemetry_smoke(args.tpch_sf))
     else:
-        print("== ci stage 4/7: telemetry smoke == (skipped)")
+        print("== ci stage 4/8: telemetry smoke == (skipped)")
     if not args.no_doctor_smoke:
         rcs.append(_stage_doctor_smoke(args.tpch_sf))
     else:
-        print("== ci stage 5/7: doctor smoke == (skipped)")
+        print("== ci stage 5/8: doctor smoke == (skipped)")
     if not args.no_chaos_smoke:
         rcs.append(_stage_chaos_smoke(args.tpch_sf))
     else:
-        print("== ci stage 6/7: chaos-recovery smoke == (skipped)")
+        print("== ci stage 6/8: chaos-recovery smoke == (skipped)")
+    if not args.no_ooc_smoke:
+        rcs.append(_stage_ooc_smoke(args.tpch_sf))
+    else:
+        print("== ci stage 7/8: out-of-core smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 7/7: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 8/8: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

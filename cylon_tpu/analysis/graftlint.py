@@ -56,6 +56,21 @@ with examples):
                           point would be injectable but invisible.
                           Dynamic names are skipped (mirrors
                           counter-not-in-catalogue).
+  host-array-unpooled     a ``jax.device_get`` / ``np.asarray`` /
+                          ``np.array`` materialization whose argument
+                          is LEAF-SIZED (mentions a table leaf
+                          attribute — ``.data``/``.validity``/
+                          ``.pending_mask`` — or a ``leaves``-named
+                          collection) outside the spill pool and the
+                          sanctioned device↔host boundaries
+                          (cylon_tpu/spill/pool.py
+                          SANCTIONED_HOST_BOUNDARIES, parsed like the
+                          metric/fault catalogues).  Column-sized host
+                          copies made ad hoc bypass the host-tier
+                          budget, the LRU and the staging fault
+                          points — route them through
+                          ``spill.pool.stage_out_arrays``
+                          (docs/out_of_core.md).
   warn-once-key-literal   a ``glog.warn_once`` whose key is neither a
                           string literal nor a tuple opening with one —
                           a fully dynamic key makes every call unique,
@@ -100,6 +115,7 @@ RULES = (
     "dist-op-unlowered",
     "counter-not-in-catalogue",
     "warn-once-key-literal",
+    "host-array-unpooled",
 )
 
 # Modules whose job IS the device↔host boundary: ingest, export, the
@@ -113,6 +129,10 @@ DEVICE_GET_ALLOWED = (
     "cylon_tpu/parallel/dtable.py",
     "cylon_tpu/ops/compact.py",
     "cylon_tpu/io/",
+    # the spill pool IS the sanctioned host-tier staging boundary
+    # (docs/out_of_core.md); its batched stage_out device_get is the
+    # route the host-array-unpooled rule points everyone else at
+    "cylon_tpu/spill/pool.py",
     # observe/analyze.py is the EXPLAIN ANALYZE measurement boundary:
     # its row peeks are deliberate, explicit, per-operator host reads.
     # The REST of the observe package (registry, exporter, sampler,
@@ -278,6 +298,7 @@ class _Linter(ast.NodeVisitor):
         self._check_counter_catalogue(node, target)
         self._check_warn_once_key(node, target)
         self._check_fault_catalogue(node, target)
+        self._check_host_unpooled(node, target)
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -453,6 +474,37 @@ class _Linter(ast.NodeVisitor):
                    "catalogue (cylon_tpu/observe/metrics.py METRICS) — "
                    "add a row documenting its kind/unit/meaning, or "
                    "derive the name from a catalogued family")
+
+    # -- host-array-unpooled -------------------------------------------------
+
+    def _check_host_unpooled(self, node: ast.Call,
+                             target: Optional[str]) -> None:
+        """Leaf-sized device→host materializations must go through the
+        spill pool (docs/out_of_core.md): a ``jax.device_get`` or
+        ``np.asarray``/``np.array`` whose argument mentions a table
+        leaf attribute (``.data``/``.validity``/``.pending_mask``) or
+        a ``leaves`` collection, outside the sanctioned boundary list
+        the pool itself publishes (``SANCTIONED_HOST_BOUNDARIES`` —
+        mtime-cached AST parse like the metric and fault-point
+        catalogues), bypasses the host budget, the LRU and the
+        ``spill.stage_*`` fault points."""
+        if target not in ("jax.device_get", "device_get", "np.asarray",
+                          "np.array", "numpy.asarray", "numpy.array"):
+            return
+        if not node.args or not _is_leafish_host(node.args[0]):
+            return
+        allowed = _host_boundary_names(self.path)
+        if allowed is None:
+            return  # no pool module to check against (partial tree)
+        norm = self.path.replace(os.sep, "/")
+        if any(a in norm for a in allowed):
+            return
+        self._emit(node, "host-array-unpooled",
+                   f"{target}() materializes leaf-sized data outside "
+                   "the spill pool / sanctioned boundaries — route it "
+                   "through spill.pool.stage_out_arrays so the host "
+                   "budget, LRU and staging fault points apply "
+                   "(docs/out_of_core.md)")
 
     # -- warn-once-key-literal -----------------------------------------------
 
@@ -688,6 +740,38 @@ def _metric_names(linted_path: str) -> Optional[frozenset]:
     return _sibling_names(linted_path, "cylon_tpu/",
                           "cylon_tpu/observe/metrics.py", "METRICS",
                           rows)
+
+
+_LEAF_ATTRS = {"data", "validity", "pending_mask"}
+
+
+def _is_leafish_host(node: ast.AST) -> bool:
+    """Does this expression plausibly reference table-leaf-sized
+    arrays?  Tuned for precision like ``_is_deviceish``: leaf
+    attributes of the table types, or a ``leaves``-named collection."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _LEAF_ATTRS:
+            return True
+        if isinstance(sub, ast.Name) and ("leaves" in sub.id
+                                          or sub.id == "leaf"):
+            return True
+    return False
+
+
+def _host_boundary_names(linted_path: str) -> Optional[frozenset]:
+    """The sanctioned device↔host boundary paths, parsed from the
+    ``SANCTIONED_HOST_BOUNDARIES = (...)`` literal in
+    cylon_tpu/spill/pool.py (located relative to the linted file —
+    the same mtime-cached idiom as the metric catalogue)."""
+    def rows(value: ast.AST) -> Optional[frozenset]:
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            return None
+        return frozenset(e.value for e in value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+    return _sibling_names(linted_path, "cylon_tpu/",
+                          "cylon_tpu/spill/pool.py",
+                          "SANCTIONED_HOST_BOUNDARIES", rows)
 
 
 def _fault_point_names(linted_path: str) -> Optional[frozenset]:

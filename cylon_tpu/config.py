@@ -151,6 +151,102 @@ def device_memory_budget() -> int:
 
 
 # ---------------------------------------------------------------------------
+# host-tier (spill) memory budget (docs/out_of_core.md): the byte
+# ceiling of the spillable leaf pool (cylon_tpu/spill/pool.py) — how
+# much host memory may hold spilled DTable leaves at once.  Pinned
+# entries (host-only copies whose device side was dropped) count in
+# full; resident entries (host copies retained after a fault-in for
+# cheap re-spill) are evictable LRU cache.  Resolution order mirrors
+# the device budget: explicit set_host_memory_budget(bytes) >
+# CYLON_HOST_MEMORY_BUDGET env > DEFAULT_HOST_BUDGET_FRACTION of
+# physical host RAM (floor 64 MiB).
+# ---------------------------------------------------------------------------
+
+DEFAULT_HOST_BUDGET_FRACTION = 0.5
+
+_host_memory_budget: Optional[int] = None   # None -> env/auto
+_auto_host_budget: Optional[int] = None     # detection cache
+
+
+def set_host_memory_budget(n: "Optional[int]") -> "Optional[int]":
+    """Set the session-wide host-tier spill budget in bytes; returns
+    the previous EXPLICIT setting (None when env/auto-resolved) so
+    callers restore it in a finally — the same contract as
+    ``set_device_memory_budget``.  ``None`` restores env/auto."""
+    global _host_memory_budget
+    if n is not None:
+        n = _validate_budget(n, "host memory budget")
+    prev = _host_memory_budget
+    _host_memory_budget = n
+    return prev
+
+
+def host_memory_budget() -> int:
+    """The effective host-tier spill budget in bytes (explicit knob,
+    else ``CYLON_HOST_MEMORY_BUDGET``, else the auto-detected RAM
+    fraction).  The spill pool prices every stage-out against it and
+    raises a typed OutOfMemory (the resource arm of the escalation
+    ladder) when pinned host bytes would exceed it."""
+    global _auto_host_budget
+    if _host_memory_budget is not None:
+        return _host_memory_budget
+    env = os.environ.get("CYLON_HOST_MEMORY_BUDGET", "")
+    if env:
+        try:
+            return _validate_budget(int(env), "CYLON_HOST_MEMORY_BUDGET")
+        except ValueError:
+            raise CylonError(Status(Code.Invalid,
+                f"CYLON_HOST_MEMORY_BUDGET must be an int byte count, "
+                f"got {env!r}")) from None
+    if _auto_host_budget is None:
+        try:
+            limit = (os.sysconf("SC_PAGE_SIZE")
+                     * os.sysconf("SC_PHYS_PAGES"))
+        except (ValueError, OSError, AttributeError):
+            limit = 0
+        if not limit or limit <= 0:
+            limit = 16 << 30
+        _auto_host_budget = max(int(limit * DEFAULT_HOST_BUDGET_FRACTION),
+                                64 << 20)
+    return _auto_host_budget
+
+
+# ---------------------------------------------------------------------------
+# out-of-core (spill) switch (docs/out_of_core.md): governs whether the
+# host-tier spill subsystem engages at all — the planner's morsel-scan
+# insertion, the spilled-input routing in dist_groupby_fused/dist_join,
+# and the chooser's staged-spill floor tier.  Resolution: explicit
+# set_spill_enabled() > CYLON_SPILL env (default on).  The off switch
+# is the A/B lever for isolating whether a behavior difference comes
+# from the out-of-core path itself.
+# ---------------------------------------------------------------------------
+
+_spill_enabled: Optional[bool] = None   # None -> env-resolved
+
+
+def spill_enabled() -> bool:
+    """Whether the host-tier spill subsystem is active (explicit knob,
+    else ``CYLON_SPILL`` — any value but ``0``/empty enables)."""
+    if _spill_enabled is not None:
+        return _spill_enabled
+    return os.environ.get("CYLON_SPILL", "1") not in ("", "0")
+
+
+def set_spill_enabled(on: "Optional[bool]") -> "Optional[bool]":
+    """Set the spill switch (``None`` restores env resolution); returns
+    the previous EXPLICIT setting so callers restore it in a
+    ``finally`` — the same contract as ``set_optimizer_enabled``."""
+    global _spill_enabled
+    if on is not None and not isinstance(on, bool):
+        raise CylonError(Status(Code.Invalid,
+            "spill switch must be True, False or None (env-resolved), "
+            f"got {type(on).__name__} {on!r}"))
+    prev = _spill_enabled
+    _spill_enabled = on
+    return prev
+
+
+# ---------------------------------------------------------------------------
 # exchange strategy override (docs/tpu_perf_notes.md "Choosing the
 # collective"): the costed redistribution chooser (parallel/cost.py)
 # normally picks the collective sequence per exchange from the live

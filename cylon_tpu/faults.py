@@ -109,6 +109,22 @@ POINTS: Dict[str, str] = {
         "the circuit breaker's half-open probe admission "
         "(serve/session.py) — a failure re-opens the breaker for "
         "another cooldown instead of restoring service",
+    # the host tier (docs/out_of_core.md): the spill pool's two staging
+    # boundaries.  Failures here are classed onto the RESOURCE arm of
+    # the escalation ladder, transient kind included — an injected
+    # PERMANENT stays permanent (resilience.classify checks that
+    # first): a staging transfer that failed will fail again on blind
+    # retry — the sound recovery is a replan onto a lowering with a
+    # different host-tier footprint
+    "spill.stage_out":
+        "the spill pool's batched device->host staging transfer "
+        "(spill/pool.stage_out_arrays) — a failed D2H on a tunneled "
+        "backend, or host allocation failure for the pinned blocks",
+    "spill.stage_in":
+        "the spill pool's host->device staging transfer "
+        "(spill/pool.stage_in_arrays; whole fault-ins and per-morsel "
+        "slices) — a failed H2D or device allocation failure for the "
+        "staged block",
 }
 
 
@@ -247,6 +263,20 @@ class FaultPlan:
             # chaos gate covers the self-healing path end to end
             FaultRule("exec.stage", kind="transient", probability=0.02),
             FaultRule("exec.stage", kind="resource", probability=0.01),
+            # host-tier staging faults (docs/out_of_core.md): both
+            # classify onto the resource arm (resilience.classify maps
+            # spill.* fault points to RESOURCE), so chaos runs exercise
+            # the replan ladder over spilled plans end to end.
+            # limit=1: a morsel scan consults these points once PER
+            # MORSEL (hundreds per attempt) — an uncapped per-call
+            # probability would fault every recovery attempt afresh
+            # and defeat the ladder's bounded-replan contract, which
+            # models "a staging fault happened", not "the host tier is
+            # permanently down"
+            FaultRule("spill.stage_in", kind="resource",
+                      probability=0.01, limit=1),
+            FaultRule("spill.stage_out", kind="resource",
+                      probability=0.01, limit=1),
         ])
 
     def _decide(self, point: str, want_value: bool) -> Optional[FaultRule]:

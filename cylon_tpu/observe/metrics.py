@@ -173,6 +173,10 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "exchanges the chooser lowered as replicate-and-filter "
      "(all_gather every leaf, keep own rows — beats the all_to_all "
      "transient under one-hot-cell skew)"),
+    ("shuffle.strategy.staged_spill", COUNTER, "exchanges",
+     "exchanges the chooser lowered as host-tier staged-spill morsel "
+     "rounds (no resident strategy fit the budget; the payload staged "
+     "out to the spill pool and streamed back — docs/out_of_core.md)"),
     ("shuffle.strategy.downgrades", COUNTER, "exchanges",
      "exchanges the chooser moved OFF the single-shot fast path (sum "
      "of the non-single-shot strategy tallies) — bench's per-query "
@@ -365,6 +369,60 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "with the attempt log and the flight recorder holds a "
      "recover_failed event (organic first failures the ladder never "
      "engaged with are annotated but NOT booked here)"),
+    # out-of-core execution (docs/out_of_core.md): the host-tier spill
+    # pool, device<->host staging, and morsel-partitioned scans
+    ("spill.spills", COUNTER, "tables",
+     "tables whose leaves were staged out to the host-tier spill pool "
+     "(device arrays dropped; a content-signature re-spill hit does "
+     "not re-read the device)"),
+    ("spill.respill_hits", COUNTER, "tables",
+     "re-spills served from a retained host copy (content signature "
+     "unchanged since the last spill — no device read ran)"),
+    ("spill.faultins", COUNTER, "tables",
+     "spilled tables faulted back onto the device (transparent on "
+     "first device use, or explicit ensure_device)"),
+    ("spill.evictions", COUNTER, "entries",
+     "resident (cache-tier) pool entries evicted to admit a new "
+     "stage-out under the host memory budget"),
+    ("spill.stage_outs", COUNTER, "transfers",
+     "batched device->host staging transfers through the spill pool "
+     "(the sanctioned leaf-sized D2H boundary — the "
+     "host-array-unpooled lint rule routes here)"),
+    ("spill.stage_out_bytes", COUNTER, "bytes",
+     "payload bytes staged device->host through the pool"),
+    ("spill.stage_ins", COUNTER, "transfers",
+     "host->device staging transfers through the spill pool (whole "
+     "fault-ins and per-morsel slices both count)"),
+    ("spill.stage_in_bytes", COUNTER, "bytes",
+     "payload bytes staged host->device through the pool"),
+    ("spill.host_bytes_peak", WATERMARK, "bytes",
+     "largest total host memory the spill pool held at once (pinned + "
+     "resident entries; the CYLON_HOST_MEMORY_BUDGET watermark)"),
+    ("spill.morsels", COUNTER, "morsels",
+     "admission-priced morsels streamed through out-of-core operators "
+     "(morsel scans and staged-spill exchange rounds)"),
+    ("spill.morsel_groupbys", COUNTER, "groupbys",
+     "groupbys executed through the morsel-partitioned scan (per "
+     "morsel: staged slice -> local partials -> fold; one final "
+     "partial exchange + combine)"),
+    ("spill.morsel_joins", COUNTER, "joins",
+     "joins whose probe side streamed from the spill pool in morsels"),
+    ("spill.exchanges", COUNTER, "exchanges",
+     "exchanges run as the staged-spill lowering (payload staged out, "
+     "morsel rounds staged back in)"),
+    # sketch-based approximate aggregation (docs/out_of_core.md
+    # "sketches"; arXiv:2010.14596): mergeable per-group sketches ARE
+    # the partials, so cross-shard wire bytes are constant per group
+    ("sketch.groupbys", COUNTER, "groupbys",
+     "sketch groupbys executed (dist_groupby_sketch: local sketch "
+     "build -> partial exchange -> sketch merge -> finalize)"),
+    ("sketch.partial_rows", COUNTER, "rows",
+     "per-shard sketch partial rows entering the combine exchange "
+     "(<= groups x shards regardless of input rows — the "
+     "constant-per-group wire contract)"),
+    ("sketch.register_bytes", COUNTER, "bytes",
+     "sketch state bytes moved through combine exchanges (HLL "
+     "register arrays + bottom-k sample lanes)"),
     # serving-layer overload protection (docs/serving.md): the
     # per-plan circuit breaker, load shedding, and graceful drain
     ("serve.shed", COUNTER, "queries",

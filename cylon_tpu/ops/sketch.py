@@ -1,0 +1,244 @@
+"""Mergeable sketches: approximate distinct-count and quantiles.
+
+The second out-of-core workload family (docs/out_of_core.md
+"sketches"; arXiv:2010.14596): aggregations whose per-group state is a
+FIXED-SIZE mergeable summary, so they decompose through the engine's
+partial → exchange → combine path with the sketches themselves as the
+partials — cross-shard wire bytes are constant per group no matter how
+many rows fed them, which is exactly what a high-QPS serving tier
+wants to answer over larger-than-memory data.
+
+Two sketches, both pure jnp kernels over the per-shard sorted group
+structure (ops/groupby.py):
+
+  **HLL distinct count** (``approx_distinct``): ``HLL_M`` = 256
+  registers per group; each row's 32-bit mixed hash contributes
+  ``rank = leading-zeros(hash >> HLL_P) + 1`` to register
+  ``hash & (M-1)`` via one scatter-max.  Merge = elementwise register
+  max (associative, idempotent — re-delivered rows cannot skew it).
+  Estimate: the standard bias-corrected harmonic mean with the
+  small-range linear-counting correction.  Standard error is
+  ``1.04/sqrt(M)`` ≈ 6.5%; :data:`HLL_ERROR_BOUND` advertises the 4σ
+  envelope the error-bound tests assert.
+
+  **Bottom-k quantile sample** (``approx_quantile:<q>``): each row
+  draws a fixed uniform priority ``mix32(value_bits ^ mix32(global row
+  id))``; a group's sketch is the K = ``QUANTILE_K`` rows of smallest
+  priority (a uniform without-replacement sample, because priorities
+  are a fixed random permutation of rows).  Merge = keep the K
+  smallest priorities of the union — order-insensitive and mergeable
+  across shards AND morsels.  The q-quantile estimate is the empirical
+  quantile of the sample (exact when the group has ≤ K rows).  Rank
+  error σ = ``sqrt(q(1-q)/K)`` ≤ ``0.5/sqrt(K)``;
+  :data:`QUANTILE_RANK_ERROR_BOUND` advertises the 4σ envelope.
+
+Layout notes: sketch state rides DTable columns with a trailing dim
+([rows, M] int32 registers / [rows, K] value+priority lanes) — the
+exchange kernels' per-leaf path moves trailing-dim leaves natively, so
+the combine exchange is an ordinary shuffle of the partial table.
+"""
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "HLL_M", "HLL_P", "QUANTILE_K", "PRIO_MAX", "HLL_ERROR_BOUND",
+    "QUANTILE_RANK_ERROR_BOUND", "mix32", "value_bits32", "hll_build",
+    "hll_merge_rows", "hll_estimate", "bottomk_build", "bottomk_merge_rows",
+    "bottomk_quantile", "sorted_slots",
+]
+
+HLL_P = 8                 # register index bits
+HLL_M = 1 << HLL_P        # registers per group (256 → σ ≈ 6.5%)
+QUANTILE_K = 256          # sample slots per group
+PRIO_MAX = jnp.uint32(0xFFFFFFFF)   # empty sample-slot sentinel
+
+# Advertised error envelopes (docs/out_of_core.md "sketch error
+# bounds"): 4× the sketch's standard error — the bound the
+# sketch-vs-exact tests assert, wide enough that a seeded test never
+# flakes, tight enough that a broken sketch (wrong rank math, a merge
+# that drops registers) blows through it.
+HLL_ERROR_BOUND = 4 * 1.04 / math.sqrt(HLL_M)
+QUANTILE_RANK_ERROR_BOUND = 4 * 0.5 / math.sqrt(QUANTILE_K)
+
+
+def mix32(x: jax.Array) -> jax.Array:
+    """The murmur3 32-bit finalizer: a measurably uniform avalanche mix
+    (every input bit flips every output bit with ~1/2 probability) —
+    the hash behind both register selection and sample priorities."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def value_bits32(col: jax.Array) -> jax.Array:
+    """A 32-bit pattern identifying one VALUE (equal values → equal
+    bits): integer/dictionary-code columns narrow with a fold of the
+    high half (x64), floats bitcast (distinct bit patterns are distinct
+    values; ±0.0 and NaN-payload edge cases are documented sketch
+    approximations)."""
+    if jnp.issubdtype(col.dtype, jnp.floating):
+        if jax.config.jax_enable_x64 and col.dtype == jnp.float64:
+            bits = jax.lax.bitcast_convert_type(col, jnp.uint64)
+            return (bits ^ (bits >> 32)).astype(jnp.uint32)
+        bits = jax.lax.bitcast_convert_type(col.astype(jnp.float32),
+                                            jnp.uint32)
+        return bits
+    if jax.config.jax_enable_x64 and col.dtype.itemsize > 4:
+        u = col.astype(jnp.uint64)
+        return (u ^ (u >> 32)).astype(jnp.uint32)
+    return col.astype(jnp.uint32)
+
+
+def sorted_slots(is_first: jax.Array, rvS: jax.Array,
+                 out_cap: int) -> Tuple[jax.Array, jax.Array]:
+    """Per-sorted-row group slot from the group structure: slot ``g``
+    for the rows of the g-th real group, ``out_cap`` (dropped) for
+    padding rows.  Returns ``(slot, keep_first)``."""
+    keep_first = is_first & rvS
+    gid = jnp.cumsum(keep_first.astype(jnp.int32)) - 1
+    slot = jnp.where(rvS, jnp.clip(gid, 0, out_cap),
+                     jnp.int32(out_cap))
+    return slot, keep_first
+
+
+# ---------------------------------------------------------------------------
+# HLL distinct count
+# ---------------------------------------------------------------------------
+
+def _hll_rank(h: jax.Array) -> jax.Array:
+    """rank = leading zeros of the (32−P)-bit suffix + 1; an all-zero
+    suffix saturates at 32−P+1 (the standard convention)."""
+    w = (h >> HLL_P).astype(jnp.uint32)
+    clz_in_32 = jax.lax.clz(w.astype(jnp.int32)).astype(jnp.int32)
+    rank = clz_in_32 - HLL_P + 1
+    return jnp.clip(rank, 1, 32 - HLL_P + 1).astype(jnp.int32)
+
+
+def hll_build(slot: jax.Array, out_cap: int, bits: jax.Array,
+              vmask: jax.Array) -> jax.Array:
+    """[n] rows → [out_cap, M] int32 registers: one scatter-max of each
+    valid row's rank into (its group's slot, its hash's register)."""
+    h = mix32(bits)
+    reg = (h & jnp.uint32(HLL_M - 1)).astype(jnp.int32)
+    rank = jnp.where(vmask, _hll_rank(h), 0)
+    tgt = jnp.where(vmask, slot, jnp.int32(out_cap))
+    return jnp.zeros((out_cap + 1, HLL_M), jnp.int32).at[
+        tgt, reg].max(rank, mode="drop")[:out_cap]
+
+
+def hll_merge_rows(slot: jax.Array, out_cap: int,
+                   regs_rows: jax.Array, row_valid: jax.Array
+                   ) -> jax.Array:
+    """Merge per-row register arrays ([n, M] — each row one partial
+    sketch) into [out_cap, M] by group slot: elementwise scatter-max."""
+    tgt = jnp.where(row_valid, slot, jnp.int32(out_cap))
+    return jnp.zeros((out_cap + 1, HLL_M), jnp.int32).at[tgt].max(
+        regs_rows, mode="drop")[:out_cap]
+
+
+def hll_estimate(regs: jax.Array) -> jax.Array:
+    """[C, M] registers → [C] estimated distinct counts (bias-corrected
+    harmonic mean + the linear-counting small-range correction)."""
+    m = float(HLL_M)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    z = jnp.sum(jnp.exp2(-regs.astype(jnp.float32)), axis=1)
+    raw = alpha * m * m / z
+    v = jnp.sum(regs == 0, axis=1).astype(jnp.float32)
+    small = m * jnp.log(m / jnp.maximum(v, 1.0))
+    est = jnp.where((raw <= 2.5 * m) & (v > 0), small, raw)
+    return jnp.round(est).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# bottom-k quantile sample
+# ---------------------------------------------------------------------------
+
+def _rank_within_slot(slot_sorted: jax.Array) -> jax.Array:
+    """Position of each sorted element within its (nondecreasing) slot
+    run: i − start-of-run, via a cumulative max over run starts."""
+    n = slot_sorted.shape[0]
+    i = jnp.arange(n, dtype=jnp.int32)
+    is_first = jnp.concatenate([jnp.ones(1, bool),
+                                slot_sorted[1:] != slot_sorted[:-1]])
+    starts = jnp.where(is_first, i, jnp.int32(0))
+    return i - jax.lax.cummax(starts)
+
+
+def _bottomk_scatter(slot: jax.Array, prio: jax.Array, vals: jax.Array,
+                     valid: jax.Array, out_cap: int
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Keep each group's K smallest-priority elements: lexicographic
+    (slot, priority) sort via two stable argsorts, rank-within-run,
+    scatter ranks < K into the [out_cap, K] sample lanes."""
+    prio = jnp.where(valid, prio, PRIO_MAX)
+    s = jnp.where(valid, slot, jnp.int32(out_cap))
+    o1 = jnp.argsort(prio, stable=True)
+    o2 = jnp.argsort(s[o1], stable=True)
+    order = o1[o2]
+    slot_sorted = s[order]
+    rank = _rank_within_slot(slot_sorted)
+    keep = (rank < QUANTILE_K) & (slot_sorted < out_cap) \
+        & (prio[order] < PRIO_MAX)
+    tgt_row = jnp.where(keep, slot_sorted, jnp.int32(out_cap))
+    tgt_col = jnp.clip(rank, 0, QUANTILE_K - 1)
+    out_v = jnp.zeros((out_cap + 1, QUANTILE_K), vals.dtype).at[
+        tgt_row, tgt_col].set(vals[order], mode="drop")[:out_cap]
+    out_p = jnp.full((out_cap + 1, QUANTILE_K), PRIO_MAX,
+                     jnp.uint32).at[
+        tgt_row, tgt_col].set(jnp.where(keep, prio[order], PRIO_MAX),
+                              mode="drop")[:out_cap]
+    return out_v, out_p
+
+
+def bottomk_build(slot: jax.Array, out_cap: int, vals: jax.Array,
+                  bits: jax.Array, gidx: jax.Array, vmask: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """[n] rows → ([out_cap, K] sample values, [out_cap, K] priorities):
+    per-row priority = mix of the value bits and the GLOBAL row id, so
+    duplicates draw independent priorities (a uniform row sample, not a
+    distinct-value sample) and the draw is deterministic per row — a
+    re-delivered row merges idempotently."""
+    prio = mix32(bits ^ mix32(gidx.astype(jnp.uint32)))
+    # reserve the sentinel: a real priority of PRIO_MAX would read as
+    # an empty slot after the merge
+    prio = jnp.minimum(prio, PRIO_MAX - jnp.uint32(1))
+    return _bottomk_scatter(slot, prio, vals, vmask, out_cap)
+
+
+def bottomk_merge_rows(slot: jax.Array, out_cap: int,
+                       vals_rows: jax.Array, prio_rows: jax.Array,
+                       row_valid: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Merge per-row [n, K] sample lanes by group slot: flatten every
+    (row, k) element and re-run the bottom-k selection over the union."""
+    flat_slot = jnp.repeat(slot, QUANTILE_K)
+    flat_valid = (jnp.repeat(row_valid, QUANTILE_K)
+                  & (prio_rows.reshape(-1) < PRIO_MAX))
+    return _bottomk_scatter(flat_slot, prio_rows.reshape(-1),
+                            vals_rows.reshape(-1), flat_valid, out_cap)
+
+
+def bottomk_quantile(vals: jax.Array, prios: jax.Array,
+                     q: float) -> Tuple[jax.Array, jax.Array]:
+    """[C, K] sample lanes → ([C] q-quantile estimates float32, [C]
+    non-empty mask).  The estimate is the empirical quantile of the
+    sample: sample values sorted ascending (empty slots to +inf), index
+    ``round(q·(s−1))`` of the ``s`` valid entries."""
+    valid = prios < PRIO_MAX
+    s = jnp.sum(valid, axis=1).astype(jnp.int32)
+    big = jnp.asarray(jnp.inf, jnp.float32)
+    v = jnp.where(valid, vals.astype(jnp.float32), big)
+    vsort = jnp.sort(v, axis=1)
+    idx = jnp.clip(jnp.round(q * jnp.maximum(s - 1, 0)), 0,
+                   QUANTILE_K - 1).astype(jnp.int32)
+    est = jnp.take_along_axis(vsort, idx[:, None], axis=1)[:, 0]
+    return jnp.where(s > 0, est, jnp.float32(0)), s > 0

@@ -14,7 +14,8 @@ from .broadcast import replicate_table
 from .dtable import DColumn, DTable
 from .shuffle import shuffle_leaves
 from .dist_ops import (dist_aggregate, dist_anti_join, dist_groupby,
-                       dist_groupby_fused, dist_head, dist_intersect,
+                       dist_groupby_fused, dist_groupby_sketch,
+                       dist_head, dist_intersect,
                        dist_join, dist_multiway_join, dist_project,
                        dist_select, dist_semi_join, dist_sort,
                        dist_sort_multi, dist_subtract, dist_union,
@@ -28,7 +29,7 @@ __all__ = [
     "dist_semi_join", "dist_anti_join",
     "dist_union", "dist_intersect",
     "dist_subtract", "dist_groupby", "dist_groupby_fused",
-    "dist_aggregate", "dist_sort",
+    "dist_groupby_sketch", "dist_aggregate", "dist_sort",
     "dist_sort_multi",
     "dist_select", "dist_project", "dist_with_column", "dist_head",
     "run_pipeline",

@@ -77,10 +77,11 @@ from ..ops.compact import next_bucket
 
 __all__ = [
     "SINGLE_SHOT", "CHUNKED", "RING", "ALLGATHER", "REPLICATE",
-    "STRATEGIES", "StrategyPrice", "exchange_sizes", "single_shot_bytes",
-    "price_single_shot", "price_chunked", "price_ring", "price_allgather",
-    "price_replicate", "price_retained", "chunk_plan",
-    "enumerate_strategies", "choose", "COLLECTIVE_OF", "predicted_ms",
+    "STAGED_SPILL", "STRATEGIES", "StrategyPrice", "exchange_sizes",
+    "single_shot_bytes", "price_single_shot", "price_chunked",
+    "price_ring", "price_allgather", "price_replicate", "price_retained",
+    "price_staged_spill", "chunk_plan", "enumerate_strategies", "choose",
+    "COLLECTIVE_OF", "predicted_ms",
 ]
 
 SINGLE_SHOT = "single-shot"
@@ -90,11 +91,32 @@ ALLGATHER = "allgather"
 REPLICATE = "replicate"   # broadcast replication (priced, never chosen
 #                           by the shuffle chooser — it changes the
 #                           layout contract, not just the lowering)
+STAGED_SPILL = "staged-spill"   # host-tier staging (docs/out_of_core.md):
+#                           stage the payload OUT to the host pool and
+#                           stream it back in K admission-priced morsels,
+#                           each running one bounded all_to_all round —
+#                           spill is just another lowering with a
+#                           different peak-bytes/wire/rounds point (the
+#                           arXiv:2112.01075 framing extended to the
+#                           host tier).  The chooser's spill TIER fires
+#                           when no resident candidate fits; note that
+#                           under the DEFAULT enumerate pricing the
+#                           chunked floor always prices at or below
+#                           spill's transient (the exchange altitude
+#                           cannot claim the input-residency win — the
+#                           caller owns the input either way), so the
+#                           organic out-of-core entry is the MORSEL
+#                           SCAN at the table/planner altitude, and
+#                           this lowering is reached by the forced
+#                           override or by callers whose candidate
+#                           lists price input residency.
 
 # the shuffle chooser's selectable catalogue, in preference order for
 # deterministic tie-breaks (counter names derive from these — see
-# strategy_counter)
-STRATEGIES = (SINGLE_SHOT, ALLGATHER, CHUNKED, RING)
+# strategy_counter).  staged-spill sits last: it trades PCIe round
+# trips for resident bytes, the lowering of last resort before the
+# best-effort floor
+STRATEGIES = (SINGLE_SHOT, ALLGATHER, CHUNKED, RING, STAGED_SPILL)
 
 
 def strategy_counter(strategy: str) -> str:
@@ -126,10 +148,18 @@ class StrategyPrice:
     wire_bytes: int
     rounds: int
     sizes: Tuple[int, ...]
+    # bytes crossing the HOST boundary (D2H stage-out + H2D stage-in) —
+    # zero for every resident strategy; the staged-spill lowering's
+    # extra cost axis, priced by predicted_ms from the measured
+    # h2d/d2h transfer coefficients (parallel/meshprobe.py)
+    host_bytes: int = 0
 
     def describe(self) -> str:
+        host = (f", {self.host_bytes} B host-staged"
+                if self.host_bytes else "")
         return (f"{self.strategy}: peak {self.peak_bytes} B, "
-                f"{self.rounds} round(s), {self.wire_bytes} B wire")
+                f"{self.rounds} round(s), {self.wire_bytes} B wire"
+                f"{host}")
 
 
 def exchange_sizes(counts: np.ndarray) -> Tuple[int, int, np.ndarray]:
@@ -268,21 +298,52 @@ def price_chunked(nparts: int, counts: np.ndarray, rbytes: int,
         rounds=rounds, sizes=(rounds, C, block, outcap_r))
 
 
+def price_staged_spill(nparts: int, counts: np.ndarray, rbytes: int,
+                       budget: int) -> StrategyPrice:
+    """The host-tier lowering (docs/out_of_core.md "staging price
+    math"): stage the payload out to the spill pool (D2H), stream it
+    back in K rank-sliced morsels — each an independent [P,
+    bucket(C)]-shaped bounded all_to_all round over a MORSEL-sized
+    device block — and fold receiver-side exactly like the chunked
+    rounds.  Unlike every resident strategy, the full input block is
+    NOT on device while the exchange runs: the transient is one
+    morsel's round (the chunked formula) plus the staged morsel block
+    itself, and the price adds 2× the payload in host-boundary bytes
+    (out and back), which :func:`predicted_ms` converts to time via
+    the measured h2d/d2h coefficients."""
+    rounds, C, block, outcap_r = chunk_plan(nparts, counts, rbytes,
+                                            budget)
+    payload = int(counts.sum()) * rbytes
+    return StrategyPrice(
+        STAGED_SPILL,
+        peak_bytes=(single_shot_bytes(nparts, (block, outcap_r), rbytes)
+                    + nparts * block * rbytes),
+        wire_bytes=int(rounds * (nparts - 1) * block * rbytes),
+        rounds=rounds, sizes=(rounds, C, block, outcap_r),
+        host_bytes=2 * payload)
+
+
 def enumerate_strategies(nparts: int, cap: int, counts: np.ndarray,
                          rbytes: int, budget: int,
-                         staged_ok: bool = True) -> List[StrategyPrice]:
+                         staged_ok: bool = True,
+                         spill_ok: bool = False) -> List[StrategyPrice]:
     """Every candidate lowering for one exchange, priced from the count
     matrix.  ``cap`` is the per-shard row capacity (the allgather
     payload).  ``staged_ok=False`` restricts the catalogue to
     single-shot + chunked — the combine-spec (fold-by-key partial
     aggregation) exchanges, whose receiver-side group fold only the
-    chunked rounds implement."""
+    chunked rounds implement.  ``spill_ok`` adds the host-tier
+    ``staged-spill`` lowering (the spill subsystem is enabled and this
+    payload can be staged) — the chooser reaches it only when no
+    resident strategy fits."""
     block, outcap, _ = exchange_sizes(counts)
     out = [price_single_shot(nparts, block, outcap, rbytes)]
     if staged_ok and nparts > 1:
         out.append(price_allgather(nparts, cap, outcap, rbytes))
         out.append(price_ring(nparts, block, outcap, rbytes))
     out.append(price_chunked(nparts, counts, rbytes, budget))
+    if spill_ok and nparts > 1:
+        out.append(price_staged_spill(nparts, counts, rbytes, budget))
     return out
 
 
@@ -295,20 +356,33 @@ COLLECTIVE_OF = {
     RING: "ppermute",
     ALLGATHER: "all_gather",
     REPLICATE: "all_gather",
+    STAGED_SPILL: "all_to_all",   # ICI rounds; the host legs add the
+    #                               measured h2d/d2h terms below
 }
 
 
 def predicted_ms(price: StrategyPrice, profile) -> Optional[float]:
     """Predicted wall-clock of one exchange lowering from a measured
     mesh profile (meshprobe.MeshProfile): α·rounds + wire/β of the
-    strategy's underlying collective.  None without a profile (or for
-    an unmeasured collective) — the annotation and the measured-ranking
-    escape hatch both degrade gracefully to 'unmeasured'."""
+    strategy's underlying collective, plus — for the host-staged
+    lowering — the D2H/H2D transfer legs from the measured ``d2h``/
+    ``h2d`` coefficients (``host_bytes`` is split evenly between the
+    two directions).  None without a profile (or for an unmeasured
+    collective) — the annotation and the measured-ranking escape hatch
+    both degrade gracefully to 'unmeasured'."""
     if profile is None:
         return None
     s = profile.predicted_s(COLLECTIVE_OF.get(price.strategy, ""),
                             price.wire_bytes, price.rounds)
-    return None if s is None else s * 1e3
+    if s is None:
+        return None
+    if price.host_bytes:
+        half = price.host_bytes // 2
+        for leg in ("d2h", "h2d"):
+            t = profile.predicted_s(leg, half, 1)
+            if t is not None:
+                s += t
+    return s * 1e3
 
 
 def choose(candidates: Sequence[StrategyPrice], budget: int,
@@ -360,8 +434,20 @@ def choose(candidates: Sequence[StrategyPrice], budget: int,
             by_name = {c.strategy: c for c in candidates}
             demoted = (f"replan demotion excluded "
                        f"{', '.join(exclude)}; ")
-    feasible = [c for c in candidates if c.peak_bytes <= budget]
+    # the host tier (docs/out_of_core.md): staged-spill never competes
+    # with a FITTING resident strategy — it trades PCIe round trips for
+    # resident bytes, which only pays when nothing resident fits.  It
+    # is the tier between "a resident strategy fits" and the
+    # best-effort floor.
+    spill_c = by_name.get(STAGED_SPILL)
+    feasible = [c for c in candidates
+                if c.peak_bytes <= budget and c.strategy != STAGED_SPILL]
     if not feasible:
+        if spill_c is not None and spill_c.peak_bytes <= budget:
+            return spill_c, (
+                demoted + "no resident strategy fits the "
+                f"{budget} B budget — host-tier staging: "
+                f"{spill_c.describe()}"), True
         c = by_name.get(CHUNKED, min(candidates,
                                      key=lambda s: s.peak_bytes))
         return c, (demoted + f"budget {budget} B below every strategy's "

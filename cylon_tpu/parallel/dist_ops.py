@@ -380,6 +380,15 @@ def dist_join(left: DTable, right: DTable, config: JoinConfig,
     (order an output that needs it with dist_sort, as the TPC-H plans
     do).
     """
+    if left.is_spilled and config.join_type.value in ("inner", "left") \
+            and not right.is_spilled:
+        # out-of-core probe side (docs/out_of_core.md): stream the
+        # spilled left through the morsel scan instead of faulting the
+        # whole block in (INNER/LEFT only — the streaming restriction;
+        # morsel_join falls back with a fault-in for the rest)
+        from ..spill import morsel as spill_morsel
+        return spill_morsel.morsel_join(left, right, config,
+                                        dense_key_range=dense_key_range)
     node = plan_check.note("dist_join", left, right,
                            how=config.join_type.value,
                            alg=config.algorithm.value,
@@ -1830,6 +1839,19 @@ def dist_groupby_fused(dt: DTable, key_columns: Sequence[Union[int, str]],
     if mode not in ("psum", "pre-aggregate", "shuffle"):
         raise CylonError(Status(Code.Invalid,
             f"dist_groupby_fused: unknown mode {mode!r}"))
+    if dt.is_spilled and not emit_empty:
+        # out-of-core input (docs/out_of_core.md): the leaves live in
+        # the host-tier spill pool — stream them through the
+        # morsel-partitioned scan instead of faulting the whole block
+        # in.  Row-identical to the resident path, psum mode included
+        # (psum is a performance lowering; the morsel fold is the
+        # generic one).  emit_empty faults in transparently below: the
+        # dense hint may not engage at morsel width.
+        from ..spill import morsel as spill_morsel
+        return spill_morsel.morsel_groupby(
+            dt, list(key_columns), list(aggregations), where=where,
+            dense_key_range=dense_key_range, emit_empty=emit_empty,
+            reason=reason)
     node = plan_check.note("dist_groupby_fused", dt,
                            keys=tuple(key_columns),
                            aggs=tuple(op for _, op in aggregations),
@@ -1873,6 +1895,374 @@ def dist_groupby_fused(dt: DTable, key_columns: Sequence[Union[int, str]],
                         dense_key_range=dense_key_range,
                         pre_aggregate=False, _local_only=True)
     return _recompose_partials(dt, aggregations, plan, comb, K)
+
+
+# ---------------------------------------------------------------------------
+# sketch-based approximate aggregation (docs/out_of_core.md "sketches";
+# arXiv:2010.14596): per-group mergeable sketches ARE the partials, so
+# the combine exchange moves constant bytes per group regardless of rows
+# ---------------------------------------------------------------------------
+
+def _parse_sketch_op(op: str) -> Tuple[str, "float | None"]:
+    """``approx_distinct`` | ``approx_quantile:<q>`` (default q 0.5) →
+    ``(kind, q)``; anything else raises."""
+    if op == "approx_distinct":
+        return "distinct", None
+    if op == "approx_quantile" or op.startswith("approx_quantile:"):
+        q = 0.5
+        if ":" in op:
+            try:
+                q = float(op.split(":", 1)[1])
+            except ValueError:
+                raise CylonError(Status(Code.Invalid,
+                    f"bad quantile in sketch op {op!r}")) from None
+        if not 0.0 <= q <= 1.0:
+            raise CylonError(Status(Code.Invalid,
+                f"quantile must be in [0, 1], got {q} ({op!r})"))
+        return "quantile", q
+    raise CylonError(Status(Code.Invalid,
+        f"unknown sketch aggregation {op!r} (expected approx_distinct "
+        "or approx_quantile:<q>)"))
+
+
+def sketch_output_name(col_name: str, op: str) -> str:
+    kind, q = _parse_sketch_op(op)
+    if kind == "distinct":
+        return f"approx_distinct_{col_name}"
+    return f"p{int(round(q * 100))}_{col_name}"
+
+
+@kernel_factory
+def _sketch_partial_fn(mesh, axis: str, cap: int, total_cap: int,
+                       key_hasv: Tuple[bool, ...],
+                       val_hasv: Tuple[bool, ...],
+                       kinds: Tuple[str, ...], out_cap: int,
+                       has_where: bool):
+    """Phase A (per shard, no exchange): sort-group the rows and build
+    one fixed-size sketch per (group, aggregation) — HLL registers or
+    bottom-k sample lanes (ops/sketch.py).  Returns the per-shard
+    partial block: group keys + [out_cap, M/K] sketch leaves + group
+    counts.  ``off`` (traced) is the morsel row offset, so the per-row
+    sample priorities stay globally unique across staged morsels with
+    ONE compiled program."""
+    from ..ops import sketch as ops_sketch
+
+    def kernel(cnt, off, key_leaves, val_leaves, *maybe_mask):
+        row_valid = jnp.arange(cap) < cnt[0]
+        if has_where:
+            row_valid = row_valid & maybe_mask[0]
+        me = jax.lax.axis_index(axis)
+        gidx = (me.astype(jnp.uint32) * jnp.uint32(total_cap)
+                + off[0].astype(jnp.uint32)
+                + jnp.arange(cap, dtype=jnp.uint32))
+        carry = [d for d, _ in val_leaves]
+        carry += [v for _, v in val_leaves if v is not None]
+        carry.append(gidx)
+        structure = ops_groupby.group_structure(
+            tuple(d for d, _ in key_leaves),
+            tuple(v for _, v in key_leaves), row_valid,
+            carry=tuple(carry))
+        idxS, is_first, rvS, carried = structure
+        nv = len(val_leaves)
+        vals_s = carried[:nv]
+        it = iter(carried[nv:-1])
+        valids_s = tuple(next(it) if hv else None for hv in val_hasv)
+        gidx_s = carried[-1]
+        slot, keep_first = ops_sketch.sorted_slots(is_first, rvS,
+                                                   out_cap)
+        ngroups = jnp.sum(keep_first).astype(jnp.int32)
+        starts = ops_compact.compact_indices(keep_first, out_cap,
+                                             fill=-1)
+        key_idx = jnp.where(
+            starts >= 0,
+            jnp.take(idxS, jnp.clip(starts, 0, cap - 1)),
+            jnp.int32(-1))
+        keys_out = ops_gather.take_many(key_leaves, key_idx,
+                                        fill_null=False)
+        outs = []
+        for col_s, valid_s, kind in zip(vals_s, valids_s, kinds):
+            vmask = rvS if valid_s is None else (rvS & valid_s)
+            bits = ops_sketch.value_bits32(col_s)
+            if kind == "distinct":
+                outs.append((ops_sketch.hll_build(slot, out_cap, bits,
+                                                  vmask),))
+            else:
+                sv, sp = ops_sketch.bottomk_build(
+                    slot, out_cap, col_s.astype(jnp.float32), bits,
+                    gidx_s, vmask)
+                outs.append((sv, sp))
+        return tuple(keys_out), tuple(outs), ngroups[None]
+
+    spec = P(axis)
+    return jax.jit(shard_map(
+        kernel, mesh=mesh,
+        in_specs=(spec,) * (5 if has_where else 4),
+        out_specs=(spec, spec, spec)))
+
+
+@kernel_factory
+def _sketch_combine_fn(mesh, axis: str, cap: int,
+                       key_hasv: Tuple[bool, ...],
+                       kinds: Tuple[str, ...], qs: Tuple,
+                       out_cap: int, finalize: bool = True):
+    """Phase B (per shard): re-group the partial rows by key and MERGE
+    each group's sketches (register max / bottom-k of the union).
+    With ``finalize`` (after the partial exchange) the merged sketches
+    collapse to result lanes — HLL harmonic estimate, empirical sample
+    quantile; without it (the per-morsel fold of a spilled scan) the
+    MERGED SKETCH STATE comes back instead, in the partial-table lane
+    layout, so the accumulator stays one row per group seen so far.
+    Returns keys + one lane tuple per aggregation + group counts."""
+    from ..ops import sketch as ops_sketch
+
+    def kernel(cnt, key_leaves, sk_leaves):
+        row_valid = jnp.arange(cap) < cnt[0]
+        # sketch state is 2-D ([n, M/K] lanes): it cannot ride the
+        # lax.sort carry (operand shapes must match the keys), so the
+        # rows are gathered into sorted order explicitly instead
+        structure = ops_groupby.group_structure(
+            tuple(d for d, _ in key_leaves),
+            tuple(v for _, v in key_leaves), row_valid)
+        idxS, is_first, rvS, _ = structure
+        carried = []
+        for leaves in sk_leaves:
+            for lf in leaves:
+                carried.append(jnp.take(lf, idxS, axis=0))
+        slot, keep_first = ops_sketch.sorted_slots(is_first, rvS,
+                                                   out_cap)
+        ngroups = jnp.sum(keep_first).astype(jnp.int32)
+        starts = ops_compact.compact_indices(keep_first, out_cap,
+                                             fill=-1)
+        key_idx = jnp.where(
+            starts >= 0,
+            jnp.take(idxS, jnp.clip(starts, 0, cap - 1)),
+            jnp.int32(-1))
+        keys_out = ops_gather.take_many(key_leaves, key_idx,
+                                        fill_null=False)
+        outs = []
+        ci = 0
+        for kind, q in zip(kinds, qs):
+            if kind == "distinct":
+                regs_rows = carried[ci]
+                ci += 1
+                regs = ops_sketch.hll_merge_rows(slot, out_cap,
+                                                 regs_rows, rvS)
+                if finalize:
+                    outs.append((ops_sketch.hll_estimate(regs), None))
+                else:
+                    outs.append((regs,))
+            else:
+                vals_rows, prio_rows = carried[ci], carried[ci + 1]
+                ci += 2
+                mv, mp = ops_sketch.bottomk_merge_rows(
+                    slot, out_cap, vals_rows, prio_rows, rvS)
+                if finalize:
+                    est, nonempty = ops_sketch.bottomk_quantile(mv, mp,
+                                                                q)
+                    outs.append((est, nonempty))
+                else:
+                    outs.append((mv, mp))
+        return tuple(keys_out), tuple(outs), ngroups[None]
+
+    spec = P(axis)
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=(spec, spec, spec)))
+
+
+def _sketch_state_table(ctx, key_meta_cols, keys_out, sk_outs, kinds,
+                        cap: int, counts) -> DTable:
+    """Assemble a sketch PARTIAL-state DTable (keys + trailing-dim
+    sketch lanes) — shared by the per-shard build, the per-morsel fold
+    and nothing else, so the lane layout cannot drift between them."""
+    from ..dtypes import Type
+    cols = []
+    for meta, (kd, kv) in zip(key_meta_cols, keys_out):
+        cols.append(DColumn(meta.name, meta.dtype, kd, kv,
+                            meta.dictionary, meta.arrow_type))
+    for j, (leaves, kind) in enumerate(zip(sk_outs, kinds)):
+        if kind == "distinct":
+            cols.append(DColumn(f"__hll{j}", DataType(Type.INT32),
+                                leaves[0]))
+        else:
+            cols.append(DColumn(f"__bkv{j}", DataType(Type.FLOAT),
+                                leaves[0]))
+            cols.append(DColumn(f"__bkp{j}", DataType(Type.UINT32),
+                                leaves[1]))
+    return DTable(ctx, cols, cap, counts)
+
+
+def _sketch_state_groups(part: DTable, K: int, kinds) -> Tuple:
+    """The sketch-lane leaves of a partial-state table, grouped per
+    aggregation in the `_sketch_state_table` layout."""
+    groups = []
+    ci = K
+    for kind in kinds:
+        if kind == "distinct":
+            groups.append((part.columns[ci].data,))
+            ci += 1
+        else:
+            groups.append((part.columns[ci].data,
+                           part.columns[ci + 1].data))
+            ci += 2
+    return tuple(groups)
+
+
+def _sketch_merge_local(part: DTable, K: int, kinds, qs) -> DTable:
+    """Merge same-group rows of a partial-state table IN PLACE (no
+    exchange): the per-morsel fold of a spilled sketch scan — the
+    accumulator stays one row per group seen so far instead of growing
+    with morsels."""
+    key_leaves = tuple((part.columns[i].data, part.columns[i].validity)
+                       for i in range(K))
+    fn = _sketch_combine_fn(
+        part.ctx.mesh, part.ctx.axis, part.cap,
+        tuple(part.columns[i].validity is not None for i in range(K)),
+        kinds, qs, part.cap, False)
+    keys_out, outs, counts = fn(part.counts, key_leaves,
+                                _sketch_state_groups(part, K, kinds))
+    return _sketch_state_table(part.ctx, part.columns[:K], keys_out,
+                               outs, kinds, part.cap, counts)
+
+
+def _sketch_partial_table(dt: DTable, key_ids, val_ids, kinds, where,
+                          off: int, total_cap: int) -> DTable:
+    """One table's (or morsel's) per-shard sketch partials as a DTable:
+    key columns + sketch-state columns with trailing dims (the
+    exchange's per-leaf path moves those natively)."""
+    pmask = _effective_mask(dt, where)
+    key_leaves = tuple((dt.columns[i].data, dt.columns[i].validity)
+                       for i in key_ids)
+    val_leaves = tuple((dt.columns[i].data, dt.columns[i].validity)
+                       for i in val_ids)
+    out_cap = dt.cap   # groups <= rows/shard; partial blocks are
+    #                    input-capacity-bounded (the exchange's receive
+    #                    blocks size to ACTUAL groups via the counts)
+    fn = _sketch_partial_fn(
+        dt.ctx.mesh, dt.ctx.axis, dt.cap, total_cap,
+        tuple(dt.columns[i].validity is not None for i in key_ids),
+        tuple(dt.columns[i].validity is not None for i in val_ids),
+        kinds, out_cap, pmask is not None)
+    offs = jax.device_put(np.full(dt.nparts, off, np.int32),
+                          dt.ctx.sharding())
+    args = (dt.counts, offs, key_leaves, val_leaves) \
+        + (() if pmask is None else (pmask,))
+    keys_out, sk_outs, counts = fn(*args)
+    return _sketch_state_table(dt.ctx, [dt.columns[i] for i in key_ids],
+                               keys_out, sk_outs, kinds, out_cap,
+                               counts)
+
+
+@plan_check.instrument
+def dist_groupby_sketch(dt: DTable,
+                        key_columns: Sequence[Union[int, str]],
+                        aggregations: Sequence[Tuple[Union[int, str],
+                                                     str]],
+                        where=None) -> DTable:
+    """Sketch-based approximate groupby (docs/out_of_core.md
+    "sketches"): per group, ``approx_distinct`` estimates the distinct
+    count of a column via HLL registers and ``approx_quantile:<q>``
+    estimates its q-quantile from a bottom-k uniform sample — both
+    within the advertised error bounds (ops/sketch.py
+    ``HLL_ERROR_BOUND`` / ``QUANTILE_RANK_ERROR_BOUND``), both
+    decomposed through the partial → exchange → combine path with the
+    SKETCHES as the partials: the combine exchange moves one
+    fixed-size summary per (group, shard) no matter how many rows fed
+    it — the constant-per-group wire contract that makes these the
+    cheap high-QPS answer over larger-than-memory data (the serving
+    tier submits them like any other plan, and a SPILLED input streams
+    through the morsel scan, merging per-morsel sketches).
+
+    Output columns: keys, then ``approx_distinct_{col}`` (int) /
+    ``p{q*100}_{col}`` (float32, null for all-null groups) in
+    aggregation order."""
+    from ..dtypes import Type
+    node = plan_check.note("dist_groupby_sketch", dt,
+                           keys=tuple(key_columns),
+                           aggs=tuple(op for _, op in aggregations))
+    trace.count("sketch.groupbys")
+    key_ids = _resolve_ids(dt, key_columns)
+    K = len(key_ids)
+    val_ids = [dt.column_index(c) for c, _ in aggregations]
+    parsed = [_parse_sketch_op(op) for _, op in aggregations]
+    kinds = tuple(kind for kind, _ in parsed)
+    qs = tuple(-1.0 if q is None else q for _, q in parsed)
+    if dt.is_spilled:
+        # out-of-core input: per-morsel partials from staged slices,
+        # FOLDED incrementally — sketches merge, so the accumulator
+        # holds one state row per group seen so far (retaining all K
+        # morsel partials would scale device memory with the input ×
+        # the sketch width, defeating the budget the scan honors).
+        # Stage-in of morsel k+1 overlaps device compute of morsel k
+        # through the HostPipeline, the morsel-scan invariant.
+        from contextlib import closing
+
+        from ..resilience import exchange_budget
+        from ..spill import morsel as spill_morsel
+        from ..spill import pool as spill_pool
+        from .streaming import _concat_compact
+        entry = spill_pool.get_pool().pin_for_scan(dt)
+        cap = entry.cap
+        k, w, per = spill_morsel.plan_morsels(
+            dt.nparts, cap, spill_morsel._spilled_rbytes(dt),
+            exchange_budget())
+        plan_check.annotate(node, decision="morsel-scan",
+                            reason=f"{k} morsels x {w} rows/shard "
+                                   f"({per} B/morsel)")
+        acc = None
+        with closing(spill_morsel.iter_morsels(dt, entry, k, w,
+                                               cap)) as scan:
+            for m, sl in enumerate(scan):
+                part_m = _sketch_partial_table(
+                    sl, key_ids, val_ids, kinds, where, m * w, cap)
+                if acc is None:
+                    acc = part_m
+                else:
+                    acc = _sketch_merge_local(
+                        _concat_compact([acc, part_m]), K, kinds, qs)
+        part = acc
+    else:
+        part = _sketch_partial_table(dt, key_ids, val_ids, kinds,
+                                     where, 0, dt.cap)
+    pcnt = part.counts_host()
+    prows = int(np.asarray(pcnt).sum())
+    trace.count("sketch.partial_rows", prows)
+    from .. import observe
+    sk_leaves = [lf for c in part.columns[K:]
+                 for lf in (c.data, c.validity) if lf is not None]
+    trace.count("sketch.register_bytes",
+                prows * max(observe.row_bytes(sk_leaves), 1))
+    with trace.span("sketch.shuffle"):
+        sh = _shuffle_by_pids(part, _hash_pids(part, list(range(K))),
+                              owner="groupby")
+    key_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
+                       for i in range(K))
+    fn = _sketch_combine_fn(
+        sh.ctx.mesh, sh.ctx.axis, sh.cap,
+        tuple(sh.columns[i].validity is not None for i in range(K)),
+        kinds, qs, sh.cap, True)
+    with trace.span_sync("sketch.combine") as sp:
+        keys_out, outs, counts = fn(
+            sh.counts, key_leaves, _sketch_state_groups(sh, K, kinds))
+        sp.sync(outs)
+    cols = []
+    for i, (kd, kv) in zip(key_ids, keys_out):
+        c = dt._columns[i]
+        cols.append(DColumn(c.name, c.dtype, kd, kv, c.dictionary,
+                            c.arrow_type))
+    idt = Type.INT64 if jax.config.jax_enable_x64 else Type.INT32
+    for (cref, op), (est, valid), kind in zip(aggregations, outs,
+                                              kinds):
+        base = dt._columns[dt.column_index(cref)]
+        name = sketch_output_name(base.name, op)
+        if kind == "distinct":
+            cols.append(DColumn(name, DataType(idt),
+                                est.astype(jnp.int64
+                                           if jax.config.jax_enable_x64
+                                           else jnp.int32), None))
+        else:
+            cols.append(DColumn(name, DataType(Type.FLOAT), est,
+                                valid))
+    return DTable(dt.ctx, cols, sh.cap, counts)
 
 
 @kernel_factory

@@ -49,6 +49,23 @@ _HEAD_FUSED_MAX = 4096
 _TO_TABLE_PROBE_MAX_CELLS = 16 << 20
 
 
+class _SpilledLeaf:
+    """Sentinel standing in for a device leaf while the table's data
+    resides host-side in the spill pool (cylon_tpu/spill/pool.py).
+    Never reaches a kernel: every device-data access path goes through
+    the ``DTable.columns``/``counts`` properties, which fault the real
+    arrays back in first (docs/out_of_core.md "transparent
+    fault-in")."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "<spilled>"
+
+
+_SPILLED = _SpilledLeaf()
+
+
 @dataclass
 class DColumn:
     """One distributed column: global sharded data + optional validity.
@@ -82,12 +99,70 @@ class DTable:
                  counts: jax.Array, pending_mask: Optional[jax.Array] = None,
                  pending_cnts: Optional[jax.Array] = None):
         self.ctx = ctx
+        # host-tier spill state (cylon_tpu/spill/pool.py): while
+        # _spill_entry is set, the leaves live host-side and the
+        # columns/counts PROPERTIES fault them back in on first device
+        # use.  _spill_sig is the content signature — it survives a
+        # fault-in so an unchanged table re-spills without a device
+        # read, and is invalidated whenever contents change
+        # (_collapse_pending).
+        self._spill_entry = None
+        self._spill_sig: Optional[int] = None
         self.columns = columns
         self.cap = int(cap)
         self.counts = counts               # [P] int32, sharded P('p')
         self.pending_mask = pending_mask   # [P*cap] bool or None
         self.pending_cnts = pending_cnts   # replicated [P] survivor counts
         self._counts_host: Optional[np.ndarray] = None
+
+    # -- the host tier (docs/out_of_core.md) ---------------------------------
+
+    @property
+    def columns(self) -> List[DColumn]:
+        if self._spill_entry is not None:
+            self._fault_in()
+        return self._columns
+
+    @columns.setter
+    def columns(self, v: List[DColumn]) -> None:
+        self._columns = v
+
+    @property
+    def counts(self):
+        if self._spill_entry is not None:
+            self._fault_in()
+        return self._counts
+
+    @counts.setter
+    def counts(self, v) -> None:
+        self._counts = v
+
+    @property
+    def is_spilled(self) -> bool:
+        """Whether the leaves currently reside host-side (spill pool)."""
+        return self._spill_entry is not None
+
+    def spill(self) -> "DTable":
+        """Move this table's leaves to the host-tier spill pool and
+        drop the device arrays (docs/out_of_core.md).  The table keeps
+        working: metadata (names/dtypes/counts) reads stay host-side,
+        any device use faults the leaves back in transparently, and the
+        morsel scan (spill/morsel.py) streams row slices straight from
+        the pooled blocks.  Idempotent; returns self."""
+        from ..spill import pool as spill_pool
+        spill_pool.spill_table(self)
+        return self
+
+    def ensure_device(self) -> "DTable":
+        """Explicitly fault spilled leaves back onto the device (the
+        eager counterpart of the transparent property fault-in)."""
+        if self._spill_entry is not None:
+            self._fault_in()
+        return self
+
+    def _fault_in(self) -> None:
+        from ..spill import pool as spill_pool
+        spill_pool.ensure_device(self)
 
     def _collapse_pending(self) -> None:
         """Materialize a deferred select IN PLACE (identity-preserving:
@@ -105,6 +180,8 @@ class DTable:
         self.cap = out.cap
         self.counts = out.counts
         self._counts_host = None
+        self._spill_sig = None   # contents changed: the pooled host
+        #                          copy (if any) no longer matches
 
     # -- shape ---------------------------------------------------------------
 
@@ -114,14 +191,18 @@ class DTable:
 
     @property
     def num_columns(self) -> int:
-        return len(self.columns)
+        return len(self._columns)   # metadata: never faults a spill in
 
     @property
     def column_names(self) -> List[str]:
-        return [c.name for c in self.columns]
+        return [c.name for c in self._columns]   # metadata: no fault-in
 
     def counts_host(self) -> np.ndarray:
         self._collapse_pending()
+        if self._counts_host is not None:
+            # cached (ingest / spill): answer host-side — a SPILLED
+            # table's row counts must never fault the leaves back in
+            return self._counts_host
         if self._counts_host is None and is_abstract(self.counts):
             # abstract plan run: the counts of a derived table are data-
             # dependent by definition — a plan that needs them on host
@@ -164,7 +245,7 @@ class DTable:
 
     def column_index(self, i: Union[int, str]) -> int:
         if isinstance(i, str):
-            for j, c in enumerate(self.columns):
+            for j, c in enumerate(self._columns):   # metadata only
                 if c.name == i:
                     return j
             raise CylonError(Status(Code.KeyError, f"no column {i!r}"))
@@ -175,7 +256,7 @@ class DTable:
         if self.num_columns != other.num_columns:
             raise CylonError(Status(Code.Invalid,
                 f"column count mismatch {self.num_columns} vs {other.num_columns}"))
-        for a, b in zip(self.columns, other.columns):
+        for a, b in zip(self._columns, other._columns):
             if a.dtype.type != b.dtype.type:
                 raise CylonError(Status(Code.TypeError,
                     f"type mismatch {a.name}:{a.dtype.type.name} vs "
@@ -580,14 +661,15 @@ class DTable:
             if validate:
                 plan_check._check_table("explain", self)
             cols = ", ".join(f"{c.name}:{c.dtype.type.name}"
-                             for c in self.columns)
+                             for c in self._columns)
             ch = self._counts_host
             rows = (f"{int(ch.sum())} rows" if ch is not None
                     else "rows data-dependent")
             mask = ", deferred-select mask pending" \
                 if self.pending_mask is not None else ""
+            spilled = ", spilled to host" if self.is_spilled else ""
             return (f"DTable[{rows} over {self.nparts} shards, "
-                    f"cap={self.cap}{mask}]({cols})")
+                    f"cap={self.cap}{mask}{spilled}]({cols})")
         target = tables if tables is not None else self
         op = plan
         if optimize:
@@ -603,18 +685,20 @@ class DTable:
                                   concrete=concrete)
 
     def __repr__(self) -> str:
-        cols = ", ".join(f"{c.name}:{c.dtype.type.name}" for c in self.columns)
+        cols = ", ".join(f"{c.name}:{c.dtype.type.name}"
+                         for c in self._columns)
         ch = self._counts_host
         if ch is not None:
             rows = f"{int(ch.sum())} rows"
-        elif is_abstract(self.counts):
+        elif is_abstract(self._counts):
             # abstract plan run: a repr (user print, debugger, error
             # formatter) must never raise the counts_host plan error
             rows = "abstract rows"
         else:
             rows = f"{self.num_rows} rows"
+        spilled = ", spilled to host" if self.is_spilled else ""
         return (f"DTable[{rows} over {self.nparts} shards, "
-                f"cap={self.cap}]({cols})")
+                f"cap={self.cap}{spilled}]({cols})")
 
 
 @jax.jit

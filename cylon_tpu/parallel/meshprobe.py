@@ -48,9 +48,17 @@ from jax.sharding import PartitionSpec as P
 from .. import trace
 
 __all__ = ["MeshProfile", "mesh_fingerprint", "probe", "get_profile",
-           "clear_profiles", "COLLECTIVES"]
+           "clear_profiles", "COLLECTIVES", "TRANSFERS"]
 
 COLLECTIVES = ("all_to_all", "ppermute", "all_gather")
+
+# host-boundary transfer probes (docs/out_of_core.md "staging price
+# math"): the two legs every staged-spill lowering pays — host→device
+# (jax.device_put under the mesh sharding) and device→host
+# (jax.device_get) — fitted to the same α/β model and cached under the
+# same mesh fingerprint, so cost.predicted_ms can price a spill's PCIe
+# round trips next to its ICI rounds
+TRANSFERS = ("h2d", "d2h")
 
 # fingerprint -> MeshProfile (plus the optional JSON mirror behind
 # CYLON_MESHPROBE_PATH); lock-guarded — a serve dispatcher may probe
@@ -110,7 +118,7 @@ class MeshProfile:
 
     def describe(self) -> str:
         parts = []
-        for c in COLLECTIVES:
+        for c in COLLECTIVES + TRANSFERS:
             if c in self.latency_s:
                 parts.append(f"{c}: {self.latency_s[c] * 1e3:.3f} ms + "
                              f"{self.bytes_per_s[c] / 1e9:.3f} GB/s")
@@ -226,9 +234,31 @@ def probe(ctx, sizes: Tuple[int, ...] = (1 << 12, 1 << 15, 1 << 18),
                     dt = time.perf_counter() - t0
                     best = dt if best is None else min(best, dt)
                 samples.append((coll, int(wire), float(best)))
+            # the host-boundary legs of the staged-spill lowering: one
+            # sharded device_put (h2d) and one device_get (d2h) of the
+            # same payload, timed to hard completion like the
+            # collectives — the spill pool's stage_in/stage_out pay
+            # exactly these
+            host = np.asarray(
+                jax.device_get(x))  # graftlint: ok[implicit-host-sync]
+            #                         — the transfer IS the measurement
+            best_h = best_d = None
+            for _ in range(max(reps, 1)):
+                t0 = time.perf_counter()
+                y = jax.device_put(host, ctx.sharding())
+                trace.hard_sync(y)
+                dt = time.perf_counter() - t0
+                best_h = dt if best_h is None else min(best_h, dt)
+                t0 = time.perf_counter()
+                np.asarray(
+                    jax.device_get(y))  # graftlint: ok[implicit-host-sync]
+                dt = time.perf_counter() - t0
+                best_d = dt if best_d is None else min(best_d, dt)
+            samples.append(("h2d", int(host.nbytes), float(best_h)))
+            samples.append(("d2h", int(host.nbytes), float(best_d)))
     latency: Dict[str, float] = {}
     bw: Dict[str, float] = {}
-    for coll in COLLECTIVES:
+    for coll in COLLECTIVES + TRANSFERS:
         pts = [(w, t) for c, w, t in samples if c == coll]
         if pts:
             latency[coll], bw[coll] = _fit(pts)

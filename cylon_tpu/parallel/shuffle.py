@@ -659,6 +659,143 @@ def _fold_combine_fn(mesh, axis: str, spec, incap: int, acc_cap: int,
 _chunk_sizes = cost.chunk_plan
 
 
+def _staged_spill_exchange(ctx, pid, leaves, counts: np.ndarray,
+                           rbytes: int, budget: int, outcap_total: int,
+                           choice, combine=None):
+    """The host-tier lowering (docs/out_of_core.md): stage the payload
+    OUT to the spill pool, then stream it back in ``rounds``
+    rank-sliced morsels — each a [P, bucket(C)]-shaped bounded
+    all_to_all over a MORSEL-sized staged block — folded receiver-side
+    exactly like the chunked rounds (plain concat, or fold-by-key under
+    a ``combine`` spec).  Unlike the chunked path, the full-size input
+    block is not needed on device while the rounds run; morsel k+1's
+    host assembly + async ``device_put`` overlaps morsel k's device
+    compute through the HostPipeline.  Identical rows out, same
+    ``(block, outcap)`` size classes as the chunked plan, so the extra
+    compile cost is zero."""
+    from ..spill import pool as spill_pool
+    from .streaming import HostPipeline
+    mesh, axis, Pn = ctx.mesh, ctx.axis, ctx.get_world_size()
+    rounds, C, block, outcap_k = choice.sizes
+    trace.count("spill.exchanges")
+    trace.count_max("shuffle.exchange_bytes_peak", choice.peak_bytes)
+    from ..analysis import plan_check
+    plan_check.annotate(
+        degraded=f"staged-spill shuffle: {rounds} host-staged morsels "
+                 f"of <= {C} rows/cell ({choice.peak_bytes} B/morsel "
+                 f"vs {budget} B budget)")
+    cap = pid.shape[0] // max(Pn, 1)
+    morsel_cap = ops_compact.next_bucket(
+        max(min(Pn * C, cap), 1), minimum=8)
+    # the host budget covers EVERY stage-out (config contract): reserve
+    # the payload PLUS the in-flight staged-morsel working copies (two
+    # can be live at once under the HostPipeline prefetch) against the
+    # pool before transferring — exhaustion raises the typed OOM the
+    # escalation ladder replans on, instead of a raw host OOM
+    payload_bytes = int(pid.nbytes) + sum(int(lf.nbytes) for lf in leaves)
+    per_row = 4 + sum(int(np.dtype(lf.dtype).itemsize)
+                      * int(np.prod(lf.shape[1:], dtype=np.int64))
+                      for lf in leaves)
+    reserve_bytes = payload_bytes + 2 * Pn * morsel_cap * per_row
+    the_pool = spill_pool.get_pool()
+    the_pool.reserve_transient(reserve_bytes)
+    try:
+        hosts = spill_pool.stage_out_arrays([pid] + list(leaves))
+    except BaseException:
+        the_pool.release_transient(reserve_bytes)
+        raise
+    hpid = hosts[0].astype(np.int32, copy=False)
+    hleaves = hosts[1:]
+    # host-side rank of every row within its (shard, target) cell —
+    # the same quantity _rank_fn computes on device for the chunked
+    # path; morsel k stages exactly the rank slice [k·C, (k+1)·C)
+    rank = np.empty(Pn * cap, np.int64)
+    for i in range(Pn):
+        blk = hpid[i * cap:(i + 1) * cap]
+        order = np.argsort(blk, kind="stable")
+        cell = np.bincount(blk, minlength=Pn + 2)
+        offs = np.concatenate([[0], np.cumsum(cell)])[:-1]
+        rank_sorted = np.arange(cap) - offs[blk[order]]
+        rank[i * cap:(i + 1) * cap][order] = rank_sorted
+    exchange = _exchange_fn(mesh, axis, Pn, block, outcap_k)
+
+    def stage(k: int):
+        pid_m = np.full(Pn * morsel_cap, Pn, np.int32)
+        lm = [np.zeros((Pn * morsel_cap,) + h.shape[1:], h.dtype)
+              for h in hleaves]
+        for i in range(Pn):
+            lo_, hi_ = i * cap, (i + 1) * cap
+            sel = ((hpid[lo_:hi_] < Pn)
+                   & (rank[lo_:hi_] >= k * C)
+                   & (rank[lo_:hi_] < (k + 1) * C))
+            rows = np.nonzero(sel)[0]
+            n = len(rows)
+            if n:
+                at = i * morsel_cap
+                pid_m[at:at + n] = hpid[lo_:hi_][rows]
+                for lm_j, h in zip(lm, hleaves):
+                    lm_j[at:at + n] = h[lo_:hi_][rows]
+        devs = spill_pool.stage_in_arrays(ctx, [pid_m] + lm)
+        return devs[0], tuple(devs[1:])
+
+    dm0 = _devmem_before(ctx)
+    t_ex0 = time.perf_counter()
+    acc_cnt = acc = None
+    acc_cap = outcap_total
+    acc_groups = None
+    pipe = HostPipeline(name="spill-exchange")
+    try:
+        with trace.span_sync("shuffle.exchange") as sp:
+            nxt = pipe.submit(lambda: stage(0))
+            for k in range(rounds):
+                pid_k, leaves_k = nxt.wait()
+                if k + 1 < rounds:
+                    nxt = pipe.submit(lambda k=k: stage(k + 1))
+                trace.count("spill.morsels")
+                cnt_k, outs_k = exchange(pid_k, leaves_k)
+                if combine is None:
+                    if acc is None:
+                        acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
+                                                outcap_total, True)(
+                            cnt_k, outs_k)
+                    else:
+                        acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
+                                                outcap_total, False)(
+                            acc_cnt, cnt_k, acc, outs_k)
+                    continue
+                trace.count("shuffle.fold_combined")
+                if acc is None:
+                    prev_cap, out_cap = 0, outcap_k
+                    acc_cnt, acc = _fold_combine_fn(
+                        mesh, axis, combine, outcap_k, 0, out_cap,
+                        True)(cnt_k, outs_k)
+                else:
+                    recv_k = np.minimum(np.maximum(counts - k * C, 0),
+                                        C).sum(axis=0)
+                    bound = acc_groups + recv_k
+                    prev_cap = acc_cap
+                    out_cap = ops_compact.next_bucket(
+                        max(int(bound.max(initial=0)), 1), minimum=8)
+                    acc_cnt, acc = _fold_combine_fn(
+                        mesh, axis, combine, outcap_k, acc_cap, out_cap,
+                        False)(acc_cnt, cnt_k, acc, outs_k)
+                acc_cap = out_cap
+                trace.count_max(
+                    "shuffle.exchange_bytes_peak",
+                    choice.peak_bytes + (prev_cap + acc_cap) * rbytes)
+                if k + 1 < rounds:
+                    acc_groups = np.asarray(
+                        ops_compact._read_counts(acc_cnt))
+            sp.sync(acc)
+    finally:
+        pipe.close()
+        the_pool.release_transient(reserve_bytes)
+    _note_exchange_ms(ctx, choice, t_ex0, dm0)
+    if combine is not None:
+        return list(acc), acc_cnt, acc_cap
+    return list(acc), acc_cnt, outcap_total
+
+
 def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
                       budget: int, outcap_total: int, combine=None,
                       plan=None, choice=None):
@@ -773,7 +910,8 @@ def _choose(Pn: int, cap: int, counts: np.ndarray, rbytes: int,
     ``ctx``'s mesh, ranking by measured collective time instead of the
     (rounds, wire) proxy."""
     from .. import resilience
-    from ..config import cost_measured_enabled, exchange_strategy
+    from ..config import (cost_measured_enabled, exchange_strategy,
+                          spill_enabled)
     from . import meshprobe
     forced = exchange_strategy()
     profile = meshprobe.get_profile(ctx) if ctx is not None else None
@@ -794,7 +932,8 @@ def _choose(Pn: int, cap: int, counts: np.ndarray, rbytes: int,
         if ss.peak_bytes <= budget:
             return ss, f"{ss.describe()} <= budget {budget} B", True
     cands = cost.enumerate_strategies(Pn, cap, counts, rbytes, budget,
-                                      staged_ok=combine is None)
+                                      staged_ok=combine is None,
+                                      spill_ok=spill_enabled())
     return cost.choose(cands, budget, forced, profile=profile,
                        measured=measured, exclude=exclude)
 
@@ -965,6 +1104,10 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
             return _chunked_exchange(ctx, pid, leaves, counts, rbytes,
                                      budget, outcap, combine,
                                      plan=choice.sizes, choice=choice)
+        if choice.strategy == cost.STAGED_SPILL:
+            return _staged_spill_exchange(ctx, pid, leaves, counts,
+                                          rbytes, budget, outcap,
+                                          choice, combine)
         return _staged_exchange(ctx, pid, leaves, choice, outcap)
 
     try:
@@ -985,6 +1128,10 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
                                      budget, ob.need[1], combine,
                                      plan=ob.choice.sizes,
                                      choice=ob.choice)
+        if ob.choice.strategy == cost.STAGED_SPILL:
+            return _staged_spill_exchange(ctx, pid, leaves, ob.counts,
+                                          rbytes, budget, ob.need[1],
+                                          ob.choice, combine)
         return _staged_exchange(ctx, pid, leaves, ob.choice, ob.need[1])
     if budget is not None:
         trace.count_max("shuffle.exchange_bytes_peak",

@@ -135,6 +135,15 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
     the DTable contract leaves undefined).  See the module docstring for
     the INNER/LEFT restriction.
     """
+    if left.is_spilled and config.join_type.value in ("inner", "left") \
+            and not right.is_spilled:
+        # out-of-core probe side (docs/out_of_core.md): the leaves live
+        # in the host-tier spill pool — stream them from there instead
+        # of letting the prologue's first leaf access fault the whole
+        # block back in (which would re-create exactly the residency
+        # this lowering exists to bound)
+        from ..spill import morsel as spill_morsel
+        return spill_morsel.morsel_join(left, right, config)
     if (chunks <= 1 or left.cap < chunks
             or config.join_type.value in ("right", "full_outer")):
         from .. import logging as glog

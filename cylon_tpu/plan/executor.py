@@ -264,6 +264,13 @@ def _lower_groupby_fused(ctx, ins, static, rt):
         reason=static["reason"])
 
 
+def _lower_groupby_sketch(ctx, ins, static, rt):
+    from ..parallel import dist_ops
+    return dist_ops.dist_groupby_sketch(
+        ins[0], list(static["keys"]),
+        [(c, op) for c, op in static["aggs"]], where=rt.get("where"))
+
+
 def _lower_aggregate(ctx, ins, static, rt):
     from ..parallel import dist_ops
     return dist_ops.dist_aggregate(ins[0],
@@ -300,6 +307,37 @@ def _lower_shuffle(ctx, ins, static, rt):
     return dist_ops.shuffle_table(ins[0], list(static["keys"]))
 
 
+def _lower_morsel_scan(ctx, ins, static, rt):
+    """The out-of-core seam (docs/out_of_core.md): re-price the scan
+    against the LIVE budget — like every costed decision, the plan
+    cache stays budget-free — and spill its leaves to the host pool
+    when it still does not fit.  The spilled table flows to the
+    consumer unchanged; dist_groupby_fused / dist_join detect the
+    spilled input and stream it in morsels."""
+    from ..config import spill_enabled
+    from ..resilience import exchange_budget
+    from ..spill import morsel as spill_morsel
+    dt = ins[0]
+    if not spill_enabled() or dt.is_spilled:
+        return dt
+    nparts = ctx.get_world_size()
+    rbytes = spill_morsel._spilled_rbytes(dt)
+    priced = spill_morsel.table_priced_bytes(nparts, dt.cap, rbytes)
+    budget = exchange_budget()
+    node = plan_check.note("morsel_scan", priced_bytes=priced,
+                           budget=budget)
+    if priced <= budget:
+        plan_check.annotate(node, decision="resident",
+                            reason=f"{priced} B fits the {budget} B "
+                                   "budget at execution — no spill")
+        return dt
+    plan_check.annotate(node, decision="spill",
+                        reason=f"{priced} B over the {budget} B budget "
+                               "— leaves staged to the host pool")
+    dt.spill()
+    return dt
+
+
 # Keys are the IR op names; graftlint's dist-op-unlowered rule reads
 # this literal's string keys from the AST — keep them literal.
 LOWERING = {
@@ -315,6 +353,7 @@ LOWERING = {
     "dist_anti_join": _lower_anti,
     "dist_groupby": _lower_groupby,
     "dist_groupby_fused": _lower_groupby_fused,
+    "dist_groupby_sketch": _lower_groupby_sketch,
     "dist_aggregate": _lower_aggregate,
     "dist_sort": _lower_sort,
     "dist_sort_multi": _lower_sort_multi,
@@ -323,6 +362,7 @@ LOWERING = {
     "dist_intersect": _lower_setop("dist_intersect"),
     "dist_subtract": _lower_setop("dist_subtract"),
     "shuffle_table": _lower_shuffle,
+    "morsel_scan": _lower_morsel_scan,
 }
 
 

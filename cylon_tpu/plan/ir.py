@@ -132,13 +132,13 @@ EXCHANGE_OPS = frozenset({
     "shuffle_table", "dist_join", "dist_join_streaming", "dist_semi_join",
     "dist_anti_join", "dist_groupby", "dist_aggregate", "dist_sort",
     "dist_sort_multi", "dist_union", "dist_intersect", "dist_subtract",
-    "dist_multiway_join", "dist_groupby_fused",
+    "dist_multiway_join", "dist_groupby_fused", "dist_groupby_sketch",
 })
 
 # row-count-preserving ops: plan-time row bounds flow through these
 ROW_PRESERVING = frozenset({
     "dist_project", "rename", "dist_sort", "dist_sort_multi",
-    "shuffle_table", "dist_with_column",
+    "shuffle_table", "dist_with_column", "morsel_scan",
 })
 
 
@@ -305,7 +305,7 @@ def infer_schema(op: str, ins: Sequence[Schema], static: Dict) -> Schema:
         return static["schema"]
     if op in ("dist_select", "shuffle_table", "dist_sort",
               "dist_sort_multi", "dist_head", "dist_semi_join",
-              "dist_anti_join"):
+              "dist_anti_join", "morsel_scan"):
         return ins[0]
     if op == "dist_project":
         return tuple(_col(ins[0], n) for n in static["columns"])
@@ -362,6 +362,24 @@ def infer_schema(op: str, ins: Sequence[Schema], static: Dict) -> Schema:
     if op == "dist_aggregate":
         return tuple(_agg_spec(_col(ins[0], n), agg, downgrade=True)
                      for n, agg in static["aggs"])
+    if op == "dist_groupby_sketch":
+        # keys, then one result lane per sketch aggregation
+        # (docs/out_of_core.md "sketches"): distinct-count int (x64
+        # downgrade like every device int), quantile float32 (null for
+        # all-null groups)
+        from ..parallel.dist_ops import _parse_sketch_op, \
+            sketch_output_name
+        out = [_col(ins[0], n) for n in static["keys"]]
+        for n, sop in static["aggs"]:
+            kind, _q = _parse_sketch_op(sop)
+            if kind == "distinct":
+                out.append(ColSpec(sketch_output_name(n, sop),
+                                   DataType(_downgraded(Type.INT64)),
+                                   nullable=False))
+            else:
+                out.append(ColSpec(sketch_output_name(n, sop),
+                                   DataType(Type.FLOAT), nullable=True))
+        return tuple(out)
     raise CylonError(Status(Code.Invalid, f"plan: no schema rule for {op}"))
 
 
@@ -621,6 +639,21 @@ def _capture_groupby(b: "Builder", v: Dict) -> Node:
     return node
 
 
+def _capture_groupby_sketch(b: "Builder", v: Dict) -> Node:
+    dt = b.as_node(v["dt"])
+    keys = _key_names(dt.schema, list(v["key_columns"]))
+    aggs = tuple((_key_names(dt.schema, c)[0], op)
+                 for c, op in v["aggregations"])
+    where = v.get("where")
+    reads = (referenced_columns(where, dt.schema)
+             if where is not None else ())
+    static = {"keys": keys, "aggs": aggs,
+              "where_id": None if where is None else id(where),
+              "where_reads": reads}
+    return Node("dist_groupby_sketch", [dt], static, {"where": where},
+                infer_schema("dist_groupby_sketch", [dt.schema], static))
+
+
 def _capture_aggregate(b: "Builder", v: Dict) -> Node:
     dt = b.as_node(v["dt"])
     aggs = tuple((_key_names(dt.schema, c)[0], op)
@@ -732,6 +765,9 @@ CAPTURED_OPS: Dict[str, _OpSpec] = {
          "pre_aggregate", "emit_empty"),
         {"where": None, "dense_key_range": None, "pre_aggregate": None,
          "emit_empty": False}, _capture_groupby),
+    "dist_groupby_sketch": _OpSpec(
+        ("dt", "key_columns", "aggregations", "where"), {"where": None},
+        _capture_groupby_sketch),
     "dist_aggregate": _OpSpec(
         ("dt", "aggregations", "where"), {"where": None},
         _capture_aggregate, materializes=True),

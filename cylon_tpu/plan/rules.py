@@ -70,6 +70,14 @@ Rules, in application order:
                         select compaction then carry fewer leaves —
                         ``row_bytes`` shrinks in both the wire accounting
                         and the memory-budget pricing.
+  morsel scans          a ``dist_groupby_fused`` / ``dist_groupby_sketch``
+                        / INNER-LEFT join whose streamable input prices
+                        over the memory budget from a known scan gets a
+                        ``morsel_scan`` node (docs/out_of_core.md): the
+                        lowering re-prices against the LIVE budget per
+                        execution and spills the leaf to the host pool
+                        when it still does not fit, and the consumer
+                        streams it in admission-priced morsels.
   common subplans       structurally identical subplans (same op, same
                         statics, same inputs, same runtime payload
                         identities) collapse to one node — a table
@@ -621,7 +629,8 @@ def _required_inputs(node: Node, req: Set[str]) -> List[Set[str]]:
         return [need] + list(reversed(dim_needs))
     if node.op in ("dist_semi_join", "dist_anti_join"):
         return [req | set(s["left_on"]), set(s["right_on"])]
-    if node.op in ("dist_groupby", "dist_groupby_fused"):
+    if node.op in ("dist_groupby", "dist_groupby_fused",
+                   "dist_groupby_sketch"):
         need = set(s["keys"]) | {c for c, _ in s["aggs"]}
         if s.get("where_id") is not None:
             need |= _reads_or_all(s.get("where_reads"), _names_of(ins[0]))
@@ -709,6 +718,80 @@ def _project_cleanup(root: Node) -> Node:
 
 
 # ---------------------------------------------------------------------------
+# morsel scans (docs/out_of_core.md): the out-of-core axis
+# ---------------------------------------------------------------------------
+
+def _morsel_scans(root: Node, fires: _Fires, world: int) -> Node:
+    """Insert ``morsel_scan`` nodes over scans whose priced working set
+    exceeds the memory budget (docs/out_of_core.md "morsel sizing").
+
+    Eligibility is structural: a ``dist_groupby_fused`` (every mode —
+    psum is a performance lowering, the morsel fold is the generic
+    one; emit_empty needs the resident dense hint and stays resident)
+    or an INNER/LEFT ``dist_join`` / ``dist_join_streaming`` whose
+    streamable input prices from a known scan through the
+    row-preserving chain (``ir.known_rows`` — projections, renames,
+    derived columns).  Pricing is ``morsel.table_priced_bytes`` (the
+    resident block plus one capacity-bound single-shot exchange) of
+    the PRUNED width against ``config.device_memory_budget()``.
+
+    The budget read here shapes the plan but does NOT bind it: the
+    ``morsel_scan`` LOWERING re-prices against the live budget on
+    every execution (plan/executor.py) and degrades to identity when
+    the scan fits — so a cached plan under a GROWN budget never
+    spills.  Under a SHRUNK budget a cached morsel-free plan stays
+    resident (its exchanges still degrade through the costed chooser);
+    callers changing the budget mid-session clear the plan cache, the
+    established idiom (tests/test_serve.py)."""
+    if world <= 1:
+        return root
+    from ..config import device_memory_budget, spill_enabled
+    if not spill_enabled():
+        return root
+    from ..ops.compact import next_bucket
+    from ..spill import morsel as spill_morsel
+    budget = device_memory_budget()
+
+    def step(n: Node) -> Node:
+        if n.op in ("dist_groupby_fused", "dist_groupby_sketch"):
+            # emit_empty needs the resident dense hint; every OTHER
+            # mode (psum included — it is a performance lowering, not a
+            # semantic one) streams correctly through the morsel scan,
+            # and sketch partials merge across morsels by construction
+            if n.static.get("emit_empty"):
+                return n
+        elif n.op in ("dist_join", "dist_join_streaming"):
+            if n.static.get("how") not in ("inner", "left"):
+                return n
+        else:
+            return n
+        child = n.inputs[0]
+        if child.op == "morsel_scan":
+            return n
+        rows = ir.known_rows(child)
+        if rows is None:
+            return n
+        width = max(ir.row_width(child.schema), 1)
+        cap = next_bucket(max(-(-rows // world), 1), minimum=8)
+        priced = spill_morsel.table_priced_bytes(world, cap, width)
+        if priced <= budget:
+            return n
+        k, w, per = spill_morsel.plan_morsels(world, cap, width, budget)
+        node = Node("morsel_scan", [child],
+                    {"priced_bytes": int(priced)}, {}, child.schema,
+                    None, [], None)
+        fires.fire(node, "morsel-scan",
+                   f"scan priced {priced} B over the {budget} B budget: "
+                   f"{k} morsels x {w} rows/shard ({per} B/morsel; "
+                   "re-priced at execution)")
+        new_ins = list(n.inputs)
+        new_ins[0] = node
+        return _clone(n, new_ins)
+
+    return _remap(root, step)
+
+
+# ---------------------------------------------------------------------------
 # common-subplan elimination
 # ---------------------------------------------------------------------------
 
@@ -767,5 +850,6 @@ def optimize(builder, root: Node) -> Tuple[Node, List[str], int, int]:
     root = _join_strategy(root, fires, world)
     root = _projection_pruning(root, fires)
     root = _project_cleanup(root)
+    root = _morsel_scans(root, fires, world)
     root = _cse(root, fires)
     return root, fires.records, pre, exchange_row_bytes(root)

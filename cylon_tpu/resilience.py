@@ -282,9 +282,21 @@ def classify(exc: BaseException) -> str:
     allocation; the ladder REPLANS the exchange instead.
 
     ``permanent`` — everything else, :class:`faults.PermanentFault`
-    included: no recovery action is sound, fail with the evidence."""
+    included: no recovery action is sound, fail with the evidence.
+
+    Host-tier failures (docs/out_of_core.md) land on the RESOURCE arm
+    by construction: spill-pool exhaustion raises a typed
+    ``Code.OutOfMemory`` CylonError (caught by the OOM rule below),
+    and ANY injected fault at the ``spill.stage_in``/``spill.stage_out``
+    staging boundaries — transient kind included — classifies resource
+    here: a staging transfer that failed will fail the same way on a
+    blind retry, so the sound recovery is a replan onto a lowering
+    with a different host-tier footprint, not another spin."""
     if isinstance(exc, faults.PermanentFault):
         return PERMANENT
+    if isinstance(exc, faults.FaultError) \
+            and getattr(exc, "point", "").startswith("spill."):
+        return RESOURCE
     if isinstance(exc, faults.ResourceFault) \
             or isinstance(exc, MemoryError):
         return RESOURCE

@@ -48,11 +48,24 @@ def price_table(dt) -> int:
     shared cost model's single-shot formula (``cost.single_shot_bytes``)
     fed with admission-time (sync-free) size evidence.  Static metadata
     only; never touches device data, so pricing N queued queries costs
-    zero round trips."""
+    zero round trips.
+
+    A SPILLED table (docs/out_of_core.md) prices as ONE admission-sized
+    morsel instead of its whole block: its leaves live host-side, the
+    engine streams them in morsels priced to fit, and reading
+    ``dt.columns`` here would fault the whole table in just to price
+    it — exactly the transfer admission exists to avoid scheduling."""
     from .. import observe
     from ..ops import compact as ops_compact
     from ..parallel import cost
 
+    if getattr(dt, "is_spilled", False):
+        from ..resilience import exchange_budget
+        from ..spill import morsel as spill_morsel
+        _k, _w, per_morsel = spill_morsel.plan_morsels(
+            dt.nparts, dt.cap, spill_morsel._spilled_rbytes(dt),
+            exchange_budget())
+        return per_morsel
     leaves = [lf for c in dt.columns for lf in (c.data, c.validity)
               if lf is not None]
     rbytes = max(observe.row_bytes(leaves), 1)

@@ -1,0 +1,32 @@
+"""Out-of-core execution: the host-tier spill subsystem
+(docs/out_of_core.md).
+
+Three pieces:
+
+  * :mod:`spill.pool` — the spillable leaf pool: DTable leaves moved to
+    pinned host blocks (LRU within ``CYLON_HOST_MEMORY_BUDGET``), with
+    transparent fault-in on first device use and the sanctioned
+    device↔host staging boundaries (``stage_out``/``stage_in`` — the
+    ``host-array-unpooled`` graftlint rule routes leaf-sized transfers
+    here).
+  * :mod:`spill.morsel` — morsel-partitioned scans: an over-budget leaf
+    streams through its consumer in admission-priced morsels, staged
+    from the pool through the HostPipeline so host staging of morsel
+    k+1 overlaps device compute of morsel k, with per-morsel partials
+    folded through the combine-spec machinery.
+  * the ``staged-spill`` lowering in the exchange cost catalogue
+    (parallel/cost.py): spill is just another redistribution strategy
+    with a different peak-bytes/wire/rounds point, priced from the
+    measured H2D/D2H transfer profile (parallel/meshprobe.py).
+
+Reference Cylon has no out-of-core story at all (PAPER.md
+limitations) — this subsystem is a capability the rebuild adds over
+the source.
+"""
+from . import morsel, pool  # noqa: F401
+from .pool import (SpillPool, clear_pool, ensure_device, get_pool,  # noqa: F401
+                   spill_table, stage_in_arrays, stage_out_arrays)
+
+__all__ = ["pool", "morsel", "SpillPool", "get_pool", "clear_pool",
+           "spill_table", "ensure_device", "stage_out_arrays",
+           "stage_in_arrays"]

@@ -1,0 +1,418 @@
+"""The spillable leaf pool: DTable leaves resident host-side.
+
+Everything the engine built so far assumes a table's leaves live on
+device from ingest to export.  This module opens the host tier: a
+DTable can ``spill()`` — its column leaves move to pinned host blocks
+held here, the device arrays are dropped, and the table keeps working
+through transparent fault-in on first device use
+(``DTable.columns``/``counts`` are properties that call
+:func:`ensure_device`).  The morsel scan (spill/morsel.py) reads row
+SLICES straight from the pooled blocks without faulting the whole
+table back, which is what makes larger-than-device-memory execution
+possible at all (docs/out_of_core.md).
+
+Pool semantics:
+
+  * entries are keyed by **content signature** — a monotone id stamped
+    on the table at first spill and invalidated whenever the table's
+    contents change (``_collapse_pending``), so an unchanged table
+    re-spills without a second device read (``spill.respill_hits``).
+  * a **pinned** entry (host-only: the device side was dropped) is the
+    sole copy of its data and is never evicted; a **resident** entry
+    (host copy retained after fault-in) is pure cache and lives in an
+    LRU within the host budget.
+  * the budget is ``config.host_memory_budget()``
+    (``CYLON_HOST_MEMORY_BUDGET``).  A stage-out admits by evicting
+    resident entries oldest-first; when pinned bytes alone would
+    exceed the budget it raises a typed ``Code.OutOfMemory``
+    CylonError — the RESOURCE class, so the escalation ladder
+    (resilience.classify) answers with a replan, not a blind retry.
+
+Staging boundaries: :func:`stage_out_arrays` (one batched
+``jax.device_get``) and :func:`stage_in_arrays` (sharded
+``jax.device_put``) are the engine's only sanctioned leaf-sized
+device↔host transfers outside ingest/export — graftlint's
+``host-array-unpooled`` rule reads :data:`SANCTIONED_HOST_BOUNDARIES`
+below and flags leaf-sized materializations anywhere else.  Both host
+the ``spill.stage_out``/``spill.stage_in`` fault points, so chaos runs
+exercise the host tier like every other failure surface.
+
+Thread safety: one pool lock orders spill / fault-in / eviction; the
+2-thread fault-in race (two consumers touching one spilled table)
+resolves to a single stage-in.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .. import faults, trace
+from ..config import host_memory_budget, spill_enabled
+from ..status import Code, CylonError, Status
+
+__all__ = [
+    "SANCTIONED_HOST_BOUNDARIES", "SpillPool", "get_pool", "clear_pool",
+    "spill_table", "ensure_device", "stage_out_arrays", "stage_in_arrays",
+]
+
+# The allow-list graftlint's host-array-unpooled rule enforces: modules
+# whose job IS the device↔host boundary (ingest/export/count protocol)
+# plus this pool.  A leaf-sized jax.device_get / np.asarray-of-device
+# anywhere else must route through stage_out_arrays.  Keep the entries
+# literal — the rule parses this assignment from the AST (mtime-cached,
+# like the metric and fault-point catalogues).
+SANCTIONED_HOST_BOUNDARIES = (
+    "cylon_tpu/spill/pool.py",
+    "cylon_tpu/parallel/dtable.py",
+    "cylon_tpu/table.py",
+    "cylon_tpu/row.py",
+    "cylon_tpu/ops/compact.py",
+    "cylon_tpu/io/",
+    "cylon_tpu/trace.py",
+    "cylon_tpu/observe/analyze.py",
+    "cylon_tpu/tpch/",
+)
+
+_sig_counter = itertools.count(1)
+
+
+def stage_out_arrays(arrays: Sequence) -> List[np.ndarray]:
+    """ONE batched device→host transfer of ``arrays`` (the D2H staging
+    boundary).  Hosts the ``spill.stage_out`` fault point and the
+    ``spill.stage_out_bytes`` accounting; every leaf-sized D2H in the
+    engine outside ingest/export must come through here (the
+    ``host-array-unpooled`` graftlint rule)."""
+    faults.check("spill.stage_out")
+    hosts = [np.asarray(a) for a in jax.device_get(list(arrays))]
+    nbytes = sum(h.nbytes for h in hosts)
+    trace.count("spill.stage_outs")
+    trace.count("spill.stage_out_bytes", nbytes)
+    return hosts
+
+
+def stage_in_arrays(ctx, blocks: Sequence[np.ndarray]) -> List[jax.Array]:
+    """Host→device staging of ``blocks`` under ``ctx``'s mesh sharding
+    (each block a [P*cap, ...] shard-major layout).  Hosts the
+    ``spill.stage_in`` fault point and the ``spill.stage_in_bytes``
+    accounting; transfers dispatch asynchronously, so staging morsel
+    k+1 overlaps device compute of morsel k when driven through the
+    HostPipeline (spill/morsel.py)."""
+    faults.check("spill.stage_in")
+    sharding = ctx.sharding()
+    out = [jax.device_put(b, sharding) for b in blocks]
+    nbytes = sum(int(b.nbytes) for b in blocks)
+    trace.count("spill.stage_ins")
+    trace.count("spill.stage_in_bytes", nbytes)
+    return out
+
+
+class _Entry:
+    """One spilled table's host-side state.
+
+    ``leaves`` holds ``(data_block, validity_block_or_None)`` per
+    column in column order; ``counts`` the [P] host row counts;
+    ``pinned`` True while the host copy is the ONLY copy (device side
+    dropped) — pinned entries never evict.
+    """
+
+    __slots__ = ("sig", "leaves", "counts", "cap", "nbytes", "pinned")
+
+    def __init__(self, sig: int, leaves, counts: np.ndarray, cap: int):
+        self.sig = sig
+        self.leaves = leaves
+        self.counts = counts
+        self.cap = int(cap)
+        self.nbytes = sum(d.nbytes + (0 if v is None else v.nbytes)
+                          for d, v in leaves)
+        self.pinned = True
+
+
+class SpillPool:
+    """The process-level host-tier pool (module singleton via
+    :func:`get_pool`; a fresh instance per test via ``clear_pool``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # sig -> entry; dict order doubles as LRU recency for the
+        # RESIDENT entries (pop/reinsert on touch, oldest first(iter))
+        self._entries: Dict[int, _Entry] = {}
+        # host bytes reserved by in-flight staged-spill EXCHANGES
+        # (shuffle._staged_spill_exchange): transient payloads that
+        # live outside the entry table but must still price against
+        # the host budget — the budget contract covers every
+        # stage-out, not just table spills
+        self._transient = 0
+
+    # -- accounting ----------------------------------------------------------
+
+    def _pinned_bytes_locked(self) -> int:
+        return (sum(e.nbytes for e in self._entries.values() if e.pinned)
+                + self._transient)
+
+    def _total_bytes_locked(self) -> int:
+        return (sum(e.nbytes for e in self._entries.values())
+                + self._transient)
+
+    def host_bytes(self) -> int:
+        with self._lock:
+            return self._total_bytes_locked()
+
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- admission -----------------------------------------------------------
+
+    def _admit_locked(self, need: int) -> None:
+        """Make room for ``need`` new pinned bytes: evict RESIDENT
+        entries oldest-first; when the pinned set alone cannot fit, the
+        pool is exhausted — a typed OutOfMemory (the resource arm of
+        the escalation ladder replans instead of dying)."""
+        budget = host_memory_budget()
+        pinned = self._pinned_bytes_locked()
+        if pinned + need > budget:
+            raise CylonError(Status(Code.OutOfMemory,
+                f"spill pool exhausted: {need} B stage-out over the "
+                f"{budget} B host budget ({pinned} B already pinned) — "
+                "raise CYLON_HOST_MEMORY_BUDGET or let the replan "
+                "ladder degrade the plan"))
+        while self._total_bytes_locked() + need > budget:
+            victim = None
+            for sig, e in self._entries.items():
+                if not e.pinned:
+                    victim = sig
+                    break
+            if victim is None:
+                break  # only pinned left; the pinned check above held
+            self._entries.pop(victim)
+            trace.count("spill.evictions")
+
+    def reserve_transient(self, nbytes: int) -> None:
+        """Admit ``nbytes`` of transient host staging (a staged-spill
+        exchange payload) against the budget — same eviction/typed-OOM
+        contract as a table spill, released by
+        :meth:`release_transient` when the exchange completes."""
+        nbytes = max(int(nbytes), 0)
+        with self._lock:
+            self._admit_locked(nbytes)
+            self._transient += nbytes
+            trace.count_max("spill.host_bytes_peak",
+                            self._total_bytes_locked())
+
+    def release_transient(self, nbytes: int) -> None:
+        with self._lock:
+            self._transient = max(self._transient - max(int(nbytes), 0),
+                                  0)
+
+    # -- the table-level operations ------------------------------------------
+
+    def spill_table(self, dt) -> None:
+        """Move ``dt``'s leaves host-side and drop the device arrays.
+        Idempotent; an unchanged previously-spilled table whose host
+        copy is still pooled re-spills without a device read.
+
+        The WHOLE operation runs under the pool lock (the stage-out
+        included): two threads spilling one table concurrently must
+        resolve to a single entry — an unserialized loser would orphan
+        a pinned entry the eviction loop can never reclaim."""
+        from ..parallel.dtable import _SPILLED
+        with self._lock:
+            if dt._spill_entry is not None:
+                return  # already spilled
+            dt._collapse_pending()
+            counts = np.asarray(dt.counts_host()).copy()
+            sig = dt._spill_sig
+            hit = self._entries.get(sig) if sig is not None else None
+            if hit is not None:
+                # content-signature hit: the host copy from the last
+                # spill is still valid — just drop the device side
+                self._entries.pop(sig)
+                self._entries[sig] = hit     # LRU touch
+                hit.pinned = True
+                trace.count("spill.respill_hits")
+                self._drop_device(dt, hit, _SPILLED)
+                return
+            cols = dt._columns
+            flat = []
+            for c in cols:
+                flat.append(c.data)
+                if c.validity is not None:
+                    flat.append(c.validity)
+            # admit BEFORE the transfer (leaf byte counts are static
+            # metadata): an over-budget spill raises the typed OOM
+            # without paying the D2H first
+            self._admit_locked(sum(int(lf.nbytes) for lf in flat))
+            hosts = stage_out_arrays(flat)
+            leaves = []
+            hi = 0
+            for c in cols:
+                d = hosts[hi]
+                hi += 1
+                v = None
+                if c.validity is not None:
+                    v = hosts[hi]
+                    hi += 1
+                leaves.append((d, v))
+            entry = _Entry(next(_sig_counter), tuple(leaves), counts,
+                           dt.cap)
+            self._entries[entry.sig] = entry
+            trace.count("spill.spills")
+            trace.count_max("spill.host_bytes_peak",
+                            self._total_bytes_locked())
+            self._drop_device(dt, entry, _SPILLED)
+
+    @staticmethod
+    def _drop_device(dt, entry: _Entry, sentinel) -> None:
+        """Point ``dt`` at ``entry`` and swap in FRESH column objects
+        holding the spilled sentinel (metadata — names, dtypes,
+        dictionaries, nullability — stays readable without a fault-in).
+        Fresh objects, not in-place mutation: derived tables may share
+        this table's DColumn objects (``dist_ops._cleared``, projection
+        views), and poisoning a shared object would break a view whose
+        own spill state says resident."""
+        from dataclasses import replace
+        cols = [replace(c, data=sentinel,
+                        validity=sentinel if v is not None else None)
+                for c, (_, v) in zip(dt._columns, entry.leaves)]
+        dt._counts_host = entry.counts
+        dt._spill_sig = entry.sig
+        # publish ORDER matters for lock-free readers of the
+        # columns/counts properties: _spill_entry must be visible
+        # BEFORE the sentinel columns land.  A reader that loads
+        # _spill_entry just before this line still sees the OLD live
+        # column list (the device arrays it captured stay valid);
+        # a reader that loads it after takes the fault-in path, which
+        # blocks on the pool lock until this spill completes.  The
+        # reverse order would let a reader observe sentinel leaves
+        # with _spill_entry still None and crash inside a kernel.
+        dt._spill_entry = entry
+        dt._columns = cols
+        dt._counts = sentinel
+
+    def ensure_device(self, dt) -> None:
+        """Fault ``dt``'s leaves back in (transparent on first device
+        use via the DTable properties).  The host copy is RETAINED as a
+        resident LRU entry, so an unchanged table re-spills for free;
+        eviction reclaims it under budget pressure.
+
+        The WHOLE fault-in runs under the pool lock: ``_spill_entry``
+        must stay set until the device arrays are installed, or a
+        second thread racing the same table would read the sentinel
+        columns mid-restore (the 2-thread hammer contract); a failed
+        stage-in (injected ``spill.stage_in`` fault) leaves the table
+        consistently spilled."""
+        with self._lock:
+            entry = dt._spill_entry
+            if entry is None:
+                return  # another thread faulted it in already
+            blocks: List[np.ndarray] = []
+            for d, v in entry.leaves:
+                blocks.append(d)
+                if v is not None:
+                    blocks.append(v)
+            blocks.append(entry.counts)
+            devs = stage_in_arrays(dt.ctx, blocks)
+            hi = 0
+            for c, (_, v) in zip(dt._columns, entry.leaves):
+                c.data = devs[hi]
+                hi += 1
+                if v is not None:
+                    c.validity = devs[hi]
+                    hi += 1
+            dt._counts = devs[hi]
+            # the host copy demotes to evictable cache only once the
+            # device side exists again
+            entry.pinned = False
+            dt._spill_entry = None
+            trace.count("spill.faultins")
+
+    def pin_for_scan(self, dt) -> _Entry:
+        """Spill ``dt`` if needed and capture its entry under ONE lock
+        hold — the morsel scan's entry point.  A separate
+        is_spilled/spill()/entry_of sequence would race a concurrent
+        consumer whose transparent fault-in clears ``_spill_entry``
+        between the check and the capture, handing the scan a None
+        entry; captured atomically, the entry object keeps the host
+        blocks readable for the whole scan even if the table faults in
+        mid-scan (``slice_blocks``' pinning contract)."""
+        with self._lock:
+            if dt._spill_entry is None:
+                spill_table(dt)   # module fn: keeps the CYLON_SPILL gate
+            return dt._spill_entry
+
+    def slice_blocks(self, dt, lo: int, hi: int,
+                     col_ids: Optional[Sequence[int]] = None,
+                     entry: "Optional[_Entry]" = None):
+        """Host-side row slice [lo, hi) of every shard's block of a
+        SPILLED table — the morsel scan's read path (no fault-in, no
+        device traffic; the staging to device is the caller's
+        ``stage_in_arrays``).  Returns ``(blocks, counts, w)`` where
+        ``blocks`` is ``(data[P*w], validity[P*w]|None)`` per selected
+        column and ``counts`` the clipped per-shard valid counts.
+
+        ``entry`` is the pool entry the caller captured when the scan
+        STARTED (``pin_for_scan``): a running morsel scan must keep
+        reading the same host blocks even if a concurrent consumer's
+        transparent fault-in clears ``dt._spill_entry`` (or eviction
+        drops the pool's reference) mid-scan — the captured entry
+        object pins the blocks either way."""
+        if entry is None:
+            entry = dt._spill_entry
+        if entry is None:
+            raise CylonError(Status(Code.Invalid,
+                "slice_blocks needs a spilled table (call spill() "
+                "first)"))
+        cap = entry.cap
+        w = hi - lo
+        nparts = len(entry.counts)
+        ids = range(len(entry.leaves)) if col_ids is None else col_ids
+        out = []
+        for i in ids:
+            d, v = entry.leaves[i]
+            db = d.reshape((nparts, cap) + d.shape[1:])[:, lo:hi]
+            db = np.ascontiguousarray(db).reshape((nparts * w,)
+                                                  + d.shape[1:])
+            vb = None
+            if v is not None:
+                vb = np.ascontiguousarray(
+                    v.reshape(nparts, cap)[:, lo:hi]).reshape(nparts * w)
+            out.append((db, vb))
+        counts = np.clip(entry.counts - lo, 0, w).astype(np.int32)
+        return out, counts, w
+
+
+_pool: Optional[SpillPool] = None
+_pool_lock = threading.Lock()
+
+
+def get_pool() -> SpillPool:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = SpillPool()
+        return _pool
+
+
+def clear_pool() -> None:
+    """Drop every pooled entry (test isolation).  Tables currently
+    spilled keep their own entry references, so their data survives —
+    only the pool's budget accounting and resident cache reset."""
+    global _pool
+    with _pool_lock:
+        _pool = None
+
+
+def spill_table(dt) -> None:
+    if not spill_enabled():
+        raise CylonError(Status(Code.Invalid,
+            "spill is disabled (CYLON_SPILL=0 / "
+            "config.set_spill_enabled(False))"))
+    get_pool().spill_table(dt)
+
+
+def ensure_device(dt) -> None:
+    get_pool().ensure_device(dt)

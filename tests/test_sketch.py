@@ -1,0 +1,201 @@
+"""Sketch-based approximate aggregation (docs/out_of_core.md
+"sketches"): error bounds, mergeability, the constant-per-group wire
+contract, and the plan/serving surfaces."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+
+from cylon_tpu import plan as planner, trace
+from cylon_tpu.context import CylonContext
+from cylon_tpu.ops import sketch as ops_sketch
+from cylon_tpu.parallel import dist_ops
+from cylon_tpu.parallel.dtable import DTable
+from cylon_tpu.spill import pool
+from cylon_tpu.status import CylonError
+
+
+@pytest.fixture(scope="module")
+def dctx():
+    return CylonContext({"backend": "dist", "devices": jax.devices()})
+
+
+@pytest.fixture(scope="module")
+def groups_df():
+    rng = np.random.default_rng(41)
+    n = 40000
+    return pd.DataFrame({
+        "g": rng.integers(0, 6, n),
+        "ids": rng.integers(0, 4000, n),
+        "x": rng.standard_normal(n) * 50.0,
+    })
+
+
+def _frame(dt):
+    return dt.to_table().to_pandas()
+
+
+def test_sketch_op_parsing():
+    assert dist_ops._parse_sketch_op("approx_distinct") == ("distinct",
+                                                            None)
+    assert dist_ops._parse_sketch_op("approx_quantile") == ("quantile",
+                                                            0.5)
+    assert dist_ops._parse_sketch_op("approx_quantile:0.9") == (
+        "quantile", 0.9)
+    for bad in ("approx_quantile:2.0", "approx_quantile:x", "median"):
+        with pytest.raises(CylonError):
+            dist_ops._parse_sketch_op(bad)
+    assert dist_ops.sketch_output_name("v", "approx_distinct") \
+        == "approx_distinct_v"
+    assert dist_ops.sketch_output_name("v", "approx_quantile:0.9") \
+        == "p90_v"
+
+
+def test_distinct_within_advertised_bound(dctx, groups_df):
+    out = _frame(dist_ops.dist_groupby_sketch(
+        DTable.from_pandas(dctx, groups_df), ["g"],
+        [("ids", "approx_distinct")]))
+    exact = groups_df.groupby("g")["ids"].nunique()
+    for _, r in out.iterrows():
+        e = exact[r["g"]]
+        rel = abs(int(r["approx_distinct_ids"]) - e) / e
+        assert rel <= ops_sketch.HLL_ERROR_BOUND, (r["g"], rel)
+
+
+def test_quantile_within_advertised_rank_bound(dctx, groups_df):
+    out = _frame(dist_ops.dist_groupby_sketch(
+        DTable.from_pandas(dctx, groups_df), ["g"],
+        [("x", "approx_quantile:0.5"), ("x", "approx_quantile:0.95")]))
+    for _, r in out.iterrows():
+        vals = np.sort(groups_df[groups_df["g"] == r["g"]]["x"]
+                       .to_numpy())
+        for col, q in (("p50_x", 0.5), ("p95_x", 0.95)):
+            rank = np.searchsorted(vals, r[col]) / len(vals)
+            assert abs(rank - q) \
+                <= ops_sketch.QUANTILE_RANK_ERROR_BOUND, (col, rank)
+
+
+def test_small_group_quantile_is_exact(dctx):
+    """A group with <= K rows carries every row in its sample — the
+    quantile estimate is the exact empirical quantile."""
+    df = pd.DataFrame({"g": np.zeros(100, np.int64),
+                       "x": np.arange(100.0)})
+    out = _frame(dist_ops.dist_groupby_sketch(
+        DTable.from_pandas(dctx, df), ["g"],
+        [("x", "approx_quantile:0.5")]))
+    # empirical median of 0..99 at index round(0.5 * 99) = 50
+    assert float(out["p50_x"].iloc[0]) == 50.0
+
+
+def test_constant_per_group_wire_bytes(dctx):
+    """The acceptance contract: doubling the rows changes NOTHING on
+    the wire — the sketches are the partials, one per (group, shard)."""
+    rng = np.random.default_rng(43)
+    frames = [pd.DataFrame({"g": rng.integers(0, 5, n),
+                            "v": rng.integers(0, 999, n)})
+              for n in (20000, 40000)]
+    sent = []
+    for df in frames:
+        trace.enable_counters()
+        trace.reset()
+        dist_ops.dist_groupby_sketch(
+            DTable.from_pandas(dctx, df), ["g"],
+            [("v", "approx_distinct")]).to_table()
+        sent.append(trace.counters().get("shuffle.bytes_sent", 0))
+    assert sent[0] == sent[1] > 0, sent
+
+
+def test_sketch_counters_and_null_values(dctx):
+    rng = np.random.default_rng(47)
+    v = rng.standard_normal(5000)
+    df = pd.DataFrame({"g": rng.integers(0, 3, 5000),
+                       "v": pd.array(np.where(rng.random(5000) < 0.2,
+                                              None, v),
+                                     dtype="Float64")})
+    trace.enable_counters()
+    trace.reset()
+    out = _frame(dist_ops.dist_groupby_sketch(
+        DTable.from_pandas(dctx, df), ["g"],
+        [("v", "approx_quantile:0.5")]))
+    c = trace.counters()
+    assert c.get("sketch.groupbys", 0) == 1
+    assert c.get("sketch.partial_rows", 0) > 0
+    assert c.get("sketch.register_bytes", 0) > 0
+    assert len(out) == 3   # null VALUES drop; groups remain
+
+
+def test_sketch_through_planner_and_plan_cache(dctx, groups_df):
+    """dist_groupby_sketch is a captured + lowered op: the optimized
+    plan answers identically and repeated queries hit the plan cache
+    (the serving tier's cheap high-QPS shape)."""
+    dt = DTable.from_pandas(dctx, groups_df)
+    eager = _frame(dist_ops.dist_groupby_sketch(
+        dt, ["g"], [("ids", "approx_distinct")]))
+
+    def q(t):
+        return dist_ops.dist_groupby_sketch(t, ["g"],
+                                            [("ids", "approx_distinct")])
+
+    planner.clear_plan_cache()
+    trace.enable_counters()
+    trace.reset()
+    first = _frame(planner.run(dctx, q, dt))
+    second = _frame(planner.run(dctx, q, dt))
+    c = trace.counters()
+    planner.clear_plan_cache()
+    assert c.get("plan.cache_hit", 0) >= 1, c
+    for got in (first, second):
+        pd.testing.assert_frame_equal(
+            got.sort_values("g").reset_index(drop=True),
+            eager.sort_values("g").reset_index(drop=True),
+            check_dtype=False)
+
+
+def test_sketch_over_spilled_input_merges_morsels(dctx, groups_df):
+    """A spilled input streams through per-morsel sketch partials; the
+    merged estimate stays within the advertised bound (mergeability is
+    what makes the sketch the out-of-core aggregation)."""
+    pool.clear_pool()
+    dt = DTable.from_pandas(dctx, groups_df)
+    dt.spill()
+    trace.enable_counters()
+    trace.reset()
+    from cylon_tpu import config as cfg
+    prev = cfg.set_device_memory_budget(150_000)
+    try:
+        out = _frame(dist_ops.dist_groupby_sketch(
+            dt, ["g"], [("ids", "approx_distinct"),
+                        ("x", "approx_quantile:0.5")]))
+    finally:
+        cfg.set_device_memory_budget(prev)
+        pool.clear_pool()
+    assert trace.counters().get("spill.morsels", 0) >= 2
+    exact = groups_df.groupby("g")["ids"].nunique()
+    for _, r in out.iterrows():
+        e = exact[r["g"]]
+        assert abs(int(r["approx_distinct_ids"]) - e) / e \
+            <= ops_sketch.HLL_ERROR_BOUND
+        vals = np.sort(groups_df[groups_df["g"] == r["g"]]["x"]
+                       .to_numpy())
+        rank = np.searchsorted(vals, r["p50_x"]) / len(vals)
+        assert abs(rank - 0.5) <= ops_sketch.QUANTILE_RANK_ERROR_BOUND
+
+
+def test_sketch_served_from_the_serving_tier(dctx, groups_df):
+    """The serving tier answers sketch queries like any plan — the
+    cheap high-QPS aggregate over big data (docs/serving.md)."""
+    from cylon_tpu.serve import ServeSession
+    dt = DTable.from_pandas(dctx, groups_df)
+    want = _frame(dist_ops.dist_groupby_sketch(
+        dt, ["g"], [("ids", "approx_distinct")]))
+    with ServeSession(dctx, tables={"t": dt},
+                      batch_window_ms=30.0) as s:
+        h = s.submit(lambda t: dist_ops.dist_groupby_sketch(
+            t["t"], ["g"], [("ids", "approx_distinct")]),
+            label="sketch", export=lambda r: r.to_table().to_pandas())
+        got = h.result(timeout=600)
+    pd.testing.assert_frame_equal(
+        got.sort_values("g").reset_index(drop=True),
+        want.sort_values("g").reset_index(drop=True),
+        check_dtype=False)

@@ -1,0 +1,451 @@
+"""Out-of-core execution: the host-tier spill subsystem
+(docs/out_of_core.md).
+
+Covers the acceptance contracts of the spill PR: pool LRU + fault-in
+correctness (including a 2-thread hammer), morsel-scan vs resident
+parity across key families, the staged-spill exchange lowering, the
+planner's morsel-scan insertion with row parity under a pinned budget,
+the escalation ladder over host-tier faults, and a chaos leg over a
+spilled plan with ``retry.exhausted == 0``.
+"""
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+
+from cylon_tpu import config as cfg
+from cylon_tpu import faults, plan as planner, trace
+from cylon_tpu.config import JoinConfig
+from cylon_tpu.context import CylonContext
+from cylon_tpu.parallel import dist_ops
+from cylon_tpu.parallel import shuffle as shmod
+from cylon_tpu.parallel.dtable import DTable
+from cylon_tpu.spill import morsel, pool
+from cylon_tpu.status import Code, CylonError
+
+
+@pytest.fixture(scope="module")
+def dctx():
+    return CylonContext({"backend": "dist", "devices": jax.devices()})
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool():
+    pool.clear_pool()
+    shmod.clear_chunk_state()
+    yield
+    pool.clear_pool()
+    shmod.clear_chunk_state()
+    cfg.set_host_memory_budget(None)
+
+
+def _frame(dt):
+    return dt.to_table().to_pandas()
+
+
+def _canon(df):
+    out = df.copy()
+    for c in out.columns:
+        if isinstance(out[c].dtype, pd.CategoricalDtype):
+            out[c] = out[c].astype(str)
+    return out.sort_values(list(out.columns)).reset_index(drop=True)
+
+
+def _assert_rows_equal(got, want):
+    g, w = _canon(got), _canon(want)
+    assert list(g.columns) == list(w.columns)
+    assert len(g) == len(w), (len(g), len(w))
+    for c in g.columns:
+        if pd.api.types.is_float_dtype(w[c]):
+            np.testing.assert_allclose(
+                g[c].to_numpy(np.float64), w[c].to_numpy(np.float64),
+                rtol=1e-6, atol=1e-9)
+        else:
+            assert g[c].astype(str).tolist() == w[c].astype(str).tolist()
+
+
+# ---------------------------------------------------------------------------
+# pool semantics
+# ---------------------------------------------------------------------------
+
+def test_spill_and_transparent_fault_in(dctx):
+    df = pd.DataFrame({"k": np.arange(500) % 7,
+                       "v": np.arange(500.0)})
+    dt = DTable.from_pandas(dctx, df)
+    trace.enable_counters()
+    trace.reset()
+    dt.spill()
+    assert dt.is_spilled
+    # metadata stays host-side: none of these fault the leaves in
+    assert dt.num_rows == 500
+    assert dt.column_names == ["k", "v"]
+    assert dt.num_columns == 2
+    assert "spilled" in repr(dt)
+    assert dt.is_spilled
+    assert trace.counters().get("spill.faultins", 0) == 0
+    # first DEVICE use faults in transparently
+    out = _frame(dist_ops.dist_groupby(dt, ["k"], [("v", "sum")]))
+    assert not dt.is_spilled
+    c = trace.counters()
+    assert c.get("spill.spills", 0) == 1
+    assert c.get("spill.faultins", 0) == 1
+    want = df.groupby("k")["v"].sum().reset_index(name="sum_v")
+    _assert_rows_equal(out, want)
+
+
+def test_respill_hits_need_no_device_read(dctx):
+    dt = DTable.from_pandas(dctx, pd.DataFrame({"v": np.arange(100.0)}))
+    trace.enable_counters()
+    trace.reset()
+    dt.spill()
+    dt.ensure_device()
+    dt.spill()          # content unchanged: the pooled host copy serves
+    c = trace.counters()
+    assert c.get("spill.respill_hits", 0) == 1
+    assert c.get("spill.stage_outs", 0) == 1   # only the first spill read
+    assert dt.is_spilled
+
+
+def test_pool_lru_eviction_and_budget_exhaustion(dctx):
+    blocks = [DTable.from_pandas(
+        dctx, pd.DataFrame({"v": np.arange(4096.0) + i}))
+        for i in range(3)]
+    nbytes = 4096 * 8 + 64   # one spilled table (plus counts slack)
+    trace.enable_counters()
+    trace.reset()
+    prev = cfg.set_host_memory_budget(2 * nbytes)
+    try:
+        blocks[0].spill()
+        blocks[0].ensure_device()      # entry 0 becomes resident cache
+        blocks[1].spill()              # fits next to the cached entry
+        blocks[2].spill()              # must EVICT the resident entry
+        c = trace.counters()
+        assert c.get("spill.evictions", 0) >= 1
+        # two PINNED entries fill the budget: a third pinned stage-out
+        # must raise the typed OutOfMemory (the resource arm)
+        with pytest.raises(CylonError) as ei:
+            DTable.from_pandas(
+                dctx, pd.DataFrame({"v": np.arange(4096.0)})).spill()
+        assert ei.value.status.code == Code.OutOfMemory
+        from cylon_tpu import resilience
+        assert resilience.classify(ei.value) == resilience.RESOURCE
+    finally:
+        cfg.set_host_memory_budget(prev)
+    # evicted entry's table still answers (its own entry ref survives)
+    assert _frame(blocks[0]).v.sum() == np.arange(4096.0).sum()
+
+
+def test_pool_two_thread_fault_in_hammer(dctx):
+    """Two threads racing device use of one spilled table must resolve
+    to exactly one stage-in and identical data."""
+    df = pd.DataFrame({"k": np.arange(2000) % 5, "v": np.arange(2000.0)})
+    want = df.groupby("k")["v"].sum().reset_index(name="sum_v")
+    for _ in range(4):
+        dt = DTable.from_pandas(dctx, df)
+        dt.spill()
+        trace.enable_counters()
+        trace.reset()
+        results, errors = [], []
+
+        def use():
+            try:
+                results.append(_frame(
+                    dist_ops.dist_groupby(dt, ["k"], [("v", "sum")])))
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        ts = [threading.Thread(target=use) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors, errors
+        assert trace.counters().get("spill.faultins", 0) == 1
+        for r in results:
+            _assert_rows_equal(r, want)
+
+
+def test_spill_disabled_switch(dctx):
+    dt = DTable.from_pandas(dctx, pd.DataFrame({"v": [1.0, 2.0]}))
+    prev = cfg.set_spill_enabled(False)
+    try:
+        with pytest.raises(CylonError):
+            dt.spill()
+    finally:
+        cfg.set_spill_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# morsel-scan vs resident parity (the key-family matrix)
+# ---------------------------------------------------------------------------
+
+def _family_frame(rng, n, family):
+    if family == "int":
+        return pd.DataFrame({"k": rng.integers(0, 37, n),
+                             "v": rng.standard_normal(n)})
+    if family == "dict-string":
+        words = np.array(["lima", "oslo", "kiev", "baku", "apia"])
+        return pd.DataFrame({"k": pd.Categorical(
+            words[rng.integers(0, len(words), n)]),
+            "v": rng.standard_normal(n)})
+    if family == "null":
+        k = rng.integers(0, 11, n).astype("float64")
+        k[rng.random(n) < 0.1] = np.nan
+        return pd.DataFrame({"k": pd.array(
+            np.where(np.isnan(k), None, k), dtype="Int64"),
+            "v": rng.standard_normal(n)})
+    # composite
+    return pd.DataFrame({"k": rng.integers(0, 7, n),
+                         "k2": rng.integers(0, 5, n),
+                         "v": rng.standard_normal(n)})
+
+
+@pytest.mark.parametrize("family", ["int", "dict-string", "null",
+                                    "composite"])
+def test_morsel_groupby_parity(dctx, family):
+    rng = np.random.default_rng(5)
+    df = _family_frame(rng, 4000, family)
+    keys = ["k", "k2"] if family == "composite" else ["k"]
+    aggs = [("v", "sum"), ("v", "mean"), ("v", "min"), ("v", "count")]
+    want = _frame(dist_ops.dist_groupby(
+        DTable.from_pandas(dctx, df), keys, aggs))
+    spilled = DTable.from_pandas(dctx, df)
+    spilled.spill()
+    trace.enable_counters()
+    trace.reset()
+    got = _frame(morsel.morsel_groupby(spilled, keys, aggs, morsels=4))
+    assert trace.counters().get("spill.morsels", 0) == 4
+    _assert_rows_equal(got, want)
+
+
+@pytest.mark.parametrize("how", ["InnerJoin", "LeftJoin"])
+def test_morsel_join_parity(dctx, how):
+    rng = np.random.default_rng(9)
+    ldf = pd.DataFrame({"k": rng.integers(0, 60, 3000),
+                        "v": rng.standard_normal(3000)})
+    rdf = pd.DataFrame({"k": np.arange(55), "w": np.arange(55.0)})
+    config = getattr(JoinConfig, how)(0, 0)
+    want = _frame(dist_ops.dist_join(
+        DTable.from_pandas(dctx, ldf),
+        DTable.from_pandas(dctx, rdf), config))
+    left = DTable.from_pandas(dctx, ldf)
+    left.spill()
+    got = _frame(morsel.morsel_join(
+        left, DTable.from_pandas(dctx, rdf), config, morsels=3))
+    _assert_rows_equal(got, want)
+
+
+def test_forced_staged_spill_exchange_parity(dctx):
+    """CYLON_EXCHANGE_STRATEGY=staged-spill: the host-tier exchange
+    lowering produces the single-shot row set."""
+    rng = np.random.default_rng(2)
+    df = pd.DataFrame({"k": rng.integers(0, 40, 2500),
+                       "v": rng.standard_normal(2500)})
+    want = _frame(dist_ops.shuffle_table(
+        DTable.from_pandas(dctx, df), ["k"]))
+    trace.enable_counters()
+    trace.reset()
+    prev = cfg.set_exchange_strategy("staged-spill")
+    try:
+        got = _frame(dist_ops.shuffle_table(
+            DTable.from_pandas(dctx, df), ["k"]))
+    finally:
+        cfg.set_exchange_strategy(prev)
+    c = trace.counters()
+    assert c.get("shuffle.strategy.staged_spill", 0) == 1
+    assert c.get("spill.exchanges", 0) == 1
+    _assert_rows_equal(got, want)
+
+
+def test_chooser_reaches_spill_only_past_the_resident_floor():
+    """cost.choose: staged-spill is the tier between 'a resident
+    strategy fits' and the best-effort floor — never picked while
+    anything resident fits, picked instead of the infeasible
+    best-effort chunked plan when it alone fits."""
+    from cylon_tpu.parallel import cost
+    counts = np.full((4, 4), 100, np.int64)
+    cands = cost.enumerate_strategies(4, 400, counts, 8, 1 << 20,
+                                      spill_ok=True)
+    choice, reason, ok = cost.choose(cands, 1 << 20)
+    assert ok and choice.strategy == cost.SINGLE_SHOT
+    # shrink the budget below every resident strategy's peak but above
+    # the spill morsel's: hand-build the candidate list so the tiers
+    # are unambiguous
+    spill = cost.price_staged_spill(4, counts, 8, 1 << 20)
+    floor = min(c.peak_bytes for c in cands
+                if c.strategy != cost.STAGED_SPILL)
+    tight = [c for c in cands if c.strategy != cost.STAGED_SPILL]
+    tight.append(cost.StrategyPrice(cost.STAGED_SPILL, floor - 1,
+                                    spill.wire_bytes, spill.rounds,
+                                    spill.sizes, spill.host_bytes))
+    choice2, reason2, ok2 = cost.choose(tight, floor - 1)
+    assert ok2 and choice2.strategy == cost.STAGED_SPILL
+    assert "no resident strategy fits" in reason2
+
+
+# ---------------------------------------------------------------------------
+# planner insertion + end-to-end parity under a pinned budget
+# ---------------------------------------------------------------------------
+
+def test_planner_inserts_morsel_scan_and_stays_row_identical(dctx):
+    rng = np.random.default_rng(17)
+    df = pd.DataFrame({"k": rng.integers(0, 23, 30000),
+                       "v": rng.standard_normal(30000)})
+
+    def q(t):
+        return dist_ops.dist_groupby(t, ["k"], [("v", "sum"),
+                                                ("v", "mean")])
+
+    want = _frame(planner.run(dctx, q, DTable.from_pandas(dctx, df)))
+    trace.enable_counters()
+    trace.reset()
+    planner.clear_plan_cache()
+    prev = cfg.set_device_memory_budget(100_000)
+    try:
+        got = _frame(planner.run(dctx, q, DTable.from_pandas(dctx, df)))
+        c = dict(trace.counters())
+    finally:
+        cfg.set_device_memory_budget(prev)
+        planner.clear_plan_cache()
+    assert c.get("spill.spills", 0) >= 1, c
+    assert c.get("spill.morsels", 0) >= 2, c
+    assert c.get("spill.morsel_groupbys", 0) >= 1, c
+    assert 0 < c.get("shuffle.exchange_bytes_peak", 0) <= 100_000, c
+    _assert_rows_equal(got, want)
+
+
+def test_morsel_scan_degrades_to_resident_at_ample_budget(dctx):
+    """The morsel_scan lowering re-prices at EXECUTION: the same
+    cached plan (budget-free fingerprint) runs resident — no spill —
+    once the live budget fits the scan."""
+    rng = np.random.default_rng(23)
+    df = pd.DataFrame({"k": rng.integers(0, 23, 30000),
+                       "v": rng.standard_normal(30000)})
+
+    def q(t):
+        return dist_ops.dist_groupby(t, ["k"], [("v", "sum")])
+
+    planner.clear_plan_cache()
+    prev = cfg.set_device_memory_budget(100_000)
+    try:
+        dt = DTable.from_pandas(dctx, df)
+        first = _frame(planner.run(dctx, q, dt))
+    finally:
+        cfg.set_device_memory_budget(prev)
+    # budget restored (ample): the SAME plan structure executes
+    # resident — cache hit, no new spill
+    trace.enable_counters()
+    trace.reset()
+    dt2 = DTable.from_pandas(dctx, df)
+    second = _frame(planner.run(dctx, q, dt2))
+    c = trace.counters()
+    assert c.get("plan.cache_hit", 0) == 1, c
+    assert c.get("spill.spills", 0) == 0, c
+    planner.clear_plan_cache()
+    _assert_rows_equal(second, first)
+
+
+# ---------------------------------------------------------------------------
+# resilience: host-tier faults on the resource arm + the chaos leg
+# ---------------------------------------------------------------------------
+
+def test_staging_faults_classify_resource():
+    from cylon_tpu import resilience
+    assert resilience.classify(
+        faults.TransientFault("spill.stage_in")) == resilience.RESOURCE
+    assert resilience.classify(
+        faults.ResourceFault("spill.stage_out")) == resilience.RESOURCE
+    assert resilience.classify(
+        faults.PermanentFault("spill.stage_in")) == resilience.PERMANENT
+
+
+def test_spilled_plan_recovers_from_staging_fault(dctx):
+    """An injected staging fault mid-morsel-scan replans through the
+    ladder and still answers row-identically."""
+    rng = np.random.default_rng(29)
+    df = pd.DataFrame({"k": rng.integers(0, 23, 30000),
+                       "v": rng.standard_normal(30000)})
+
+    def q(t):
+        return dist_ops.dist_groupby(t, ["k"], [("v", "sum")])
+
+    want = _frame(planner.run(dctx, q, DTable.from_pandas(dctx, df)))
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("spill.stage_in", kind="resource", nth=3)])
+    trace.enable_counters()
+    trace.reset()
+    planner.clear_plan_cache()
+    prev = cfg.set_device_memory_budget(100_000)
+    try:
+        with faults.active(plan):
+            got = _frame(planner.run(dctx, q,
+                                     DTable.from_pandas(dctx, df)))
+        c = dict(trace.counters())
+    finally:
+        cfg.set_device_memory_budget(prev)
+        planner.clear_plan_cache()
+    assert plan.injected == 1
+    assert c.get("recover.replans", 0) >= 1, c
+    _assert_rows_equal(got, want)
+
+
+def test_chaos_leg_over_spilled_plan(dctx):
+    """CYLON_CHAOS-shaped leg: a seeded default FaultPlan (now
+    including the host-tier staging rules) over a plan forced through
+    the spill path — result parity, retry.exhausted == 0."""
+    from cylon_tpu import resilience
+    from cylon_tpu.resilience import RetryPolicy
+    rng = np.random.default_rng(31)
+    df = pd.DataFrame({"k": rng.integers(0, 23, 30000),
+                       "v": rng.standard_normal(30000)})
+
+    def q(t):
+        return dist_ops.dist_groupby(t, ["k"], [("v", "sum"),
+                                                ("v", "count")])
+
+    want = _frame(planner.run(dctx, q, DTable.from_pandas(dctx, df)))
+    plan = faults.FaultPlan.default(23)
+    prev_policy = resilience.set_retry_policy(
+        RetryPolicy(max_attempts=6, base_delay_s=0.0))
+    trace.enable_counters()
+    trace.reset()
+    planner.clear_plan_cache()
+    prev = cfg.set_device_memory_budget(100_000)
+    try:
+        with faults.active(plan):
+            got = _frame(planner.run(dctx, q,
+                                     DTable.from_pandas(dctx, df)))
+        c = dict(trace.counters())
+    finally:
+        cfg.set_device_memory_budget(prev)
+        resilience.set_retry_policy(prev_policy)
+        planner.clear_plan_cache()
+    assert c.get("retry.exhausted", 0) == 0, c
+    assert c.get("spill.morsels", 0) >= 2, c
+    _assert_rows_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# admission prices a spilled table by its morsel
+# ---------------------------------------------------------------------------
+
+def test_admission_prices_spilled_table_by_morsel(dctx):
+    from cylon_tpu.serve.admission import price_table
+    df = pd.DataFrame({"v": np.arange(30000.0)})
+    dt = DTable.from_pandas(dctx, df)
+    resident_price = price_table(dt)
+    dt.spill()
+    trace.enable_counters()
+    trace.reset()
+    prev = cfg.set_device_memory_budget(100_000)
+    try:
+        spilled_price = price_table(dt)
+    finally:
+        cfg.set_device_memory_budget(prev)
+    assert dt.is_spilled                       # pricing never faults in
+    assert trace.counters().get("spill.faultins", 0) == 0
+    assert 0 < spilled_price <= 100_000
+    assert spilled_price < resident_price

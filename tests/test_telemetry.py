@@ -271,12 +271,14 @@ def test_meshprobe_fits_and_caches_per_fingerprint(dctx):
     meshprobe.clear_profiles()
     assert meshprobe.get_profile(dctx) is None   # read side never probes
     prof = meshprobe.probe(dctx, sizes=(1 << 10, 1 << 12), reps=1)
-    assert set(prof.latency_s) == set(meshprobe.COLLECTIVES)
-    for c in meshprobe.COLLECTIVES:
+    # collectives + the spill subsystem's h2d/d2h transfer legs
+    assert set(prof.latency_s) == set(meshprobe.COLLECTIVES
+                                      + meshprobe.TRANSFERS)
+    for c in meshprobe.COLLECTIVES + meshprobe.TRANSFERS:
         assert prof.latency_s[c] >= 0
         assert prof.bytes_per_s[c] > 0
     assert prof.fingerprint == meshprobe.mesh_fingerprint(dctx)
-    assert len(prof.samples) == 2 * 3            # sizes x collectives
+    assert len(prof.samples) == 2 * 5   # sizes x (collectives + legs)
     # cached per fingerprint: a second probe() is a cache hit
     assert meshprobe.probe(dctx) is prof
     assert meshprobe.get_profile(dctx) is prof
