@@ -50,7 +50,10 @@ Env knobs: CYLON_BENCH_ROWS (rows per device per side),
 CYLON_BENCH_REPS (timed repetitions, default 3), CYLON_BENCH_TPCH_SF
 (0 disables), CYLON_BENCH_PIPELINE_K (default 4), CYLON_BENCH_OOC
 (default on: the pinned-budget out-of-core stage — spill-path row
-parity on a small query set; 0 skips).
+parity on a small query set; 0 skips), CYLON_BENCH_MESHCHAOS=<seed>
+(the mesh-loss chaos stage: a device is lost mid-run under sustained
+serving and the topology rung must re-mesh onto the survivors; emits
+serve_meshchaos_recovered_ratio/_remesh_ms/_p99, benchdiff-gated).
 """
 from __future__ import annotations
 
@@ -1611,6 +1614,128 @@ def main() -> None:
                 _trace.disable_counters()
                 _trace.reset()
             em.emit("chaos")
+
+        # mesh-loss chaos stage (docs/robustness.md "Elasticity"):
+        # CYLON_BENCH_MESHCHAOS=<seed> reruns the sustained serving
+        # workload with a deterministic mid-run device loss injected —
+        # the topology rung must evacuate + re-mesh onto the survivors
+        # WHILE 8 clients drive traffic, and the session must keep
+        # serving on the shrunken mesh.  Emits the recovered ratio
+        # (benchdiff gates it DOWN), p99 across the degrade (gated
+        # UP), and the measured re-mesh wall-clock (ungated — it
+        # scales with data volume).  Rides CYLON_BENCH_SUSTAIN.
+        meshchaos_seed = os.environ.get("CYLON_BENCH_MESHCHAOS", "")
+        if q_ms and meshchaos_seed not in ("", "0") and sustain_s > 0 \
+                and remaining() > sustain_s + 60 \
+                and ctx.get_world_size() >= 2:
+            import threading as _threading
+
+            from cylon_tpu import faults as _faults
+            from cylon_tpu import topology as _topology
+            from cylon_tpu.serve import Overloaded, Quarantined, \
+                ServeSession
+            mix = _serve_mix(q_ms, pad_to=8)
+            world0 = ctx.get_world_size()
+            _progress(f"mesh-chaos serving: {len(mix)} clients x "
+                      f"{sustain_s:.0f}s, one device lost mid-run "
+                      f"(seed {meshchaos_seed})")
+            try:
+                _trace.enable_counters()
+                _trace.reset()
+                stop_at = time.monotonic() + sustain_s
+                lat_ok = []
+                failed = [0]
+                lat_lock = _threading.Lock()
+                # nth targets a stage-boundary consult a few queries
+                # in: the loss lands MID-run, so the emitted ratio
+                # covers before, across, and after the degrade
+                fplan = _faults.FaultPlan(int(meshchaos_seed), rules=[
+                    _faults.FaultRule("mesh.device_lost",
+                                      kind="topology", nth=20, lost=1),
+                ])
+                with _faults.active(fplan), \
+                        ServeSession(ctx, tables=dts,
+                                     batch_window_ms=8.0,
+                                     shed_depth=6) as srv:
+
+                    def mesh_client(qname):
+                        qfn = queries.QUERIES[qname]
+                        while time.monotonic() < stop_at:
+                            try:
+                                h = srv.submit(
+                                    lambda t, q=qfn: q(ctx, t),
+                                    label=qname,
+                                    export=lambda r: r.to_pandas())
+                                h.result(timeout=600)
+                            except (Overloaded, Quarantined):
+                                time.sleep(0.05)
+                                continue
+                            except Exception:  # graftlint: ok[broad-except] — mesh-chaos failures are the measurement, not an abort
+                                with lat_lock:
+                                    failed[0] += 1
+                                continue
+                            with lat_lock:
+                                lat_ok.append(h.latency_ms)
+
+                    t0 = time.perf_counter()
+                    threads = [
+                        _threading.Thread(target=mesh_client, args=(q,))
+                        for q in mix]
+                    for th in threads:
+                        th.start()
+                    for th in threads:
+                        th.join()
+                    wall = time.perf_counter() - t0
+                    stats = srv.drain()
+                from cylon_tpu.serve.session import percentile
+                c = _trace.counters()
+                lat_sorted = sorted(lat_ok)
+                done = len(lat_ok)
+                attempted = done + failed[0]
+                eff_world = _topology.effective(ctx).get_world_size()
+                em.detail["serve_meshchaos_s"] = round(wall, 1)
+                em.detail["serve_meshchaos_seed"] = int(meshchaos_seed)
+                em.detail["serve_meshchaos_queries"] = attempted
+                em.detail["serve_meshchaos_recovered_ratio"] = round(
+                    done / attempted, 4) if attempted else None
+                em.detail["serve_meshchaos_qps"] = round(done / wall, 3)
+                em.detail["serve_meshchaos_p50_ms"] = round(
+                    percentile(lat_sorted, 50), 2) if lat_sorted else None
+                em.detail["serve_meshchaos_p99_ms"] = round(
+                    percentile(lat_sorted, 99), 2) if lat_sorted else None
+                em.detail["serve_meshchaos_remeshes"] = \
+                    c.get("recover.remesh", 0)
+                em.detail["serve_meshchaos_remesh_ms"] = round(
+                    c.get("recover.remesh_us", 0) / 1e3, 2)
+                em.detail["serve_meshchaos_evacuated_bytes"] = \
+                    c.get("recover.evacuated_bytes", 0)
+                em.detail["serve_meshchaos_survivor_world"] = eff_world
+                em.detail["serve_meshchaos_shed"] = stats.get("shed", 0)
+                em.detail["serve_meshchaos_degraded_windows"] = \
+                    stats.get("mesh_degraded", 0)
+                _progress(
+                    f"mesh-chaos: "
+                    f"{em.detail['serve_meshchaos_recovered_ratio']} "
+                    f"recovered ratio over {attempted} queries on "
+                    f"{eff_world}/{world0} devices "
+                    f"({em.detail['serve_meshchaos_remeshes']} remesh, "
+                    f"{em.detail['serve_meshchaos_remesh_ms']} ms "
+                    f"evacuating "
+                    f"{em.detail['serve_meshchaos_evacuated_bytes']} B)"
+                    f", p99 {em.detail['serve_meshchaos_p99_ms']} ms")
+            except Exception as e:  # graftlint: ok[broad-except] — the mesh-chaos stage must not kill the bench
+                print(f"mesh-chaos stage FAILED: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+                em.detail["serve_meshchaos_error"] = str(e)[:200]
+            finally:
+                _trace.disable_counters()
+                _trace.reset()
+                try:
+                    from cylon_tpu import topology as _topology
+                    _topology.reset()
+                except Exception:  # graftlint: ok[broad-except] — teardown must not mask the stage verdict
+                    pass
+            em.emit("meshchaos")
 
     em.detail["bench_wall_s"] = round(time.monotonic() - t_start, 1)
     em.emit("final")

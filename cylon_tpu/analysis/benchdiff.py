@@ -59,6 +59,12 @@ Gated metrics (relative threshold, default 15%):
     ``serve_chaos_p99_ms`` tail latency under chaos (higher = worse);
     the shed count is reported ungated (docs/robustness.md
     "self-healing execution")
+  * ``serve_meshchaos_recovered_ratio``  completed / attempted queries
+    of the mesh-loss chaos stage (CYLON_BENCH_MESHCHAOS; lower = worse
+    — queries stopped surviving the evacuation + re-mesh) and
+    ``serve_meshchaos_p99_ms`` tail latency across the degrade (higher
+    = worse); the remesh wall-clock ``serve_meshchaos_remesh_ms`` is
+    reported ungated (docs/robustness.md "Elasticity")
   * ``tpch_<q>_spill_bytes``  host-tier staging bytes of the timed rep
     (higher = worse — the main stage runs at AMPLE budget, so spilling
     there means the out-of-core machinery engaged when the resident
@@ -175,6 +181,16 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     # MORE under pressure can be the correct response).
     (r"serve_chaos_recovered_ratio$", "down"),
     (r"serve_chaos_p99_ms$", "up"),
+    # mesh-loss chaos family (docs/robustness.md "Elasticity",
+    # CYLON_BENCH_MESHCHAOS): a deterministic mid-run device loss under
+    # sustained serving — the recovered ratio gates DOWN (queries must
+    # keep completing across the evacuation + re-mesh and afterwards
+    # on the survivor mesh) and p99 UNDER DEGRADE gates UP (with the
+    # ms floor): elasticity that works but stalls the pipeline is a
+    # regression too.  The remesh wall-clock is reported ungated (it
+    # scales with data volume, not code quality).
+    (r"serve_meshchaos_recovered_ratio$", "down"),
+    (r"serve_meshchaos_p99_ms$", "up"),
     # out-of-core family (docs/out_of_core.md): the main TPC-H stage
     # runs at AMPLE budget, so per-query spill bytes must stay 0 —
     # spilling when memory is ample means the morsel pricing or the

@@ -55,13 +55,23 @@ Stages:
      the result must be row-identical to the resident run, and on
      failure a doctor bundle renders the evidence
      (``--no-ooc-smoke`` skips);
-  8. **benchdiff** (only when ``--baseline`` and a candidate artifact
+  8. **mesh-loss chaos smoke** (docs/robustness.md "Elasticity"): a
+     deterministic ``mesh.device_lost`` topology fault is injected into
+     ONE served 2-stage query — the victim must recover row-identical
+     on the shrunken survivor mesh (``recover.remesh`` in its own
+     counter slice), peers and a post-degrade query stay clean, the
+     session flips into degraded mode, and doctor renders the
+     ``mesh_degraded`` bundle with the evacuation timeline
+     (``--no-mesh-smoke`` skips; auto-skips below 2 devices);
+  9. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
      including the serving families (``serve_qps``/``serve_sustain_qps``
      down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up), the
-     ``tpch_<q>_recompiles`` / ``serve_slo_violations`` up-gates, and
-     the chaos family (``serve_chaos_recovered_ratio`` down,
-     ``serve_chaos_p99_ms`` up).
+     ``tpch_<q>_recompiles`` / ``serve_slo_violations`` up-gates, the
+     chaos family (``serve_chaos_recovered_ratio`` down,
+     ``serve_chaos_p99_ms`` up), and the mesh-chaos family
+     (``serve_meshchaos_recovered_ratio`` down,
+     ``serve_meshchaos_p99_ms`` up).
 
 Exit code is the worst across stages under the shared contract: 0 clean,
 1 findings/regressions/plan errors, 2 usage or tooling errors.
@@ -89,14 +99,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/8: graftlint ==")
+    print("== ci stage 1/9: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/8: plan_check pre-flight ==")
+    print("== ci stage 2/9: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -157,7 +167,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/8: serving smoke ==")
+    print("== ci stage 3/9: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -280,7 +290,7 @@ def _stage_telemetry_smoke(sf: float) -> int:
     CONTRACTS rather than the numbers: sampler non-empty, catalogue
     compliance, export validity (one track per query trace id), stats
     store populated with per-node observations."""
-    print("== ci stage 4/8: telemetry smoke ==")
+    print("== ci stage 4/9: telemetry smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -402,7 +412,7 @@ def _stage_doctor_smoke(sf: float) -> int:
     post-mortem machinery end to end: the victim fails onto its own
     handle, peers stay row-identical to serial execution, a
     flight-recorder bundle lands on disk, and doctor renders it."""
-    print("== ci stage 5/8: doctor smoke ==")
+    print("== ci stage 5/9: doctor smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -514,7 +524,7 @@ def _stage_chaos_smoke(sf: float) -> int:
     shows the ladder's stage retry with fewer stages replayed than the
     plan has), peers complete untouched, and the flight-recorder
     bundle doctor renders shows the ladder's events."""
-    print("== ci stage 6/8: chaos-recovery smoke ==")
+    print("== ci stage 6/9: chaos-recovery smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -669,7 +679,7 @@ def _stage_ooc_smoke(sf: float) -> int:
     run, and the exchange transient must stay within the pinned
     budget.  On failure a flight-recorder bundle is dumped and doctor
     renders it, so the evidence ships with the red CI run."""
-    print("== ci stage 7/8: out-of-core smoke ==")
+    print("== ci stage 7/9: out-of-core smoke ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -762,10 +772,182 @@ def _stage_ooc_smoke(sf: float) -> int:
     return 1 if bad else 0
 
 
+def _stage_mesh_smoke(sf: float) -> int:
+    """Mesh-loss chaos smoke (docs/robustness.md "Elasticity"): a
+    deterministic ``mesh.device_lost`` nth-rule is injected into ONE
+    served 2-stage query — the victim must RECOVER row-identical on
+    the shrunken survivor mesh (``recover.remesh`` in ITS counter
+    slice), its batch peers must complete untouched with clean
+    slices, the session must flip into degraded mode, and the
+    flight-recorder bundle doctor renders must show the
+    ``mesh_degraded`` event + evacuation timeline."""
+    print("== ci stage 8/9: mesh-loss chaos smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import tempfile
+
+        import jax
+
+        from .. import faults, plan as planner, topology
+        from ..context import CylonContext
+        from ..observe import doctor, flightrec
+        from ..parallel.dtable import DTable
+        from ..serve import ServeSession
+        from ..tpch import generate
+        from ..tpch.queries import QUERIES
+
+        if len(jax.devices()) < 2:
+            print("mesh-loss smoke: skipped — needs >= 2 devices (set "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+            return 0
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(sf, seed=7)
+        dts = {name: DTable.from_pandas(ctx, df)
+               for name, df in data.items()}
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding —
+        # the same contract as the stages above
+        print(f"mesh-loss smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    world0 = ctx.get_world_size()
+    prev_dir = os.environ.get("CYLON_FLIGHTREC_DIR")
+    tmpdir = tempfile.mkdtemp(prefix="cylon-mesh-")
+    os.environ["CYLON_FLIGHTREC_DIR"] = tmpdir
+    try:
+        from ..config import JoinConfig
+        from ..parallel import dist_groupby, dist_join
+
+        li = dts["lineitem"].column_names.index("l_orderkey")
+        oi = dts["orders"].column_names.index("o_orderkey")
+
+        def victim_op(t):
+            # two exchange stages (join, then groupby over its output):
+            # the nth=2 topology fault below lands at the SECOND stage
+            # boundary, after stage 1 checkpointed — the victim loses a
+            # device MID-query, not between queries
+            j = dist_join(t["lineitem"], t["orders"],
+                          JoinConfig.InnerJoin(li, oi))
+            return dist_groupby(j, ["lt-l_orderkey"],
+                                [("lt-l_quantity", "sum")])
+
+        serial = planner.run(ctx, victim_op, dts).to_table().to_pandas()
+        q6 = QUERIES["q6"]
+        serial_peer = planner.run(
+            ctx, lambda t: q6(ctx, t), dts).to_pandas()
+        plan = faults.FaultPlan(seed=0, rules=[
+            faults.FaultRule("mesh.device_lost", kind="topology",
+                             nth=2, lost=1)])
+        flightrec.clear()
+        from .. import trace as _trace
+        _trace.enable_counters()
+        _trace.reset()
+        with faults.active(plan), \
+                ServeSession(ctx, tables=dts, batch_window_ms=30.0) as s:
+            # the victim submits FIRST and executes first, so the
+            # plan-wide second mesh.device_lost consult is its second
+            # exchange boundary
+            victim = s.submit(victim_op, label="victim")
+            peers = [s.submit(lambda t, q=q6: q(ctx, t),
+                              label=f"peer{i}",
+                              export=lambda r: r.to_pandas())
+                     for i in range(2)]
+            got = victim.result(timeout=600).to_table().to_pandas()
+            peer_results = [h.result(timeout=600) for h in peers]
+            # one more post-degrade window proves the session keeps
+            # serving on the survivor mesh
+            tail = s.submit(lambda t, q=q6: q(ctx, t), label="tail",
+                            export=lambda r: r.to_pandas())
+            tail_got = tail.result(timeout=600)
+            stats = s.stats()
+        if not got.sort_values(list(got.columns))\
+                .reset_index(drop=True).equals(
+                    serial.sort_values(list(serial.columns))
+                    .reset_index(drop=True)):
+            print("mesh-loss smoke: the recovered victim DIVERGED from "
+                  "the healthy run", file=sys.stderr)
+            bad += 1
+        vc = victim.counters
+        if not vc.get("recover.remesh", 0):
+            print("mesh-loss smoke: the victim's counter slice shows "
+                  "no re-mesh — the topology rung never engaged",
+                  file=sys.stderr)
+            bad += 1
+        eff = topology.effective(ctx)
+        if eff.get_world_size() != world0 - 1:
+            print(f"mesh-loss smoke: survivor world is "
+                  f"{eff.get_world_size()}, expected {world0 - 1}",
+                  file=sys.stderr)
+            bad += 1
+        if not stats.get("mesh_degraded", 0):
+            print("mesh-loss smoke: the session never flipped into "
+                  "degraded mode", file=sys.stderr)
+            bad += 1
+        for h, gotp in zip(peers, peer_results):
+            if not gotp.sort_values(list(gotp.columns))\
+                    .reset_index(drop=True).equals(
+                        serial_peer.sort_values(
+                            list(serial_peer.columns))
+                        .reset_index(drop=True)):
+                print(f"mesh-loss smoke: {h.label} diverged",
+                      file=sys.stderr)
+                bad += 1
+            if h.counters.get("fault.injected", 0) \
+                    or h.counters.get("recover.remesh", 0):
+                print(f"mesh-loss smoke: {h.label}'s counter slice "
+                      "shows the victim's fault/re-mesh — attribution "
+                      "leaked", file=sys.stderr)
+                bad += 1
+        if not tail_got.sort_values(list(tail_got.columns))\
+                .reset_index(drop=True).equals(
+                    serial_peer.sort_values(list(serial_peer.columns))
+                    .reset_index(drop=True)):
+            print("mesh-loss smoke: the post-degrade query diverged on "
+                  "the survivor mesh", file=sys.stderr)
+            bad += 1
+        if not any(e.get("kind") == "mesh_degraded"
+                   for e in flightrec.events()):
+            print("mesh-loss smoke: no mesh_degraded event reached the "
+                  "flight recorder", file=sys.stderr)
+            bad += 1
+        bundle_path = flightrec.dump(reason="ci mesh-loss chaos smoke")
+        rc = doctor.main([bundle_path])
+        if rc != 0:
+            print(f"mesh-loss smoke: doctor exited {rc} on the bundle",
+                  file=sys.stderr)
+            bad += 1
+        print(f"mesh-loss smoke: victim recovered on "
+              f"{eff.get_world_size()}/{world0} devices "
+              f"(remesh={vc.get('recover.remesh', 0)}, evacuated "
+              f"{vc.get('recover.evacuated_bytes', 0)} B), "
+              f"{len(peers)} peers + 1 post-degrade query clean "
+              f"({time.perf_counter() - t0:.1f}s, sf={sf})")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract and
+        # let the remaining stages run instead of dying with a traceback
+        print(f"mesh-loss smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        try:
+            from .. import topology as _topology, trace as _trace
+            _trace.disable_counters()
+            _trace.reset()
+            _topology.reset()
+        except Exception:  # graftlint: ok[broad-except] — best-effort
+            pass           # teardown must not mask the stage verdict
+        if prev_dir is None:
+            os.environ.pop("CYLON_FLIGHTREC_DIR", None)
+        else:
+            os.environ["CYLON_FLIGHTREC_DIR"] = prev_dir
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 8/8: benchdiff ==")
+    print("== ci stage 9/9: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -797,6 +979,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the chaos-recovery smoke stage")
     ap.add_argument("--no-ooc-smoke", action="store_true",
                     help="skip the out-of-core (spill) smoke stage")
+    ap.add_argument("--no-mesh-smoke", action="store_true",
+                    help="skip the mesh-loss chaos smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -806,32 +990,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/8: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/9: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/8: serving smoke == (skipped)")
+        print("== ci stage 3/9: serving smoke == (skipped)")
     if not args.no_telemetry_smoke:
         rcs.append(_stage_telemetry_smoke(args.tpch_sf))
     else:
-        print("== ci stage 4/8: telemetry smoke == (skipped)")
+        print("== ci stage 4/9: telemetry smoke == (skipped)")
     if not args.no_doctor_smoke:
         rcs.append(_stage_doctor_smoke(args.tpch_sf))
     else:
-        print("== ci stage 5/8: doctor smoke == (skipped)")
+        print("== ci stage 5/9: doctor smoke == (skipped)")
     if not args.no_chaos_smoke:
         rcs.append(_stage_chaos_smoke(args.tpch_sf))
     else:
-        print("== ci stage 6/8: chaos-recovery smoke == (skipped)")
+        print("== ci stage 6/9: chaos-recovery smoke == (skipped)")
     if not args.no_ooc_smoke:
         rcs.append(_stage_ooc_smoke(args.tpch_sf))
     else:
-        print("== ci stage 7/8: out-of-core smoke == (skipped)")
+        print("== ci stage 7/9: out-of-core smoke == (skipped)")
+    if not args.no_mesh_smoke:
+        rcs.append(_stage_mesh_smoke(args.tpch_sf))
+    else:
+        print("== ci stage 8/9: mesh-loss chaos smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 8/8: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 9/9: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

@@ -297,6 +297,72 @@ def exchange_strategy() -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# exchange hang watchdog (docs/robustness.md "Elasticity"): a bounded
+# timeout around collective dispatch in parallel/shuffle.py.  A wedged
+# exchange — the signature of a device dying mid-collective on real
+# hardware — raises a classified TransientFault naming the fault point
+# instead of hanging the dispatcher forever (the escalation ladder then
+# retries / re-meshes).  Resolution: explicit set_exchange_timeout_ms()
+# > CYLON_EXCHANGE_TIMEOUT_MS env > None (disabled — the default,
+# because the guard runs each dispatch on a helper thread and a wedged
+# one is leaked, a cost only worth paying when hangs are a live risk).
+# ---------------------------------------------------------------------------
+
+_exchange_timeout_ms: Optional[int] = None   # None -> env/disabled
+
+
+def _validate_timeout_ms(n, what: str) -> int:
+    if isinstance(n, bool) or not isinstance(n, int):
+        raise CylonError(Status(Code.Invalid,
+            f"{what} must be a positive int millisecond count, "
+            f"got {type(n).__name__} {n!r}"))
+    if n <= 0:
+        raise CylonError(Status(Code.Invalid,
+            f"{what} must be positive, got {n} (pass None to disable "
+            "the watchdog)"))
+    return n
+
+
+def exchange_timeout_ms() -> Optional[int]:
+    """The collective-dispatch watchdog timeout in ms, or None when the
+    watchdog is disabled (explicit knob, else
+    ``CYLON_EXCHANGE_TIMEOUT_MS`` — validated like the budget knob).
+
+    Set it GENEROUSLY: the guarded window covers the whole dispatch,
+    so the first call of a new kernel shape pays trace + XLA compile
+    inside it — a timeout sized to warm exchange wall time will
+    misread a cold compile as a wedged collective and fail a healthy
+    query onto the retry rung."""
+    if _exchange_timeout_ms is not None:
+        return _exchange_timeout_ms
+    env = os.environ.get("CYLON_EXCHANGE_TIMEOUT_MS", "")
+    if env:
+        try:
+            return _validate_timeout_ms(int(env),
+                                        "CYLON_EXCHANGE_TIMEOUT_MS")
+        except ValueError:
+            raise CylonError(Status(Code.Invalid,
+                f"CYLON_EXCHANGE_TIMEOUT_MS must be an int millisecond "
+                f"count, got {env!r}")) from None
+    return None
+
+
+def set_exchange_timeout_ms(n: "Optional[int]") -> "Optional[int]":
+    """Set the exchange watchdog timeout in ms (``None`` restores env
+    resolution / disabled); returns the previous EXPLICIT setting so
+    callers restore it in a ``finally`` — the same contract as
+    ``set_device_memory_budget``.  Zero, negative, float and bool
+    values are rejected: a silently-stored 0 would time every exchange
+    out instantly."""
+    global _exchange_timeout_ms
+    if n is not None:
+        n = _validate_timeout_ms(n, "exchange watchdog timeout")
+    prev = _exchange_timeout_ms
+    _exchange_timeout_ms = n
+    return prev
+
+
+# ---------------------------------------------------------------------------
 # measured-cost ranking (docs/observability.md "the mesh bandwidth
 # profile"): the costed chooser normally ranks feasible exchange
 # lowerings on the (rounds, wire bytes) proxy.  This knob — explicit

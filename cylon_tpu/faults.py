@@ -59,8 +59,9 @@ from .status import Code, CylonError, Status
 
 __all__ = [
     "POINTS", "FaultError", "TransientFault", "ResourceFault",
-    "PermanentFault", "FaultRule", "FaultPlan", "install", "uninstall",
-    "active", "plan", "check", "perturb", "undersize_hint",
+    "PermanentFault", "TopologyFault", "FaultRule", "FaultPlan",
+    "install", "uninstall", "active", "plan", "check", "perturb",
+    "undersize_hint",
 ]
 
 # ---------------------------------------------------------------------------
@@ -125,24 +126,48 @@ POINTS: Dict[str, str] = {
         "(spill/pool.stage_in_arrays; whole fault-ins and per-morsel "
         "slices) — a failed H2D or device allocation failure for the "
         "staged block",
+    # elastic degraded-mesh execution (docs/robustness.md
+    # "Elasticity"): loss of a device / mesh slice mid-query.  The
+    # point is consulted at the plan executor's exchange-boundary
+    # dispatch (next to exec.stage) — the place a real collective
+    # failure on a dead chip would surface — and topology-kind rules
+    # raise a TopologyFault carrying how many devices died.  The
+    # ladder's TOPOLOGY rung answers by evacuating to the host tier
+    # and re-meshing onto the survivors, never by blind retry on the
+    # hardware that just vanished.
+    "mesh.device_lost":
+        "loss of a device (or mesh slice) mid-query, surfacing as a "
+        "collective failure at an exchange boundary "
+        "(plan/executor._execute) — topology rules carry lost=k; the "
+        "escalation ladder's TOPOLOGY rung evacuates and re-meshes "
+        "onto the P-k survivors",
 }
 
 
 class FaultError(CylonError):
-    """Base of every injected fault; carries the fault point's name."""
+    """Base of every injected fault; carries the fault point's name.
+    ``detail`` overrides the default message — the engine reuses the
+    typed classes for ORGANIC failures it classifies the same way (the
+    exchange hang watchdog raises a TransientFault naming its boundary),
+    and those must not claim to be injected."""
 
-    def __init__(self, point: str, kind: str):
+    def __init__(self, point: str, kind: str,
+                 detail: Optional[str] = None):
         super().__init__(Status(Code.ExecutionError,
+                                detail if detail is not None else
                                 f"injected {kind} fault at {point!r}"))
         self.point = point
 
 
 class TransientFault(FaultError):
-    """An injected failure of the retryable class (network blip, flaky
-    read) — ``resilience.retrying`` boundaries absorb these."""
+    """A failure of the retryable class (network blip, flaky read) —
+    ``resilience.retrying`` boundaries absorb these.  Injected by
+    transient rules, and raised ORGANICALLY (with ``detail``) by the
+    exchange hang watchdog, whose wedged-collective timeout is exactly
+    this class: retry from checkpoint, never spin forever."""
 
-    def __init__(self, point: str):
-        super().__init__(point, "transient")
+    def __init__(self, point: str, detail: Optional[str] = None):
+        super().__init__(point, "transient", detail)
 
 
 class ResourceFault(FaultError):
@@ -161,6 +186,22 @@ class PermanentFault(FaultError):
 
     def __init__(self, point: str):
         super().__init__(point, "permanent")
+
+
+class TopologyFault(FaultError):
+    """A failure of the TOPOLOGY class: a device (or mesh slice) died
+    mid-query, surfacing as a collective failure at an exchange
+    boundary.  Carries ``lost`` — how many devices vanished — so the
+    escalation ladder's topology rung (docs/robustness.md
+    "Elasticity") knows how far to shrink the survivor mesh.  Neither
+    retry nor replan is sound here: the same collective on the same
+    mesh re-touches the dead chip; the recovery is evacuate + re-mesh
+    onto the P−lost survivors."""
+
+    def __init__(self, point: str, lost: int = 1,
+                 detail: Optional[str] = None):
+        super().__init__(point, "topology", detail)
+        self.lost = max(int(lost), 1)
 
 
 # ---------------------------------------------------------------------------
@@ -187,12 +228,13 @@ class FaultRule:
     or a total-fires cap)."""
 
     point: str                      # exact name or fnmatch pattern
-    kind: str = "transient"         # transient|resource|permanent|value
+    kind: str = "transient"   # transient|resource|permanent|topology|value
     probability: float = 1.0        # seeded draw per matching call
     nth: Optional[int] = None       # fire ONLY on the nth call (1-based)
     once: bool = False              # at most one fire PER POINT
     limit: Optional[int] = None     # max fires PER POINT
     mutate: Optional[Callable] = None  # kind="value": old -> new
+    lost: int = 1                   # kind="topology": devices that died
     # once/limit caps are scoped per (rule, point): for an exact-name
     # rule that is the historical "once ever", while a PATTERN rule
     # ("io.*") caps each matching point independently — a shared
@@ -202,13 +244,18 @@ class FaultRule:
 
     def __post_init__(self):
         if self.kind not in ("transient", "resource", "permanent",
-                             "value"):
+                             "topology", "value"):
             raise CylonError(Status(Code.Invalid,
                 f"fault kind must be transient/resource/permanent/"
-                f"value, got {self.kind!r}"))
+                f"topology/value, got {self.kind!r}"))
         if self.kind == "value" and self.mutate is None:
             raise CylonError(Status(Code.Invalid,
                 f"value fault at {self.point!r} needs a mutate callable"))
+        if isinstance(self.lost, bool) or not isinstance(self.lost, int) \
+                or self.lost < 1:
+            raise CylonError(Status(Code.Invalid,
+                f"topology fault 'lost' must be a positive int device "
+                f"count, got {self.lost!r}"))
 
 
 class FaultPlan:
@@ -277,6 +324,15 @@ class FaultPlan:
                       probability=0.01, limit=1),
             FaultRule("spill.stage_out", kind="resource",
                       probability=0.01, limit=1),
+            # device loss (docs/robustness.md "Elasticity"): one device
+            # dies at an exchange boundary, exercising the topology
+            # rung — evacuate to the host tier, re-mesh onto the P−1
+            # survivors, resume from checkpoint.  limit=1: the registry
+            # keeps the process on the survivor mesh afterwards, so a
+            # second fire would shrink again — one loss per chaos run
+            # models "a chip died", not "the fleet is melting"
+            FaultRule("mesh.device_lost", kind="topology",
+                      probability=0.003, limit=1),
         ])
 
     def _decide(self, point: str, want_value: bool) -> Optional[FaultRule]:
@@ -368,6 +424,8 @@ def check(point: str) -> None:
         raise PermanentFault(point)
     if rule.kind == "resource":
         raise ResourceFault(point)
+    if rule.kind == "topology":
+        raise TopologyFault(point, lost=rule.lost)
     raise TransientFault(point)
 
 

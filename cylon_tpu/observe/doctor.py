@@ -24,8 +24,8 @@ __all__ = ["render", "main"]
 
 # counters worth surfacing even when a reader doesn't know what to grep
 _INTERESTING_PREFIXES = ("serve.", "compile.", "fault.", "retry.",
-                         "flightrec.", "shuffle.strategy.", "devmem.",
-                         "plan.cache")
+                         "recover.", "spill.", "flightrec.",
+                         "shuffle.strategy.", "devmem.", "plan.cache")
 
 
 def _fmt_ts(t: Optional[float]) -> str:
@@ -86,6 +86,32 @@ def render(doc: Dict[str, Any]) -> str:
         lines.append(f"  #{q.get('qid', '?'):>4} {q.get('label', '?'):<12} "
                      f"{state:<9} {q.get('latency_ms', '?'):>9} ms"
                      f"{tail}")
+
+    # elastic degraded-mesh timeline (docs/robustness.md
+    # "Elasticity"): device losses and the evacuations that answered
+    # them, in ring order — the "what happened to the fleet" view of a
+    # post-mortem
+    mesh = [e for e in doc.get("events", [])
+            if e.get("kind") == "mesh_degraded"
+            or (e.get("kind") == "recover"
+                and e.get("action") == "remesh")]
+    if mesh:
+        lines.append(_section(f"mesh topology / evacuation timeline "
+                              f"({len(mesh)})"))
+        for e in mesh[-8:]:
+            if e.get("kind") == "mesh_degraded":
+                lines.append(
+                    f"  [{_fmt_ts(e.get('t'))}] MESH DEGRADED: lost "
+                    f"{e.get('lost', '?')} device(s) -> "
+                    f"{e.get('survivor_world', '?')} survivors"
+                    + (f" (session {e.get('session')})"
+                       if e.get("session") else ""))
+            else:
+                lines.append(
+                    f"  [{_fmt_ts(e.get('t'))}] REMESH: evacuated "
+                    f"{e.get('evacuated_bytes', '?')} B, resumed on "
+                    f"{e.get('survivor_world', '?')} survivors "
+                    f"[{e.get('error', '')}]")
 
     choices = [e for e in doc.get("events", [])
                if e.get("kind") == "exchange_choice"]

@@ -369,6 +369,34 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "with the attempt log and the flight recorder holds a "
      "recover_failed event (organic first failures the ladder never "
      "engaged with are annotated but NOT booked here)"),
+    # elastic degraded-mesh execution (docs/robustness.md
+    # "Elasticity"): the topology rung — device loss answered by
+    # evacuation to the host tier + re-meshing onto the survivors
+    ("recover.remesh", COUNTER, "remeshes",
+     "topology-rung re-meshes: a device loss (mesh.device_lost / an "
+     "XLA device-lost error) evacuated live state through the host "
+     "tier and resumed the plan on a shrunken survivor mesh"),
+    ("recover.remesh_us", COUNTER, "us",
+     "wall-clock spent inside re-mesh evacuations (memo drop + scan "
+     "table + checkpoint re-partition + restage) — bench emits it as "
+     "serve_meshchaos_remesh_ms"),
+    ("recover.evacuated_bytes", COUNTER, "bytes",
+     "bytes evacuated device->host through the spill pool's staging "
+     "boundary during topology-rung re-meshes (spilled tables "
+     "re-block from their pooled copies and add nothing here)"),
+    ("recover.survivor_world", GAUGE, "devices",
+     "world size of the current survivor mesh after the most recent "
+     "device loss (cylon_tpu/topology.py)"),
+    ("serve.degraded", GAUGE, "devices",
+     "devices the serving session has lost vs its construction-time "
+     "mesh — nonzero means degraded mode: admission budgets re-price "
+     "to the survivor fraction and new builders anchor on the "
+     "survivor mesh"),
+    ("shuffle.watchdog_timeouts", COUNTER, "timeouts",
+     "collective dispatches aborted by the exchange hang watchdog "
+     "(CYLON_EXCHANGE_TIMEOUT_MS): the wedged exchange raised a "
+     "classified TransientFault naming its boundary instead of "
+     "hanging the dispatcher forever"),
     # out-of-core execution (docs/out_of_core.md): the host-tier spill
     # pool, device<->host staging, and morsel-partitioned scans
     ("spill.spills", COUNTER, "tables",

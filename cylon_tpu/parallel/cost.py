@@ -77,11 +77,11 @@ from ..ops.compact import next_bucket
 
 __all__ = [
     "SINGLE_SHOT", "CHUNKED", "RING", "ALLGATHER", "REPLICATE",
-    "STAGED_SPILL", "STRATEGIES", "StrategyPrice", "exchange_sizes",
-    "single_shot_bytes", "price_single_shot", "price_chunked",
-    "price_ring", "price_allgather", "price_replicate", "price_retained",
-    "price_staged_spill", "chunk_plan", "enumerate_strategies", "choose",
-    "COLLECTIVE_OF", "predicted_ms",
+    "STAGED_SPILL", "REMESH", "STRATEGIES", "StrategyPrice",
+    "exchange_sizes", "single_shot_bytes", "price_single_shot",
+    "price_chunked", "price_ring", "price_allgather", "price_replicate",
+    "price_retained", "price_staged_spill", "price_remesh", "chunk_plan",
+    "enumerate_strategies", "choose", "COLLECTIVE_OF", "predicted_ms",
 ]
 
 SINGLE_SHOT = "single-shot"
@@ -91,6 +91,13 @@ ALLGATHER = "allgather"
 REPLICATE = "replicate"   # broadcast replication (priced, never chosen
 #                           by the shuffle chooser — it changes the
 #                           layout contract, not just the lowering)
+REMESH = "remesh"   # the elastic re-partition P -> P'
+#                     (docs/robustness.md "Elasticity"): priced like any
+#                     exchange but never chosen by the shuffle chooser —
+#                     it changes the MESH, not the lowering, so only the
+#                     escalation ladder's topology rung dispatches it
+#                     (parallel/remesh.py; annotated remesh=P->P' in
+#                     EXPLAIN ANALYZE)
 STAGED_SPILL = "staged-spill"   # host-tier staging (docs/out_of_core.md):
 #                           stage the payload OUT to the host pool and
 #                           stream it back in K admission-priced morsels,
@@ -262,6 +269,37 @@ def price_retained(cap: int, rbytes: int) -> int:
     (``resilience.RecoveryPolicy.checkpoint_fraction``), not a
     default."""
     return int(max(cap, 0) * max(rbytes, 1))
+
+
+def price_remesh(p_old: int, p_new: int, counts: np.ndarray,
+                 rbytes: int) -> StrategyPrice:
+    """The elastic re-partition (docs/robustness.md "Elasticity"): a
+    table's rows move from a ``p_old``-shard layout onto ``p_new``
+    shards by staging OUT through the host tier (the spill pool's
+    sanctioned D2H boundary), re-blocking host-side, and staging back
+    IN under the survivor mesh's sharding — a resharding lowered
+    entirely through the host because the old mesh can no longer run a
+    collective (a device in it is gone; the arXiv:2112.01075 framing
+    taken to the degraded case).
+
+    ``counts`` is the old layout's [p_old] per-shard row counts.  The
+    price: ``peak_bytes`` is the NEW resident block (the survivor
+    shards absorb the same rows over fewer devices — the re-priced
+    footprint every later exchange inherits), ``wire_bytes`` the
+    payload that crosses shard boundaries, ``host_bytes`` the 2×
+    payload D2H + H2D staging (what :func:`predicted_ms` converts to
+    time via the measured h2d/d2h coefficients), 1 round.  Annotated
+    ``remesh=P->P'`` on the plan by parallel/remesh.py."""
+    total = int(np.asarray(counts).sum())
+    per_new = -(-max(total, 1) // max(p_new, 1))
+    cap_new = next_bucket(max(per_new, 1), minimum=8)
+    payload = total * rbytes
+    return StrategyPrice(
+        REMESH,
+        peak_bytes=int(max(p_new, 1) * cap_new * rbytes),
+        wire_bytes=int(payload),
+        rounds=1, sizes=(cap_new,),
+        host_bytes=2 * payload)
 
 
 def chunk_plan(nparts: int, counts: np.ndarray, rbytes: int,

@@ -1,0 +1,195 @@
+"""Elastic re-partition: move a DTable from a P-shard mesh onto the
+P′-shard survivor mesh (docs/robustness.md "Elasticity").
+
+The escalation ladder's TOPOLOGY rung (plan/executor.py) calls
+:func:`remesh_table` for every live piece of state a resumed attempt
+needs — the plan's scan tables and the retained stage checkpoints —
+after a ``mesh.device_lost`` fault.  The move is a resharding lowered
+entirely through the HOST tier, because the old mesh can no longer run
+a collective (one of its devices is gone):
+
+  1. **evacuate** — the table's leaves stage OUT through the spill
+     pool's sanctioned D2H boundary (``spill.pool.stage_out_arrays``;
+     a table already spilled reads its pooled host blocks instead —
+     zero device traffic);
+  2. **re-block** — each shard's valid rows concatenate host-side and
+     re-split into P′ balanced blocks under a fresh size-class
+     capacity;
+  3. **restage** — the new blocks stage IN under the survivor mesh's
+     sharding (``stage_in_arrays``).
+
+The mutation is IN PLACE (fresh DColumn objects, same DTable object —
+the spill pool's shared-column rule): execution-memo signatures and
+plan fingerprints key scan tables by identity, so an in-place re-mesh
+lets checkpoints restore and plans resume without re-capturing
+anything.  Derived tables sharing the old device arrays keep them (the
+arrays stay valid); only THIS handle moves.
+
+Priced like any exchange: ``cost.price_remesh`` (peak = the survivor
+block, host_bytes = 2× payload) — the price is annotated
+``remesh=P->P'`` on the plan (visible in EXPLAIN ANALYZE) and the
+staged bytes are booked as ``recover.evacuated_bytes``.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .. import observe, trace
+from ..ops.compact import next_bucket
+from ..status import Code, CylonError, Status
+from . import cost
+
+__all__ = ["remesh_table", "ensure_current"]
+
+
+def ensure_current(tables) -> int:
+    """Migrate every table whose mesh has degraded (the topology
+    registry resolves its context to a survivor) onto that survivor
+    mesh, in place; returns the bytes evacuated.  The victim plan's
+    rung only re-meshes the tables IT scans — a table untouched by it
+    still lives on the mesh containing the dead chip, and its first
+    collective after the degrade would cost ANOTHER healthy device
+    (the organic failure re-enters the rung and shrinks again).  The
+    serve dispatcher calls this on every degrade and ``plan.run`` /
+    the per-query builders call it before wrapping, so stale tables
+    move exactly once, at the boundary that would otherwise pay twice.
+    Accepts a DTable, a dict of them, or any iterable; whole-mesh
+    tables are a dict-lookup no-op."""
+    from .. import topology
+    if tables is None:
+        return 0
+    if hasattr(tables, "values"):
+        tabs = list(tables.values())
+    elif hasattr(tables, "ctx"):
+        tabs = [tables]
+    else:
+        tabs = list(tables)
+    evac = 0
+    for dt in tabs:
+        dctx = getattr(dt, "ctx", None)
+        if dctx is None:
+            continue
+        eff = topology.effective(dctx)
+        if eff is not dctx:
+            evac += remesh_table(dt, eff)
+    return evac
+
+
+def _host_leaves(dt) -> "tuple[List, int]":
+    """The table's leaves as host arrays, in (data, validity?) column
+    order: from the pooled entry when spilled (no device read), else
+    staged out through the sanctioned D2H boundary.  Returns
+    ``(pairs, staged_bytes)`` where ``pairs`` is ``[(data, validity or
+    None), ...]``."""
+    from ..spill import pool as spill_pool
+    entry = dt._spill_entry
+    if entry is not None:
+        # already evacuated: the host tier holds the sole copy — the
+        # re-mesh consumes it and releases the pinned entry below
+        return list(entry.leaves), 0
+    flat = []
+    for c in dt._columns:
+        flat.append(c.data)
+        if c.validity is not None:
+            flat.append(c.validity)
+    hosts = spill_pool.stage_out_arrays(flat)
+    staged = sum(int(h.nbytes) for h in hosts)
+    pairs = []
+    hi = 0
+    for c in dt._columns:
+        d = hosts[hi]
+        hi += 1
+        v = None
+        if c.validity is not None:
+            v = hosts[hi]
+            hi += 1
+        pairs.append((d, v))
+    return pairs, staged
+
+
+def remesh_table(dt, new_ctx) -> int:
+    """Re-partition ``dt`` IN PLACE onto ``new_ctx``'s mesh; returns
+    the bytes evacuated through the host boundary (0 when the table
+    was already host-resident or already on the target mesh).  Row
+    multiset is preserved exactly — shard-major row order re-blocks,
+    which no consumer depends on after an exchange."""
+    from dataclasses import replace as _dc_replace
+
+    from ..analysis import plan_check
+    from ..spill import pool as spill_pool
+    if dt.ctx is new_ctx:
+        return 0
+    p_old = dt.ctx.get_world_size()
+    p_new = new_ctx.get_world_size()
+    dt._collapse_pending()
+    counts = np.asarray(dt.counts_host()).astype(np.int64)
+    if len(counts) != p_old:
+        raise CylonError(Status(Code.ExecutionError,
+            f"remesh: table counts span {len(counts)} shards but its "
+            f"context world is {p_old} (corrupt layout)"))
+    cap_old = dt.cap
+    spilled_sig = (dt._spill_entry.sig
+                   if dt._spill_entry is not None else None)
+    pairs, staged = _host_leaves(dt)
+
+    # pricing + the plan annotation (the EXPLAIN ANALYZE surface):
+    # validity lanes are part of the moved payload, so price the full
+    # row width, not just the data lanes
+    leaves_flat = [a for d, v in pairs for a in (d, v) if a is not None]
+    rbytes = max(observe.row_bytes(leaves_flat), 1)
+    price = cost.price_remesh(p_old, p_new, counts, rbytes)
+    plan_check.annotate_append(
+        "remesh", f"{p_old}->{p_new}: {price.describe()}")
+
+    total = int(counts.sum())
+    base, rem = divmod(total, max(p_new, 1))
+    sizes = np.array([base + (1 if i < rem else 0) for i in range(p_new)],
+                     np.int32)
+    cap_new = next_bucket(max(int(sizes.max(initial=0)), 1), minimum=8)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    blocks: List[np.ndarray] = []
+    has_validity: List[bool] = []
+    for d, v in pairs:
+        for h in ((d,) if v is None else (d, v)):
+            h = np.asarray(h)
+            valid = (np.concatenate(
+                [h[i * cap_old:i * cap_old + int(counts[i])]
+                 for i in range(p_old)]) if p_old else
+                h[:0])
+            block = np.zeros((p_new * cap_new,) + h.shape[1:], h.dtype)
+            for i in range(p_new):
+                block[i * cap_new:i * cap_new + sizes[i]] = \
+                    valid[offs[i]:offs[i + 1]]
+            blocks.append(block)
+        has_validity.append(v is not None)
+    blocks.append(sizes)
+    devs = spill_pool.stage_in_arrays(new_ctx, blocks)
+
+    cols = []
+    hi = 0
+    for c, hv in zip(dt._columns, has_validity):
+        data = devs[hi]
+        hi += 1
+        validity = None
+        if hv:
+            validity = devs[hi]
+            hi += 1
+        cols.append(_dc_replace(c, data=data, validity=validity))
+    # publish order mirrors the spill pool's: clear the spill linkage
+    # FIRST so no reader takes a fault-in path against the consumed
+    # entry, then land the new-mesh state
+    dt._spill_entry = None
+    dt._spill_sig = None
+    dt.ctx = new_ctx
+    dt._columns = cols
+    dt.cap = int(cap_new)
+    dt._counts = devs[hi]
+    dt._counts_host = sizes.copy()
+    if spilled_sig is not None:
+        # the old-mesh host copy was the pinned sole copy — consumed
+        # now; releasing it returns its bytes to the host budget
+        spill_pool.get_pool().drop_entry(spilled_sig)
+    trace.count("recover.evacuated_bytes", staged)
+    return staged

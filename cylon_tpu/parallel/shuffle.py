@@ -116,6 +116,55 @@ class _OverBudget(Exception):
 _priced_bytes = cost.single_shot_bytes
 
 
+def _watchdog_dispatch(point: str, thunk):
+    """Bounded-timeout guard around one collective dispatch
+    (docs/robustness.md "Elasticity").  A collective whose peer died
+    mid-flight does not fail on every backend — it can WEDGE, and a
+    wedged exchange hangs the serve dispatcher (and every queued
+    result()) forever.  With ``CYLON_EXCHANGE_TIMEOUT_MS`` /
+    ``config.set_exchange_timeout_ms`` configured, the dispatch (and
+    its completion wait) runs on a helper thread bounded by the
+    timeout; a breach raises a classified
+    :class:`faults.TransientFault` naming the fault point — the
+    escalation ladder's transient/topology machinery takes it from
+    there — and bumps ``shuffle.watchdog_timeouts``.  The wedged
+    helper thread is deliberately LEAKED (daemon): there is no sound
+    way to interrupt a stuck collective from the host, and a leaked
+    waiter is strictly better than a hung dispatcher.  Disabled
+    (``None``, the default) this is one knob read + a direct call."""
+    from ..config import exchange_timeout_ms
+    timeout_ms = exchange_timeout_ms()
+    if not timeout_ms:
+        return thunk()
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            out = thunk()
+            jax.block_until_ready(out)
+            box["out"] = out
+        except BaseException as e:  # graftlint: ok[broad-except] — the
+            box["err"] = e          # waiter re-raises it on its thread
+        finally:
+            done.set()
+
+    th = threading.Thread(target=run, name="cylon-exchange-watchdog",
+                          daemon=True)
+    th.start()
+    if not done.wait(timeout_ms / 1e3):
+        from .. import faults
+        trace.count("shuffle.watchdog_timeouts")
+        raise faults.TransientFault(point, detail=(
+            f"exchange watchdog: collective dispatch at {point!r} "
+            f"exceeded CYLON_EXCHANGE_TIMEOUT_MS={timeout_ms} ms — "
+            "treating the exchange as wedged (transient class; the "
+            "recovery ladder retries or re-meshes)"))
+    if "err" in box:
+        raise box["err"]
+    return box["out"]
+
+
 def _account(counts: np.ndarray, rbytes: int, combine=None,
              owner: "str | None" = None) -> None:
     """Exchange-volume accounting shared by the single-shot post() and
@@ -391,11 +440,16 @@ def _staged_exchange(ctx, pid, leaves, choice, outcap_total: int):
     with trace.span_sync("shuffle.exchange") as sp:
         if choice.strategy == cost.RING:
             block = choice.sizes[0]
-            newcounts, outs = _ring_exchange_fn(
-                mesh, axis, Pn, block, outcap_total)(pid, tuple(leaves))
+            newcounts, outs = _watchdog_dispatch(
+                "shuffle.exchange",
+                lambda: _ring_exchange_fn(mesh, axis, Pn, block,
+                                          outcap_total)(pid,
+                                                        tuple(leaves)))
         else:
-            newcounts, outs = _allgather_exchange_fn(
-                mesh, axis, Pn, outcap_total)(pid, tuple(leaves))
+            newcounts, outs = _watchdog_dispatch(
+                "shuffle.exchange",
+                lambda: _allgather_exchange_fn(
+                    mesh, axis, Pn, outcap_total)(pid, tuple(leaves)))
         sp.sync(outs)
     _note_exchange_ms(ctx, choice, t0, dm0)
     return list(outs), newcounts, outcap_total
@@ -752,7 +806,10 @@ def _staged_spill_exchange(ctx, pid, leaves, counts: np.ndarray,
                 if k + 1 < rounds:
                     nxt = pipe.submit(lambda k=k: stage(k + 1))
                 trace.count("spill.morsels")
-                cnt_k, outs_k = exchange(pid_k, leaves_k)
+                cnt_k, outs_k = _watchdog_dispatch(
+                    "shuffle.exchange",
+                    lambda pid_k=pid_k, leaves_k=leaves_k:
+                        exchange(pid_k, leaves_k))
                 if combine is None:
                     if acc is None:
                         acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
@@ -849,7 +906,9 @@ def _chunked_exchange(ctx, pid, leaves, counts: np.ndarray, rbytes: int,
         for k in range(rounds):
             pid_k = slicer(pid, rank, jnp.int32(k * C),
                            jnp.int32((k + 1) * C))
-            cnt_k, outs_k = exchange(pid_k, tuple(leaves))
+            cnt_k, outs_k = _watchdog_dispatch(
+                "shuffle.exchange",
+                lambda pid_k=pid_k: exchange(pid_k, tuple(leaves)))
             if combine is None:
                 if acc is None:
                     acc_cnt, acc = _fold_fn(mesh, axis, outcap_k,
@@ -1002,7 +1061,10 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
     cap = pid.shape[0] // max(Pn, 1)
 
     def dispatch(sizes):
-        return _exchange_fn(mesh, axis, Pn, *sizes)(pid, tuple(leaves))
+        return _watchdog_dispatch(
+            "shuffle.exchange",
+            lambda: _exchange_fn(mesh, axis, Pn, *sizes)(pid,
+                                                         tuple(leaves)))
 
     def post(counts):
         # exchange-volume accounting lives HERE, not after the dispatch:

@@ -37,8 +37,22 @@ def run(ctx, op, tables=None):
     the explain surfaces wrap.  ``op`` receives ``tables`` (a dict of
     DTables, a single DTable, or None) with every table replaced by a
     lazy :class:`ir.LogicalTable`; the return value is materialized back
-    to concrete tables before returning."""
-    b = Builder(ctx)
+    to concrete tables before returning.
+
+    The context resolves through the elastic-topology registry
+    (cylon_tpu/topology.py): after a mid-query device loss re-meshed
+    the process onto a survivor mesh, every subsequent plan anchors on
+    it automatically — degraded throughput, same answers
+    (docs/robustness.md "Elasticity")."""
+    from .. import topology
+    if tables is not None:
+        # tables a previous victim's rung never scanned are still on
+        # the old mesh — migrate them here, before pricing reads their
+        # layout, instead of paying another device on first touch
+        # (whole-mesh tables make this a dict lookup per table)
+        from ..parallel.remesh import ensure_current
+        ensure_current(tables)
+    b = Builder(topology.effective(ctx))
     wrapped = b.wrap_tables(tables) if tables is not None else None
     with ir.capture(b):
         out = op(wrapped) if tables is not None else op()

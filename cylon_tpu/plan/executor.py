@@ -577,6 +577,80 @@ class _CheckpointStore:
         trace.count("recover.checkpoint_hits")
         return entry[0]
 
+    def remesh(self, new_ctx) -> int:
+        """Evacuate + re-partition every retained checkpoint onto the
+        survivor mesh (the topology rung, docs/robustness.md
+        "Elasticity") — a checkpoint that cannot move is dropped (its
+        stage replays) rather than poisoning the resumed attempt with
+        old-mesh arrays.  Prices are re-derived for the new layout;
+        returns the bytes evacuated through the host boundary."""
+        from .. import observe
+        from ..parallel import cost
+        from ..parallel.remesh import remesh_table
+        evac = 0
+        for esig in list(self._order):
+            out, old_price = self._entries[esig]
+            try:
+                evac += remesh_table(out, new_ctx)
+                leaves = []
+                for c in out._columns:
+                    leaves.append(c.data)
+                    if c.validity is not None:
+                        leaves.append(c.validity)
+                price = cost.price_retained(
+                    int(out.cap), max(observe.row_bytes(leaves), 1))
+            except BaseException:  # graftlint: ok[broad-except] — a
+                # checkpoint that fails to evacuate degrades to replay
+                # of its stage, never to a failed recovery
+                self._entries.pop(esig, None)
+                self._order.remove(esig)
+                self.total -= old_price
+                trace.count("recover.restore_failed")
+                continue
+            self._entries[esig] = (out, price)
+            self.total += price - old_price
+        # the survivor layout can re-price the store past the budget
+        # its entries were admitted under (the same rows over fewer
+        # shards mean bigger resident blocks): evict oldest-first back
+        # under it — offer()'s contract, keeping the newest resume
+        # points
+        while self.total > self.budget and self._order:
+            oldest = self._order.pop(0)
+            _, old_price = self._entries.pop(oldest)
+            self.total -= old_price
+            trace.count("recover.checkpoint_evictions")
+        trace.count_max("recover.checkpoint_bytes", self.total)
+        return evac
+
+
+def _remesh_scan_tables(pre_nodes: List[Node], new_ctx) -> int:
+    """Evacuate + re-partition every scan table of the plan onto the
+    survivor mesh, in place (parallel/remesh.py) — identity-preserving,
+    so execution-memo signatures and plan fingerprints keep lining up
+    across the resumed attempt.  Staging faults (the chaos plan's
+    ``spill.stage_out``/``spill.stage_in`` rules fire inside the
+    evacuation too) are retried a bounded number of times per table:
+    aborting mid-evacuation would strand a mixed-mesh plan, the one
+    state no rung can resume.  Returns bytes evacuated."""
+    from ..parallel.remesh import remesh_table
+    evac = 0
+    seen: Set[int] = set()
+    for n in pre_nodes:
+        if n.op != "scan":
+            continue
+        dt = n.runtime.get("dtable")
+        if dt is None or id(dt) in seen:
+            continue
+        seen.add(id(dt))
+        for attempt in range(3):
+            try:
+                evac += remesh_table(dt, new_ctx)
+                break
+            except faults.FaultError:
+                if attempt == 2:
+                    raise
+    return evac
+
 
 def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node]):
     """The classified escalation ladder around ``_execute``
@@ -586,7 +660,16 @@ def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node]):
     this ladder's memo insertions are dropped to free memory, the next
     attempt runs under ``resilience.demoted_exchanges`` (the costed
     chooser re-lowers the failing exchange onto a degraded catalogue
-    strategy) and resumes from the priced checkpoint store; permanent
+    strategy) and resumes from the priced checkpoint store; topology
+    (device loss) → REMESH: the whole execution memo is dropped (its
+    results live on a mesh that can no longer run a collective), the
+    plan's scan tables and the retained checkpoints evacuate through
+    the host tier onto a survivor mesh (cylon_tpu/topology.py +
+    parallel/remesh.py), the builder re-anchors on it, and the attempt
+    resumes from the re-meshed checkpoints — every remaining stage
+    re-lowers under the new world size because lowering re-enters the
+    eager operators, which read the mesh from their (re-meshed) input
+    tables; permanent
     or exhausted → fail, with the ladder's attempt log attached to the
     error (``e.ladder``) and recorded for the flight recorder's
     bundle.  ``CYLON_RECOVERY=0`` /
@@ -699,6 +782,71 @@ def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node]):
                       type(e).__name__, ladder.demote_level)
                 flightrec.note("recover", action="replan",
                                level=ladder.demote_level,
+                               error=f"{type(e).__name__}: "
+                                     f"{str(e)[:160]}")
+            elif action == "remesh":
+                # the TOPOLOGY rung (docs/robustness.md "Elasticity"):
+                # a device died — retrying any collective on the old
+                # mesh re-touches the dead chip, so shrink the world
+                # instead.  A single-device mesh has no survivors to
+                # shrink onto; the rung degrades to a checkpointed
+                # stage retry there (the fault is the only thing left
+                # to outlast).
+                from .. import topology
+                lost = max(int(getattr(e, "lost", 1) or 1), 1)
+                new_ctx = topology.mark_lost(builder.ctx, lost)
+                if new_ctx is builder.ctx:
+                    ladder.attempts[-1].action = "retry (no survivors)"
+                    trace.count("recover.stage_retries")
+                    flightrec.note("recover", action="stage_retry",
+                                   retries=ladder.retries,
+                                   error=f"{type(e).__name__}: "
+                                         f"{str(e)[:160]}")
+                    continue
+                import time as _time
+                t0 = _time.perf_counter()
+                try:
+                    # EVERY memo result lives on a mesh that can no
+                    # longer run a collective — drop them all (not just
+                    # this ladder's insertions; .pop() keeps the shared
+                    # serve memo's owner records consistent), then
+                    # evacuate + re-partition the state a resumed
+                    # attempt needs: the plan's scan tables and the
+                    # retained checkpoints
+                    for esig in list(builder.exec_memo.keys()):
+                        builder.exec_memo.pop(esig, None)
+                    inserted.clear()
+                    evac = _remesh_scan_tables(pre_nodes, new_ctx)
+                    evac += ckpt.remesh(new_ctx)
+                    from ..parallel import broadcast as _bcast
+                    _bcast.clear_replica_cache()  # old-mesh replicas
+                except BaseException as re_err:  # graftlint: ok[broad-except]
+                    # the evacuation itself failed: the plan is now
+                    # possibly mixed-mesh — nothing below can resume
+                    # it, so fail annotated (the replan-setup shape)
+                    trace.count("recover.failures")
+                    ladder.attempts.append(resilience.LadderAttempt(
+                        resilience.TOPOLOGY, "fail",
+                        f"remesh evacuation failed: "
+                        f"{type(re_err).__name__}: {str(re_err)[:120]}"))
+                    re_err.ladder = ladder.as_dicts()
+                    flightrec.note("recover_failed",
+                                   attempts=ladder.as_dicts(),
+                                   error=f"remesh evacuation failed: "
+                                         f"{re_err}")
+                    raise
+                builder.ctx = new_ctx
+                trace.count("recover.remesh")
+                trace.count("recover.remesh_us",
+                            int((_time.perf_counter() - t0) * 1e6))
+                _warn("recovery: topology-class failure (%s) — lost %d "
+                      "device(s); evacuated %d B and re-meshed onto %d "
+                      "survivors, resuming from checkpoint",
+                      type(e).__name__, lost, evac,
+                      new_ctx.get_world_size())
+                flightrec.note("recover", action="remesh", lost=lost,
+                               survivor_world=new_ctx.get_world_size(),
+                               evacuated_bytes=evac,
                                error=f"{type(e).__name__}: "
                                      f"{str(e)[:160]}")
             else:
@@ -850,6 +998,12 @@ def _execute(builder, opt_root: Node, pre_nodes: List[Node],
         boundary = ir.is_stage_boundary(node)
         if boundary:
             faults.check("exec.stage")
+            # the topology fault point (docs/robustness.md
+            # "Elasticity"): a device dying surfaces as a collective
+            # failure at an exchange boundary — this consult is where
+            # chaos injects it, and the recovering driver's TOPOLOGY
+            # rung answers by evacuating + re-meshing onto survivors
+            faults.check("mesh.device_lost")
             if prior is not None and esig in prior:
                 trace.count("recover.stages_replayed")
         lower = LOWERING.get(node.op)

@@ -55,7 +55,15 @@ executor takes between stage attempts (plan/executor.py):
     degraded sequence (chunked / ring) with a smaller transient;
   * ``permanent`` (or an exhausted ladder) → **fail**, with the
     ladder's attempt log attached to the error and a flight-recorder
-    bundle annotated with it (observe/flightrec.py).
+    bundle annotated with it (observe/flightrec.py);
+  * ``topology`` (:class:`faults.TopologyFault`, an XLA runtime error
+    reporting a lost/unavailable device) → **remesh**: the executor
+    evacuates live state to the host tier, builds a survivor mesh over
+    the remaining devices (cylon_tpu/topology.py), re-partitions every
+    restored leaf onto it (parallel/remesh.py, priced by
+    ``cost.price_remesh``) and resumes from the last checkpoint —
+    retrying the same collective on a mesh containing a dead chip can
+    only fail again (docs/robustness.md "Elasticity").
 """
 from __future__ import annotations
 
@@ -129,6 +137,16 @@ class RetryPolicy:
     multiplier: float = 2.0
     max_delay_s: float = 0.25
     jitter: bool = True
+    # total ELAPSED-time budget across one retry loop (first attempt's
+    # wall-clock included), in seconds.  The attempt cap alone does not
+    # bound latency: five attempts whose sleeps individually back off
+    # can exceed any deadline a serving query carries.  With a budget
+    # set, retry_call stops retrying once the next sleep would bust it
+    # (retry.exhausted, the last transient error re-raised) — and the
+    # serve layer's deadline estimates can SEE the cap
+    # (docs/serving.md "deadlines").  None keeps the attempts-only
+    # historical behavior.
+    max_elapsed_s: Optional[float] = None
     transient_types: Tuple[Type[BaseException], ...] = (
         faults.TransientFault, ConnectionError, TimeoutError,
         InterruptedError)
@@ -138,6 +156,13 @@ class RetryPolicy:
             raise CylonError(Status(Code.Invalid,
                 f"max_attempts must be a positive int, "
                 f"got {self.max_attempts!r}"))
+        if self.max_elapsed_s is not None:
+            if isinstance(self.max_elapsed_s, bool) \
+                    or not isinstance(self.max_elapsed_s, (int, float)) \
+                    or not self.max_elapsed_s > 0:
+                raise CylonError(Status(Code.Invalid,
+                    f"max_elapsed_s must be a positive duration in "
+                    f"seconds or None, got {self.max_elapsed_s!r}"))
 
     def is_transient(self, exc: BaseException) -> bool:
         if isinstance(exc, faults.PermanentFault):
@@ -205,6 +230,7 @@ def retry_call(fn: Callable, *, point: str = "",
 
     pol = policy if policy is not None else _policy
     sleep_s = 0.0
+    t0 = time.monotonic()
     for attempt in range(1, pol.max_attempts + 1):
         try:
             return fn()
@@ -217,8 +243,22 @@ def retry_call(fn: Callable, *, point: str = "",
                     "retry exhausted after %d attempt(s) at %s: %s",
                     attempt, point or "<boundary>", e)
                 raise
-            trace.count("retry.attempts")
             sleep_s = _next_sleep(pol, sleep_s, attempt)
+            if pol.max_elapsed_s is not None and \
+                    time.monotonic() - t0 + sleep_s > pol.max_elapsed_s:
+                # the elapsed-time budget: another backoff would bust
+                # it — stop HERE, not after sleeping past the deadline
+                # the caller is holding (the retries-exceed-any-
+                # deadline failure mode the attempts cap alone allows)
+                trace.count("retry.exhausted")
+                glog.warning(
+                    "retry elapsed budget (%.3f s) exhausted after %d "
+                    "attempt(s) at %s: %s", pol.max_elapsed_s, attempt,
+                    point or "<boundary>", e)
+                raise
+            # booked only once a retry is actually going to happen —
+            # the budget abort above is an exhaustion, not an attempt
+            trace.count("retry.attempts")
             glog.vlog(1, "transient failure at %s (attempt %d/%d), "
                          "retrying in %.0f ms: %s",
                       point or "<boundary>", attempt, pol.max_attempts,
@@ -262,6 +302,7 @@ def exchange_budget() -> int:
 TRANSIENT = "transient"
 RESOURCE = "resource"
 PERMANENT = "permanent"
+TOPOLOGY = "topology"
 
 
 def classify(exc: BaseException) -> str:
@@ -284,6 +325,16 @@ def classify(exc: BaseException) -> str:
     ``permanent`` — everything else, :class:`faults.PermanentFault`
     included: no recovery action is sound, fail with the evidence.
 
+    ``topology`` — the device-loss class (docs/robustness.md
+    "Elasticity"): an injected :class:`faults.TopologyFault`, or an
+    XLA runtime error whose message reports a lost / unavailable /
+    halted device (matched by name+message so jaxlib stays an indirect
+    dependency).  Neither retry nor replan touches the cause — the
+    same collective re-dispatched onto a mesh containing a dead chip
+    fails again regardless of lowering — so the ladder's answer is
+    the TOPOLOGY rung: evacuate to the host tier, re-mesh onto the
+    survivors, resume from checkpoint.
+
     Host-tier failures (docs/out_of_core.md) land on the RESOURCE arm
     by construction: spill-pool exhaustion raises a typed
     ``Code.OutOfMemory`` CylonError (caught by the OOM rule below),
@@ -294,6 +345,13 @@ def classify(exc: BaseException) -> str:
     with a different host-tier footprint, not another spin."""
     if isinstance(exc, faults.PermanentFault):
         return PERMANENT
+    if isinstance(exc, faults.TopologyFault):
+        return TOPOLOGY
+    if type(exc).__name__ == "XlaRuntimeError":
+        msg = str(exc).lower()
+        if "device" in msg and any(w in msg for w in
+                                   ("lost", "unavailable", "halted")):
+            return TOPOLOGY
     if isinstance(exc, faults.FaultError) \
             and getattr(exc, "point", "").startswith("spill."):
         return RESOURCE
@@ -327,6 +385,12 @@ class RecoveryPolicy:
                               transients (chunked is never excluded:
                               its C = 1 floor is the engine's
                               last-resort lowering already).
+    ``max_remeshes``          topology-classed re-meshes (device loss,
+                              docs/robustness.md "Elasticity"); each
+                              one evacuates to the host tier and
+                              shrinks the mesh onto the survivors —
+                              bounded because every re-mesh halves-ish
+                              the fleet a query may consume.
     ``checkpoint_fraction``   the share of ``exchange_budget()`` the
                               stage-checkpoint store may pin across
                               attempts — checkpointing is a COSTED
@@ -337,12 +401,14 @@ class RecoveryPolicy:
 
     max_stage_retries: int = 2
     max_replans: int = 2
+    max_remeshes: int = 1
     checkpoint_fraction: float = 0.25
 
     def __post_init__(self):
-        if self.max_stage_retries < 0 or self.max_replans < 0:
+        if self.max_stage_retries < 0 or self.max_replans < 0 \
+                or self.max_remeshes < 0:
             raise CylonError(Status(Code.Invalid,
-                "RecoveryPolicy retry/replan caps must be >= 0"))
+                "RecoveryPolicy retry/replan/remesh caps must be >= 0"))
         if not 0.0 <= self.checkpoint_fraction <= 1.0:
             raise CylonError(Status(Code.Invalid,
                 f"checkpoint_fraction must be in [0, 1], got "
@@ -393,6 +459,7 @@ class Ladder:
         self.policy = policy if policy is not None else _recovery_policy
         self.retries = 0
         self.replans = 0
+        self.remeshes = 0
         self.attempts: List[LadderAttempt] = []
 
     @property
@@ -402,7 +469,9 @@ class Ladder:
     def decide(self, exc: BaseException) -> str:
         """Class ``exc``, record the attempt, return the action:
         ``"retry"`` (stage retry from checkpoint), ``"replan"``
-        (re-lower the exchange demoted one level), or ``"fail"``."""
+        (re-lower the exchange demoted one level), ``"remesh"``
+        (evacuate + shrink the mesh onto the survivors), or
+        ``"fail"``."""
         klass = classify(exc)
         if klass == TRANSIENT and self.retries < self.policy.max_stage_retries:
             self.retries += 1
@@ -410,6 +479,9 @@ class Ladder:
         elif klass == RESOURCE and self.replans < self.policy.max_replans:
             self.replans += 1
             action = "replan"
+        elif klass == TOPOLOGY and self.remeshes < self.policy.max_remeshes:
+            self.remeshes += 1
+            action = "remesh"
         else:
             action = "fail"
         self.attempts.append(LadderAttempt(

@@ -39,7 +39,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from .. import faults, resilience, trace
+from .. import faults, resilience, topology, trace
 from ..status import Code, CylonError, Status
 from . import admission
 
@@ -548,8 +548,16 @@ class ServeSession:
             "completed": 0, "failed": 0, "batches": 0,
             "subplan_shared": 0, "exports_async": 0,
             "slo_violations": 0, "shed": 0, "breaker_rejected": 0,
-            "breaker_probes": 0, "recovered": 0,
+            "breaker_probes": 0, "recovered": 0, "mesh_degraded": 0,
         }
+        # elastic degraded-mesh state (docs/robustness.md
+        # "Elasticity"): the session polls the topology epoch each
+        # dispatcher turn — a mid-query device loss flips it into
+        # degraded mode (re-priced admission budget, serve.degraded
+        # gauge, mesh_degraded flight-recorder event) and every later
+        # query's builder anchors on the survivor mesh
+        self._base_world = max(ctx.get_world_size(), 1)
+        self._topology_epoch = topology.epoch()
         self._latencies: List[float] = []
         self._ids = 0
         self._closing = threading.Event()
@@ -641,6 +649,15 @@ class ServeSession:
                     "retry later or submit with priority>=1")
             if deadline_ms is not None and self._ewma_ms and not probe:
                 est_wait = depth * self._ewma_ms
+                # the retry elapsed-time budget (RetryPolicy
+                # .max_elapsed_s) bounds the worst transient-retry
+                # stall THIS query can hit — with a cap configured the
+                # deadline estimate can honestly include it (without
+                # one, retries that individually back off can exceed
+                # any deadline and the estimate stays blind to them)
+                cap_s = resilience.retry_policy().max_elapsed_s
+                if cap_s:
+                    est_wait += cap_s * 1e3
                 if est_wait > deadline_ms:
                     trace.count("serve.shed")
                     self._tally("shed")
@@ -791,14 +808,65 @@ class ServeSession:
             self._stats[key] = self._stats.get(key, 0) + n
 
     def _budget(self) -> int:
-        if self._admission_budget is not None:
-            return self._admission_budget
-        return resilience.exchange_budget()
+        base = (self._admission_budget
+                if self._admission_budget is not None
+                else resilience.exchange_budget())
+        # degraded mesh: P' survivors hold P'/P of the fleet's
+        # aggregate transient headroom, so a window may co-admit
+        # proportionally less — the re-priced admission budget of
+        # docs/robustness.md "Elasticity" (per-QUERY prices already
+        # re-derive from the re-meshed tables' counts)
+        eff = topology.effective(self.ctx)
+        world = eff.get_world_size()
+        if world < self._base_world:
+            base = max(int(base * world / self._base_world), 1)
+        return base
+
+    def _check_topology(self) -> None:
+        """One epoch poll (an int compare in the common case): on a new
+        degrade, record the event once — the gauge, the session tally,
+        and the flight-recorder ``mesh_degraded`` event the doctor
+        renders.  In-flight work needs no action here: the victim's
+        ladder already re-meshed the shared tables in place, and every
+        later query's builder resolves the survivor context."""
+        ep = topology.epoch()
+        if ep == self._topology_epoch:
+            return
+        self._topology_epoch = ep
+        eff = topology.effective(self.ctx)
+        world = eff.get_world_size()
+        if world < self._base_world:
+            from ..observe import flightrec
+            lost = self._base_world - world
+            trace.gauge("serve.degraded", lost)
+            self._tally("mesh_degraded")
+            with self._lock:
+                self._stats["degraded_world"] = world
+            flightrec.note("mesh_degraded", session=self.name,
+                           survivor_world=world, lost=lost)
+            # session tables the victim's plan never scanned are still
+            # sharded over the mesh containing the dead chip — their
+            # first collective would cost ANOTHER healthy device.
+            # Migrate them now, on the dispatcher thread (queries
+            # execute here too, so nothing races the in-place move);
+            # a failed migration degrades to the per-query lazy path
+            try:
+                from ..parallel.remesh import ensure_current
+                ensure_current(self._tables)
+            except Exception as mig_err:  # graftlint: ok[broad-except]
+                # — the lazy ensure_current in _execute_one retries
+                # per query; a migration failure must not kill the
+                # dispatcher
+                from ..logging import warning as _warn
+                _warn("degraded-mode table migration failed (per-query"
+                      " migration will retry): %s: %s",
+                      type(mig_err).__name__, str(mig_err)[:160])
 
     def _loop(self) -> None:
         pending: List[QueryHandle] = []
         while True:
             got = self._queue.wait_nonempty(timeout=0.05)
+            self._check_topology()
             if not got and not pending:
                 if self._closing.is_set() and len(self._queue) == 0:
                     return
@@ -883,7 +951,20 @@ class ServeSession:
                     resilience.collect_recoveries() as recoveries, \
                     resilience.counter_scope(deltas):
                 with trace.span("serve.query"):
-                    b = ir.Builder(self.ctx, exec_memo=memo)
+                    # the builder anchors on the EFFECTIVE context: a
+                    # batch peer executing right after a victim's
+                    # mid-window re-mesh runs on the survivor mesh
+                    # (its tables were re-meshed in place) instead of
+                    # dispatching a collective onto the dead chip
+                    b = ir.Builder(topology.effective(self.ctx),
+                                   exec_memo=memo)
+                    if h.tables is not None:
+                        # per-query tables (submit(tables=...)) are
+                        # not covered by the session-table migration
+                        # in _check_topology — move any stale one
+                        # before pricing reads its layout
+                        from ..parallel.remesh import ensure_current
+                        ensure_current(h.tables)
                     wrapped = (b.wrap_tables(h.tables)
                                if h.tables is not None else None)
                     with ir.capture(b):

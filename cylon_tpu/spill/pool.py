@@ -330,6 +330,15 @@ class SpillPool:
             dt._spill_entry = None
             trace.count("spill.faultins")
 
+    def drop_entry(self, sig: int) -> None:
+        """Forget one pooled entry by signature — the elastic re-mesh
+        (parallel/remesh.py) rebuilds a spilled table's layout from the
+        entry's host blocks and must then release the PINNED entry, or
+        the old-mesh copy would hold host budget forever (pinned
+        entries are deliberately un-evictable)."""
+        with self._lock:
+            self._entries.pop(sig, None)
+
     def pin_for_scan(self, dt) -> _Entry:
         """Spill ``dt`` if needed and capture its entry under ONE lock
         hold — the morsel scan's entry point.  A separate

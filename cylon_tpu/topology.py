@@ -1,0 +1,112 @@
+"""Elastic mesh topology: device-loss bookkeeping + survivor contexts.
+
+The engine's mesh is fixed at context construction (context.py wraps a
+1-D ``jax.sharding.Mesh``), which is the right model right up until a
+device dies mid-query.  This module is the process-level record of that
+event (docs/robustness.md "Elasticity"): when the escalation ladder's
+TOPOLOGY rung fires (plan/executor.py), it calls :func:`mark_lost`,
+which builds a **survivor context** — the same backend over the first
+``P − lost`` devices — registers it here, and bumps the topology
+epoch.  Everything that starts new work afterwards resolves its context
+through :func:`effective` (``plan.run``, the serve dispatcher's
+per-query builders), so the whole process converges onto the survivor
+mesh: degraded throughput, identical answers.
+
+Deterministic survivor choice: the LAST ``lost`` devices of the current
+mesh are the casualties.  In this repo's CPU-simulation environment the
+"lost" devices remain physically readable — which is exactly what makes
+the evacuation path (stage the victim's leaves out through the spill
+pool, re-partition onto the survivors) an honest rehearsal of the real
+TPU flow, where the same bytes would come from the host-tier spill pool
+and stage checkpoints rather than the dead chip.
+
+The registry chains: a second loss shrinks the CURRENT survivor mesh,
+and ``effective`` follows the chain from any context it has ever seen.
+``reset()`` restores the full mesh (test isolation; operationally, the
+repaired-fleet restart).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from . import trace
+
+__all__ = ["effective", "mark_lost", "epoch", "degraded", "reset"]
+
+# id(ctx) -> (ctx, survivor_ctx): the value pins BOTH contexts so an
+# id() key can never be reused by the garbage collector while mapped.
+_lock = threading.Lock()
+_survivors: Dict[int, Tuple[object, object]] = {}
+_epoch = 0
+
+
+def effective(ctx):
+    """The context work should actually run under: ``ctx`` itself while
+    the mesh is whole, else the (chained) survivor context registered by
+    :func:`mark_lost`.  One dict lookup per hop — the production cost of
+    elasticity is a lock-free read."""
+    cur = ctx
+    while True:
+        hit = _survivors.get(id(cur))
+        if hit is None or hit[1] is cur:
+            return cur
+        cur = hit[1]
+
+
+def degraded(ctx) -> bool:
+    """Whether ``ctx`` currently resolves to a shrunken survivor mesh."""
+    return effective(ctx) is not ctx
+
+
+def epoch() -> int:
+    """Monotone counter bumped by every :func:`mark_lost` — pollers
+    (the serve dispatcher) compare it instead of chasing contexts."""
+    return _epoch
+
+
+def mark_lost(ctx, lost: int = 1):
+    """Record the loss of ``lost`` devices from ``ctx``'s (effective)
+    mesh and return the survivor context.
+
+    The survivors are the first ``P − lost`` devices of the current
+    effective mesh (deterministic — chaos runs replay).  ``lost`` is
+    clamped so at least one device survives; a single-device mesh has
+    no survivors to shrink onto and is returned UNCHANGED (the caller's
+    topology rung degrades to a stage retry there).  Registers the
+    mapping for every context that resolves through ``ctx``, bumps the
+    epoch, and records the event (``recover.survivor_world`` gauge +
+    a ``mesh_degraded`` flight-recorder event)."""
+    from .context import CylonContext
+    from .logging import warning as _warn
+    from .observe import flightrec
+    global _epoch
+    with _lock:
+        cur = effective(ctx)
+        world = cur.get_world_size()
+        lost_eff = min(max(int(lost), 1), world - 1)
+        if world <= 1 or lost_eff < 1:
+            return cur
+        survivors = cur.devices[:world - lost_eff]
+        new_ctx = CylonContext({"backend": "dist", "devices": survivors})
+        _survivors[id(ctx)] = (ctx, new_ctx)
+        _survivors[id(cur)] = (cur, new_ctx)
+        _survivors[id(new_ctx)] = (new_ctx, new_ctx)
+        _epoch += 1
+    trace.gauge("recover.survivor_world", len(survivors))
+    _warn("mesh degraded: %d device(s) lost, re-meshing %d -> %d "
+          "survivors (epoch %d)", lost_eff, world, len(survivors),
+          _epoch)
+    flightrec.note("mesh_degraded", lost=lost_eff, world=world,
+                   survivor_world=len(survivors), epoch=_epoch)
+    return new_ctx
+
+
+def reset() -> None:
+    """Forget every degrade (test isolation / repaired-fleet restart).
+    Tables already re-meshed in place stay on their survivor mesh —
+    only the ROUTING of new work reverts."""
+    global _epoch
+    with _lock:
+        _survivors.clear()
+        _epoch += 1

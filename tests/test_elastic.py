@@ -1,0 +1,589 @@
+"""Elastic degraded-mesh execution (docs/robustness.md "Elasticity"):
+the topology fault class, the survivor-context registry, the in-place
+re-mesh, the executor's topology rung, serving degraded mode, the
+exchange hang watchdog, and the retry elapsed-time budget.
+
+The acceptance shape: a query that loses k of P devices mid-execution
+completes row-identical to the healthy run on the P−k survivor mesh
+(``recover.remesh >= 1``, fewer stages replayed than the plan has),
+the serving session flips into degraded mode and keeps serving, a
+wedged exchange raises a classified TransientFault instead of hanging
+forever, and bounded retries respect a total elapsed-time budget.
+"""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import CylonError, Table, config, faults, resilience
+from cylon_tpu import logging as glog
+from cylon_tpu import plan as planner
+from cylon_tpu import topology, trace
+from cylon_tpu.config import JoinConfig
+from cylon_tpu.parallel import DTable, cost
+from cylon_tpu.parallel import dist_ops as dops
+from cylon_tpu.parallel import remesh as remesh_mod
+from cylon_tpu.parallel import shuffle as shmod
+from cylon_tpu.plan import executor
+from cylon_tpu.resilience import Ladder, RecoveryPolicy, RetryPolicy
+from cylon_tpu.serve import ServeSession
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Counter-only tracing + teardown of every module-level lever this
+    suite pulls (topology registry, fault plans, budgets, timeout knob,
+    chunk state) — a degraded mesh must never leak into another test."""
+    session_plan = faults.plan()
+    trace.enable_counters()
+    trace.reset()
+    yield
+    trace.disable_counters()
+    trace.reset()
+    topology.reset()
+    shmod.clear_chunk_state()
+    glog.reset_warn_once()
+    executor.clear_plan_cache()
+    config.set_exchange_timeout_ms(None)
+    config.set_device_memory_budget(None)
+    config.set_recovery_enabled(None)
+    if session_plan is not None:
+        faults.install(session_plan)
+    else:
+        faults.uninstall()
+
+
+def _two_stage(dctx, seed=5, rows=4000):
+    """A join + groupby plan (two exchange-boundary stages), FRESH
+    tables (re-mesh mutates in place — a shared fixture would leak a
+    survivor-mesh table into later tests), and the healthy result."""
+    rng = np.random.default_rng(seed)
+    fact = pd.DataFrame({
+        "k": rng.integers(0, 300, rows).astype(np.int32),
+        "v": rng.random(rows).astype(np.float32)})
+    dim = pd.DataFrame({
+        "k": np.arange(300, dtype=np.int32),
+        "w": rng.random(300).astype(np.float32)})
+
+    def mk():
+        return {
+            "fact": DTable.from_table(dctx, Table.from_pandas(dctx, fact)),
+            "dim": DTable.from_table(dctx, Table.from_pandas(dctx, dim)),
+        }
+
+    def op(t):
+        j = dops.dist_join(t["fact"], t["dim"], JoinConfig.InnerJoin(0, 0))
+        return dops.dist_groupby(j, ["lt-k"], [("rt-w", "sum")])
+
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        expect = (planner.run(dctx, op, mk()).to_table().to_pandas()
+                  .sort_values("lt-k").reset_index(drop=True))
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    return op, mk, expect
+
+
+# ---------------------------------------------------------------------------
+# the fault class + classification
+# ---------------------------------------------------------------------------
+
+def test_topology_fault_type_and_rule():
+    exc = faults.TopologyFault("mesh.device_lost", lost=3)
+    assert exc.point == "mesh.device_lost"
+    assert exc.lost == 3
+    assert isinstance(exc, faults.FaultError)
+    assert not isinstance(exc, faults.TransientFault)
+    rule = faults.FaultRule("mesh.device_lost", kind="topology", lost=2)
+    assert rule.lost == 2
+    with pytest.raises(CylonError):
+        faults.FaultRule("mesh.device_lost", kind="topology", lost=0)
+    with pytest.raises(CylonError):
+        faults.FaultRule("mesh.device_lost", kind="topology", lost=True)
+
+
+def test_check_raises_topology_with_lost():
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology", nth=1,
+                         lost=4)])
+    with faults.active(plan):
+        with pytest.raises(faults.TopologyFault) as ei:
+            faults.check("mesh.device_lost")
+    assert ei.value.lost == 4
+    assert "mesh.device_lost" in faults.POINTS
+
+
+def test_default_chaos_plan_has_capped_topology_rule():
+    # the chaos gate's contract: FaultPlan.default exercises the
+    # topology rung, but capped — one device loss per run models "a
+    # chip died", not "the fleet is melting"
+    rules = [r for r in faults.FaultPlan.default(0).rules
+             if r.point == "mesh.device_lost"]
+    assert len(rules) == 1
+    assert rules[0].kind == "topology"
+    assert rules[0].limit == 1
+
+
+def test_classify_topology():
+    assert resilience.classify(
+        faults.TopologyFault("mesh.device_lost")) == resilience.TOPOLOGY
+
+    # an XLA runtime error reporting a dead device classifies topology
+    # (matched by type name + message, jaxlib stays indirect)
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert resilience.classify(
+        XlaRuntimeError("device lost: TPU_3 halted")) \
+        == resilience.TOPOLOGY
+    assert resilience.classify(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: out of memory")) \
+        == resilience.RESOURCE
+    # micro retries must NOT absorb a topology fault: the same
+    # collective on the same mesh re-touches the dead chip
+    assert not RetryPolicy().is_transient(
+        faults.TopologyFault("mesh.device_lost"))
+
+
+def test_ladder_remesh_rung_bounded():
+    ladder = Ladder(RecoveryPolicy(max_remeshes=1))
+    assert ladder.decide(
+        faults.TopologyFault("mesh.device_lost")) == "remesh"
+    assert ladder.remeshes == 1
+    # the cap: a second topology failure exhausts the rung
+    assert ladder.decide(
+        faults.TopologyFault("mesh.device_lost")) == "fail"
+    with pytest.raises(CylonError):
+        RecoveryPolicy(max_remeshes=-1)
+
+
+# ---------------------------------------------------------------------------
+# the survivor-context registry
+# ---------------------------------------------------------------------------
+
+def test_topology_registry_semantics(dctx):
+    assert topology.effective(dctx) is dctx
+    assert not topology.degraded(dctx)
+    ep0 = topology.epoch()
+    new_ctx = topology.mark_lost(dctx, 2)
+    assert new_ctx.get_world_size() == 6
+    assert new_ctx.devices == dctx.devices[:6]
+    assert topology.effective(dctx) is new_ctx
+    assert topology.effective(new_ctx) is new_ctx
+    assert topology.degraded(dctx)
+    assert topology.epoch() > ep0
+    # chained degrade: a second loss shrinks the CURRENT survivor mesh
+    newer = topology.mark_lost(dctx, 1)
+    assert newer.get_world_size() == 5
+    assert topology.effective(dctx) is newer
+    assert topology.effective(new_ctx) is newer
+    topology.reset()
+    assert topology.effective(dctx) is dctx
+
+
+def test_topology_single_device_no_survivors(ctx):
+    # a 1-device mesh has no survivors to shrink onto — unchanged
+    assert topology.mark_lost(ctx, 1) is ctx
+    assert not topology.degraded(ctx)
+
+
+def test_topology_lost_clamped(dctx):
+    # losing >= world clamps so one device survives
+    new_ctx = topology.mark_lost(dctx, 99)
+    assert new_ctx.get_world_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# the re-mesh lowering
+# ---------------------------------------------------------------------------
+
+def test_price_remesh_shape():
+    counts = np.array([100, 100, 100, 100, 100, 100, 100, 100])
+    p = cost.price_remesh(8, 4, counts, 16)
+    assert p.strategy == cost.REMESH
+    assert p.rounds == 1
+    assert p.wire_bytes == 800 * 16
+    assert p.host_bytes == 2 * 800 * 16
+    # peak = the survivor block: 4 shards x bucket(200) rows x 16 B
+    assert p.peak_bytes >= 4 * 200 * 16
+    assert cost.REMESH not in cost.STRATEGIES  # never chooser-selectable
+
+
+def test_remesh_table_in_place_parity(dctx):
+    rng = np.random.default_rng(11)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 50, 997).astype(np.int32),
+        "v": rng.random(997).astype(np.float32),
+        "s": pd.array([None if i % 7 == 0 else f"s{i % 13}"
+                       for i in range(997)], dtype="string"),
+    })
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    before = dt.to_table().to_pandas()
+    new_ctx = topology.mark_lost(dctx, 4)
+    evac = remesh_mod.remesh_table(dt, new_ctx)
+    assert evac > 0
+    assert dt.ctx is new_ctx
+    assert dt.nparts == 4
+    assert int(np.asarray(dt.counts_host()).sum()) == 997
+    after = dt.to_table().to_pandas()
+    key = list(after.columns)
+    pd.testing.assert_frame_equal(
+        after.sort_values(key).reset_index(drop=True),
+        before.sort_values(key).reset_index(drop=True))
+    c = trace.counters()
+    assert c.get("recover.evacuated_bytes", 0) == evac
+    assert c.get("spill.stage_outs", 0) >= 1  # the sanctioned boundary
+    # idempotent: already on the target mesh -> no-op
+    assert remesh_mod.remesh_table(dt, new_ctx) == 0
+
+
+def test_remesh_spilled_table(dctx):
+    from cylon_tpu.spill import pool as spill_pool
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({"k": rng.integers(0, 9, 500).astype(np.int32),
+                       "v": rng.random(500).astype(np.float32)})
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    before = dt.to_table().to_pandas()
+    dt.spill()
+    assert dt.is_spilled
+    pool = spill_pool.get_pool()
+    held = pool.host_bytes()
+    new_ctx = topology.mark_lost(dctx, 6)
+    evac = remesh_mod.remesh_table(dt, new_ctx)
+    # already host-resident: the re-block consumes the pooled copy
+    # without a second device read, and releases the pinned entry
+    assert evac == 0
+    assert not dt.is_spilled
+    assert dt.nparts == 2
+    assert pool.host_bytes() < held
+    after = dt.to_table().to_pandas()
+    key = list(after.columns)
+    pd.testing.assert_frame_equal(
+        after.sort_values(key).reset_index(drop=True),
+        before.sort_values(key).reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# the executor's topology rung, end to end
+# ---------------------------------------------------------------------------
+
+def test_device_loss_recovers_on_survivor_mesh(dctx):
+    op, mk, expect = _two_stage(dctx)
+    tables = mk()
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology", nth=2,
+                         lost=4)])
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with faults.active(plan):
+            out = planner.run(dctx, op, tables)
+        got = (out.to_table().to_pandas()
+               .sort_values("lt-k").reset_index(drop=True))
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    pd.testing.assert_frame_equal(got, expect)
+    c = trace.counters()
+    assert c.get("recover.remesh", 0) == 1
+    assert c.get("recover.recovered", 0) == 1
+    # the nth=2 fault fires AFTER stage 1 checkpointed: the re-meshed
+    # checkpoint restores, so recovery replays fewer stages than the
+    # plan has (here: none)
+    assert c.get("recover.stages_replayed", 0) < 2
+    assert c.get("recover.evacuated_bytes", 0) > 0
+    # the process converged onto the survivor mesh
+    eff = topology.effective(dctx)
+    assert eff.get_world_size() == 4
+    assert tables["fact"].ctx is eff
+    # a follow-up plan anchors on the survivor mesh and still answers
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        again = (planner.run(dctx, op, tables).to_table().to_pandas()
+                 .sort_values("lt-k").reset_index(drop=True))
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    pd.testing.assert_frame_equal(again, expect)
+
+
+def test_untouched_table_migrates_without_second_loss(dctx):
+    """A table the victim's plan never scanned is still sharded over
+    the mesh containing the dead chip; ``plan.run``'s lazy migration
+    (``remesh.ensure_current``) moves it onto the survivor mesh in
+    place — WITHOUT a second ``mark_lost`` eating another healthy
+    device when its first collective would have failed organically."""
+    op, mk, expect = _two_stage(dctx)
+    tables = mk()
+    rng = np.random.default_rng(9)
+    other = pd.DataFrame({
+        "g": rng.integers(0, 20, 2000).astype(np.int32),
+        "x": rng.random(2000).astype(np.float32)})
+    dt_other = DTable.from_table(dctx, Table.from_pandas(dctx, other))
+    exp_other = (other.groupby("g", as_index=False)["x"].sum()
+                 .sort_values("g").reset_index(drop=True))
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology", nth=2,
+                         lost=4)])
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with faults.active(plan):
+            planner.run(dctx, op, tables)
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    eff = topology.effective(dctx)
+    assert eff.get_world_size() == 4
+    assert dt_other.ctx is dctx      # untouched: still on the old mesh
+    ep = topology.epoch()
+    got = (planner.run(
+        dctx,
+        lambda t: dops.dist_groupby(t, ["g"], [("x", "sum")]),
+        dt_other).to_table().to_pandas()
+        .sort_values("g").reset_index(drop=True))
+    assert dt_other.ctx is eff       # migrated in place, exactly once
+    assert topology.epoch() == ep    # no second device sacrificed
+    assert topology.effective(dctx).get_world_size() == 4
+    assert np.allclose(got["sum_x"].to_numpy(),
+                       exp_other["x"].to_numpy(), atol=1e-4)
+
+
+def test_device_loss_single_device_degrades_to_retry(ctx):
+    # world 1: no survivors — the rung degrades to a stage retry and
+    # the (once-injected) fault is simply outlasted
+    df = pd.DataFrame({"k": np.arange(64, dtype=np.int32),
+                       "v": np.ones(64, np.float32)})
+    dt = DTable.from_table(ctx, Table.from_pandas(ctx, df))
+
+    def op(t):
+        return dops.dist_groupby(t["t"], ["k"], [("v", "sum")])
+
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology", nth=1,
+                         once=True)])
+    with faults.active(plan):
+        out = planner.run(ctx, op, {"t": dt})
+    assert out.to_table().num_rows == 64
+    c = trace.counters()
+    assert c.get("recover.remesh", 0) == 0
+    assert c.get("recover.stage_retries", 0) == 1
+    assert not topology.degraded(ctx)
+
+
+def test_device_loss_exhausts_to_annotated_failure(dctx):
+    op, mk, _ = _two_stage(dctx, seed=9, rows=600)
+    # every boundary consult fires: the one allowed remesh is spent,
+    # the next topology failure exhausts the rung -> annotated fail
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology",
+                         probability=1.0, lost=1)])
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with faults.active(plan):
+            with pytest.raises(faults.TopologyFault) as ei:
+                planner.run(dctx, op, mk())
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    ladder = getattr(ei.value, "ladder", None)
+    assert ladder and any(a["class"] == "topology" for a in ladder)
+    assert trace.counters().get("recover.failures", 0) == 1
+
+
+def test_recovery_disabled_propagates(dctx):
+    op, mk, _ = _two_stage(dctx, seed=13, rows=600)
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology", nth=1)])
+    config.set_recovery_enabled(False)
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with faults.active(plan):
+            with pytest.raises(faults.TopologyFault):
+                planner.run(dctx, op, mk())
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    assert trace.counters().get("recover.remesh", 0) == 0
+    assert not topology.degraded(dctx)
+
+
+# ---------------------------------------------------------------------------
+# serving degraded mode
+# ---------------------------------------------------------------------------
+
+def test_served_device_loss_degraded_mode(dctx):
+    op, mk, expect = _two_stage(dctx, seed=21)
+    tables = mk()
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology", nth=2,
+                         lost=2)])
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with faults.active(plan), \
+                ServeSession(dctx, tables=tables,
+                             batch_window_ms=30.0) as s:
+            victim = s.submit(op, label="victim")
+            peer = s.submit(op, label="peer")
+            got_v = (victim.result(timeout=600).to_table().to_pandas()
+                     .sort_values("lt-k").reset_index(drop=True))
+            got_p = (peer.result(timeout=600).to_table().to_pandas()
+                     .sort_values("lt-k").reset_index(drop=True))
+            # a post-degrade window: the session keeps serving on the
+            # survivor mesh
+            tail = s.submit(op, label="tail")
+            got_t = (tail.result(timeout=600).to_table().to_pandas()
+                     .sort_values("lt-k").reset_index(drop=True))
+            stats = s.stats()
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    pd.testing.assert_frame_equal(got_v, expect)
+    pd.testing.assert_frame_equal(got_p, expect)
+    pd.testing.assert_frame_equal(got_t, expect)
+    # attribution: the victim's slice holds the re-mesh, peers' clean
+    assert victim.counters.get("recover.remesh", 0) == 1
+    assert victim.recovered
+    assert peer.counters.get("recover.remesh", 0) == 0
+    assert peer.counters.get("fault.injected", 0) == 0
+    assert stats["mesh_degraded"] >= 1
+    assert stats["degraded_world"] == 6
+    assert stats["failed"] == 0
+    assert topology.effective(dctx).get_world_size() == 6
+
+
+def test_degraded_admission_budget_repriced(dctx):
+    s = ServeSession(dctx, tables=None, admission_budget=8_000_000)
+    try:
+        assert s._budget() == 8_000_000
+        topology.mark_lost(dctx, 4)
+        # 4 of 8 survivors -> half the aggregate headroom per window
+        assert s._budget() == 4_000_000
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: the exchange hang watchdog
+# ---------------------------------------------------------------------------
+
+def test_exchange_timeout_knob_validation():
+    assert config.exchange_timeout_ms() is None  # disabled by default
+    prev = config.set_exchange_timeout_ms(5000)
+    try:
+        assert config.exchange_timeout_ms() == 5000
+    finally:
+        config.set_exchange_timeout_ms(prev)
+    for bad in (0, -1, 1.5, True, "100"):
+        with pytest.raises(CylonError):
+            config.set_exchange_timeout_ms(bad)
+
+
+def test_watchdog_raises_classified_transient():
+    config.set_exchange_timeout_ms(50)
+    t0 = time.perf_counter()
+    with pytest.raises(faults.TransientFault) as ei:
+        shmod._watchdog_dispatch("shuffle.exchange",
+                                 lambda: time.sleep(5.0))
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 4.0  # bounded: did not wait out the hang
+    assert ei.value.point == "shuffle.exchange"
+    assert "watchdog" in str(ei.value)
+    # the classified ladder class is TRANSIENT: retry from checkpoint
+    assert resilience.classify(ei.value) == resilience.TRANSIENT
+    assert trace.counters().get("shuffle.watchdog_timeouts", 0) == 1
+
+
+def test_watchdog_passthrough_and_errors():
+    # disabled: direct call, zero threads
+    assert shmod._watchdog_dispatch("shuffle.exchange",
+                                    lambda: 41 + 1) == 42
+    config.set_exchange_timeout_ms(60_000)
+    # enabled + fast: value passes through, no timeout counted
+    assert shmod._watchdog_dispatch("shuffle.exchange",
+                                    lambda: "ok") == "ok"
+    # the thunk's OWN error re-raises on the caller's thread
+    def boom():
+        raise ValueError("inner")
+    with pytest.raises(ValueError, match="inner"):
+        shmod._watchdog_dispatch("shuffle.exchange", boom)
+    assert trace.counters().get("shuffle.watchdog_timeouts", 0) == 0
+
+
+def test_watchdog_end_to_end_shuffle_parity(dctx):
+    from cylon_tpu.parallel import shuffle_table
+    rng = np.random.default_rng(2)
+    df = pd.DataFrame({"k": rng.integers(0, 64, 2000).astype(np.int32),
+                       "v": rng.random(2000).astype(np.float32)})
+    dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+    prev = config.set_exchange_timeout_ms(120_000)
+    try:
+        got = shuffle_table(dt, ["k"]).to_table().to_pandas()
+    finally:
+        config.set_exchange_timeout_ms(prev)
+    pd.testing.assert_frame_equal(
+        got.sort_values(["k", "v"]).reset_index(drop=True),
+        df.sort_values(["k", "v"]).reset_index(drop=True),
+        check_dtype=False)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the retry elapsed-time budget
+# ---------------------------------------------------------------------------
+
+def test_retry_elapsed_budget_validation():
+    with pytest.raises(CylonError):
+        RetryPolicy(max_elapsed_s=0)
+    with pytest.raises(CylonError):
+        RetryPolicy(max_elapsed_s=-1.0)
+    with pytest.raises(CylonError):
+        RetryPolicy(max_elapsed_s=True)
+    assert RetryPolicy(max_elapsed_s=1.5).max_elapsed_s == 1.5
+
+
+def test_retry_elapsed_budget_bounds_total_time():
+    calls = [0]
+
+    def always_fails():
+        calls[0] += 1
+        raise faults.TransientFault("compact.read_counts")
+
+    # attempts alone would allow ~10 x 0.2 s of backoff; the elapsed
+    # budget stops the loop long before the attempt cap
+    pol = RetryPolicy(max_attempts=10, base_delay_s=0.2,
+                      max_delay_s=0.2, jitter=False,
+                      max_elapsed_s=0.05)
+    t0 = time.perf_counter()
+    with pytest.raises(faults.TransientFault):
+        resilience.retry_call(always_fails, policy=pol)
+    assert time.perf_counter() - t0 < 1.0
+    assert calls[0] < 10
+    assert trace.counters().get("retry.exhausted", 0) == 1
+
+
+def test_retry_elapsed_budget_none_keeps_attempt_semantics():
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise faults.TransientFault("compact.read_counts")
+        return "done"
+
+    pol = RetryPolicy(max_attempts=5, base_delay_s=0.0,
+                      max_delay_s=0.0, jitter=False)
+    assert resilience.retry_call(flaky, policy=pol) == "done"
+    assert calls[0] == 3
+
+
+def test_serve_deadline_estimate_sees_retry_cap(dctx):
+    from cylon_tpu.serve import Overloaded
+    s = ServeSession(dctx, tables=None, batch_window_ms=0.0)
+    try:
+        # seed the service EWMA + a queue depth of zero: without the
+        # retry cap the estimate (0 x EWMA = 0 ms) admits any deadline
+        s._ewma_ms = 10.0
+        prev_pol = resilience.set_retry_policy(
+            RetryPolicy(max_elapsed_s=5.0))
+        try:
+            with pytest.raises(Overloaded, match="deadline"):
+                s.submit(lambda: None, tables=None, deadline_ms=50.0)
+        finally:
+            resilience.set_retry_policy(prev_pol)
+        # same deadline WITHOUT a cap: admitted (and executes)
+        h = s.submit(lambda: None, tables=None, deadline_ms=50.0)
+        h.result(timeout=60)
+    finally:
+        s.close()
