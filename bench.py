@@ -540,6 +540,83 @@ def _oracle_cache_save(cache: dict) -> None:
         pass  # persistence is an optimization; never fail the bench
 
 
+# scaling-curve child (docs/tpu_perf_notes.md "Hierarchical
+# collectives" → "Measuring the scaling curve"): one fresh subprocess
+# per world size W (the only way to change
+# --xla_force_host_platform_device_count), running the two
+# exchange-bound workloads — a shuffle hash join and the fused
+# pre-aggregate groupby — at a weak-scaling AND a strong-scaling row
+# count, reporting best-of-reps wall-clock, row throughput and the
+# per-rep wire-byte counters (total + slow-axis).  The parent sets
+# CYLON_MESH_SHAPE=2x(W/2) for W >= 4 so the hierarchical machinery is
+# live exactly where a slow axis exists.  Replaces the orphaned
+# experiments/run_scaling.py CSV as the artifact source of truth.
+_SCALING_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from cylon_tpu import CylonContext, JoinAlgorithm, JoinConfig, Table
+from cylon_tpu import trace
+from cylon_tpu.parallel import DTable, dist_join, dist_groupby_fused
+
+world = {world}
+reps = {reps}
+cases = {cases!r}
+devs = jax.devices("cpu")
+assert len(devs) == world, (len(devs), world)
+ctx = CylonContext({{"backend": "tpu", "devices": devs}})
+rng = np.random.default_rng(11)
+trace.enable_counters()
+# the curve measures the EXCHANGE layer: force the co-partitioning
+# shuffle join (a broadcast join would zero the wire columns)
+from cylon_tpu import config as _cfg
+_cfg.set_broadcast_join_threshold(None)
+cfg = JoinConfig.InnerJoin(0, 0, algorithm=JoinAlgorithm.HASH)
+out = {{}}
+for mode, per in cases:
+    total = per * world
+
+    def make(n):
+        return {{"k": rng.integers(0, max(total // 8, 4),
+                                   n).astype(np.int64),
+                 "v": rng.random(n),
+                 "w": rng.integers(0, 1000, n).astype(np.int64)}}
+
+    left = DTable.from_table(ctx, Table.from_columns(ctx, make(total)))
+    right = DTable.from_table(ctx, Table.from_columns(ctx, make(total)))
+
+    def t_join():
+        t0 = time.perf_counter()
+        res = dist_join(left, right, cfg)
+        jax.block_until_ready([c.data for c in res.columns])
+        return (time.perf_counter() - t0) * 1e3
+
+    def t_groupby():
+        t0 = time.perf_counter()
+        res = dist_groupby_fused(left, ["k"],
+                                 [("v", "sum"), ("w", "max")])
+        jax.block_until_ready([c.data for c in res.columns])
+        return (time.perf_counter() - t0) * 1e3
+
+    for name, fn, nrows in (("join", t_join, 2 * total),
+                            ("groupby", t_groupby, total)):
+        fn()  # compile warm-up
+        trace.reset()
+        times = [fn() for _ in range(reps)]
+        c = dict(trace.counters())
+        best = min(times)
+        out["%s_%s_ms" % (mode, name)] = round(best, 2)
+        out["%s_%s_qps" % (mode, name)] = round(nrows / best * 1e3, 1)
+        out["%s_%s_wire_bytes" % (mode, name)] = \
+            c.get("shuffle.bytes_sent", 0) // reps
+        out["%s_%s_wire_bytes_slow" % (mode, name)] = \
+            c.get("shuffle.bytes_sent_slow", 0) // reps
+print(json.dumps(out))
+"""
+
+
 class _Emitter:
     """Incremental artifact emission (VERDICT r4 ask #1): after every
     completed stage the CURRENT full JSON line goes to stdout, so a driver
@@ -1736,6 +1813,94 @@ def main() -> None:
                 except Exception:  # graftlint: ok[broad-except] — teardown must not mask the stage verdict
                     pass
             em.emit("meshchaos")
+
+    # -- scaling-curve stage (docs/tpu_perf_notes.md "Hierarchical
+    # collectives"): weak + strong scaling at 1 -> 2 -> 4 -> 8 virtual
+    # devices over the shuffle join and the fused groupby, one fresh
+    # subprocess per world size (_SCALING_CHILD).  Emits per-world
+    # scaling_{weak|strong}_{join|groupby}_{ms,qps,wire_bytes,
+    # wire_bytes_slow}_w<W> plus the fitted weak-join efficiency slope
+    # benchdiff gates DOWN.  CYLON_BENCH_SCALING=0 skips.
+    scaling_on = os.environ.get("CYLON_BENCH_SCALING", "1") \
+        not in ("", "0")
+    if scaling_on and remaining() < 240:
+        _progress("scaling stage skipped: deadline")
+        em.detail["scaling_skipped"] = "deadline"
+        scaling_on = False
+    if scaling_on:
+        import subprocess as _subprocess
+        worlds = sorted({int(w) for w in os.environ.get(
+            "CYLON_BENCH_SCALING_WORLDS", "1,2,4,8").split(",")
+            if w.strip()})
+        srows = int(os.environ.get("CYLON_BENCH_SCALING_ROWS", "40000"))
+        reps_sc = max(min(reps, 3), 2)
+        repo_dir = os.path.dirname(os.path.abspath(__file__))
+        _progress(f"scaling curve: worlds {worlds}, {srows} rows/device "
+                  f"(weak), x{reps_sc} reps")
+        done_worlds = []
+        for w in worlds:
+            if remaining() < 120:
+                # no silent caps: record exactly which worlds were cut
+                skipped = [x for x in worlds if x not in done_worlds]
+                em.detail["scaling_truncated"] = ",".join(
+                    str(x) for x in skipped)
+                _progress(f"scaling truncated at deadline: skipped "
+                          f"worlds {skipped}")
+                break
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = \
+                f"--xla_force_host_platform_device_count={w}"
+            env["JAX_PLATFORMS"] = "cpu"
+            if w >= 4:
+                # give the child a real slow axis: 2 "hosts" of W/2
+                env["CYLON_MESH_SHAPE"] = f"2x{w // 2}"
+            else:
+                env.pop("CYLON_MESH_SHAPE", None)
+            cases = [("weak", srows),
+                     ("strong", max(srows * max(worlds) // w, 1))]
+            code = _SCALING_CHILD.format(repo=repo_dir, world=w,
+                                         reps=reps_sc, cases=cases)
+            try:
+                r = _subprocess.run(
+                    [sys.executable, "-c", code], capture_output=True,
+                    text=True, env=env,
+                    timeout=max(min(remaining(), 600), 60))
+                if r.returncode != 0:
+                    raise RuntimeError(r.stderr[-500:])
+                data = json.loads(r.stdout.strip().splitlines()[-1])
+            except Exception as e:  # graftlint: ok[broad-except] — one world's failure must not kill the bench
+                print(f"scaling world={w} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:300]}", file=sys.stderr)
+                em.detail[f"scaling_error_w{w}"] = str(e)[:200]
+                continue
+            for k, v in data.items():
+                em.detail[f"scaling_{k}_w{w}"] = v
+            done_worlds.append(w)
+            _progress(
+                f"scaling w={w}: weak join "
+                f"{data.get('weak_join_ms')} ms "
+                f"({data.get('weak_join_qps')} rows/s), slow wire "
+                f"{data.get('weak_join_wire_bytes_slow')} B")
+        if len(done_worlds) >= 2:
+            # weak-scaling efficiency e_W = qps_W / ((W/W0) * qps_W0),
+            # anchored at the smallest completed world; the fitted
+            # slope of e against log2(W/W0) is the one-number scaling
+            # headline (0 = perfect, more negative = steeper decay)
+            w0 = done_worlds[0]
+            q0 = em.detail.get(f"scaling_weak_join_qps_w{w0}")
+            xs, es = [], []
+            for w in done_worlds:
+                qw = em.detail.get(f"scaling_weak_join_qps_w{w}")
+                if q0 and qw:
+                    xs.append(float(np.log2(w / w0)))
+                    es.append(float(qw) / ((w / w0) * float(q0)))
+            if len(xs) >= 2:
+                slope = float(np.polyfit(xs, es, 1)[0])
+                em.detail["scaling_efficiency_slope"] = round(slope, 4)
+                _progress(f"scaling efficiency slope "
+                          f"{em.detail['scaling_efficiency_slope']} "
+                          f"per doubling (0 = perfect)")
+        em.emit("scaling")
 
     em.detail["bench_wall_s"] = round(time.monotonic() - t_start, 1)
     em.emit("final")

@@ -206,6 +206,18 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     # as a regression, while a query that ran and diverged still drags
     # the ratio down (the absolute count is reported ungated)
     (r"tpch_ooc_ok_ratio$", "down"),
+    # scaling-curve family (docs/tpu_perf_notes.md "Hierarchical
+    # collectives", CYLON_BENCH_SCALING): the fitted weak-scaling
+    # efficiency slope gates DOWN — a steeper per-device-throughput
+    # decay as the world grows means the exchange layer (chooser,
+    # hierarchical lowerings, per-edge pricing) lost parallel
+    # efficiency even when the single-world numbers look fine
+    (r"^scaling_efficiency_slope$", "down"),
+    # per-world-size slow-axis wire bytes gate UP (with the byte
+    # floor): deterministic priced bytes under a fixed seed, so an
+    # increase means a lowering regression started shipping more
+    # payload across the expensive edge at that world size
+    (r"scaling_.*_wire_bytes_slow(_w\d+)?$", "up"),
 )
 
 
@@ -323,15 +335,23 @@ def diff(old: Dict[str, float], new: Dict[str, float],
         gated = direction is not None
         if gated:  # sub-floor deltas are noise, not signal
             floor = (min_abs_ms if key.endswith("_ms")
-                     else min_abs_bytes if key.endswith(("_bytes_moved",
-                                                         "_bytes_saved",
-                                                         "_bytes_peak",
-                                                         "_spill_bytes"))
+                     else min_abs_bytes if (key.endswith(("_bytes_moved",
+                                                          "_bytes_saved",
+                                                          "_bytes_peak",
+                                                          "_spill_bytes"))
+                                            # scaling family: the
+                                            # per-world slow-axis wire
+                                            # bytes carry the byte floor
+                                            or "_wire_bytes_slow"
+                                            in key)
                      else min_abs_reads if key.endswith("_host_reads")
                      # ratio family (recovered ratio): a couple of
                      # queries' worth of jitter on a near-1.0 baseline
                      # must not fail CI
                      else 0.02 if key.endswith("_ratio")
+                     # efficiency slope: an absolute quantity near 0 —
+                     # the relative gate alone would flag noise
+                     else 0.02 if key.endswith("_slope")
                      else 0.0)
             if abs(n - o) < floor:
                 gated = False

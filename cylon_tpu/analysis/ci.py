@@ -63,7 +63,16 @@ Stages:
      session flips into degraded mode, and doctor renders the
      ``mesh_degraded`` bundle with the evacuation timeline
      (``--no-mesh-smoke`` skips; auto-skips below 2 devices);
-  9. **benchdiff** (only when ``--baseline`` and a candidate artifact
+  9. **hierarchy smoke** (docs/tpu_perf_notes.md "Hierarchical
+     collectives"): on an 8-device 2x4 mesh with a synthetic per-edge
+     profile the cost chooser must SELECT the hierarchical lowering
+     for a skewed cross-slow-axis shuffle — row-identical to
+     single-shot and strictly cheaper in slow-axis wire bytes — and
+     both forced hierarchical legs (shuffle + fused-groupby combine)
+     must hold parity, with the pre-combine moving exactly one partial
+     per group across the slow axis
+     (``--no-hierarchy-smoke`` skips; auto-skips below 8 devices);
+ 10. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
      including the serving families (``serve_qps``/``serve_sustain_qps``
      down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up), the
@@ -99,14 +108,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/9: graftlint ==")
+    print("== ci stage 1/10: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/9: plan_check pre-flight ==")
+    print("== ci stage 2/10: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -167,7 +176,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/9: serving smoke ==")
+    print("== ci stage 3/10: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -290,7 +299,7 @@ def _stage_telemetry_smoke(sf: float) -> int:
     CONTRACTS rather than the numbers: sampler non-empty, catalogue
     compliance, export validity (one track per query trace id), stats
     store populated with per-node observations."""
-    print("== ci stage 4/9: telemetry smoke ==")
+    print("== ci stage 4/10: telemetry smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -412,7 +421,7 @@ def _stage_doctor_smoke(sf: float) -> int:
     post-mortem machinery end to end: the victim fails onto its own
     handle, peers stay row-identical to serial execution, a
     flight-recorder bundle lands on disk, and doctor renders it."""
-    print("== ci stage 5/9: doctor smoke ==")
+    print("== ci stage 5/10: doctor smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -524,7 +533,7 @@ def _stage_chaos_smoke(sf: float) -> int:
     shows the ladder's stage retry with fewer stages replayed than the
     plan has), peers complete untouched, and the flight-recorder
     bundle doctor renders shows the ladder's events."""
-    print("== ci stage 6/9: chaos-recovery smoke ==")
+    print("== ci stage 6/10: chaos-recovery smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -679,7 +688,7 @@ def _stage_ooc_smoke(sf: float) -> int:
     run, and the exchange transient must stay within the pinned
     budget.  On failure a flight-recorder bundle is dumped and doctor
     renders it, so the evidence ships with the red CI run."""
-    print("== ci stage 7/9: out-of-core smoke ==")
+    print("== ci stage 7/10: out-of-core smoke ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -781,7 +790,7 @@ def _stage_mesh_smoke(sf: float) -> int:
     slices, the session must flip into degraded mode, and the
     flight-recorder bundle doctor renders must show the
     ``mesh_degraded`` event + evacuation timeline."""
-    print("== ci stage 8/9: mesh-loss chaos smoke ==")
+    print("== ci stage 8/10: mesh-loss chaos smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -944,10 +953,201 @@ def _stage_mesh_smoke(sf: float) -> int:
     return 1 if bad else 0
 
 
+def _stage_hierarchy_smoke() -> int:
+    """Hierarchical-collectives smoke (docs/tpu_perf_notes.md
+    "Hierarchical collectives"): on an 8-device 2x4 mesh with a
+    synthetic per-edge profile (fast edges 1 GB/s, slow edges 1 MB/s)
+    the chooser must SELECT — not forced — the hierarchical lowering
+    for a skewed cross-slow-axis shuffle, row-identical to the forced
+    single-shot run, with strictly fewer slow-axis wire bytes than the
+    flat single-shot slow-share price.  A forced hierarchical leg and
+    a forced hierarchical-combine fused-groupby leg prove both
+    lowerings independently."""
+    print("== ci stage 9/10: hierarchy smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import pandas as pd
+
+        from .. import config, trace
+        from ..context import CylonContext
+        from ..parallel import meshprobe, shuffle
+        from ..parallel.dist_ops import dist_groupby, dist_groupby_fused
+        from ..parallel.dtable import DTable
+
+        if len(jax.devices()) < 8:
+            print("hierarchy smoke: skipped — needs >= 8 devices (set "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+            return 0
+        ctx = CylonContext({"backend": "dist",
+                            "devices": jax.devices()[:8]})
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding
+        print(f"hierarchy smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    prev_shape = config.set_mesh_shape((2, 4))
+    prev_meas = config.set_cost_measured(True)
+    trace.enable_counters()
+    try:
+        # a synthetic per-edge profile with a 1000x bandwidth gap makes
+        # the selection deterministic regardless of host jitter — the
+        # smoke tests the CHOOSER, not the probe
+        prof = meshprobe.probe(ctx)
+        lat = dict(prof.latency_s)
+        bw = dict(prof.bytes_per_s)
+        for coll in ("all_to_all", "ppermute", "all_gather"):
+            lat[coll + "@fast"] = 1e-6
+            bw[coll + "@fast"] = 1e9
+            lat[coll + "@slow"] = 1e-4
+            bw[coll + "@slow"] = 1e6
+        meshprobe.put_profile(dataclasses.replace(
+            prof, latency_s=lat, bytes_per_s=bw))
+
+        Pn = ctx.get_world_size()
+        cap = 2048
+        # every row on device d targets device (d+4)%8: all traffic
+        # crosses the slow axis, concentrated on ONE peer per sender —
+        # the flat all_to_all pads every [P, block] cell to the hot
+        # cell, the hierarchy aggregates the rows into one cell
+        pid_np = np.repeat((np.arange(Pn) + 4) % Pn, cap)
+        vals = np.arange(Pn * cap).astype(np.int64)
+        sh = ctx.sharding()
+        pid = jax.device_put(jnp.asarray(pid_np.astype(np.int32)), sh)
+        leaves = (jax.device_put(jnp.asarray(vals), sh),)
+
+        def rowset(force):
+            prev = config.set_exchange_strategy(force)
+            shuffle.clear_chunk_state()
+            trace.reset()
+            try:
+                outs, cnts, oc = shuffle.shuffle_leaves(ctx, pid, leaves)
+            finally:
+                config.set_exchange_strategy(prev)
+            # smoke-only oracle export: the whole point is reading the
+            # raw exchange result back to host for rowset comparison
+            cn = np.asarray(
+                jax.device_get(cnts))  # graftlint: ok[implicit-host-sync]
+            buf = np.asarray(
+                jax.device_get(outs[0]))  # graftlint: ok[implicit-host-sync]
+            rows = [sorted(buf[d * oc:d * oc + int(cn[d])].tolist())
+                    for d in range(Pn)]
+            return rows, dict(trace.counters())
+
+        base_rows, base_c = rowset("single-shot")
+        nat_rows, nat_c = rowset(None)
+        if not nat_c.get("shuffle.strategy.hierarchical", 0):
+            print("hierarchy smoke: the chooser did NOT select the "
+                  "hierarchical lowering under the per-edge model",
+                  file=sys.stderr)
+            bad += 1
+        if nat_rows != base_rows:
+            print("hierarchy smoke: the naturally-selected hierarchical "
+                  "shuffle diverged from single-shot", file=sys.stderr)
+            bad += 1
+        ns = nat_c.get("shuffle.bytes_sent_slow", 0)
+        fs = base_c.get("shuffle.bytes_sent_slow", 0)
+        if not (0 < ns < fs):
+            print(f"hierarchy smoke: slow-axis wire bytes not strictly "
+                  f"below the flat price (hier={ns}, flat={fs})",
+                  file=sys.stderr)
+            bad += 1
+        forced_rows, forced_c = rowset("hierarchical")
+        if forced_rows != base_rows:
+            print("hierarchy smoke: the FORCED hierarchical shuffle "
+                  "diverged from single-shot", file=sys.stderr)
+            bad += 1
+        if not forced_c.get("shuffle.strategy.hierarchical", 0):
+            print("hierarchy smoke: the forced leg did not run the "
+                  "hierarchical lowering", file=sys.stderr)
+            bad += 1
+
+        # forced hierarchical-combine over the fused-groupby exchange:
+        # parity against the plain groupby and the pre-combine proof
+        # that only per-group partials crossed the slow axis
+        n = 6000
+        nkeys = 37
+        df = pd.DataFrame({
+            "k": (np.arange(n) % nkeys).astype(np.int32),
+            "v": (np.arange(n) * 0.5).astype(np.float32),
+        })
+        dt = DTable.from_pandas(ctx, df)
+        aggs = [("v", "sum"), ("v", "count")]
+
+        def canon(res):
+            if not hasattr(res, "to_pandas"):
+                res = res.to_table()
+            return res.to_pandas().sort_values("k")\
+                .reset_index(drop=True)
+
+        want = canon(dist_groupby(dt, ["k"], aggs))
+        prev = config.set_exchange_strategy("hierarchical-combine")
+        shuffle.clear_chunk_state()
+        trace.reset()
+        try:
+            got = canon(dist_groupby_fused(dt, ["k"], aggs,
+                                           mode="pre-aggregate"))
+            comb_c = dict(trace.counters())
+        finally:
+            config.set_exchange_strategy(prev)
+        ok = list(got.columns) == list(want.columns)
+        if ok:
+            for col in want.columns:
+                w = want[col].to_numpy(np.float64)
+                g = got[col].to_numpy(np.float64)
+                ok = ok and np.allclose(g, w, rtol=1e-9, atol=1e-9)
+        if not ok:
+            print("hierarchy smoke: the hierarchical-combine fused "
+                  "groupby diverged from plain groupby",
+                  file=sys.stderr)
+            bad += 1
+        if not comb_c.get("shuffle.strategy.hierarchical_combine", 0):
+            print("hierarchy smoke: the forced combine leg did not run "
+                  "the hierarchical-combine lowering", file=sys.stderr)
+            bad += 1
+        pre_rows = comb_c.get("groupby.axis_precombine_rows", 0)
+        # striped keys put every group on every device: the pre-combine
+        # must move EXACTLY one partial per group per non-resident slow
+        # block — K*(S-1) rows, nothing proportional to n
+        if pre_rows != nkeys * (2 - 1):
+            print(f"hierarchy smoke: pre-combine moved {pre_rows} rows "
+                  f"across the slow axis, expected exactly {nkeys}",
+                  file=sys.stderr)
+            bad += 1
+        print(f"hierarchy smoke: natural selection OK "
+              f"(slow bytes {ns} < flat {fs}), forced parity OK, "
+              f"combine pre-aggregate crossed {pre_rows} partials "
+              f"({time.perf_counter() - t0:.1f}s)")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract
+        print(f"hierarchy smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        try:
+            from .. import config as _config, trace as _trace
+            from ..parallel import meshprobe as _meshprobe
+            from ..parallel import shuffle as _shuffle
+            _config.set_mesh_shape(prev_shape)
+            _config.set_cost_measured(prev_meas)
+            _meshprobe.clear_profiles()
+            _shuffle.clear_chunk_state()
+            _trace.disable_counters()
+            _trace.reset()
+        except Exception:  # graftlint: ok[broad-except] — best-effort
+            pass           # teardown must not mask the stage verdict
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 9/9: benchdiff ==")
+    print("== ci stage 10/10: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -981,6 +1181,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the out-of-core (spill) smoke stage")
     ap.add_argument("--no-mesh-smoke", action="store_true",
                     help="skip the mesh-loss chaos smoke stage")
+    ap.add_argument("--no-hierarchy-smoke", action="store_true",
+                    help="skip the hierarchical-collectives smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -990,36 +1192,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/9: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/10: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/9: serving smoke == (skipped)")
+        print("== ci stage 3/10: serving smoke == (skipped)")
     if not args.no_telemetry_smoke:
         rcs.append(_stage_telemetry_smoke(args.tpch_sf))
     else:
-        print("== ci stage 4/9: telemetry smoke == (skipped)")
+        print("== ci stage 4/10: telemetry smoke == (skipped)")
     if not args.no_doctor_smoke:
         rcs.append(_stage_doctor_smoke(args.tpch_sf))
     else:
-        print("== ci stage 5/9: doctor smoke == (skipped)")
+        print("== ci stage 5/10: doctor smoke == (skipped)")
     if not args.no_chaos_smoke:
         rcs.append(_stage_chaos_smoke(args.tpch_sf))
     else:
-        print("== ci stage 6/9: chaos-recovery smoke == (skipped)")
+        print("== ci stage 6/10: chaos-recovery smoke == (skipped)")
     if not args.no_ooc_smoke:
         rcs.append(_stage_ooc_smoke(args.tpch_sf))
     else:
-        print("== ci stage 7/9: out-of-core smoke == (skipped)")
+        print("== ci stage 7/10: out-of-core smoke == (skipped)")
     if not args.no_mesh_smoke:
         rcs.append(_stage_mesh_smoke(args.tpch_sf))
     else:
-        print("== ci stage 8/9: mesh-loss chaos smoke == (skipped)")
+        print("== ci stage 8/10: mesh-loss chaos smoke == (skipped)")
+    if not args.no_hierarchy_smoke:
+        rcs.append(_stage_hierarchy_smoke())
+    else:
+        print("== ci stage 9/10: hierarchy smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 9/9: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 10/10: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

@@ -7,8 +7,9 @@ from __future__ import annotations
 
 import enum
 import os
+import re
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Tuple
 
 from .status import Code, CylonError, Status
 
@@ -293,6 +294,67 @@ def exchange_strategy() -> Optional[str]:
     env = os.environ.get("CYLON_EXCHANGE_STRATEGY", "")
     if env:
         return _validate_strategy(env, "CYLON_EXCHANGE_STRATEGY")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# 2-level mesh shape (docs/tpu_perf_notes.md "Hierarchical collectives"):
+# the (slow, fast) factorization of the device mesh — fast = the cheap
+# intra-host/intra-chip axis, slow = the expensive cross-host boundary.
+# topology.axis_split() resolves it per context: explicit
+# set_mesh_shape() > CYLON_MESH_SHAPE env ("SxF") > the platform's
+# host/local-device grouping.  A non-trivial split is what makes the
+# hierarchical exchange lowerings enumerable and lets meshprobe fit
+# per-axis bandwidth coefficients.
+# ---------------------------------------------------------------------------
+
+_mesh_shape: "Optional[Tuple[int, int]]" = None   # None -> env/platform
+
+
+def _validate_mesh_shape(shape, what: str) -> "Tuple[int, int]":
+    ok = (isinstance(shape, (tuple, list)) and len(shape) == 2
+          and all(isinstance(x, int) and not isinstance(x, bool)
+                  for x in shape)
+          and all(x > 0 for x in shape))
+    if not ok:
+        raise CylonError(Status(Code.Invalid,
+            f"{what} must be a (slow, fast) pair of positive ints or "
+            f"None to restore platform resolution, got {shape!r}"))
+    return (int(shape[0]), int(shape[1]))
+
+
+def set_mesh_shape(shape: "Optional[Tuple[int, int]]"
+                   ) -> "Optional[Tuple[int, int]]":
+    """Set the explicit (slow, fast) mesh factorization (``None``
+    restores env/platform resolution); returns the previous EXPLICIT
+    setting so callers restore it in a ``finally`` — the same contract
+    as ``set_exchange_strategy``.  The shape need not match every
+    context's world size: ``topology.axis_split`` re-resolves it per
+    (possibly degraded) mesh and falls back to a flat split when it
+    cannot tile the surviving devices."""
+    global _mesh_shape
+    if shape is not None:
+        shape = _validate_mesh_shape(shape, "mesh shape")
+    prev = _mesh_shape
+    _mesh_shape = shape
+    return prev
+
+
+def mesh_shape() -> "Optional[Tuple[int, int]]":
+    """The configured (slow, fast) mesh shape, or None when the
+    platform grouping should decide (explicit knob, else
+    ``CYLON_MESH_SHAPE`` as ``SxF``, e.g. ``2x4``)."""
+    if _mesh_shape is not None:
+        return _mesh_shape
+    env = os.environ.get("CYLON_MESH_SHAPE", "")
+    if env:
+        m = re.fullmatch(r"(\d+)\s*[xX,]\s*(\d+)", env.strip())
+        if not m:
+            raise CylonError(Status(Code.Invalid,
+                f"CYLON_MESH_SHAPE must look like 'SxF' (e.g. '2x4'), "
+                f"got {env!r}"))
+        return _validate_mesh_shape((int(m.group(1)), int(m.group(2))),
+                                    "CYLON_MESH_SHAPE")
     return None
 
 

@@ -22,6 +22,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 MESH_AXIS = "p"  # the row-partition axis: the engine's one parallelism axis
 
+# 2-level view of the same devices (docs/tpu_perf_notes.md "Hierarchical
+# collectives"): slow = the cross-host/cross-slice boundary, fast = the
+# intra-host/ICI axis.  Kernels that lower a redistribution as a
+# sequence of per-axis collectives shard over BOTH axes with
+# ``P((MESH_SLOW_AXIS, MESH_FAST_AXIS))`` — the row-major reshape keeps
+# the flat device order, so leaves sharded on the 1-D mesh feed 2-D
+# kernels with an identical physical layout (jit re-binds the sharding,
+# no data movement).
+MESH_SLOW_AXIS = "ps"
+MESH_FAST_AXIS = "pf"
+
 
 class CylonContext:
     """Entry point to the runtime.
@@ -55,6 +66,7 @@ class CylonContext:
                                     f"unknown backend config {config!r}"))
         self._devices = devs
         self._mesh = Mesh(np.array(devs), (MESH_AXIS,))
+        self._mesh2d: Dict[Any, Mesh] = {}
         self._finalized = False
         from . import logging as glog
         glog.vlog(1, "CylonContext: backend=%s world=%d platform=%s",
@@ -216,6 +228,26 @@ class CylonContext:
     @property
     def axis(self) -> str:
         return MESH_AXIS
+
+    def mesh2d(self, split) -> Mesh:
+        """The 2-level ``(MESH_SLOW_AXIS, MESH_FAST_AXIS)`` view of this
+        context's devices for a ``(slow, fast)`` split (usually
+        ``topology.axis_split(ctx)``).  Row-major reshape of the SAME
+        flat device list, so 1-D-sharded leaves flow into 2-D kernels
+        without any physical relayout; cached per split."""
+        slow, fast = int(split[0]), int(split[1])
+        if slow * fast != len(self._devices) or slow < 1 or fast < 1:
+            from .status import Code, CylonError, Status
+            raise CylonError(Status(Code.Invalid,
+                f"mesh2d split {split!r} does not tile world size "
+                f"{len(self._devices)}"))
+        key = (slow, fast)
+        hit = self._mesh2d.get(key)
+        if hit is None:
+            hit = Mesh(np.array(self._devices).reshape(slow, fast),
+                       (MESH_SLOW_AXIS, MESH_FAST_AXIS))
+            self._mesh2d[key] = hit
+        return hit
 
     def sharding(self, spec: Optional[P] = None) -> NamedSharding:
         return NamedSharding(self._mesh, spec if spec is not None else P(MESH_AXIS))

@@ -50,7 +50,7 @@ from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["kernel_factory", "attribute_compiles", "note_build",
-           "clear_state", "STORM_KEYS", "STORM_WINDOW_S"]
+           "clear_state", "serial_call", "STORM_KEYS", "STORM_WINDOW_S"]
 
 # the storm detector's window: this many DISTINCT cache keys built by
 # one factory within this many seconds is a retrace storm worth a warn
@@ -179,6 +179,63 @@ def clear_state() -> None:
 
 
 # ---------------------------------------------------------------------------
+# host-platform dispatch serialization
+# ---------------------------------------------------------------------------
+#
+# XLA's CPU client rendezvouses collective participants in-process: a
+# shard_map launch blocks inside dispatch until every virtual-device
+# participant has arrived.  Two kernels launched from different Python
+# threads at the same time can interleave their per-device arrivals
+# across each other's rendezvous and starve both — on a single-core
+# host the interleaving is near-certain and the launch blocks forever
+# (observed: concurrent ``replicate_table`` / concurrent serve submits
+# hang tier-1 until pytest's global timeout).  Real accelerator
+# platforms serialize launches on the device stream and are unaffected,
+# so the lock is gated to the cpu backend.  Serialized issuance is
+# exactly what a single-threaded caller does anyway; the RLock keeps
+# nested kernel calls on one thread legal, and uncontended acquisition
+# costs nanoseconds.
+
+_dispatch_lock = threading.RLock()
+_serialize_dispatch: Optional[bool] = None
+
+
+def _serial_dispatch() -> bool:
+    global _serialize_dispatch
+    if _serialize_dispatch is None:
+        try:
+            import jax
+            _serialize_dispatch = jax.default_backend() == "cpu"
+        except Exception:  # graftlint: ok[broad-except] — the gate is
+            _serialize_dispatch = False  # best-effort; never break dispatch
+    return _serialize_dispatch
+
+
+def serial_call(fn, args, kwargs):
+    """Invoke ``fn`` with cpu-backend launch serialization (module
+    comment above).  Dispatch alone is not enough: jit dispatch is
+    async, so two serially-ISSUED programs can still execute — and
+    rendezvous — concurrently.  The lock is therefore held until the
+    outputs are ready, guaranteeing at most one program in flight.
+    Under an ambient abstract trace nothing executes, so nothing is
+    held.  ``_KernelHandle`` routes every wrapped kernel through here;
+    bare ``lru_cache`` factories whose kernels are reachable from
+    worker threads (``dtable._head_fn`` and friends) call it directly."""
+    if not _serial_dispatch():
+        return fn(*args, **kwargs)
+    import jax
+    if not jax.core.trace_state_clean():
+        return fn(*args, **kwargs)
+    with _dispatch_lock:
+        out = fn(*args, **kwargs)
+        try:
+            jax.block_until_ready(out)
+        except Exception:  # graftlint: ok[broad-except] — non-array
+            pass           # leaves in the output tree stay un-waited
+        return out
+
+
+# ---------------------------------------------------------------------------
 # the factory decorator + the per-kernel build timer
 # ---------------------------------------------------------------------------
 
@@ -212,6 +269,9 @@ class _KernelHandle:
         self._seen: set = set()
         self.fresh = True
 
+    def _dispatch(self, args, kwargs):
+        return serial_call(self._fn, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         # fast-path gate: unobserved dispatch (counters off, no
         # collector) goes straight to the kernel — no flatten, no
@@ -221,7 +281,7 @@ class _KernelHandle:
         # signature measures as a near-zero "build" — harmless noise
         # vs. taxing every production dispatch
         if not _observing():
-            return self._fn(*args, **kwargs)
+            return self._dispatch(args, kwargs)
         from ..analysis._abstract import is_abstract
         import jax
         try:
@@ -232,9 +292,9 @@ class _KernelHandle:
                 return self._fn(*args, **kwargs)
             sig = _signature(args, kwargs)
         except TypeError:
-            return self._fn(*args, **kwargs)   # unhashable leaf — skip
+            return self._dispatch(args, kwargs)  # unhashable leaf — skip
         if sig in self._seen:
-            return self._fn(*args, **kwargs)
+            return self._dispatch(args, kwargs)
         return self._build_call(sig, args, kwargs)
 
     def _build_call(self, sig, args, kwargs):
@@ -251,7 +311,7 @@ class _KernelHandle:
             except Exception:  # graftlint: ok[broad-except] — the
                 trace_ms = None  # trace split is best-effort telemetry
         t1 = time.perf_counter()
-        out = self._fn(*args, **kwargs)
+        out = self._dispatch(args, kwargs)
         build_ms = (time.perf_counter() - t1) * 1e3
         # mark seen AFTER a successful dispatch: a failed first call
         # must re-measure (and re-raise) next time, not go dark

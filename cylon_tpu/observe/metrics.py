@@ -177,6 +177,32 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "exchanges the chooser lowered as host-tier staged-spill morsel "
      "rounds (no resident strategy fit the budget; the payload staged "
      "out to the spill pool and streamed back — docs/out_of_core.md)"),
+    # topology-aware hierarchical collectives (docs/tpu_perf_notes.md
+    # "Hierarchical collectives"): two-level lowerings over the
+    # (slow, fast) mesh split + the slow-edge traffic they shrink
+    ("shuffle.strategy.hierarchical", COUNTER, "exchanges",
+     "exchanges lowered as the two-level shuffle (all_to_all within "
+     "the fast axis, then a ring ppermute across the slow axis — each "
+     "row crosses the slow edge at most once, in one aggregated cell)"),
+    ("shuffle.strategy.hierarchical_combine", COUNTER, "exchanges",
+     "combine-spec exchanges lowered hierarchically with an axis-local "
+     "pre-combine: stage 1's landing folds by (group key, target) so "
+     "only per-group partials ever cross the slow axis"),
+    ("shuffle.rows_sent_slow", COUNTER, "rows",
+     "exchanged rows whose sender and receiver sit in different SLOW "
+     "mesh groups (cross-host/DCN traffic under the (slow, fast) "
+     "split; flat meshes tally nothing)"),
+    ("shuffle.bytes_sent_slow", COUNTER, "bytes",
+     "priced wire bytes crossing the slow axis for chosen lowerings "
+     "(StrategyPrice.slow_wire_bytes x P) — the number the hierarchy "
+     "smoke and benchdiff's scaling_*_wire_bytes_slow gates compare"),
+    ("groupby.axis_precombine", COUNTER, "exchanges",
+     "hierarchical combine exchanges that ran the fast-axis-local "
+     "pre-combine before crossing the slow axis"),
+    ("groupby.axis_precombine_rows", COUNTER, "rows",
+     "post-pre-combine partial rows that crossed the slow axis (the "
+     "exact per-group payload; compare groupby.partials_rows for what "
+     "a flat exchange would have moved)"),
     ("shuffle.strategy.downgrades", COUNTER, "exchanges",
      "exchanges the chooser moved OFF the single-shot fast path (sum "
      "of the non-single-shot strategy tallies) — bench's per-query "
@@ -270,6 +296,10 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "mesh bandwidth microbench runs (parallel/meshprobe.py) — one per "
      "mesh fingerprint unless forced; the fitted (latency, bytes/s) "
      "coefficients are cached and surfaced through cost.predicted_ms"),
+    ("meshprobe.axis_probes", COUNTER, "probes",
+     "per-axis probe passes over a non-trivial (slow, fast) split — "
+     "fits the @fast/@slow per-edge coefficients the hierarchical "
+     "lowerings are priced against"),
     ("stats.records", COUNTER, "records",
      "run-stats store writes (observe.stats): ANALYZE reports or served "
      "executions recorded under their plan-cache fingerprint — the "

@@ -68,7 +68,7 @@ A/B escape hatch (parity tests, kernel timing), same idiom as
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -77,10 +77,12 @@ from ..ops.compact import next_bucket
 
 __all__ = [
     "SINGLE_SHOT", "CHUNKED", "RING", "ALLGATHER", "REPLICATE",
-    "STAGED_SPILL", "REMESH", "STRATEGIES", "StrategyPrice",
+    "STAGED_SPILL", "REMESH", "HIERARCHICAL", "HIER_COMBINE",
+    "STRATEGIES", "StrategyPrice",
     "exchange_sizes", "single_shot_bytes", "price_single_shot",
     "price_chunked", "price_ring", "price_allgather", "price_replicate",
     "price_retained", "price_staged_spill", "price_remesh", "chunk_plan",
+    "hier_plan", "price_hierarchical", "price_hier_combine", "slow_share",
     "enumerate_strategies", "choose", "COLLECTIVE_OF", "predicted_ms",
 ]
 
@@ -118,12 +120,33 @@ STAGED_SPILL = "staged-spill"   # host-tier staging (docs/out_of_core.md):
 #                           override or by callers whose candidate
 #                           lists price input residency.
 
+HIERARCHICAL = "hierarchical"   # the 2-level shuffle (docs/
+#                           tpu_perf_notes.md "Hierarchical
+#                           collectives"): one all_to_all WITHIN the
+#                           fast axis routes every row to its target's
+#                           fast coordinate, then S−1 ppermute rounds
+#                           ACROSS the slow axis deliver the slow hop —
+#                           the arXiv:2112.01075 sequence-of-collectives
+#                           idea applied to a (slow, fast) topology.
+#                           Same rows, but the bytes that cross the
+#                           expensive slow boundary shrink from
+#                           (P−F)/P of the payload shipped point-to-
+#                           point to ONE aggregated lane per slow peer.
+HIER_COMBINE = "hierarchical-combine"   # the fused-groupby spelling:
+#                           after the fast-axis hop, an AXIS-LOCAL
+#                           fold-by-key pre-combines every slow group's
+#                           partials, so only per-GROUP partial rows —
+#                           not per-input rows — cross the slow axis
+#                           (arXiv:2010.14596's hierarchical
+#                           aggregation result).
+
 # the shuffle chooser's selectable catalogue, in preference order for
 # deterministic tie-breaks (counter names derive from these — see
 # strategy_counter).  staged-spill sits last: it trades PCIe round
 # trips for resident bytes, the lowering of last resort before the
 # best-effort floor
-STRATEGIES = (SINGLE_SHOT, ALLGATHER, CHUNKED, RING, STAGED_SPILL)
+STRATEGIES = (SINGLE_SHOT, ALLGATHER, CHUNKED, RING, HIERARCHICAL,
+              HIER_COMBINE, STAGED_SPILL)
 
 
 def strategy_counter(strategy: str) -> str:
@@ -160,6 +183,13 @@ class StrategyPrice:
     # extra cost axis, priced by predicted_ms from the measured
     # h2d/d2h transfer coefficients (parallel/meshprobe.py)
     host_bytes: int = 0
+    # of wire_bytes, the share that crosses the SLOW mesh axis under the
+    # live (slow, fast) split — the expensive edge the hierarchical
+    # lowerings exist to starve.  Zero when the split is trivial (flat
+    # mesh) or unknown; predicted_ms prices it against the per-axis
+    # coefficients when meshprobe measured them, and
+    # ``shuffle.bytes_sent_slow`` tallies it for the executed choice.
+    slow_wire_bytes: int = 0
 
     def describe(self) -> str:
         host = (f", {self.host_bytes} B host-staged"
@@ -361,19 +391,125 @@ def price_staged_spill(nparts: int, counts: np.ndarray, rbytes: int,
         host_bytes=2 * payload)
 
 
+def hier_plan(nparts: int, split: Tuple[int, int], counts: np.ndarray
+              ) -> Tuple[int, int, int, np.ndarray]:
+    """Size the two-level exchange from the [P, P] count matrix under a
+    ``(slow, fast)`` split with ``slow·fast == nparts`` (device ``p``
+    sits at slow coordinate ``p // fast``, fast coordinate ``p % fast``
+    — the row-major ``context.mesh2d`` layout).
+
+    Stage 1 (fast-axis all_to_all) routes every row to its TARGET's
+    fast coordinate within the sender's slow group: cell ``c1[p, f']``
+    = rows device ``p`` holds for fast column ``f'``; ``block1`` buckets
+    the largest such cell and ``outcap1`` the largest stage-1 receive.
+    Stage 2 (slow-axis ring) then moves whole per-slow-peer lanes:
+    ``c2[s, f', s']`` = rows sitting at mesh position ``(s, f')`` after
+    stage 1 that belong to slow group ``s'``; ``block2`` buckets the
+    largest CROSS cell (the diagonal never rides the wire).  Returns
+    ``(block1, outcap1, block2, c2)``."""
+    slow, fast = int(split[0]), int(split[1])
+    c = np.asarray(counts).reshape(slow, fast, slow, fast)
+    # c1[p, f'] summed over target slow groups; flattened sender index
+    c1 = c.sum(axis=2).reshape(nparts, fast)
+    block1 = next_bucket(max(int(c1.max(initial=0)), 1), minimum=8)
+    # stage-1 receive at (s, f') = everything s's group holds for f'
+    recv1 = c1.reshape(slow, fast, fast).sum(axis=1)
+    outcap1 = next_bucket(max(int(recv1.max(initial=0)), 1), minimum=8)
+    # c2[s, f', s'] = rows at (s, f') after stage 1 destined to slow s'
+    c2 = np.transpose(c.sum(axis=1), (0, 2, 1))
+    cross = c2.copy()
+    cross[np.arange(slow), :, np.arange(slow)] = 0
+    block2 = next_bucket(max(int(cross.max(initial=0)), 1), minimum=8)
+    return block1, outcap1, block2, c2
+
+
+def price_hierarchical(nparts: int, split: Tuple[int, int],
+                       counts: np.ndarray, rbytes: int) -> StrategyPrice:
+    """The two-level shuffle: 1 fast-axis all_to_all + (S−1) slow-axis
+    ppermute rounds, receiver-side folded like the ring.  Rows carry
+    their int32 pid lane through both stages (stage 2 routes on it), so
+    both stages price at ``rbytes + _PID_BYTES``.  ``sizes`` =
+    ``(S, F, block1, outcap1, block2, outcap)``; ``slow_wire_bytes`` is
+    the stage-2 share — the number the hierarchy exists to shrink."""
+    slow, fast = int(split[0]), int(split[1])
+    block1, outcap1, block2, _ = hier_plan(nparts, split, counts)
+    _, outcap, _ = exchange_sizes(counts)
+    rb2 = rbytes + _PID_BYTES
+    peak1 = (2 * fast * block1 + outcap1) * rb2
+    peak2 = (outcap1 * rb2 + block2 * (2 * rb2 + _RING_ROUTING_BYTES)
+             + outcap * rbytes)
+    wire_slow = (slow - 1) * block2 * rb2
+    return StrategyPrice(
+        HIERARCHICAL,
+        peak_bytes=int(max(peak1, peak2)),
+        wire_bytes=int((fast - 1) * block1 * rb2 + wire_slow),
+        rounds=slow,  # 1 a2a + (S−1) ppermute — the latency axis
+        sizes=(slow, fast, block1, outcap1, block2, outcap),
+        slow_wire_bytes=int(wire_slow))
+
+
+def price_hier_combine(nparts: int, split: Tuple[int, int],
+                       counts: np.ndarray, rbytes: int) -> StrategyPrice:
+    """The fused-groupby two-level exchange: stage 1 as above, then an
+    AXIS-LOCAL fold-by-key (the chunked path's combine kernel) collapses
+    each slow group's partials BEFORE the slow rounds, so stage 2 moves
+    per-group partial rows only.  Priced conservatively from the RAW
+    count matrix (the dispatch re-sizes stage 2 from the post-combine
+    counts, which can only shrink); the stage-2 fold accumulates into a
+    result block of at most ``outcap`` combined groups, which rides the
+    peak like the chunked rounds' accumulator."""
+    slow, fast = int(split[0]), int(split[1])
+    block1, outcap1, block2, _ = hier_plan(nparts, split, counts)
+    _, outcap, _ = exchange_sizes(counts)
+    rb2 = rbytes + _PID_BYTES
+    peak1 = (2 * fast * block1 + outcap1) * rb2
+    peak2 = (outcap1 * rb2 + block2 * (2 * rb2 + _RING_ROUTING_BYTES)
+             + 2 * outcap * rbytes)
+    wire_slow = (slow - 1) * block2 * rb2
+    return StrategyPrice(
+        HIER_COMBINE,
+        peak_bytes=int(max(peak1, peak2)),
+        wire_bytes=int((fast - 1) * block1 * rb2 + wire_slow),
+        rounds=slow,
+        sizes=(slow, fast, block1, outcap1, block2, outcap),
+        slow_wire_bytes=int(wire_slow))
+
+
+def slow_share(price: StrategyPrice, nparts: int,
+               split: Optional[Tuple[int, int]]) -> StrategyPrice:
+    """Decorate a FLAT lowering's price with the share of its wire
+    bytes that crosses the slow axis under ``split``: a flat collective
+    treats all P−1 peers alike, and P−F of them sit across the slow
+    boundary.  Identity for trivial/unknown splits or prices that
+    already carry a slow share (the hierarchical lowerings)."""
+    if (split is None or price.slow_wire_bytes or nparts <= 1
+            or split[0] <= 1 or split[1] <= 1
+            or split[0] * split[1] != nparts):
+        return price
+    frac = (nparts - split[1]) / (nparts - 1)
+    return replace(price, slow_wire_bytes=int(price.wire_bytes * frac))
+
+
 def enumerate_strategies(nparts: int, cap: int, counts: np.ndarray,
                          rbytes: int, budget: int,
                          staged_ok: bool = True,
-                         spill_ok: bool = False) -> List[StrategyPrice]:
+                         spill_ok: bool = False,
+                         split: Optional[Tuple[int, int]] = None
+                         ) -> List[StrategyPrice]:
     """Every candidate lowering for one exchange, priced from the count
     matrix.  ``cap`` is the per-shard row capacity (the allgather
-    payload).  ``staged_ok=False`` restricts the catalogue to
+    payload).  ``staged_ok=False`` restricts the flat catalogue to
     single-shot + chunked — the combine-spec (fold-by-key partial
     aggregation) exchanges, whose receiver-side group fold only the
     chunked rounds implement.  ``spill_ok`` adds the host-tier
     ``staged-spill`` lowering (the spill subsystem is enabled and this
     payload can be staged) — the chooser reaches it only when no
-    resident strategy fits."""
+    resident strategy fits.  A non-trivial ``split`` (``(slow, fast)``,
+    both > 1, tiling ``nparts``) adds the matching hierarchical
+    lowering — the two-level shuffle for plain exchanges, the
+    pre-combining spelling for combine-spec ones — and decorates every
+    flat candidate with its slow-axis wire share so the per-edge
+    :func:`predicted_ms` model can rank them all on the same axes."""
     block, outcap, _ = exchange_sizes(counts)
     out = [price_single_shot(nparts, block, outcap, rbytes)]
     if staged_ok and nparts > 1:
@@ -382,6 +518,14 @@ def enumerate_strategies(nparts: int, cap: int, counts: np.ndarray,
     out.append(price_chunked(nparts, counts, rbytes, budget))
     if spill_ok and nparts > 1:
         out.append(price_staged_spill(nparts, counts, rbytes, budget))
+    hier = (split is not None and split[0] > 1 and split[1] > 1
+            and split[0] * split[1] == nparts)
+    if hier:
+        out = [slow_share(c, nparts, split) for c in out]
+        if staged_ok:
+            out.append(price_hierarchical(nparts, split, counts, rbytes))
+        else:
+            out.append(price_hier_combine(nparts, split, counts, rbytes))
     return out
 
 
@@ -407,11 +551,41 @@ def predicted_ms(price: StrategyPrice, profile) -> Optional[float]:
     ``h2d`` coefficients (``host_bytes`` is split evenly between the
     two directions).  None without a profile (or for an unmeasured
     collective) — the annotation and the measured-ranking escape hatch
-    both degrade gracefully to 'unmeasured'."""
+    both degrade gracefully to 'unmeasured'.
+
+    PER-EDGE model (docs/tpu_perf_notes.md "Hierarchical collectives"):
+    when meshprobe fitted per-AXIS coefficients (``all_to_all@fast``,
+    ``ppermute@slow``, …), the hierarchical lowerings price each stage
+    against its own axis, and a flat lowering with a known
+    ``slow_wire_bytes`` share splits its wire between the two axes'
+    bandwidths — the slow β is what makes a flat all_to_all lose to the
+    two-level sequence on a real cross-host boundary."""
     if profile is None:
         return None
-    s = profile.predicted_s(COLLECTIVE_OF.get(price.strategy, ""),
-                            price.wire_bytes, price.rounds)
+    if price.strategy in (HIERARCHICAL, HIER_COMBINE):
+        fast_wire = max(price.wire_bytes - price.slow_wire_bytes, 0)
+        t_fast = profile.predicted_s("all_to_all@fast", fast_wire, 1)
+        t_slow = profile.predicted_s("ppermute@slow",
+                                     price.slow_wire_bytes,
+                                     max(price.rounds - 1, 1))
+        if t_fast is None or t_slow is None:
+            return None
+        return (t_fast + t_slow) * 1e3
+    coll = COLLECTIVE_OF.get(price.strategy, "")
+    s = None
+    if price.slow_wire_bytes:
+        # flat collective over a 2-level mesh: rounds synchronize on the
+        # slow edge; the fast/slow wire shares ride their own β
+        alpha = profile.latency_s.get(coll + "@slow")
+        bw_slow = profile.bytes_per_s.get(coll + "@slow")
+        bw_fast = profile.bytes_per_s.get(coll + "@fast")
+        if alpha is not None and bw_slow and bw_fast:
+            s = (max(price.rounds, 1) * alpha
+                 + price.slow_wire_bytes / max(bw_slow, 1.0)
+                 + (price.wire_bytes - price.slow_wire_bytes)
+                 / max(bw_fast, 1.0))
+    if s is None:
+        s = profile.predicted_s(coll, price.wire_bytes, price.rounds)
     if s is None:
         return None
     if price.host_bytes:

@@ -1827,7 +1827,13 @@ def dist_groupby_fused(dt: DTable, key_columns: Sequence[Union[int, str]],
         the eager tail's replicate-everywhere combine gather), and the
         over-budget chunked path folds rounds together BY GROUP KEY so
         ``shuffle.exchange_bytes_peak`` scales with distinct groups,
-        not rows (shuffle._fold_combine_fn).
+        not rows (shuffle._fold_combine_fn).  On a non-trivial
+        (slow, fast) mesh split the chooser may further lower this
+        exchange HIERARCHICALLY (``exchange=hierarchical-combine``):
+        the same combiner spec drives a fast-axis-local pre-combine so
+        only per-group partials cross the slow axis
+        (shuffle._hierarchical_exchange; ``groupby.axis_precombine*``
+        counters, docs/tpu_perf_notes.md "Hierarchical collectives").
       * ``"shuffle"`` — plan-proven near-unique keys: the partial pass
         cannot shrink the exchange, so raw rows move once and aggregate
         in place (identical to ``pre_aggregate=False``).

@@ -19,7 +19,6 @@ dictionaries onto a shared one at ingest.
 """
 from __future__ import annotations
 
-import functools as _functools
 import threading
 from dataclasses import dataclass, replace
 from typing import Any, List, Optional, Sequence, Union
@@ -30,6 +29,7 @@ import numpy as np
 
 from ..analysis._abstract import is_abstract
 from ..context import CylonContext
+from ..observe.compile import kernel_factory
 from ..dtypes import DataType, is_dictionary_encoded
 from ..ops import compact as ops_compact
 from ..status import Code, CylonError, Status
@@ -707,7 +707,7 @@ def _export_take(a: jax.Array, idx: jax.Array) -> jax.Array:
     return jnp.take(a, idx, axis=0)
 
 
-@_functools.lru_cache(maxsize=None)
+@kernel_factory
 def _replicate_counts_fn(mesh, axis: str):
     """[P]-sharded counts → replicated copy every controller can read."""
     from .._jax_compat import shard_map
@@ -722,7 +722,7 @@ def _replicate_counts_fn(mesh, axis: str):
                              out_specs=P(), check_vma=False))
 
 
-@_functools.lru_cache(maxsize=None)
+@kernel_factory
 def _head_fn(mesh, axis: str, cap: int, n: int, has_v):
     """Per shard: scatter my first ``take`` rows into a replicated [n]
     block at my global shard-major offset; shards write disjoint slots, so

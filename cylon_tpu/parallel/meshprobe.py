@@ -48,7 +48,7 @@ from jax.sharding import PartitionSpec as P
 from .. import trace
 
 __all__ = ["MeshProfile", "mesh_fingerprint", "probe", "get_profile",
-           "clear_profiles", "COLLECTIVES", "TRANSFERS"]
+           "put_profile", "clear_profiles", "COLLECTIVES", "TRANSFERS"]
 
 COLLECTIVES = ("all_to_all", "ppermute", "all_gather")
 
@@ -99,12 +99,21 @@ class MeshProfile:
     ``samples``         the raw ``(collective, wire_bytes, seconds)``
                         points the fit consumed (diagnostics; the
                         BENCH artifact can embed them).
+
+    PER-EDGE coefficients (docs/tpu_perf_notes.md "Hierarchical
+    collectives"): when the mesh has a non-trivial ``(slow, fast)``
+    split, :func:`probe` additionally times each collective restricted
+    to ONE axis of the 2-level mesh and fits those under the keys
+    ``"<collective>@fast"`` / ``"<collective>@slow"`` — what turns
+    ``cost.predicted_ms`` from a flat model into a per-edge one.
+    ``axis_split`` records the split those keys were measured under.
     """
 
     fingerprint: Tuple
     latency_s: Dict[str, float]
     bytes_per_s: Dict[str, float]
     samples: Tuple[Tuple[str, int, float], ...]
+    axis_split: Optional[Tuple[int, int]] = None
 
     def predicted_s(self, collective: str, wire_bytes: int,
                     rounds: int = 1) -> Optional[float]:
@@ -118,7 +127,8 @@ class MeshProfile:
 
     def describe(self) -> str:
         parts = []
-        for c in COLLECTIVES + TRANSFERS:
+        axis_keys = tuple(sorted(k for k in self.latency_s if "@" in k))
+        for c in COLLECTIVES + TRANSFERS + axis_keys:
             if c in self.latency_s:
                 parts.append(f"{c}: {self.latency_s[c] * 1e3:.3f} ms + "
                              f"{self.bytes_per_s[c] / 1e9:.3f} GB/s")
@@ -134,18 +144,21 @@ class MeshProfile:
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _a2a_probe_fn(mesh, axis: str, nparts: int, m: int):
+def _a2a_probe_fn(mesh, axis: str, nparts: int, m: int, spec_axes=None):
+    spec = P(spec_axes if spec_axes is not None else axis)
+
     def kernel(x_blk):
         y = jax.lax.all_to_all(x_blk.reshape(nparts, m), axis, 0, 0,
                                tiled=True)
         return jnp.sum(y).reshape(1)
 
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=P(axis), out_specs=P(axis)))
+                             in_specs=spec, out_specs=spec))
 
 
 @functools.lru_cache(maxsize=None)
-def _ppermute_probe_fn(mesh, axis: str, nparts: int):
+def _ppermute_probe_fn(mesh, axis: str, nparts: int, spec_axes=None):
+    spec = P(spec_axes if spec_axes is not None else axis)
     perm = [(i, (i + 1) % nparts) for i in range(nparts)]
 
     def kernel(x_blk):
@@ -153,11 +166,13 @@ def _ppermute_probe_fn(mesh, axis: str, nparts: int):
         return jnp.sum(y).reshape(1)
 
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=P(axis), out_specs=P(axis)))
+                             in_specs=spec, out_specs=spec))
 
 
 @functools.lru_cache(maxsize=None)
-def _allgather_probe_fn(mesh, axis: str):
+def _allgather_probe_fn(mesh, axis: str, spec_axes=None):
+    spec = P(spec_axes if spec_axes is not None else axis)
+
     def kernel(x_blk):
         y = jax.lax.all_gather(x_blk, axis, tiled=True)
         return jnp.sum(y).reshape(1)
@@ -165,7 +180,7 @@ def _allgather_probe_fn(mesh, axis: str):
     # check_vma=False: the gathered intermediate is replicated, which
     # shard_map cannot statically infer (same note as broadcast.py)
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=P(axis), out_specs=P(axis),
+                             in_specs=spec, out_specs=spec,
                              check_vma=False))
 
 
@@ -256,13 +271,63 @@ def probe(ctx, sizes: Tuple[int, ...] = (1 << 12, 1 << 15, 1 << 18),
                 best_d = dt if best_d is None else min(best_d, dt)
             samples.append(("h2d", int(host.nbytes), float(best_h)))
             samples.append(("d2h", int(host.nbytes), float(best_d)))
+        # per-edge probes (docs/tpu_perf_notes.md "Hierarchical
+        # collectives"): on a 2-level mesh, time each collective
+        # RESTRICTED to one axis of the (slow, fast) view — the payload
+        # stays sharded over both axes (the exchange kernels' layout),
+        # only the collective's axis narrows.  The "@fast"/"@slow" fits
+        # are what let cost.predicted_ms price a two-level sequence
+        # edge by edge.
+        split = None
+        from .. import topology
+        s_f = topology.axis_split(ctx)
+        if s_f[0] > 1 and s_f[1] > 1 and s_f[0] * s_f[1] == Pn:
+            split = (int(s_f[0]), int(s_f[1]))
+            from ..context import MESH_FAST_AXIS, MESH_SLOW_AXIS
+            mesh2 = ctx.mesh2d(split)
+            axes2 = (MESH_SLOW_AXIS, MESH_FAST_AXIS)
+            for size in sizes:
+                for edge, ax_name, nA in (
+                        ("fast", MESH_FAST_AXIS, split[1]),
+                        ("slow", MESH_SLOW_AXIS, split[0])):
+                    n = max((size // 4 // nA) * nA, nA)
+                    x = jax.device_put(
+                        rng.standard_normal(n * Pn).astype(np.float32),
+                        ctx.sharding())
+                    m = n // nA
+                    for coll, fn, wire in (
+                            ("all_to_all",
+                             _a2a_probe_fn(mesh2, ax_name, nA, m, axes2),
+                             (nA - 1) * m * 4),
+                            ("ppermute",
+                             _ppermute_probe_fn(mesh2, ax_name, nA,
+                                                axes2),
+                             n * 4),
+                            ("all_gather",
+                             _allgather_probe_fn(mesh2, ax_name, axes2),
+                             (nA - 1) * n * 4)):
+                        trace.hard_sync(fn(x))  # compile + warm
+                        best = None
+                        for _ in range(max(reps, 1)):
+                            t0 = time.perf_counter()
+                            trace.hard_sync(fn(x))
+                            dt = time.perf_counter() - t0
+                            best = dt if best is None else min(best, dt)
+                        samples.append((f"{coll}@{edge}", int(wire),
+                                        float(best)))
+            trace.count("meshprobe.axis_probes")
     latency: Dict[str, float] = {}
     bw: Dict[str, float] = {}
-    for coll in COLLECTIVES + TRANSFERS:
+    seen = []
+    for c, _, _ in samples:
+        if c not in seen:
+            seen.append(c)
+    for coll in seen:
         pts = [(w, t) for c, w, t in samples if c == coll]
         if pts:
             latency[coll], bw[coll] = _fit(pts)
-    profile = MeshProfile(fp, latency, bw, tuple(samples))
+    profile = MeshProfile(fp, latency, bw, tuple(samples),
+                          axis_split=split)
     trace.count("meshprobe.probes")
     with _lock:
         _profiles[fp] = profile
@@ -292,6 +357,20 @@ def get_profile(ctx) -> Optional[MeshProfile]:
         else:
             _misses.add(fp)
     return loaded
+
+
+def put_profile(profile: MeshProfile) -> None:
+    """Register a profile under its own fingerprint (and persist it
+    when ``CYLON_MESHPROBE_PATH`` is set).  The injection seam for
+    synthetic per-edge coefficients: CI's hierarchy smoke and the
+    acceptance dryrun run on a CPU-simulated mesh whose physical slow
+    edge does not exist, so they install a profile whose ``@slow``
+    bandwidth reflects the topology being modelled and let the chooser
+    rank for real (docs/observability.md)."""
+    with _lock:
+        _profiles[profile.fingerprint] = profile
+        _misses.discard(profile.fingerprint)
+    _persist(profile)
 
 
 def clear_profiles() -> None:
@@ -328,6 +407,8 @@ def _persist(profile: MeshProfile) -> None:
             "latency_s": profile.latency_s,
             "bytes_per_s": profile.bytes_per_s,
             "samples": [list(s) for s in profile.samples],
+            "axis_split": (list(profile.axis_split)
+                           if profile.axis_split else None),
         }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -347,9 +428,11 @@ def _load_persisted(fp: Tuple) -> Optional[MeshProfile]:
         rec = data.get(_fp_key(fp))
         if not isinstance(rec, dict):
             return None
+        split = rec.get("axis_split")
         return MeshProfile(
             fp, dict(rec.get("latency_s", {})),
             dict(rec.get("bytes_per_s", {})),
-            tuple(tuple(s) for s in rec.get("samples", ())))
+            tuple(tuple(s) for s in rec.get("samples", ())),
+            axis_split=tuple(split) if split else None)
     except (OSError, ValueError):
         return None

@@ -166,7 +166,7 @@ def _watchdog_dispatch(point: str, thunk):
 
 
 def _account(counts: np.ndarray, rbytes: int, combine=None,
-             owner: "str | None" = None) -> None:
+             owner: "str | None" = None, split=None) -> None:
     """Exchange-volume accounting shared by the single-shot post() and
     the chunked path (docs/observability.md).  Counts what ACTUALLY
     crosses the wire: for a partial-group exchange (``combine`` set)
@@ -174,7 +174,15 @@ def _account(counts: np.ndarray, rbytes: int, combine=None,
     the count matrix here was computed over the partial table, so the
     off-diagonal IS the partials moved.  ``owner`` attributes the bytes
     to a subsystem (``groupby.bytes_moved`` feeds bench's
-    ``tpch_*_groupby_bytes_saved`` column)."""
+    ``tpch_*_groupby_bytes_saved`` column).
+
+    With a non-trivial ``(slow, fast)`` ``split``, the rows whose
+    sender and receiver sit in DIFFERENT slow groups additionally tally
+    ``shuffle.rows_sent_slow`` — the expensive-edge traffic the
+    hierarchical lowerings exist to shrink.  Combine-spec exchanges
+    skip this here: their slow-axis crossing depends on the executed
+    lowering (the hierarchical pre-combine collapses it), so the
+    dispatch path tallies the exact post-combine number instead."""
     moved = int(counts.sum() - np.trace(counts))
     trace.count("shuffle.rows_sent", moved)
     trace.count("shuffle.bytes_sent", moved * rbytes)
@@ -184,6 +192,24 @@ def _account(counts: np.ndarray, rbytes: int, combine=None,
         # every partial row entering the combine exchange (diagonal
         # included: rows staying home are still partials produced)
         trace.count("groupby.partials_rows", int(counts.sum()))
+    elif split is not None:
+        slow, fast = split
+        c = np.asarray(counts)
+        if slow > 1 and fast > 1 and c.shape[0] == slow * fast:
+            slow_of = np.arange(slow * fast) // fast
+            cross = slow_of[:, None] != slow_of[None, :]
+            trace.count("shuffle.rows_sent_slow", int(c[cross].sum()))
+
+
+def _axis_split_of(ctx):
+    """``topology.axis_split(ctx)`` reduced to the chooser's contract:
+    the (slow, fast) pair when it is NON-trivial and tiles the live
+    world, else None (flat mesh — no hierarchy to price)."""
+    from .. import topology
+    slow, fast = topology.axis_split(ctx)
+    if slow > 1 and fast > 1 and slow * fast == ctx.get_world_size():
+        return (slow, fast)
+    return None
 
 
 # THE sizing rule for a single-shot exchange, shared by the optimistic
@@ -235,13 +261,22 @@ def _counts_fn(mesh, axis: str, nparts: int):
 
 
 @kernel_factory
-def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
+def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int,
+                 spec_axes=None):
     """The exchange program: group-by-target, all_to_all, compact.
 
     Returns a jitted fn ``(pid, leaves_tuple) -> (counts[P], new_leaves)``
     reused across calls with the same (mesh, block, outcap); differing leaf
     structures hit jit's own cache.
-    """
+
+    ``spec_axes`` (the 2-level lowering, docs/tpu_perf_notes.md
+    "Hierarchical collectives"): when set — e.g. ``(MESH_SLOW_AXIS,
+    MESH_FAST_AXIS)`` on a ``ctx.mesh2d`` mesh — the leaves shard over
+    BOTH axes while the collective itself runs only over ``axis``
+    (``nparts`` = that axis's extent): the fast stage of the
+    hierarchical shuffle is exactly this kernel restricted to the fast
+    axis."""
+    spec = P(spec_axes if spec_axes is not None else axis)
 
     def kernel(pid_blk, leaves):
         cap = pid_blk.shape[0]
@@ -292,8 +327,8 @@ def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
         return newcount[None], tuple(outs)
 
     f = shard_map(kernel, mesh=mesh,
-                  in_specs=(P(axis), P(axis)),
-                  out_specs=(P(axis), P(axis)))
+                  in_specs=(spec, spec),
+                  out_specs=(spec, spec))
     return jax.jit(f)
 
 
@@ -309,14 +344,21 @@ def _exchange_fn(mesh, axis: str, nparts: int, block: int, outcap: int):
 
 @kernel_factory
 def _ring_exchange_fn(mesh, axis: str, nparts: int, block: int,
-                      outcap: int):
+                      outcap: int, spec_axes=None):
     """Staged ring exchange: P−1 ``lax.ppermute`` rounds, round r moving
     each shard's whole (me → me+r) cell as ONE [block] buffer — the
     collective-permute decomposition of arXiv:2112.01075.  Only one
     send + one receive block live per round (vs the all_to_all's
     [P, block] pair), so the transient is ``2·block`` rows — the shape
     the cost model prices as ``ring``.  Received rows scatter straight
-    into the result block at the running offset; own rows land first."""
+    into the result block at the running offset; own rows land first.
+
+    ``spec_axes``: as in :func:`_exchange_fn` — shard over the full
+    2-level mesh, permute only over ``axis`` (``nparts`` = that axis's
+    extent); the slow stage of the hierarchical shuffle is this ring
+    restricted to the slow axis, fed pids already rewritten to
+    slow-axis coordinates."""
+    spec = P(spec_axes if spec_axes is not None else axis)
 
     def kernel(pid_blk, leaves):
         me = jax.lax.axis_index(axis)
@@ -375,8 +417,8 @@ def _ring_exchange_fn(mesh, axis: str, nparts: int, block: int,
         return total[None], tuple(outs)
 
     f = shard_map(kernel, mesh=mesh,
-                  in_specs=(P(axis), P(axis)),
-                  out_specs=(P(axis), P(axis)))
+                  in_specs=(spec, spec),
+                  out_specs=(spec, spec))
     return jax.jit(f)
 
 
@@ -455,16 +497,22 @@ def _staged_exchange(ctx, pid, leaves, choice, outcap_total: int):
     return list(outs), newcounts, outcap_total
 
 
-def _note_choice(choice, reason: str) -> None:
+def _note_choice(choice, reason: str, nparts=None) -> None:
     """Record one chooser decision: the per-strategy tally counter +
     the plan annotation (static EXPLAIN and ANALYZE both render it —
     docs/query_planner.md "annotation surface").  Annotations APPEND:
     an op that runs several exchanges (a shuffle join co-partitions
     both sides under one node) keeps every choice, not just the
-    last."""
+    last.  When the chooser priced a (slow, fast) split the choice
+    carries a per-device ``slow_wire_bytes``; with ``nparts`` that
+    tallies the mesh-wide ``shuffle.bytes_sent_slow`` — the number the
+    hierarchy smoke and the scaling bench compare across lowerings."""
     from ..analysis import plan_check
     from ..resilience import note_strategy_choice
     trace.count(cost.strategy_counter(choice.strategy))
+    if nparts is not None and choice.slow_wire_bytes:
+        trace.count("shuffle.bytes_sent_slow",
+                    int(choice.slow_wire_bytes) * int(nparts))
     # the recovery driver's per-attempt record: a resource-classed
     # failure demotes the chooser off whatever was picked here
     note_strategy_choice(choice.strategy)
@@ -706,6 +754,225 @@ def _fold_combine_fn(mesh, axis: str, spec, incap: int, acc_cap: int,
         f = shard_map(kernel, mesh=mesh,
                       in_specs=(P(axis),) * 4, out_specs=(P(axis), P(axis)))
     return jax.jit(f)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical lowerings (docs/tpu_perf_notes.md "Hierarchical
+# collectives"): the two-level decomposition of one redistribution over
+# a (slow, fast) mesh split.  Stage 1 is the single-shot all_to_all
+# kernel restricted to the FAST axis (every row moves to the device in
+# its own slow group whose fast coordinate matches the target's), so
+# the slow edge then carries each row AT MOST ONCE — stage 2 is the
+# ring restricted to the SLOW axis.  The fused-aggregation variant
+# folds stage 1's landing by (keys, target pid) BEFORE the slow stage,
+# so only per-group partials ever cross the expensive edge.
+# ---------------------------------------------------------------------------
+
+@kernel_factory
+def _fast_targets_fn(nparts: int, fast: int):
+    """pid → stage-1 target: the FAST coordinate of the final owner.
+    Padding rows (pid == nparts) map to the drop lane ``fast`` — NOT
+    ``nparts % fast``, which would alias a real fast coordinate and
+    ship padding over the wire.  Elementwise, so plain jit over the
+    sharded pid lane (no collective, no axis name)."""
+
+    def kernel(pid):
+        return jnp.where(pid < nparts, pid % fast,
+                         jnp.int32(fast)).astype(jnp.int32)
+
+    return jax.jit(kernel)
+
+
+@kernel_factory
+def _stage2_pids_fn(mesh, spec_axes, nparts: int, fast: int, nslow: int,
+                    incap: int):
+    """Stage-1 landing pids → stage-2 targets (the SLOW coordinate of
+    the final owner).  Rows past the landing count and padding pids map
+    to the drop lane ``nslow``."""
+    spec = P(spec_axes)
+
+    def kernel(cnt_blk, pid_blk):
+        valid = jnp.arange(incap, dtype=jnp.int32) < cnt_blk[0]
+        return jnp.where(valid & (pid_blk < nparts), pid_blk // fast,
+                         jnp.int32(nslow)).astype(jnp.int32)
+
+    f = shard_map(kernel, mesh=mesh, in_specs=(spec, spec),
+                  out_specs=spec)
+    return jax.jit(f)
+
+
+@kernel_factory
+def _slow_counts_fn(mesh, spec_axes, slow_axis: str, fast_axis: str,
+                    nparts: int, fast: int, nslow: int, incap: int):
+    """Per-device histogram of stage-2 targets, replicated — the count
+    protocol of the slow stage.  Gathered over (slow, fast) in that
+    order so the flattened leading dim IS the flat device id
+    (p = s·F + f); the host reads one [P, S] matrix and sizes every
+    ring round exactly."""
+    spec = P(spec_axes)
+
+    def kernel(cnt_blk, pid_blk):
+        valid = jnp.arange(incap, dtype=jnp.int32) < cnt_blk[0]
+        ts = jnp.where(valid & (pid_blk < nparts), pid_blk // fast,
+                       jnp.int32(nslow))
+        c = jnp.bincount(ts, length=nslow + 1)[:nslow].astype(jnp.int32)
+        return jax.lax.all_gather(c, (slow_axis, fast_axis))
+
+    # check_vma=False: the all_gather replicates the output, which
+    # shard_map cannot statically infer (same note as _counts_fn)
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec),
+                             out_specs=P(), check_vma=False))
+
+
+@kernel_factory
+def _slow_cell_fn(mesh, spec_axes, slow_axis: str, nparts: int, fast: int,
+                  nslow: int, r: int, block: int, incap: int):
+    """One slow-ring round of the hierarchical COMBINE path: each
+    device selects its post-combine rows destined to slow group
+    (me + r) % S, compacts them into a [block] cell, and (r > 0)
+    ppermutes the cell r hops around the slow axis.  Round 0 moves
+    nothing over the wire — own-group rows feed the first fold
+    directly, which is why the cross-only ``block`` prices the wire.
+    Returns (received count, received leaves) for the receiver-side
+    fold; one send + one receive cell live at a time."""
+    spec = P(spec_axes)
+
+    def kernel(cnt_blk, pid_blk, leaves):
+        me = jax.lax.axis_index(slow_axis)
+        valid_row = jnp.arange(incap, dtype=jnp.int32) < cnt_blk[0]
+        ts = jnp.where(valid_row & (pid_blk < nparts), pid_blk // fast,
+                       jnp.int32(nslow))
+        sel = ts == ((me + r) % nslow)
+        idx = ops_compact.compact_indices(sel, block, fill=0)
+        cnt = jnp.sum(sel).astype(jnp.int32)
+        valid = jnp.arange(block, dtype=jnp.int32) < cnt
+        outs = []
+        if r == 0:
+            rcnt = cnt
+            for lf in leaves:
+                C = jnp.take(lf, idx, axis=0)
+                outs.append(jnp.where(_bcast(valid, C), C,
+                                      jnp.zeros((), C.dtype)))
+        else:
+            perm = [(i, (i + r) % nslow) for i in range(nslow)]
+            rcnt = jax.lax.ppermute(cnt[None], slow_axis, perm)[0]
+            rvalid = jnp.arange(block, dtype=jnp.int32) < rcnt
+            for lf in leaves:
+                as_bool = lf.dtype == jnp.bool_
+                x = lf.astype(jnp.uint8) if as_bool else lf
+                S = jnp.take(x, idx, axis=0)
+                S = jnp.where(_bcast(valid, S), S, jnp.zeros((), S.dtype))
+                R = jax.lax.ppermute(S, slow_axis, perm)
+                R = jnp.where(_bcast(rvalid, R), R, jnp.zeros((), R.dtype))
+                outs.append(R.astype(jnp.bool_) if as_bool else R)
+        return rcnt[None], tuple(outs)
+
+    f = shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
+                  out_specs=(spec, spec))
+    return jax.jit(f)
+
+
+def _hierarchical_exchange(ctx, pid, leaves, counts: np.ndarray,
+                           rbytes: int, outcap_total: int, choice,
+                           combine=None):
+    """Dispatch one two-level exchange (strategy ``hierarchical`` /
+    ``hierarchical-combine``; priced by cost.price_hierarchical /
+    price_hier_combine, sized by cost.hier_plan from the SAME count
+    matrix).  Same ``(leaves, counts, outcap)`` contract as every other
+    lowering; rows come back identical up to intra-shard order.
+
+    Plain path: fast-stage all_to_all (pid rides as an extra int32
+    lane), then a slow-stage ring keyed on ``pid // F``.  Combine path
+    (``combine`` = the fold spec): stage 1's landing is folded by
+    (keys, pid) BEFORE the slow axis — the pid is a hash of the keys,
+    so adding it as a key slot changes nothing about the grouping — and
+    each slow-ring round's received cell folds into the accumulator, so
+    the slow edge only ever carries per-group partials
+    (``groupby.axis_precombine_rows`` is the exact row count)."""
+    from ..context import MESH_FAST_AXIS, MESH_SLOW_AXIS
+    Pn = ctx.get_world_size()
+    S, F, block1, outcap1, block2, _outcap_ss = choice.sizes
+    mesh2 = ctx.mesh2d((S, F))
+    axes = (MESH_SLOW_AXIS, MESH_FAST_AXIS)
+    trace.count_max("shuffle.exchange_bytes_peak", choice.peak_bytes)
+    dm0 = _devmem_before(ctx)
+    t0 = time.perf_counter()
+    try:
+        with trace.span_sync("shuffle.exchange") as sp:
+            tf = _fast_targets_fn(Pn, F)(pid)
+            cnt1, outs1 = _watchdog_dispatch(
+                "shuffle.exchange",
+                lambda: _exchange_fn(mesh2, MESH_FAST_AXIS, F, block1,
+                                     outcap1, axes)(
+                    tf, tuple(leaves) + (pid,)))
+            pid_idx = len(leaves)
+            if combine is None:
+                pid2 = _stage2_pids_fn(mesh2, axes, Pn, F, S,
+                                       outcap1)(cnt1, outs1[pid_idx])
+                cnt2, outs2 = _watchdog_dispatch(
+                    "shuffle.exchange",
+                    lambda: _ring_exchange_fn(mesh2, MESH_SLOW_AXIS, S,
+                                              block2, outcap_total,
+                                              axes)(pid2, outs1))
+                sp.sync(outs2)
+                return list(outs2[:pid_idx]), cnt2, outcap_total
+            # combine path: axis-local pre-combine, then per-round folds
+            trace.count("groupby.axis_precombine")
+            key_slots, val_slots = combine
+            spec2 = (tuple(key_slots) + ((pid_idx, None),),
+                     tuple(val_slots))
+            ngc, comb = _fold_combine_fn(mesh2, axes, spec2, outcap1,
+                                         0, outcap1, True)(cnt1, outs1)
+            trace.count("shuffle.fold_combined")
+            c2c = np.asarray(ops_compact._read_counts(
+                _slow_counts_fn(mesh2, axes, MESH_SLOW_AXIS,
+                                MESH_FAST_AXIS, Pn, F, S,
+                                outcap1)(ngc, comb[pid_idx])))
+            c2c = c2c.reshape(Pn, S)
+            slow_of = np.arange(Pn) // F
+            fast_of = np.arange(Pn) % F
+            cross = c2c.copy()
+            cross[np.arange(Pn), slow_of] = 0
+            moved_slow = int(cross.sum())
+            trace.count("shuffle.rows_sent_slow", moved_slow)
+            trace.count("groupby.axis_precombine_rows", moved_slow)
+            block_own = ops_compact.next_bucket(
+                max(int(c2c[np.arange(Pn), slow_of].max(initial=0)), 1),
+                minimum=8)
+            block_x = ops_compact.next_bucket(
+                max(int(cross.max(initial=0)), 1), minimum=8)
+            per_recv = np.zeros((Pn,), np.int64)
+            acc = None
+            acc_cnt = None
+            acc_cap = 0
+            for r in range(S):
+                blk_r = block_own if r == 0 else block_x
+                src = ((slow_of - r) % S) * F + fast_of
+                per_recv += c2c[src, slow_of]
+                out_cap = ops_compact.next_bucket(
+                    max(int(per_recv.max(initial=0)), 1), minimum=8)
+                rcnt, cells = _watchdog_dispatch(
+                    "shuffle.exchange",
+                    lambda blk=blk_r, rr=r: _slow_cell_fn(
+                        mesh2, axes, MESH_SLOW_AXIS, Pn, F, S, rr, blk,
+                        outcap1)(ngc, comb[pid_idx], tuple(comb)))
+                if r == 0:
+                    acc_cnt, acc = _fold_combine_fn(
+                        mesh2, axes, spec2, blk_r, 0, out_cap,
+                        True)(rcnt, cells)
+                else:
+                    acc_cnt, acc = _fold_combine_fn(
+                        mesh2, axes, spec2, blk_r, acc_cap, out_cap,
+                        False)(acc_cnt, rcnt, acc, cells)
+                trace.count("shuffle.fold_combined")
+                trace.count_max("shuffle.exchange_bytes_peak",
+                                choice.peak_bytes
+                                + (acc_cap + out_cap) * rbytes)
+                acc_cap = out_cap
+            sp.sync(acc)
+            return list(acc[:pid_idx]), acc_cnt, acc_cap
+    finally:
+        _note_exchange_ms(ctx, choice, t0, dm0)
 
 
 # The chunk math (rounds, C, block, outcap_round) lives in the shared
@@ -975,6 +1242,7 @@ def _choose(Pn: int, cap: int, counts: np.ndarray, rbytes: int,
     forced = exchange_strategy()
     profile = meshprobe.get_profile(ctx) if ctx is not None else None
     measured = cost_measured_enabled() and profile is not None
+    split = _axis_split_of(ctx) if ctx is not None else None
     # the escalation ladder's replan arm (docs/robustness.md): inside a
     # demoted recovery attempt the cheapest catalogue strategies are
     # excluded — the lowering that just failed must not be re-picked
@@ -985,14 +1253,18 @@ def _choose(Pn: int, cap: int, counts: np.ndarray, rbytes: int,
         # so the common under-budget exchange never pays the chunk-plan
         # halving loop or the staged pricing.  (Measured ranking must
         # NOT take it: the measurement may disagree with the proxy —
-        # that disagreement is the point of the A/B.)
+        # that disagreement is the point of the A/B.  The per-edge
+        # measured ranking is ALSO where a hierarchical lowering can
+        # genuinely win, so it never short-circuits here.)
         block, outcap, _ = cost.exchange_sizes(counts)
         ss = cost.price_single_shot(Pn, block, outcap, rbytes)
         if ss.peak_bytes <= budget:
+            ss = cost.slow_share(ss, Pn, split)
             return ss, f"{ss.describe()} <= budget {budget} B", True
     cands = cost.enumerate_strategies(Pn, cap, counts, rbytes, budget,
                                       staged_ok=combine is None,
-                                      spill_ok=spill_enabled())
+                                      spill_ok=spill_enabled(),
+                                      split=split)
     return cost.choose(cands, budget, forced, profile=profile,
                        measured=measured, exclude=exclude)
 
@@ -1048,6 +1320,10 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
     from .. import observe, resilience
     from ..analysis._abstract import is_abstract
     rbytes = max(observe.row_bytes(leaves), 1)
+    # the (slow, fast) mesh factorization, resolved ONCE per exchange
+    # from the LIVE context (a degraded survivor mesh re-resolves and
+    # re-prices): trivial split → flat accounting, no hierarchy priced
+    split = _axis_split_of(ctx)
     with trace.span("shuffle.counts"):
         cnt_dev = _counts_fn(mesh, axis, Pn)(pid)  # async dispatch
     # abstract plan runs (analysis/plan_check) price from zeroed counts
@@ -1071,7 +1347,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
         # post() sees the count matrix in immediate mode AND at the
         # deferred flush, so bench pipelines (run_pipeline) tally the
         # same rows/bytes a blocking run would (docs/observability.md)
-        _account(counts, rbytes, combine, owner)
+        _account(counts, rbytes, combine, owner, split=split)
         block, outcap, per_recv = _sizes_from_counts(counts)
         # Skew cliff: EVERY shard's receive block is sized to the HOTTEST
         # receiver (XLA collectives are ragged-free — uniform shapes or
@@ -1106,7 +1382,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
         choice, reason, _ = _choose(Pn, cap, counts, rbytes,
                                     budget, combine, ctx=ctx)
         if choice.strategy == cost.SINGLE_SHOT:
-            _note_choice(choice, reason)
+            _note_choice(choice, reason, nparts=Pn)
             return need
         _mark_degraded(hint_key)
         if ops_compact.in_flush():
@@ -1140,13 +1416,13 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
             counts = np.asarray(vals[0])
         else:
             counts = ops_compact._read_counts(cnt_dev)
-        _account(counts, rbytes, combine, owner)
+        _account(counts, rbytes, combine, owner, split=split)
         block, outcap, per_recv = _sizes_from_counts(counts)
         _warn_skew(Pn, hint_key, per_recv, outcap)
         need = (block, outcap)
         choice, reason, _ = _choose(Pn, cap, counts, rbytes,
                                     budget, combine, ctx=ctx)
-        _note_choice(choice, reason)
+        _note_choice(choice, reason, nparts=Pn)
         if choice.strategy == cost.SINGLE_SHOT:
             # this call prices back under budget (the data shrank):
             # promote to the single-shot path and reseed the optimism
@@ -1170,6 +1446,10 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
             return _staged_spill_exchange(ctx, pid, leaves, counts,
                                           rbytes, budget, outcap,
                                           choice, combine)
+        if choice.strategy in (cost.HIERARCHICAL, cost.HIER_COMBINE):
+            return _hierarchical_exchange(ctx, pid, leaves, counts,
+                                          rbytes, outcap, choice,
+                                          combine)
         return _staged_exchange(ctx, pid, leaves, choice, outcap)
 
     try:
@@ -1184,7 +1464,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
         # the hinted dispatch (if any) was launched before the counts
         # came back — its result is discarded; the chosen degraded
         # strategy recovers from the counts the exception carries
-        _note_choice(ob.choice, ob.reason)
+        _note_choice(ob.choice, ob.reason, nparts=Pn)
         if ob.choice.strategy == cost.CHUNKED:
             return _chunked_exchange(ctx, pid, leaves, ob.counts, rbytes,
                                      budget, ob.need[1], combine,
@@ -1194,6 +1474,10 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
             return _staged_spill_exchange(ctx, pid, leaves, ob.counts,
                                           rbytes, budget, ob.need[1],
                                           ob.choice, combine)
+        if ob.choice.strategy in (cost.HIERARCHICAL, cost.HIER_COMBINE):
+            return _hierarchical_exchange(ctx, pid, leaves, ob.counts,
+                                          rbytes, ob.need[1], ob.choice,
+                                          combine)
         return _staged_exchange(ctx, pid, leaves, ob.choice, ob.need[1])
     if budget is not None:
         trace.count_max("shuffle.exchange_bytes_peak",

@@ -415,9 +415,12 @@ def fingerprint(root: Node) -> Tuple:
 def _config_fingerprint(ctx) -> Tuple:
     import jax
 
-    from ..config import broadcast_join_threshold
+    from ..config import broadcast_join_threshold, mesh_shape
+    # mesh_shape participates: a changed (slow, fast) split re-prices
+    # the exchange lowerings (hierarchical vs flat), so a cached plan
+    # compiled under one factorization must not serve another
     return (ctx.mesh, ctx.get_world_size(), broadcast_join_threshold(),
-            bool(jax.config.jax_enable_x64))
+            mesh_shape(), bool(jax.config.jax_enable_x64))
 
 
 # root fingerprint -> _Entry.  Bounded LRU (capacity from

@@ -32,7 +32,8 @@ from typing import Dict, Optional, Tuple
 
 from . import trace
 
-__all__ = ["effective", "mark_lost", "epoch", "degraded", "reset"]
+__all__ = ["effective", "mark_lost", "epoch", "degraded", "reset",
+           "axis_split"]
 
 # id(ctx) -> (ctx, survivor_ctx): the value pins BOTH contexts so an
 # id() key can never be reused by the garbage collector while mapped.
@@ -100,6 +101,42 @@ def mark_lost(ctx, lost: int = 1):
     flightrec.note("mesh_degraded", lost=lost_eff, world=world,
                    survivor_world=len(survivors), epoch=_epoch)
     return new_ctx
+
+
+def axis_split(ctx) -> Tuple[int, int]:
+    """The ``(slow, fast)`` factorization of ``ctx``'s mesh (docs/
+    tpu_perf_notes.md "Hierarchical collectives").
+
+    Resolution: explicit ``config.set_mesh_shape`` / ``CYLON_MESH_SHAPE``
+    first; else the platform's host grouping (equal per-process device
+    counts over >1 process → ``(hosts, devices_per_host)``); else the
+    flat ``(1, world)``.  A configured shape that no longer tiles the
+    (possibly degraded) world keeps its FAST extent when that still
+    divides — losing a host shrinks the slow axis, not the intra-host
+    one — and otherwise degrades to flat.  Total: always returns a
+    valid factorization of the live world size, so a remesh onto
+    survivors automatically re-prices the hierarchy (a trivial split
+    simply stops enumerating the hierarchical lowerings)."""
+    from . import config
+    world = int(ctx.get_world_size())
+    if world <= 0:
+        return (1, 1)
+    shape = config.mesh_shape()
+    if shape is None:
+        groups: Dict[int, int] = {}
+        for d in ctx.devices:
+            p = int(getattr(d, "process_index", 0) or 0)
+            groups[p] = groups.get(p, 0) + 1
+        counts = list(groups.values())
+        if len(counts) > 1 and len(set(counts)) == 1:
+            return (len(counts), counts[0])
+        return (1, world)
+    slow, fast = shape
+    if slow * fast == world:
+        return (slow, fast)
+    if fast > 1 and world % fast == 0:
+        return (world // fast, fast)
+    return (1, world)
 
 
 def reset() -> None:

@@ -13,7 +13,12 @@ on a real v5e slice, point JAX_PLATFORMS at tpu and drop the flag).
     python experiments/run_scaling.py -s w -r 0.1 -w 1 2 4 8 --reps 2
 
 Writes one CSV (world, rows_per_worker, rep, j_t_ms, exchanged_rows,
-exchanged_mb, collectives) and prints a summary.
+exchanged_mb, collectives) under ``experiments/`` and prints a summary.
+This harness is exploratory; the regression-gated scaling curve —
+``scaling_*_qps/_ms/_wire_bytes`` per world size plus the fitted
+``scaling_efficiency_slope`` — is emitted by ``bench.py``'s scaling
+stage into the bench artifact and diffed by
+``cylon_tpu/analysis/benchdiff.py``.
 
 **What constitutes a scaling signal here** (VERDICT r2 weak #4): virtual
 devices oversubscribe the host's cores, so wall-clock j_t vs W measures
@@ -126,7 +131,8 @@ def main() -> int:
     p.add_argument("-w", dest="world", type=int, nargs="+",
                    default=[1, 2, 4, 8])
     p.add_argument("--reps", type=int, default=2)
-    p.add_argument("-o", dest="out", default="scaling_results.csv")
+    p.add_argument("-o", dest="out",
+                   default="experiments/scaling_results.csv")
     args = p.parse_args()
 
     rows_m = int(args.rows * 1_000_000)

@@ -1,0 +1,462 @@
+"""Topology-aware hierarchical collectives (ISSUE 16; topology.py,
+parallel/cost.py, parallel/shuffle.py, parallel/meshprobe.py,
+docs/tpu_perf_notes.md "Hierarchical collectives").
+
+The acceptance contract:
+
+  * ``topology.axis_split`` resolves an explicit (slow, fast) mesh
+    factorization (knob > ``CYLON_MESH_SHAPE`` env > platform
+    grouping > flat) and re-resolves it on a degraded mesh;
+  * both hierarchical lowerings — the two-level shuffle and the
+    fused-groupby hierarchical-combine — are row-identical to the
+    single-shot exchange across int / dict-string / null / composite
+    keys (bool and validity lanes ride along);
+  * under a measured per-edge profile with a slow cross-host boundary,
+    the chooser SELECTS the hierarchy for a skewed cross-slow-axis
+    exchange — no forcing — with strictly fewer slow-axis wire bytes
+    than the flat price;
+  * the fused-groupby pre-combine moves EXACTLY one partial per group
+    per non-resident slow block across the slow axis;
+  * a remesh onto survivors re-prices the split: trivial splits stop
+    enumerating the hierarchy and flat strategies stay feasible.
+"""
+import dataclasses
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cylon_tpu import Table, config, topology, trace
+from cylon_tpu.parallel import (DTable, cost, dist_groupby,
+                                dist_groupby_fused, meshprobe,
+                                shuffle_table)
+from cylon_tpu.parallel import shuffle as shmod
+from cylon_tpu.status import CylonError
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    """Counter-only tracing + teardown of every lever this suite pulls:
+    the mesh-shape knob, forced strategies, the injected per-edge
+    profile, the topology registry, and chooser chunk state."""
+    monkeypatch.delenv("CYLON_MESH_SHAPE", raising=False)
+    trace.enable_counters()
+    trace.reset()
+    yield
+    trace.disable_counters()
+    trace.reset()
+    config.set_mesh_shape(None)
+    config.set_cost_measured(None)
+    config.set_exchange_strategy(None)
+    meshprobe.clear_profiles()
+    topology.reset()
+    shmod.clear_chunk_state()
+
+
+def _mixed_key_frame(n=6000, seed=11):
+    """int / dict-string / nullable / composite key coverage in one
+    frame — the same flavors test_redistribution.py holds the flat
+    lowerings to."""
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ki": rng.integers(0, 50, n).astype(np.int32),
+        "ks": pd.Categorical.from_codes(
+            rng.integers(0, 7, n), categories=list("abcdefg")),
+        "kn": pd.array(np.where(np.arange(n) % 17 == 0, None,
+                                rng.integers(0, 9, n)), dtype="Int64"),
+        "v": rng.random(n, dtype=np.float32),
+        "b": (rng.integers(0, 2, n) == 1),
+    })
+
+
+def _sorted_frame(dt: DTable) -> pd.DataFrame:
+    df = dt.to_table().to_pandas()
+    for c in df.columns:
+        if isinstance(df[c].dtype, pd.CategoricalDtype):
+            df[c] = df[c].astype(str)
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def _install_steep_profile(dctx):
+    """Inject a synthetic per-edge profile — fast edges 1 GB/s / 1 us,
+    slow edges 1 MB/s / 100 us — so chooser tests are deterministic
+    regardless of host jitter (the suite tests the CHOOSER, not the
+    probe)."""
+    prof = meshprobe.probe(dctx)
+    lat = dict(prof.latency_s)
+    bw = dict(prof.bytes_per_s)
+    for coll in ("all_to_all", "ppermute", "all_gather"):
+        lat[coll + "@fast"] = 1e-6
+        bw[coll + "@fast"] = 1e9
+        lat[coll + "@slow"] = 1e-4
+        bw[coll + "@slow"] = 1e6
+    meshprobe.put_profile(dataclasses.replace(
+        prof, latency_s=lat, bytes_per_s=bw))
+
+
+def _skewed_exchange(dctx, cap=2048):
+    """Every row on device d targets (d+4)%8: all traffic crosses the
+    slow axis of a (2, 4) split, concentrated on ONE peer per sender —
+    the pattern where flat all_to_all pads every [P, block] cell to
+    the hot cell while the hierarchy aggregates into one cell."""
+    Pn = dctx.get_world_size()
+    pid_np = np.repeat((np.arange(Pn) + 4) % Pn, cap).astype(np.int32)
+    vals = np.arange(Pn * cap, dtype=np.int32)
+    sh = dctx.sharding()
+    pid = jax.device_put(jnp.asarray(pid_np), sh)
+    leaves = (jax.device_put(jnp.asarray(vals), sh),)
+    return pid, leaves
+
+
+def _rowset(dctx, pid, leaves, force):
+    prev = config.set_exchange_strategy(force)
+    shmod.clear_chunk_state()
+    trace.reset()
+    try:
+        outs, cnts, oc = shmod.shuffle_leaves(dctx, pid, leaves)
+    finally:
+        config.set_exchange_strategy(prev)
+    cn = np.asarray(jax.device_get(cnts))
+    buf = np.asarray(jax.device_get(outs[0]))
+    rows = [sorted(buf[d * oc:d * oc + int(cn[d])].tolist())
+            for d in range(dctx.get_world_size())]
+    return rows, dict(trace.counters())
+
+
+# ---------------------------------------------------------------------------
+# (slow, fast) resolution: knob, env, platform fallback, degraded math
+# ---------------------------------------------------------------------------
+
+def test_axis_split_explicit_knob(dctx):
+    prev = config.set_mesh_shape((2, 4))
+    try:
+        assert topology.axis_split(dctx) == (2, 4)
+    finally:
+        config.set_mesh_shape(prev)
+
+
+def test_axis_split_env_resolution(dctx, monkeypatch):
+    monkeypatch.setenv("CYLON_MESH_SHAPE", "4x2")
+    assert topology.axis_split(dctx) == (4, 2)
+    monkeypatch.setenv("CYLON_MESH_SHAPE", "bogus")
+    with pytest.raises(CylonError):
+        topology.axis_split(dctx)
+
+
+def test_axis_split_platform_fallback_is_flat(dctx):
+    # single-process virtual CPU devices: no host grouping to exploit
+    assert topology.axis_split(dctx) == (1, 8)
+
+
+def test_axis_split_nontiling_shapes(dctx):
+    # (3, 3) cannot tile 8 and 3 does not divide it: degrade to flat
+    prev = config.set_mesh_shape((3, 3))
+    try:
+        assert topology.axis_split(dctx) == (1, 8)
+        # (2, 2): the FAST extent still divides 8, so the slow axis
+        # absorbs the difference — intra-host locality is preserved
+        config.set_mesh_shape((2, 2))
+        assert topology.axis_split(dctx) == (4, 2)
+    finally:
+        config.set_mesh_shape(prev)
+
+
+def test_mesh_shape_knob_validation():
+    with pytest.raises(CylonError):
+        config.set_mesh_shape((0, 4))
+    with pytest.raises(CylonError):
+        config.set_mesh_shape((2, 4, 1))
+    with pytest.raises(CylonError):
+        config.set_mesh_shape("2x4")
+
+
+def test_mesh2d_tiles_or_raises(dctx):
+    m = dctx.mesh2d((2, 4))
+    assert m.devices.shape == (2, 4)
+    # row-major reshape of the SAME flat device list: flat p = s*F + f
+    assert list(m.devices.reshape(-1)) == dctx.devices
+    with pytest.raises(CylonError):
+        dctx.mesh2d((3, 3))
+
+
+def test_degraded_mesh_reprices_the_split(dctx):
+    """Losing 4 of 8 devices under a configured (2, 4) shape leaves a
+    world the slow axis cannot span: the split re-resolves to the flat
+    (1, 4) — the hierarchy silently stops being enumerable instead of
+    lowering onto devices that no longer exist."""
+    prev = config.set_mesh_shape((2, 4))
+    try:
+        survivor = topology.mark_lost(dctx, 4)
+        assert survivor.get_world_size() == 4
+        assert topology.axis_split(survivor) == (1, 4)
+        # losing ONE host's worth keeps the fast extent: 8 -> (2,4),
+        # a 6-survivor world with fast=3 configured keeps fast
+        config.set_mesh_shape((2, 3))
+        assert topology.axis_split(survivor) == (1, 4)  # 3 !| 4 -> flat
+    finally:
+        config.set_mesh_shape(prev)
+        topology.reset()
+
+
+# ---------------------------------------------------------------------------
+# pricing: per-edge model, slow-share decoration, enumeration gating
+# ---------------------------------------------------------------------------
+
+def test_enumeration_gated_on_split():
+    counts = np.full((8, 8), 64, dtype=np.int64)
+    flat = cost.enumerate_strategies(8, 512, counts, 8, 1 << 30)
+    assert all(p.strategy != cost.HIERARCHICAL for p in flat)
+    hier = cost.enumerate_strategies(8, 512, counts, 8, 1 << 30,
+                                     split=(2, 4))
+    assert any(p.strategy == cost.HIERARCHICAL for p in hier)
+    # the fold-combine path enumerates the combine spelling instead
+    comb = cost.enumerate_strategies(8, 512, counts, 8, 1 << 30,
+                                     staged_ok=False, split=(2, 4))
+    assert any(p.strategy == cost.HIER_COMBINE for p in comb)
+    assert all(p.strategy != cost.HIERARCHICAL for p in comb)
+
+
+def test_slow_share_decoration():
+    p = cost.price_single_shot(8, 128, 1024, 8)
+    assert p.slow_wire_bytes == 0
+    d = cost.slow_share(p, 8, (2, 4))
+    # 4 of the 7 peers sit across the slow boundary
+    assert d.slow_wire_bytes == int(p.wire_bytes * 4 / 7)
+    assert cost.slow_share(p, 8, None).slow_wire_bytes == 0
+    assert cost.slow_share(p, 8, (1, 8)).slow_wire_bytes == 0
+    # idempotent: an already-decorated price keeps its share
+    assert cost.slow_share(d, 8, (2, 4)).slow_wire_bytes \
+        == d.slow_wire_bytes
+
+
+def test_hierarchical_price_crosses_slow_once_per_round():
+    counts = np.zeros((8, 8), dtype=np.int64)
+    counts[np.arange(8), (np.arange(8) + 4) % 8] = 1024
+    p = cost.price_hierarchical(8, (2, 4), counts, 8)
+    S = p.sizes[0]
+    block2 = p.sizes[4]
+    assert p.strategy == cost.HIERARCHICAL
+    assert p.rounds == S == 2
+    # one slow crossing per non-resident round, pid lane included
+    assert p.slow_wire_bytes == (S - 1) * block2 * (8 + 4)
+    assert 0 < p.slow_wire_bytes < p.wire_bytes
+
+
+def test_per_edge_predicted_ms_orders_the_skewed_exchange(dctx):
+    """Under a 1000x fast/slow bandwidth gap the per-edge model must
+    rank the hierarchy ahead of every flat lowering for the one-peer
+    cross-slow pattern — the decision the natural-selection test
+    observes end to end."""
+    _install_steep_profile(dctx)
+    prof = meshprobe.get_profile(dctx)
+    counts = np.zeros((8, 8), dtype=np.int64)
+    counts[np.arange(8), (np.arange(8) + 4) % 8] = 2048
+    cands = cost.enumerate_strategies(8, 2048, counts, 4, 1 << 30,
+                                      split=(2, 4))
+    priced = {p.strategy: cost.predicted_ms(p, prof) for p in cands}
+    assert priced[cost.HIERARCHICAL] is not None
+    for strat, ms in priced.items():
+        if strat != cost.HIERARCHICAL and ms is not None:
+            assert priced[cost.HIERARCHICAL] < ms, (strat, priced)
+
+
+def test_meshprobe_fits_per_axis_coefficients(dctx):
+    prev = config.set_mesh_shape((2, 4))
+    try:
+        meshprobe.clear_profiles()
+        trace.reset()
+        prof = meshprobe.probe(dctx)
+        assert prof.axis_split == (2, 4)
+        for coll in ("all_to_all", "ppermute"):
+            assert coll + "@fast" in prof.bytes_per_s, prof.bytes_per_s
+            assert coll + "@slow" in prof.bytes_per_s, prof.bytes_per_s
+        assert trace.counters().get("meshprobe.axis_probes", 0) >= 1
+    finally:
+        config.set_mesh_shape(prev)
+
+
+# ---------------------------------------------------------------------------
+# parity: both lowerings row-identical across the key matrix
+# ---------------------------------------------------------------------------
+
+def test_hierarchical_parity_mixed_keys(dctx):
+    """The forced two-level shuffle is row-identical to single-shot
+    across int / dict-string / null / composite keys."""
+    prev = config.set_mesh_shape((2, 4))
+    try:
+        df = _mixed_key_frame()
+        base = _sorted_frame(shuffle_table(
+            DTable.from_table(dctx, Table.from_pandas(dctx, df)),
+            ["ki", "ks", "kn"]))
+        trace.reset()
+        prev_f = config.set_exchange_strategy("hierarchical")
+        try:
+            out = shuffle_table(
+                DTable.from_table(dctx, Table.from_pandas(dctx, df)),
+                ["ki", "ks", "kn"])
+            c = trace.counters()
+        finally:
+            config.set_exchange_strategy(prev_f)
+            shmod.clear_chunk_state()
+        assert c.get("shuffle.strategy.hierarchical", 0) >= 1, c
+        pd.testing.assert_frame_equal(_sorted_frame(out), base)
+    finally:
+        config.set_mesh_shape(prev)
+
+
+def test_hier_combine_parity_mixed_keys(dctx):
+    """The forced hierarchical-combine fused groupby matches the plain
+    groupby across the same key matrix, aggregations included."""
+    prev = config.set_mesh_shape((2, 4))
+    try:
+        df = _mixed_key_frame()
+        dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+        aggs = [("v", "sum"), ("v", "count"), ("v", "max")]
+        want = _sorted_frame(dist_groupby(dt, ["ki", "ks", "kn"], aggs))
+        trace.reset()
+        prev_f = config.set_exchange_strategy("hierarchical-combine")
+        try:
+            got = _sorted_frame(dist_groupby_fused(
+                dt, ["ki", "ks", "kn"], aggs, mode="pre-aggregate"))
+            c = trace.counters()
+        finally:
+            config.set_exchange_strategy(prev_f)
+            shmod.clear_chunk_state()
+        assert c.get("shuffle.strategy.hierarchical_combine", 0) >= 1, c
+        assert c.get("groupby.axis_precombine", 0) >= 1, c
+        pd.testing.assert_frame_equal(got, want, check_dtype=False,
+                                      atol=1e-5, rtol=1e-5)
+    finally:
+        config.set_mesh_shape(prev)
+
+
+def test_hierarchical_parity_skewed_raw_exchange(dctx):
+    prev = config.set_mesh_shape((2, 4))
+    try:
+        pid, leaves = _skewed_exchange(dctx)
+        flat_rows, _ = _rowset(dctx, pid, leaves, "single-shot")
+        hier_rows, c = _rowset(dctx, pid, leaves, "hierarchical")
+        assert c.get("shuffle.strategy.hierarchical", 0) >= 1, c
+        assert hier_rows == flat_rows
+    finally:
+        config.set_mesh_shape(prev)
+
+
+# ---------------------------------------------------------------------------
+# natural selection + the measured slow-axis win
+# ---------------------------------------------------------------------------
+
+def test_hierarchy_selected_naturally_with_fewer_slow_bytes(dctx):
+    """The ISSUE 16 acceptance: under the per-edge model the chooser
+    itself (no forcing) picks the hierarchy for the skewed cross-slow
+    exchange, row-identical to single-shot, and the measured slow-axis
+    wire bytes land strictly below the flat slow-share price."""
+    prev = config.set_mesh_shape((2, 4))
+    prev_m = config.set_cost_measured(True)
+    try:
+        _install_steep_profile(dctx)
+        pid, leaves = _skewed_exchange(dctx)
+        flat_rows, flat_c = _rowset(dctx, pid, leaves, "single-shot")
+        nat_rows, nat_c = _rowset(dctx, pid, leaves, None)
+        assert nat_c.get("shuffle.strategy.hierarchical", 0) >= 1, nat_c
+        assert nat_rows == flat_rows
+        ns = nat_c.get("shuffle.bytes_sent_slow", 0)
+        fs = flat_c.get("shuffle.bytes_sent_slow", 0)
+        assert 0 < ns < fs, (ns, fs)
+        # the row-level tally agrees: under one-peer skew every row
+        # crosses the slow axis exactly once in both lowerings
+        assert nat_c.get("shuffle.rows_sent_slow", 0) \
+            == flat_c.get("shuffle.rows_sent_slow", 0) > 0
+    finally:
+        config.set_mesh_shape(prev)
+        config.set_cost_measured(prev_m)
+
+
+def test_uniform_traffic_keeps_single_shot(dctx):
+    """Under uniform all-peers traffic the hierarchy's extra hop (pid
+    lane + re-bucketing) does not pay: the chooser must keep the flat
+    single-shot even with the steep per-edge profile installed."""
+    prev = config.set_mesh_shape((2, 4))
+    prev_m = config.set_cost_measured(True)
+    try:
+        _install_steep_profile(dctx)
+        Pn = dctx.get_world_size()
+        pid_np = (np.arange(Pn * 2048) % Pn).astype(np.int32)
+        sh = dctx.sharding()
+        pid = jax.device_put(jnp.asarray(pid_np), sh)
+        leaves = (jax.device_put(
+            jnp.asarray(np.arange(Pn * 2048, dtype=np.int32)), sh),)
+        _, c = _rowset(dctx, pid, leaves, None)
+        assert c.get("shuffle.strategy.single_shot", 0) >= 1, c
+        assert c.get("shuffle.strategy.hierarchical", 0) == 0, c
+    finally:
+        config.set_mesh_shape(prev)
+        config.set_cost_measured(prev_m)
+
+
+# ---------------------------------------------------------------------------
+# the pre-combine byte contract + degraded-mesh execution
+# ---------------------------------------------------------------------------
+
+def test_precombine_moves_only_per_group_partials(dctx):
+    """Striped keys put every group on every device: the fused-groupby
+    pre-combine must move EXACTLY K*(S-1) partials across the slow
+    axis — one per group per non-resident slow block, independent of
+    the row count."""
+    prev = config.set_mesh_shape((2, 4))
+    try:
+        nkeys = 37
+        for n in (2960, 5920):
+            df = pd.DataFrame({
+                "k": (np.arange(n) % nkeys).astype(np.int32),
+                "v": np.arange(n, dtype=np.float32),
+            })
+            dt = DTable.from_table(dctx, Table.from_pandas(dctx, df))
+            want = _sorted_frame(dist_groupby(dt, ["k"], [("v", "sum")]))
+            trace.reset()
+            prev_f = config.set_exchange_strategy("hierarchical-combine")
+            shmod.clear_chunk_state()
+            try:
+                got = _sorted_frame(dist_groupby_fused(
+                    dt, ["k"], [("v", "sum")], mode="pre-aggregate"))
+                c = trace.counters()
+            finally:
+                config.set_exchange_strategy(prev_f)
+                shmod.clear_chunk_state()
+            assert c.get("groupby.axis_precombine_rows", 0) \
+                == nkeys * (2 - 1), (n, dict(c))
+            pd.testing.assert_frame_equal(got, want, check_dtype=False,
+                                          atol=1e-3, rtol=1e-5)
+    finally:
+        config.set_mesh_shape(prev)
+
+
+def test_remesh_falls_back_to_flat_strategies(dctx):
+    """After losing 4 of 8 devices under a configured (2, 4) shape the
+    re-resolved split is trivial: the chooser must keep serving the
+    same exchange through a FLAT strategy on the survivor mesh —
+    feasible, row-identical, and free of hierarchical counters."""
+    prev = config.set_mesh_shape((2, 4))
+    try:
+        df = _mixed_key_frame(n=2000)
+        base = _sorted_frame(shuffle_table(
+            DTable.from_table(dctx, Table.from_pandas(dctx, df)),
+            ["ki"]))
+        survivor = topology.mark_lost(dctx, 4)
+        assert topology.axis_split(survivor) == (1, 4)
+        trace.reset()
+        shmod.clear_chunk_state()
+        out = shuffle_table(
+            DTable.from_table(survivor, Table.from_pandas(survivor, df)),
+            ["ki"])
+        c = trace.counters()
+        assert c.get("shuffle.strategy.hierarchical", 0) == 0, c
+        assert c.get("shuffle.strategy.hierarchical_combine", 0) == 0, c
+        pd.testing.assert_frame_equal(_sorted_frame(out), base)
+    finally:
+        config.set_mesh_shape(prev)
+        topology.reset()
+        shmod.clear_chunk_state()

@@ -683,3 +683,66 @@ def test_benchdiff_gates_serve_p99_up(tmp_path, capsys):
     t_old = _artifact(tmp_path, "t_old.json", {"serve_p99_ms": 2.0})
     t_new = _artifact(tmp_path, "t_new.json", {"serve_p99_ms": 2.6})
     assert benchdiff.main([t_old, t_new]) == 0
+
+
+def test_hierarchy_metrics_catalogued():
+    """The hierarchical-collective counters are documented catalogue
+    entries (docs/tpu_perf_notes.md "Hierarchical collectives"; the
+    compliance sweeps reject uncatalogued bumps)."""
+    for name in ("shuffle.strategy.hierarchical",
+                 "shuffle.strategy.hierarchical_combine",
+                 "shuffle.rows_sent_slow", "shuffle.bytes_sent_slow",
+                 "groupby.axis_precombine",
+                 "groupby.axis_precombine_rows",
+                 "meshprobe.axis_probes"):
+        spec = observe.METRICS.get(name)
+        assert spec is not None, name
+        assert spec.kind == observe.COUNTER, name
+        assert spec.doc
+
+
+def test_benchdiff_gates_scaling_slope_down(tmp_path, capsys):
+    """scaling_efficiency_slope gates DOWN with an absolute 0.02
+    floor: the fitted weak-scaling efficiency curve steepening (more
+    negative slope) fails CI even when every per-world number stayed
+    within threshold; sub-floor wobble is noise."""
+    old = _artifact(tmp_path, "old.json",
+                    {"scaling_efficiency_slope": -0.10})
+    new = _artifact(tmp_path, "new.json",
+                    {"scaling_efficiency_slope": -0.30})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "scaling_efficiency_slope" in out and "REGRESSED" in out
+    # flattening (toward 0) is an improvement, never a regression
+    better = _artifact(tmp_path, "better.json",
+                       {"scaling_efficiency_slope": -0.02})
+    assert benchdiff.main([old, better]) == 0
+    # sub-floor wobble around the same slope: noise
+    t_old = _artifact(tmp_path, "t_old.json",
+                      {"scaling_efficiency_slope": -0.100})
+    t_new = _artifact(tmp_path, "t_new.json",
+                      {"scaling_efficiency_slope": -0.115})
+    assert benchdiff.main([t_old, t_new]) == 0
+
+
+def test_benchdiff_gates_scaling_slow_wire_bytes_up(tmp_path, capsys):
+    """scaling_*_wire_bytes_slow_wN gates UP with the bytes floor: a
+    lowering regression pushing more traffic across the slow axis at
+    any measured world size fails CI; sub-floor byte wobble passes and
+    the ungated fast-axis totals never gate."""
+    old = _artifact(tmp_path, "old.json",
+                    {"scaling_weak_join_wire_bytes_slow_w8": 1 << 20,
+                     "scaling_weak_join_wire_bytes_w8": 4 << 20})
+    new = _artifact(tmp_path, "new.json",
+                    {"scaling_weak_join_wire_bytes_slow_w8": 4 << 20,
+                     "scaling_weak_join_wire_bytes_w8": 4 << 20})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "scaling_weak_join_wire_bytes_slow_w8" in out
+    assert "REGRESSED" in out
+    # below the absolute bytes floor: noise, not a regression
+    t_old = _artifact(tmp_path, "t_old.json",
+                      {"scaling_strong_groupby_wire_bytes_slow_w4": 1000.0})
+    t_new = _artifact(tmp_path, "t_new.json",
+                      {"scaling_strong_groupby_wire_bytes_slow_w4": 9000.0})
+    assert benchdiff.main([t_old, t_new]) == 0

@@ -162,10 +162,11 @@ def test_sketch_over_spilled_input_merges_morsels(dctx, groups_df):
     trace.enable_counters()
     trace.reset()
     from cylon_tpu import config as cfg
-    # a few morsels exercise the merge as well as many would — and the
+    # two morsels exercise the merge as well as many would — and the
     # per-round kernel shapes this budget implies keep the test's wall
-    # time in seconds instead of minutes (64 rounds at 150 KB)
-    prev = cfg.set_device_memory_budget(600_000)
+    # time in seconds instead of minutes (8 morsels at 600 KB cost 5x
+    # the wall of 2 at this budget for identical merge coverage)
+    prev = cfg.set_device_memory_budget(2_000_000)
     try:
         out = _frame(dist_ops.dist_groupby_sketch(
             dt, ["g"], [("ids", "approx_distinct"),
