@@ -72,7 +72,15 @@ Stages:
      must hold parity, with the pre-combine moving exactly one partial
      per group across the slow axis
      (``--no-hierarchy-smoke`` skips; auto-skips below 8 devices);
- 10. **benchdiff** (only when ``--baseline`` and a candidate artifact
+ 10. **concurrency smoke** (docs/static_analysis.md "Concurrency
+     discipline"): the two concurrency rules
+     (``shared-state-unguarded`` / ``blocking-call-under-lock``) must
+     hold the tree at ZERO findings, a deterministic AB/BA lock-order
+     inversion must be caught as a typed ``LockOrderViolation`` under
+     ``CYLON_LOCKCHECK`` enforcement — BEFORE any thread blocks — and
+     an 8-client serving window must run green with enforcement live
+     suite-wide (``--no-lockcheck-smoke`` skips);
+ 11. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
      including the serving families (``serve_qps``/``serve_sustain_qps``
      down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up), the
@@ -108,14 +116,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/10: graftlint ==")
+    print("== ci stage 1/11: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/10: plan_check pre-flight ==")
+    print("== ci stage 2/11: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -176,7 +184,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/10: serving smoke ==")
+    print("== ci stage 3/11: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -299,7 +307,7 @@ def _stage_telemetry_smoke(sf: float) -> int:
     CONTRACTS rather than the numbers: sampler non-empty, catalogue
     compliance, export validity (one track per query trace id), stats
     store populated with per-node observations."""
-    print("== ci stage 4/10: telemetry smoke ==")
+    print("== ci stage 4/11: telemetry smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -421,7 +429,7 @@ def _stage_doctor_smoke(sf: float) -> int:
     post-mortem machinery end to end: the victim fails onto its own
     handle, peers stay row-identical to serial execution, a
     flight-recorder bundle lands on disk, and doctor renders it."""
-    print("== ci stage 5/10: doctor smoke ==")
+    print("== ci stage 5/11: doctor smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -533,7 +541,7 @@ def _stage_chaos_smoke(sf: float) -> int:
     shows the ladder's stage retry with fewer stages replayed than the
     plan has), peers complete untouched, and the flight-recorder
     bundle doctor renders shows the ladder's events."""
-    print("== ci stage 6/10: chaos-recovery smoke ==")
+    print("== ci stage 6/11: chaos-recovery smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -688,7 +696,7 @@ def _stage_ooc_smoke(sf: float) -> int:
     run, and the exchange transient must stay within the pinned
     budget.  On failure a flight-recorder bundle is dumped and doctor
     renders it, so the evidence ships with the red CI run."""
-    print("== ci stage 7/10: out-of-core smoke ==")
+    print("== ci stage 7/11: out-of-core smoke ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -790,7 +798,7 @@ def _stage_mesh_smoke(sf: float) -> int:
     slices, the session must flip into degraded mode, and the
     flight-recorder bundle doctor renders must show the
     ``mesh_degraded`` event + evacuation timeline."""
-    print("== ci stage 8/10: mesh-loss chaos smoke ==")
+    print("== ci stage 8/11: mesh-loss chaos smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -963,7 +971,7 @@ def _stage_hierarchy_smoke() -> int:
     flat single-shot slow-share price.  A forced hierarchical leg and
     a forced hierarchical-combine fused-groupby leg prove both
     lowerings independently."""
-    print("== ci stage 9/10: hierarchy smoke ==")
+    print("== ci stage 9/11: hierarchy smoke ==")
     t0 = time.perf_counter()
     try:
         import dataclasses
@@ -1144,10 +1152,130 @@ def _stage_hierarchy_smoke() -> int:
     return 1 if bad else 0
 
 
+def _stage_lockcheck_smoke() -> int:
+    """Concurrency-discipline smoke (docs/static_analysis.md): (a) the
+    static half holds the tree at zero findings for both concurrency
+    rules; (b) the runtime half catches a deterministic AB/BA
+    inversion as a typed LockOrderViolation at ACQUIRE time — the
+    detector reports the deadlock instead of experiencing it; (c) an
+    8-client serving window runs green with CYLON_LOCKCHECK
+    enforcement live across every OrderedLock in the engine."""
+    print("== ci stage 10/11: concurrency smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import threading
+
+        import jax
+
+        from .. import config
+        from ..context import CylonContext
+        from ..observe.locks import LockOrderViolation, OrderedLock
+        from ..parallel.dtable import DTable
+        from ..serve import ServeSession
+        from ..tpch import generate
+        from ..tpch.queries import QUERIES
+        from . import lockcheck
+
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(0.002, seed=11)
+        dts = {name: DTable.from_pandas(ctx, df)
+               for name, df in data.items()}
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding
+        print(f"concurrency smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    prev_enforce = config.set_lockcheck(True)
+    try:
+        # (a) the static half: both rules at zero findings tree-wide
+        rc = lockcheck.main(_repo_paths())
+        if rc != 0:
+            print(f"concurrency smoke: lockcheck exited {rc} — the "
+                  "tree is not at zero concurrency findings",
+                  file=sys.stderr)
+            bad += 1
+        # (b) the runtime half: a deterministic AB/BA inversion on two
+        # throwaway locks must raise the typed violation on the SECOND
+        # thread's acquire, before it can block
+        lk_a = OrderedLock("ci.smoke_a")
+        lk_b = OrderedLock("ci.smoke_b")
+        with lk_a:
+            with lk_b:
+                pass
+        caught: list = []
+
+        def inverter():
+            try:
+                with lk_b:
+                    with lk_a:
+                        pass
+            except LockOrderViolation as e:
+                caught.append(e)
+
+        th = threading.Thread(target=inverter, name="ci-ab-ba")
+        th.start()
+        th.join(30)
+        if not caught:
+            print("concurrency smoke: the AB/BA inversion was NOT "
+                  "caught as a LockOrderViolation", file=sys.stderr)
+            bad += 1
+        elif "ci.smoke_a" not in str(caught[0])                 or "ci.smoke_b" not in str(caught[0]):
+            print("concurrency smoke: the violation message does not "
+                  f"name both chains: {caught[0]}", file=sys.stderr)
+            bad += 1
+        # (c) an 8-client serve window with enforcement live: every
+        # OrderedLock acquisition in the engine (queue, breaker,
+        # session stats, spill pool, chunk state, replica cache,
+        # warn_once) is order-checked while real queries flow
+        with ServeSession(ctx, tables=dts, batch_window_ms=20.0) as s:
+            handles = []
+            errs: list = []
+
+            def client(qfn, label):
+                try:
+                    handles.append(s.submit(
+                        lambda t, q=qfn: q(ctx, t), label=label,
+                        export=lambda r: r.to_pandas()))
+                except Exception as e:  # graftlint: ok[broad-except]
+                    errs.append(e)  # — the stage verdict needs it
+
+            mix = [("q1", QUERIES["q1"]), ("q6", QUERIES["q6"])] * 4
+            threads = [threading.Thread(target=client, args=(q, n))
+                       for n, q in mix]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for h in handles:
+                h.result(timeout=600)
+            stats = s.stats()
+        if errs:
+            print(f"concurrency smoke: {len(errs)} submit(s) raised "
+                  f"under enforcement: {errs[0]}", file=sys.stderr)
+            bad += 1
+        if stats["failed"]:
+            print(f"concurrency smoke: {stats['failed']} quer(ies) "
+                  "failed under enforcement", file=sys.stderr)
+            bad += 1
+        if not bad:
+            print(f"concurrency smoke: lint clean, AB/BA caught, "
+                  f"{stats['completed']} queries green under "
+                  f"enforcement ({time.perf_counter() - t0:.1f}s)")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract
+        print(f"concurrency smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        config.set_lockcheck(prev_enforce)
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 10/10: benchdiff ==")
+    print("== ci stage 11/11: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -1183,6 +1311,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the mesh-loss chaos smoke stage")
     ap.add_argument("--no-hierarchy-smoke", action="store_true",
                     help="skip the hierarchical-collectives smoke stage")
+    ap.add_argument("--no-lockcheck-smoke", action="store_true",
+                    help="skip the concurrency (lockcheck) smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -1192,40 +1322,44 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/10: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/11: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/10: serving smoke == (skipped)")
+        print("== ci stage 3/11: serving smoke == (skipped)")
     if not args.no_telemetry_smoke:
         rcs.append(_stage_telemetry_smoke(args.tpch_sf))
     else:
-        print("== ci stage 4/10: telemetry smoke == (skipped)")
+        print("== ci stage 4/11: telemetry smoke == (skipped)")
     if not args.no_doctor_smoke:
         rcs.append(_stage_doctor_smoke(args.tpch_sf))
     else:
-        print("== ci stage 5/10: doctor smoke == (skipped)")
+        print("== ci stage 5/11: doctor smoke == (skipped)")
     if not args.no_chaos_smoke:
         rcs.append(_stage_chaos_smoke(args.tpch_sf))
     else:
-        print("== ci stage 6/10: chaos-recovery smoke == (skipped)")
+        print("== ci stage 6/11: chaos-recovery smoke == (skipped)")
     if not args.no_ooc_smoke:
         rcs.append(_stage_ooc_smoke(args.tpch_sf))
     else:
-        print("== ci stage 7/10: out-of-core smoke == (skipped)")
+        print("== ci stage 7/11: out-of-core smoke == (skipped)")
     if not args.no_mesh_smoke:
         rcs.append(_stage_mesh_smoke(args.tpch_sf))
     else:
-        print("== ci stage 8/10: mesh-loss chaos smoke == (skipped)")
+        print("== ci stage 8/11: mesh-loss chaos smoke == (skipped)")
     if not args.no_hierarchy_smoke:
         rcs.append(_stage_hierarchy_smoke())
     else:
-        print("== ci stage 9/10: hierarchy smoke == (skipped)")
+        print("== ci stage 9/11: hierarchy smoke == (skipped)")
+    if not args.no_lockcheck_smoke:
+        rcs.append(_stage_lockcheck_smoke())
+    else:
+        print("== ci stage 10/11: concurrency smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 10/10: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 11/11: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

@@ -81,6 +81,30 @@ with examples):
                           ``warn_once(("shuffle.skew", hint_key), …)``
                           — the literal head names the family, dynamic
                           components scope the signature.
+  shared-state-unguarded  a write (assignment, aug-assignment, ``del``,
+                          or mutating container method) to a name the
+                          module's ``GUARDED_STATE`` catalogue maps to
+                          a lock, outside a ``with <that lock>`` block —
+                          or an UNCATALOGUED module-level mutable
+                          literal in a threaded module (one that
+                          declares a catalogue or spawns threads).
+                          Module top level, ``__init__``/``__new__``
+                          bodies and ``*_locked`` functions (held-by-
+                          contract) are exempt.  The catalogue format
+                          and the runtime half (observe/locks.py
+                          OrderedLock, the lock-order DAG) are in
+                          docs/static_analysis.md "Concurrency
+                          discipline".
+  blocking-call-under-lock  a call that can block indefinitely —
+                          ``jax.block_until_ready`` / ``device_get`` /
+                          ``serial_call`` / ``time.sleep`` /
+                          ``.result()`` / thread ``.join()`` —
+                          lexically inside a ``with <lock>`` body: the
+                          exact shape of the XLA:CPU collective-
+                          rendezvous deadlock (a thread blocks on
+                          device work while holding the lock the
+                          worker needs).  ``Condition.wait`` is exempt
+                          (it releases the lock while waiting).
 
 Findings carry ``file:line:col``; suppress a deliberate site with a
 ``# graftlint: ok[rule]`` (or bare ``# graftlint: ok``) comment on any
@@ -99,6 +123,7 @@ import os
 import re
 import symtable
 import sys
+import threading
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -116,6 +141,8 @@ RULES = (
     "counter-not-in-catalogue",
     "warn-once-key-literal",
     "host-array-unpooled",
+    "shared-state-unguarded",
+    "blocking-call-under-lock",
 )
 
 # Modules whose job IS the device↔host boundary: ingest, export, the
@@ -240,14 +267,30 @@ class _Linter(ast.NodeVisitor):
         self._parents: Dict[ast.AST, ast.AST] = {}
         self._suppress = _suppressions(source)
         self._finding_lines: Dict[Tuple[int, int, str], Tuple[int, int]] = {}
+        # concurrency-rule state (docs/static_analysis.md "Concurrency
+        # discipline"): the module's GUARDED_STATE catalogue, the lock
+        # names it references, and the lexical with/function context
+        # maintained during traversal
+        self.guarded: Optional[Dict[str, str]] = None
+        self.lock_names: Set[str] = set()
+        self._with_stack: List[str] = []
+        self._func_stack: List[str] = []
+        self._lc = None          # the lockcheck helper module (run())
 
     # -- plumbing -----------------------------------------------------------
 
     def run(self, tree: ast.Module) -> List[Finding]:
+        from . import lockcheck as _lockcheck
+        self._lc = _lockcheck
         for node in ast.walk(tree):
             for child in ast.iter_child_nodes(node):
                 self._parents[child] = node
         self.module_names = _module_bindings(tree)
+        self.guarded = _lockcheck.guarded_state_from_tree(tree)
+        self.lock_names = set(self.guarded.values()) if self.guarded \
+            else set()
+        if self.guarded is not None or _lockcheck.spawns_threads(tree):
+            self._check_module_mutables(tree, _lockcheck)
         self.visit(tree)
         self._check_factories(tree)
         self._check_unlowered(tree)
@@ -299,6 +342,8 @@ class _Linter(ast.NodeVisitor):
         self._check_warn_once_key(node, target)
         self._check_fault_catalogue(node, target)
         self._check_host_unpooled(node, target)
+        self._check_blocking_under_lock(node, target)
+        self._check_mutating_call(node)
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -308,6 +353,205 @@ class _Linter(ast.NodeVisitor):
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         self._check_broad_except(node)
         self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        self._with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._with(node)
+
+    def _with(self, node) -> None:
+        leaves = []
+        for item in node.items:
+            d = _dotted(item.context_expr)
+            if d:
+                leaves.append(d.rsplit(".", 1)[-1])
+        self._with_stack.extend(leaves)
+        self.generic_visit(node)
+        if leaves:
+            del self._with_stack[-len(leaves):]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._function(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._function(node, name="<lambda>")
+
+    def _function(self, node, name: Optional[str] = None) -> None:
+        # a function DEFINED inside a `with lock:` body runs later, not
+        # under the lock — the lexical with-context must not leak into
+        # its body (and vice versa for the function-name exemptions)
+        saved = self._with_stack
+        self._with_stack = []
+        self._func_stack.append(name or node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+        self._with_stack = saved
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_guarded_write(t, t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_guarded_write(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_guarded_write(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._check_guarded_write(t, node)
+        self.generic_visit(node)
+
+    # -- shared-state-unguarded ----------------------------------------------
+
+    @staticmethod
+    def _write_leaf(target: ast.AST) -> Optional[str]:
+        """The catalogued leaf name a write target touches:
+        ``self._entries[k] = v`` and ``._entries.pop(k)`` both touch
+        ``_entries``; ``self._n += 1`` touches ``_n``."""
+        while isinstance(target, (ast.Subscript, ast.Starred)):
+            target = target.value
+        if isinstance(target, ast.Attribute):
+            return target.attr
+        if isinstance(target, ast.Name):
+            return target.id
+        return None
+
+    def _exempt_context(self) -> bool:
+        """Writes at module top level and in ``__init__``/``__new__``
+        bodies initialize not-yet-shared objects; ``*_locked``
+        functions hold the lock by contract (their callers own the
+        ``with`` — the pool/stats naming convention)."""
+        if not self._func_stack:
+            return True
+        fn = self._func_stack[-1]
+        return fn in ("__init__", "__new__") or fn.endswith("_locked")
+
+    def _check_guarded_write(self, target: ast.AST,
+                             node: ast.AST) -> None:
+        if not self.guarded:
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_guarded_write(elt, node)
+            return
+        leaf = self._write_leaf(target)
+        if leaf is None or leaf not in self.guarded:
+            return
+        if self._exempt_context():
+            return
+        need = self.guarded[leaf]
+        if need in self._with_stack:
+            return
+        self._emit(node, "shared-state-unguarded",
+                   f"write to {leaf!r} outside `with {need}:` — the "
+                   "GUARDED_STATE catalogue maps it to that lock "
+                   "(hold the lock, move the write into a *_locked "
+                   "helper, or fix the catalogue)")
+
+    def _check_mutating_call(self, node: ast.Call) -> None:
+        """``x.append(…)`` / ``.pop`` / ``.update`` … on a catalogued
+        container is a write like any other."""
+        if not self.guarded or not isinstance(node.func, ast.Attribute):
+            return
+        if node.func.attr not in self._lc.MUTATING_METHODS:
+            return
+        self._check_guarded_write(node.func.value, node)
+
+    def _check_module_mutables(self, tree: ast.Module,
+                               _lockcheck) -> None:
+        """In a threaded module (declares GUARDED_STATE or spawns
+        threads), every module-level mutable literal must be catalogued
+        — an uncatalogued one is shared state the lint cannot protect.
+        CONSTANT_CASE names are immutable-by-convention tables
+        (METRICS, POINTS, LOWERING…) and exempt."""
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                name = t.id
+                if (name == "GUARDED_STATE" or name.startswith("__")
+                        or _lockcheck.is_constant_name(name)):
+                    continue
+                if self.guarded and (name in self.guarded
+                                     or name in self.lock_names):
+                    continue
+                if not _lockcheck.is_mutable_literal(value):
+                    continue
+                self._emit(node, "shared-state-unguarded",
+                           f"module-level mutable {name!r} in a "
+                           "threaded module is not in the GUARDED_STATE "
+                           "catalogue — map it to its guarding lock, or "
+                           "rename to CONSTANT_CASE if it is an "
+                           "immutable table")
+
+    # -- blocking-call-under-lock --------------------------------------------
+
+    def _innermost_lock(self) -> Optional[str]:
+        for name in reversed(self._with_stack):
+            if "lock" in name.lower() or name in self.lock_names:
+                return name
+        return None
+
+    def _check_blocking_under_lock(self, node: ast.Call,
+                                   target: Optional[str]) -> None:
+        """A device sync / collective dispatch / thread rendezvous
+        lexically inside a ``with <lock>`` body is the rendezvous-
+        deadlock shape: the blocked work may need a thread that needs
+        this lock.  ``Condition.wait`` is exempt — it RELEASES the lock
+        while waiting, which is the sanctioned way to block under
+        one."""
+        lock = self._innermost_lock()
+        if lock is None:
+            return
+        leaf = target.rsplit(".", 1)[-1] if target else None
+        if target in self._lc.BLOCKING_CALLS or leaf == "serial_call":
+            self._emit(node, "blocking-call-under-lock",
+                       f"{target or leaf}() can block indefinitely "
+                       f"while `with {lock}:` is held — move the "
+                       "blocking work outside the lock (capture state "
+                       "under it, block after release)")
+            return
+        if not isinstance(node.func, ast.Attribute):
+            return
+        if node.func.attr == "result":
+            self._emit(node, "blocking-call-under-lock",
+                       f".result() joins a future while `with {lock}:` "
+                       "is held — the worker completing it may need "
+                       "this lock; collect futures under the lock, "
+                       "join them after release")
+            return
+        if node.func.attr == "join":
+            # thread-join shapes only: t.join() / t.join(5.0) /
+            # t.join(timeout=…).  str.join/os.path.join take non-
+            # numeric positional args and are skipped.
+            joinish = (not node.args
+                       and not any(kw.arg != "timeout"
+                                   for kw in node.keywords)) \
+                or (len(node.args) == 1
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, (int, float))
+                    and not isinstance(node.args[0].value, bool))
+            if joinish and not (isinstance(node.func.value, ast.Constant)):
+                self._emit(node, "blocking-call-under-lock",
+                           f".join() rendezvouses with a thread while "
+                           f"`with {lock}:` is held — if that thread "
+                           "ever takes this lock, this is a deadlock; "
+                           "join after release")
 
     # -- broad-except --------------------------------------------------------
 
@@ -671,8 +915,20 @@ _COUNTER_FNS = {"count", "count_max", "gauge"}
 # long-lived process invalidates the parse.  Every arm is best-effort:
 # an unlocatable/unparseable catalogue returns None and the rule stays
 # silent (like the symtable arm of kernel-factory-unkeyed).
+#
+# The whole check-then-parse-then-store sequence holds _catalogue_lock:
+# concurrent linters (pytest workers sharing the process, an IDE
+# integration) used to race the plain-dict check-then-act and parse the
+# same catalogue twice — benign for the result but exactly the pattern
+# the shared-state-unguarded rule exists to forbid.  A plain
+# threading.Lock (not OrderedLock) on purpose: graftlint must stay
+# stdlib-importable (see analysis/__init__), and the lock is leaf-level
+# by construction.
+_catalogue_lock = threading.Lock()
 _catalogue_cache: Dict[Tuple[str, str],
                        Tuple[float, Optional[frozenset]]] = {}
+
+GUARDED_STATE = {"_catalogue_cache": "_catalogue_lock"}
 
 
 def _sibling_names(linted_path: str, anchor: str, rel_file: str,
@@ -690,29 +946,30 @@ def _sibling_names(linted_path: str, anchor: str, rel_file: str,
         mtime = os.path.getmtime(cat_path)
     except OSError:
         return None
-    hit = _catalogue_cache.get((cat_path, var_name))
-    if hit is not None and hit[0] == mtime:
-        return hit[1]
-    names: Optional[frozenset] = None
-    try:
-        with open(cat_path, "r", encoding="utf-8") as fh:
-            tree = ast.parse(fh.read(), filename=cat_path)
-        for node in tree.body:
-            if isinstance(node, ast.AnnAssign):
-                targets = [node.target]
-                value = node.value
-            elif isinstance(node, ast.Assign):
-                targets = node.targets
-                value = node.value
-            else:
-                continue
-            if not any(isinstance(t, ast.Name) and t.id == var_name
-                       for t in targets):
-                continue
-            names = extract(value)
-    except (OSError, SyntaxError):
-        names = None
-    _catalogue_cache[(cat_path, var_name)] = (mtime, names)
+    with _catalogue_lock:
+        hit = _catalogue_cache.get((cat_path, var_name))
+        if hit is not None and hit[0] == mtime:
+            return hit[1]
+        names: Optional[frozenset] = None
+        try:
+            with open(cat_path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=cat_path)
+            for node in tree.body:
+                if isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                    value = node.value
+                elif isinstance(node, ast.Assign):
+                    targets = node.targets
+                    value = node.value
+                else:
+                    continue
+                if not any(isinstance(t, ast.Name) and t.id == var_name
+                           for t in targets):
+                    continue
+                names = extract(value)
+        except (OSError, SyntaxError):
+            names = None
+        _catalogue_cache[(cat_path, var_name)] = (mtime, names)
     return names
 
 

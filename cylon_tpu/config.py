@@ -595,6 +595,77 @@ def set_recovery_enabled(on: "Optional[bool]") -> "Optional[bool]":
 
 
 # ---------------------------------------------------------------------------
+# lock-order enforcement + hold-time watchdog (docs/static_analysis.md
+# "Concurrency discipline"): the dynamic half of the lock discipline.
+# observe/locks.py ALWAYS maintains the lock-order DAG and records
+# inversions to the flight recorder; this switch decides whether a
+# detected AB/BA inversion RAISES a typed LockOrderViolation at the
+# acquire site (before blocking — report the deadlock instead of
+# experiencing it) or degrades to flightrec + warn_once.  Resolution:
+# explicit set_lockcheck() > CYLON_LOCKCHECK env (default off);
+# ``sanitize()`` turns it on for the sanitized scope.
+# ---------------------------------------------------------------------------
+
+_lockcheck: Optional[bool] = None           # None -> env-resolved
+
+
+def lockcheck_enabled() -> bool:
+    """Whether a lock-order inversion raises ``LockOrderViolation``
+    (explicit knob, else ``CYLON_LOCKCHECK`` — any value but
+    ``0``/empty enables)."""
+    if _lockcheck is not None:
+        return _lockcheck
+    return os.environ.get("CYLON_LOCKCHECK", "0") not in ("", "0")
+
+
+def set_lockcheck(on: "Optional[bool]") -> "Optional[bool]":
+    """Set lock-order enforcement (``None`` restores env resolution);
+    returns the previous EXPLICIT setting so callers restore it in a
+    ``finally`` — the same contract as ``set_recovery_enabled``."""
+    global _lockcheck
+    if on is not None and not isinstance(on, bool):
+        raise CylonError(Status(Code.Invalid,
+            "lockcheck switch must be True, False or None (env-resolved), "
+            f"got {type(on).__name__} {on!r}"))
+    prev = _lockcheck
+    _lockcheck = on
+    return prev
+
+
+_lock_hold_watchdog_ms: Optional[int] = None    # None -> env-resolved
+
+
+def lock_hold_watchdog_ms() -> int:
+    """Hold-time watchdog threshold in ms: an OrderedLock released
+    after being held at least this long notes a ``lock_hold`` event
+    into the flight recorder (``doctor`` surfaces them next to the
+    lock-order DAG).  0 disables.  Explicit knob, else
+    ``CYLON_LOCK_HOLD_MS`` (default 1000 — generous enough that a
+    first-compile under ``serial_call``'s dispatch lock is *noted*,
+    not noisy)."""
+    if _lock_hold_watchdog_ms is not None:
+        return _lock_hold_watchdog_ms
+    try:
+        return int(os.environ.get("CYLON_LOCK_HOLD_MS", "1000"))
+    except ValueError:
+        return 1000
+
+
+def set_lock_hold_watchdog_ms(ms: "Optional[int]") -> "Optional[int]":
+    """Set the hold-time watchdog threshold (``None`` restores env
+    resolution, 0 disables); returns the previous explicit setting."""
+    global _lock_hold_watchdog_ms
+    if ms is not None and (not isinstance(ms, int)
+                           or isinstance(ms, bool) or ms < 0):
+        raise CylonError(Status(Code.Invalid,
+            "lock hold watchdog must be a non-negative int of ms or "
+            f"None (env-resolved), got {type(ms).__name__} {ms!r}"))
+    prev = _lock_hold_watchdog_ms
+    _lock_hold_watchdog_ms = ms
+    return prev
+
+
+# ---------------------------------------------------------------------------
 # sanitizer mode (docs/static_analysis.md): the RUNTIME backstop for the
 # invariants graftlint proves statically.  When on:
 #
@@ -638,9 +709,10 @@ class _SanitizeHandle:
     """Returned by ``sanitize()``: already active; usable as a context
     manager for scoped enabling, or kept for the process lifetime."""
 
-    def __init__(self, prev_on: bool, prev_debug_nans):
+    def __init__(self, prev_on: bool, prev_debug_nans, prev_lockcheck):
         self._prev_on = prev_on
         self._prev_debug_nans = prev_debug_nans
+        self._prev_lockcheck = prev_lockcheck
 
     def __enter__(self) -> "_SanitizeHandle":
         return self
@@ -654,12 +726,15 @@ class _SanitizeHandle:
 
         _sanitizing = self._prev_on
         jax.config.update("jax_debug_nans", self._prev_debug_nans)
+        set_lockcheck(self._prev_lockcheck)
 
 
 def sanitize(enable: bool = True) -> _SanitizeHandle:
     """Turn sanitizer mode on (default) or off; see the section comment
     above for what it checks.  Returns a handle whose ``close()`` (or
-    ``with``-exit) restores the previous state."""
+    ``with``-exit) restores the previous state.  Sanitizing also turns
+    on lock-order enforcement (``lockcheck_enabled``) — an AB/BA
+    inversion under sanitize raises instead of warning."""
     global _sanitizing
     import jax
 
@@ -667,7 +742,8 @@ def sanitize(enable: bool = True) -> _SanitizeHandle:
     prev_nans = jax.config.jax_debug_nans
     _sanitizing = bool(enable)
     jax.config.update("jax_debug_nans", bool(enable))
-    return _SanitizeHandle(prev_on, prev_nans)
+    prev_lockcheck = set_lockcheck(True if enable else None)
+    return _SanitizeHandle(prev_on, prev_nans, prev_lockcheck)
 
 
 class JoinType(enum.Enum):

@@ -19,10 +19,11 @@ from __future__ import annotations
 import io
 import os
 import sys
-import threading
 import time
 import traceback
 from typing import Any
+
+from .observe.locks import OrderedLock
 
 INFO, WARNING, ERROR, FATAL = 0, 1, 2, 3
 _LETTER = "IWEF"
@@ -75,8 +76,13 @@ def warning(msg: Any, *args) -> None:
 # threads running eager plans) hit the same registry, and the
 # check-then-add pair must be atomic for the "at most once" promise —
 # and for the RETURN value tests assert on — to hold across threads.
+# The mapping below is the lint contract (graftlint
+# shared-state-unguarded; docs/static_analysis.md "Concurrency
+# discipline"): every write to _warned_keys must hold _warn_lock.
+GUARDED_STATE = {"_warned_keys": "_warn_lock"}
+
 _warned_keys: set = set()
-_warn_lock = threading.Lock()
+_warn_lock = OrderedLock("log.warn_once")
 
 
 def warn_once(key: Any, msg: Any, *args) -> bool:

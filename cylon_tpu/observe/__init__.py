@@ -36,10 +36,11 @@ unchanged — ``observe.METRICS``, ``observe.analyze``,
 """
 from __future__ import annotations
 
-from . import compile, devmem, flightrec, stats, timeseries
+from . import compile, devmem, flightrec, locks, stats, timeseries
 from .analyze import analyze
 from .compile import kernel_factory
 from .export import export_chrome_trace
+from .locks import LockOrderViolation, OrderedLock
 from .metrics import (COUNTER, GAUGE, METRICS, REGISTRY, WATERMARK,
                       MetricSpec, MetricsRegistry, counter_delta,
                       exchange_count, row_bytes)
@@ -51,5 +52,6 @@ __all__ = [
     "MetricsRegistry", "REGISTRY", "export_chrome_trace", "analyze",
     "exchange_count", "counter_delta", "row_bytes", "TimeSeriesSampler",
     "STATS_STORE", "stats", "timeseries", "compile", "devmem",
-    "flightrec", "kernel_factory",
+    "flightrec", "kernel_factory", "locks", "OrderedLock",
+    "LockOrderViolation",
 ]

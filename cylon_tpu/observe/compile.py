@@ -49,6 +49,8 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
+from .locks import OrderedLock
+
 __all__ = ["kernel_factory", "attribute_compiles", "note_build",
            "clear_state", "serial_call", "STORM_KEYS", "STORM_WINDOW_S"]
 
@@ -107,7 +109,12 @@ def _observing() -> bool:
 # recompile-storm detection (factory-level, on cache misses)
 # ---------------------------------------------------------------------------
 
-_storm_lock = threading.Lock()
+# Lint contract (graftlint shared-state-unguarded,
+# docs/static_analysis.md "Concurrency discipline"): writes to these
+# module registries hold the mapped lock.
+GUARDED_STATE = {"_recent_keys": "_storm_lock"}
+
+_storm_lock = OrderedLock("compile.storm")
 _recent_keys: Dict[str, deque] = {}   # factory -> deque[(t, key)]
 
 
@@ -196,7 +203,13 @@ def clear_state() -> None:
 # nested kernel calls on one thread legal, and uncontended acquisition
 # costs nanoseconds.
 
-_dispatch_lock = threading.RLock()
+# An OrderedLock (reentrant, matching the RLock it replaced) so the
+# serialization pressure is visible: ``lock.held_us`` watermarks how
+# long launches waited behind one another, the acquire counter sizes
+# the contention, and a hang under the lock shows up in the flight
+# recorder via the hold-time watchdog — the recompile-storm / hang
+# triage used to be blind to exactly this lock.
+_dispatch_lock = OrderedLock("compile.dispatch", reentrant=True)
 _serialize_dispatch: Optional[bool] = None
 
 
@@ -229,7 +242,10 @@ def serial_call(fn, args, kwargs):
     with _dispatch_lock:
         out = fn(*args, **kwargs)
         try:
-            jax.block_until_ready(out)
+            # the block IS the point: at most one program in flight on
+            # the cpu backend (module comment) — the sanctioned
+            # blocking-under-lock site the rule exists to make loud
+            jax.block_until_ready(out)  # graftlint: ok[blocking-call-under-lock]
         except Exception:  # graftlint: ok[broad-except] — non-array
             pass           # leaves in the output tree stay un-waited
         return out

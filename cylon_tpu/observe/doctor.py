@@ -25,7 +25,8 @@ __all__ = ["render", "main"]
 # counters worth surfacing even when a reader doesn't know what to grep
 _INTERESTING_PREFIXES = ("serve.", "compile.", "fault.", "retry.",
                          "recover.", "spill.", "flightrec.",
-                         "shuffle.strategy.", "devmem.", "plan.cache")
+                         "shuffle.strategy.", "devmem.", "plan.cache",
+                         "lock.")
 
 
 def _fmt_ts(t: Optional[float]) -> str:
@@ -112,6 +113,41 @@ def render(doc: Dict[str, Any]) -> str:
                     f"{e.get('evacuated_bytes', '?')} B, resumed on "
                     f"{e.get('survivor_world', '?')} survivors "
                     f"[{e.get('error', '')}]")
+
+    # concurrency discipline (docs/static_analysis.md): the lock-order
+    # DAG as witnessed this run, any AB/BA inversions, and releases
+    # that tripped the hold-time watchdog — rendered whenever the
+    # bundle carries lock events, because a post-mortem of a hang IS
+    # the case these sections exist for
+    edges = [e for e in doc.get("events", [])
+             if e.get("kind") == "lock_edge"]
+    if edges:
+        lines.append(_section(f"lock-order DAG ({len(edges)} edges)"))
+        for e in edges[-16:]:
+            lines.append(f"  {e.get('src')} -> {e.get('dst')} "
+                         f"(first: thread {e.get('thread', '?')!r} "
+                         f"at {e.get('site', '?')})")
+    violations = [e for e in doc.get("events", [])
+                  if e.get("kind") == "lock_violation"]
+    if violations:
+        lines.append(_section(f"lock-order violations "
+                              f"({len(violations)})"))
+        for e in violations[-8:]:
+            lines.append(f"  [{_fmt_ts(e.get('t'))}] thread "
+                         f"{e.get('thread', '?')!r}: "
+                         f"{e.get('src')} -> {e.get('dst')} inverts the "
+                         f"recorded order")
+            lines.append(f"    held here:  {e.get('chain_held')}")
+            lines.append(f"    recorded:   {e.get('chain_prior')}")
+    holds = [e for e in doc.get("events", [])
+             if e.get("kind") == "lock_hold"]
+    if holds:
+        lines.append(_section(f"lock hold-time watchdog ({len(holds)})"))
+        for e in holds[-8:]:
+            lines.append(f"  [{_fmt_ts(e.get('t'))}] {e.get('lock')} "
+                         f"held {e.get('held_ms', '?')} ms "
+                         f"(watchdog {e.get('watchdog_ms', '?')} ms) on "
+                         f"thread {e.get('thread', '?')!r}")
 
     choices = [e for e in doc.get("events", [])
                if e.get("kind") == "exchange_choice"]

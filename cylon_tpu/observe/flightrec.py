@@ -129,7 +129,8 @@ def _config_fingerprint() -> Dict[str, Any]:
         out["plan_cache_capacity"] = config.plan_cache_capacity()
     except Exception:  # graftlint: ok[broad-except] — a malformed env
         pass            # knob must not block the crash bundle
-    for env in ("CYLON_CHAOS", "CYLON_SANITIZE", "CYLON_MEMORY_BUDGET",
+    for env in ("CYLON_CHAOS", "CYLON_SANITIZE", "CYLON_LOCKCHECK",
+                "CYLON_LOCK_HOLD_MS", "CYLON_MEMORY_BUDGET",
                 "CYLON_STATS_PATH", "CYLON_MESHPROBE_PATH"):
         v = os.environ.get(env)
         if v:

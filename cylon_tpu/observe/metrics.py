@@ -503,6 +503,22 @@ METRICS: Dict[str, MetricSpec] = _specs(
     ("serve.drains", COUNTER, "drains",
      "graceful session drains: admission stopped, in-flight queries "
      "finished, async exports joined, run-stats store flushed"),
+    ("lock.acquires", COUNTER, "acquires",
+     "OrderedLock outermost acquisitions across every catalogued lock "
+     "(docs/static_analysis.md 'Concurrency discipline'); per-lock "
+     "counts live on the lock objects (observe.locks.known_locks)"),
+    ("lock.held_us", WATERMARK, "us",
+     "longest time any OrderedLock was held, microseconds — launch "
+     "serialization pressure (serial_call's dispatch lock) and "
+     "lock-convoy triage both read this watermark"),
+    ("lock.order_violations", COUNTER, "violations",
+     "AB/BA lock-order inversions detected at acquire time; raises "
+     "LockOrderViolation under CYLON_LOCKCHECK=1 / config.sanitize(), "
+     "else flightrec + warn_once"),
+    ("lock.hold_watchdog", COUNTER, "events",
+     "hold-time watchdog firings: an OrderedLock released after "
+     "holding past config.lock_hold_watchdog_ms (flightrec carries "
+     "the lock name and duration)"),
 )
 
 

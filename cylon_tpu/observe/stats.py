@@ -38,6 +38,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
+from .locks import OrderedLock
+
 __all__ = ["StatsStore", "STORE", "plan_digest", "note_plan",
            "collect_digests"]
 
@@ -81,6 +83,14 @@ def plan_digest(key) -> str:
 # ---------------------------------------------------------------------------
 
 _tls = threading.local()
+
+# The lint contract (graftlint shared-state-unguarded): every write to
+# these StatsStore attributes holds self._lock — or lives in a
+# ``*_locked`` helper whose callers do.  _flush_at_exit's bounded
+# acquire works unchanged: OrderedLock forwards acquire(timeout=...).
+GUARDED_STATE = {"_records": "_lock", "_path": "_lock",
+                 "_loaded": "_lock", "_dirty": "_lock",
+                 "_last_save": "_lock", "_atexit_registered": "_lock"}
 
 
 @contextmanager
@@ -143,7 +153,7 @@ class StatsStore:
     SAVE_INTERVAL_S = 1.0
 
     def __init__(self, path: Optional[str] = None) -> None:
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("observe.stats")
         self._records: Dict[str, Dict[str, Any]] = {}
         self._path = path
         self._loaded = False

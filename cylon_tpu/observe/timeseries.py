@@ -43,13 +43,25 @@ import time
 import weakref
 from typing import Any, Dict, List, Optional
 
+from .locks import OrderedLock
 from .metrics import REGISTRY
 
 __all__ = ["TimeSeriesSampler"]
 
+# Lint contract (graftlint shared-state-unguarded,
+# docs/static_analysis.md "Concurrency discipline"): the sampler thread
+# appends into the ring while readers snapshot it — every _buf/_n write
+# holds the instance lock.  The _prev_* delta fields are touched only
+# by whichever single caller drives sample_once (the sampler thread, or
+# a test calling it synchronously) and stay uncatalogued.
+GUARDED_STATE = {"_buf": "_lock", "_n": "_lock",
+                 "_live_samplers": "_registry_lock",
+                 "_atexit_registered": "_registry_lock"}
+
 # live samplers, stopped at interpreter exit so no daemon thread is
 # still sampling while the runtime tears down (deterministic shutdown —
 # the serve-session satellite of docs/observability.md)
+_registry_lock = OrderedLock("observe.sampler_registry")
 _live_samplers: "weakref.WeakSet" = weakref.WeakSet()
 _atexit_registered = False
 
@@ -116,7 +128,7 @@ class TimeSeriesSampler:
         self.hit_collapse_frac = hit_collapse_frac
         self.alerts: List[Dict[str, Any]] = []
         self._session = session
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("observe.sampler")
         self._buf: List[Optional[Dict[str, Any]]] = [None] * capacity
         self._n = 0                      # samples ever taken
         self._t0 = time.perf_counter()
@@ -139,13 +151,17 @@ class TimeSeriesSampler:
         self._thread = threading.Thread(target=self._loop,
                                         name="telemetry-sampler",
                                         daemon=True)
-        _live_samplers.add(self)
-        if not _atexit_registered:
-            # one process-wide hook stopping still-live samplers before
-            # the runtime tears down (deterministic shutdown: no daemon
-            # thread samples a half-destructed registry at exit)
-            atexit.register(_stop_live_samplers)
-            _atexit_registered = True
+        with _registry_lock:
+            _live_samplers.add(self)
+            if not _atexit_registered:
+                # one process-wide hook stopping still-live samplers
+                # before the runtime tears down (deterministic shutdown:
+                # no daemon thread samples a half-destructed registry at
+                # exit).  Registration is check-then-act — atomic under
+                # the registry lock so two concurrently-started samplers
+                # cannot double-register it.
+                atexit.register(_stop_live_samplers)
+                _atexit_registered = True
         self._thread.start()
         return self
 
@@ -164,7 +180,8 @@ class TimeSeriesSampler:
                 glog.warning("telemetry sampler thread did not stop "
                              "within %.1f s", timeout)
             self._thread = None
-        _live_samplers.discard(self)
+        with _registry_lock:
+            _live_samplers.discard(self)
         self.sample_once()
 
     def __enter__(self) -> "TimeSeriesSampler":

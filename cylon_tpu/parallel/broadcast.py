@@ -60,6 +60,7 @@ from jax.sharding import PartitionSpec as P
 from .. import trace
 from ..analysis import plan_check
 from ..observe.compile import kernel_factory
+from ..observe.locks import OrderedLock
 from ..analysis._abstract import is_abstract
 from ..config import broadcast_join_threshold
 from ..ops import compact as ops_compact
@@ -185,8 +186,14 @@ def rows_if_small(dt: DTable, threshold: Optional[int],
 # the gather itself runs OUTSIDE the lock (two racing misses both
 # gather — benign, last insert wins — rather than serializing device
 # work behind a host lock).
+# The lint contract (graftlint shared-state-unguarded): every write
+# to the replica cache holds its lock.  Membership/eviction already
+# did (the PR 9 race fix); the catalogue + OrderedLock make the
+# discipline checkable.
+GUARDED_STATE = {"_replica_cache": "_replica_lock"}
+
 _replica_cache: dict = {}
-_replica_lock = threading.Lock()
+_replica_lock = OrderedLock("broadcast.replica_cache")
 _REPLICA_CACHE_MAX = 64
 
 

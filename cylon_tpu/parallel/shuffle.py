@@ -48,6 +48,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import trace
 from ..observe.compile import kernel_factory
+from ..observe.locks import OrderedLock
 from ..ops import compact as ops_compact
 from ..ops import gather as ops_gather
 from . import cost
@@ -75,7 +76,17 @@ _block_hints: dict = {}
 # stay lock-free (a stale read only costs one optimistic dispatch or
 # one count block, never correctness).
 _chunked_keys: set = set()
-_chunk_lock = threading.Lock()
+_chunk_lock = OrderedLock("shuffle.chunk_state")
+
+# The lint contract (graftlint shared-state-unguarded): this module's
+# writes to the chooser's signature state hold _chunk_lock.  The hint
+# UPDATE inside ops_compact.optimistic_dispatch (update_size_hint on
+# the dict we pass it) is deliberately lock-free: a lost grow/shrink
+# race costs at most one redone dispatch — hints are validated against
+# the true counts every call — and serializing it would put a lock
+# acquisition on the optimistic hot path for no correctness gain.
+GUARDED_STATE = {"_chunked_keys": "_chunk_lock",
+                 "_block_hints": "_chunk_lock"}
 
 
 def clear_chunk_state() -> None:
@@ -89,9 +100,13 @@ def _mark_degraded(hint_key) -> None:
         _chunked_keys.add(hint_key)
 
 
-def _mark_promoted(hint_key) -> None:
+def _mark_promoted(hint_key, reseed=None) -> None:
+    """Lift a signature's degrade; ``reseed`` re-records its
+    single-shot size hint under the same lock hold."""
     with _chunk_lock:
         _chunked_keys.discard(hint_key)
+        if reseed is not None:
+            _block_hints[hint_key] = (reseed, 0)
 
 
 class _OverBudget(Exception):
@@ -1393,7 +1408,8 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
             # re-records need right after post() returns anyway —
             # the _chunked_keys gate is what keeps an over-budget
             # hint from being dispatched; promotion overwrites it)
-            _block_hints.pop(hint_key, None)
+            with _chunk_lock:
+                _block_hints.pop(hint_key, None)
             raise _OverBudget(np.asarray(counts).copy(), need, choice,
                               reason)
         return need
@@ -1427,8 +1443,7 @@ def shuffle_leaves(ctx, pid: jax.Array, leaves: Sequence[jax.Array],
             # this call prices back under budget (the data shrank):
             # promote to the single-shot path and reseed the optimism
             # for the NEXT same-signature call
-            _mark_promoted(hint_key)
-            _block_hints[hint_key] = (need, 0)
+            _mark_promoted(hint_key, reseed=need)
             trace.count_max("shuffle.exchange_bytes_peak",
                             choice.peak_bytes)
             dm0 = _devmem_before(ctx)

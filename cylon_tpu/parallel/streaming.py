@@ -45,6 +45,7 @@ from .. import trace
 from ..analysis import plan_check
 from ..config import JoinConfig
 from ..observe.compile import kernel_factory
+from ..observe.locks import OrderedLock
 from ..ops import compact as ops_compact
 from ..ops import gather as ops_gather
 from .dist_ops import (_copartition, _join_copartitioned, _join_prologue,
@@ -190,6 +191,13 @@ def dist_join_streaming(left: DTable, right: DTable, config: JoinConfig,
 # async host ingest/export lane (docs/serving.md "pipelined export")
 # ---------------------------------------------------------------------------
 
+# The lint contract (graftlint shared-state-unguarded): submit's
+# check-then-put and close's set-closed serialize on the pipeline
+# lock; _closed is the only cross-thread flag (HostTask fields are
+# single-writer: the owning worker, then the Event hand-off).
+GUARDED_STATE = {"_closed": "_lock"}
+
+
 class HostTask:
     """Handle on one submitted host-side task: ``wait()`` blocks until
     the worker ran it, then returns its result or re-raises its error
@@ -248,7 +256,7 @@ class HostPipeline:
         # set-closed: without it a task enqueued between close()'s
         # drain and its worker-stop sentinels would never run, and its
         # wait() would block forever
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("streaming.pipeline")
         self._threads = [
             threading.Thread(target=self._run, name=f"{name}-{i}",
                              daemon=True)

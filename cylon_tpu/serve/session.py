@@ -40,8 +40,22 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import faults, resilience, topology, trace
+from ..observe.locks import OrderedLock
 from ..status import Code, CylonError, Status
 from . import admission
+
+# The lint contract (graftlint shared-state-unguarded;
+# docs/static_analysis.md "Concurrency discipline"), by class:
+# QueryQueue._items under the condition's OrderedLock; the breaker's
+# entry table and the session's tallies/latency history under their
+# respective _lock.  NOT catalogued on purpose: ServeSession's
+# _pending_count (dispatcher-thread-only, readers tolerate one-window
+# staleness — see its comment) and _SharedExecMemo (batch-scoped,
+# dispatcher-thread-only).
+GUARDED_STATE = {"_items": "_cv", "_entries": "_lock",
+                 "_stats": "_lock", "_latencies": "_lock",
+                 "_ewma_ms": "_lock", "_ids": "_lock",
+                 "_drained": "_lock"}
 
 __all__ = ["QueryHandle", "QueryQueue", "ServeSession", "percentile",
            "Overloaded", "Quarantined", "CircuitBreaker"]
@@ -177,7 +191,8 @@ class QueryQueue:
                 f"QueryQueue capacity must be >= 1, got {capacity}"))
         self.capacity = capacity
         self._items: deque = deque()
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(
+            OrderedLock("serve.query_queue"))
 
     def put(self, item, block: bool = True,
             timeout: Optional[float] = None) -> bool:
@@ -312,7 +327,7 @@ class CircuitBreaker:
         self.threshold = threshold
         self.cooldown_s = cooldown_s
         self.max_entries = max_entries
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serve.breaker")
         self._entries: Dict[Tuple, _BreakerEntry] = {}
 
     @staticmethod
@@ -358,7 +373,7 @@ class CircuitBreaker:
                 defaults, tuple(cells),
                 0 if bound_to is None else id(bound_to))
 
-    def _entry(self, key: Tuple, op: Callable) -> "_BreakerEntry":
+    def _entry_locked(self, key: Tuple, op: Callable) -> "_BreakerEntry":
         e = self._entries.get(key)
         if e is None:
             while len(self._entries) >= self.max_entries:
@@ -440,7 +455,7 @@ class CircuitBreaker:
         probe's verdict (mirror of ``on_success``'s stale guard)."""
         now = time.monotonic()
         with self._lock:
-            e = self._entry(key, op)
+            e = self._entry_locked(key, op)
             tracked = self._entries.get(key) is e
             if e.state == self.HALF_OPEN:
                 if not probe:
@@ -542,7 +557,7 @@ class ServeSession:
             from ..parallel.streaming import HostPipeline
             self._pipeline = HostPipeline(workers=export_workers,
                                           name=f"{name}-export")
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serve.session")
         self._stats: Dict[str, int] = {
             "submitted": 0, "admitted": 0, "deferred": 0, "rejected": 0,
             "completed": 0, "failed": 0, "batches": 0,

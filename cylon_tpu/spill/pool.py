@@ -52,6 +52,7 @@ import numpy as np
 
 from .. import faults, trace
 from ..config import host_memory_budget, spill_enabled
+from ..observe.locks import OrderedLock
 from ..status import Code, CylonError, Status
 
 __all__ = [
@@ -78,6 +79,14 @@ SANCTIONED_HOST_BOUNDARIES = (
 )
 
 _sig_counter = itertools.count(1)
+
+# The lint contract (graftlint shared-state-unguarded): the pool's
+# entry table and transient reservation mutate only under the pool
+# lock (spill/fault-in deliberately hold it ACROSS the staging
+# transfer — see spill_table's docstring); the module singleton under
+# its registry lock.
+GUARDED_STATE = {"_entries": "_lock", "_transient": "_lock",
+                 "_pool": "_pool_lock"}
 
 
 def stage_out_arrays(arrays: Sequence) -> List[np.ndarray]:
@@ -136,7 +145,7 @@ class SpillPool:
     :func:`get_pool`; a fresh instance per test via ``clear_pool``)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = OrderedLock("spill.pool", reentrant=True)
         # sig -> entry; dict order doubles as LRU recency for the
         # RESIDENT entries (pop/reinsert on touch, oldest first(iter))
         self._entries: Dict[int, _Entry] = {}
@@ -395,7 +404,7 @@ class SpillPool:
 
 
 _pool: Optional[SpillPool] = None
-_pool_lock = threading.Lock()
+_pool_lock = OrderedLock("spill.pool_registry")
 
 
 def get_pool() -> SpillPool:

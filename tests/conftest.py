@@ -53,6 +53,18 @@ if os.environ.get("CYLON_SANITIZE", "0") not in ("", "0"):
     from cylon_tpu import config as _cylon_config
     _cylon_config.sanitize()
 
+# CYLON_LOCKCHECK=1 runs the whole suite with lock-order enforcement on
+# (cylon_tpu.config.lockcheck_enabled): every OrderedLock acquisition
+# feeds the process-wide lock-order DAG, and an AB/BA inversion raises a
+# typed LockOrderViolation at the acquire site instead of degrading to
+# flightrec + warn_once.  The acceptance gate is the full suite staying
+# green under it (docs/static_analysis.md "Concurrency discipline").
+# config reads the env var directly, so no explicit set is needed here;
+# the import just fails fast if the knob plumbing is broken.
+if os.environ.get("CYLON_LOCKCHECK", "0") not in ("", "0"):
+    from cylon_tpu import config as _cylon_config_lc
+    assert _cylon_config_lc.lockcheck_enabled()
+
 # CYLON_CHAOS=<seed> runs the whole suite under a seeded default fault
 # plan (cylon_tpu.faults.FaultPlan.default, mirroring the sanitizer
 # hook above): transient host-read/IO failures inject and are retried,

@@ -1,0 +1,472 @@
+"""Concurrency discipline: the two graftlint rules (static half), the
+OrderedLock runtime detector (lock-order DAG, AB/BA violations, hold
+watchdog), the catalogue's AST-vs-runtime equality, and the
+check-then-act hammers for the caches the satellite work made atomic.
+docs/static_analysis.md "Concurrency discipline" is the contract.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from cylon_tpu import config
+from cylon_tpu.analysis import graftlint, lockcheck
+from cylon_tpu.observe import flightrec
+from cylon_tpu.observe.locks import (LockOrderViolation, OrderedLock,
+                                     clear_graph, known_locks,
+                                     lock_graph)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(src, path="fixture.py"):
+    return sorted({f.rule for f in graftlint.lint_source(src, path)})
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Every test starts with an empty lock-order DAG and default
+    enforcement/watchdog knobs, and leaves them that way."""
+    clear_graph()
+    prev_enf = config.set_lockcheck(None)
+    prev_wd = config.set_lock_hold_watchdog_ms(None)
+    try:
+        yield
+    finally:
+        config.set_lockcheck(prev_enf)
+        config.set_lock_hold_watchdog_ms(prev_wd)
+        clear_graph()
+
+
+# ---------------------------------------------------------------------------
+# the runtime half: OrderedLock
+# ---------------------------------------------------------------------------
+
+def test_ordered_lock_is_a_lock():
+    """Drop-in parity with threading.Lock: context manager, explicit
+    acquire/release, non-blocking acquire, locked()."""
+    lk = OrderedLock("t.parity")
+    with lk:
+        assert lk.locked()
+        assert not lk.acquire(False)   # held: try-acquire fails
+    assert not lk.locked()
+    assert lk.acquire(False)
+    lk.release()
+    assert lk.acquires == 2
+    assert known_locks()["t.parity"] is lk
+
+
+def test_ordered_lock_reentrant_parity():
+    lk = OrderedLock("t.rlock", reentrant=True)
+    with lk:
+        with lk:          # nests like an RLock
+            assert lk.locked()
+        assert lk.locked()
+    assert not lk.locked()
+
+
+def test_ordered_lock_condition_compatible():
+    """threading.Condition over an OrderedLock: the wait/notify
+    protocol (including Condition's foreign-lock ownership probe)."""
+    cv = threading.Condition(OrderedLock("t.cv"))
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait_for(lambda: bool(hits), timeout=30)
+            hits.append("woke")
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.05)
+    with cv:
+        hits.append("go")
+        cv.notify_all()
+    th.join(30)
+    assert hits == ["go", "woke"]
+
+
+def test_lock_graph_records_nesting_edges():
+    a, b = OrderedLock("t.edge_a"), OrderedLock("t.edge_b")
+    with a:
+        with b:
+            pass
+    g = lock_graph()
+    assert "t.edge_b" in g.get("t.edge_a", {})
+    thread_name, site = g["t.edge_a"]["t.edge_b"]
+    assert thread_name == threading.current_thread().name
+    assert "test_lockcheck.py" in site
+    # same-order re-acquisition adds nothing new and no reverse edge
+    with a:
+        with b:
+            pass
+    assert "t.edge_a" not in lock_graph().get("t.edge_b", {})
+
+
+def test_ab_ba_inversion_raises_typed_violation():
+    """The deterministic AB/BA repro: thread 1 orders A -> B, thread 2
+    inverts it and must get the typed violation AT ACQUIRE TIME —
+    naming both chains — instead of deadlocking."""
+    config.set_lockcheck(True)
+    a, b = OrderedLock("t.ab_a"), OrderedLock("t.ab_b")
+    with a:
+        with b:
+            pass
+    caught = []
+
+    def inverter():
+        try:
+            with b:
+                with a:
+                    pass
+        except LockOrderViolation as e:
+            caught.append(e)
+
+    th = threading.Thread(target=inverter, name="ab-ba-inverter")
+    th.start()
+    th.join(30)
+    assert len(caught) == 1
+    err = caught[0]
+    msg = str(err)
+    # both chains, by name: the held stack and the recorded order
+    assert "t.ab_b -> t.ab_a" in msg          # this thread's ordering
+    assert "t.ab_a -> t.ab_b" in msg          # the recorded ordering
+    assert "ab-ba-inverter" in msg            # who inverted
+    assert err.cycle == ["t.ab_a", "t.ab_b", "t.ab_a"]
+    # the violating edge was NOT inserted: the DAG stays acyclic
+    assert "t.ab_a" not in lock_graph().get("t.ab_b", {})
+    # and it reached the flight recorder with both chains attached
+    ev = [e for e in flightrec.events() if e["kind"] == "lock_violation"
+          and e.get("src") == "t.ab_b"]
+    assert ev and "t.ab_a -> t.ab_b" in ev[-1]["chain_prior"]
+
+
+def test_violation_without_enforcement_warns_not_raises():
+    from cylon_tpu import logging as glog
+    glog.reset_warn_once()
+    assert not config.lockcheck_enabled()
+    a, b = OrderedLock("t.warn_a"), OrderedLock("t.warn_b")
+    with a:
+        with b:
+            pass
+    done = []
+
+    def inverter():
+        with b:
+            with a:       # inverted — but enforcement is off
+                done.append(True)
+
+    th = threading.Thread(target=inverter)
+    th.start()
+    th.join(30)
+    assert done == [True]
+    assert any(e["kind"] == "lock_violation" and e["src"] == "t.warn_b"
+               for e in flightrec.events())
+
+
+def test_hold_watchdog_notes_flightrec():
+    config.set_lock_hold_watchdog_ms(10)
+    lk = OrderedLock("t.slow")
+    with lk:
+        time.sleep(0.05)
+    ev = [e for e in flightrec.events() if e["kind"] == "lock_hold"
+          and e.get("lock") == "t.slow"]
+    assert ev and ev[-1]["held_ms"] >= 10
+    assert lk.held_us_max >= 10_000
+
+
+def test_watchdog_knob_validation():
+    assert config.lock_hold_watchdog_ms() == 1000   # the default
+    prev = config.set_lock_hold_watchdog_ms(250)
+    assert config.lock_hold_watchdog_ms() == 250
+    with pytest.raises(Exception):
+        config.set_lock_hold_watchdog_ms(-1)
+    with pytest.raises(Exception):
+        config.set_lock_hold_watchdog_ms(True)
+    config.set_lock_hold_watchdog_ms(prev)
+
+
+def test_sanitize_enables_enforcement():
+    assert not config.lockcheck_enabled()
+    with config.sanitize():
+        assert config.lockcheck_enabled()
+    assert not config.lockcheck_enabled()
+
+
+# ---------------------------------------------------------------------------
+# the static half: the two rules on seeded fixtures
+# ---------------------------------------------------------------------------
+
+GUARDED_FIXTURE = (
+    "import threading\n"
+    "GUARDED_STATE = {'_items': '_lock'}\n"
+    "_items: list = []\n"
+    "_lock = threading.Lock()\n"
+)
+
+
+def test_shared_state_write_outside_lock_fires():
+    src = GUARDED_FIXTURE + (
+        "def f(x):\n"
+        "    _items.append(x)\n")
+    assert "shared-state-unguarded" in _rules(src)
+
+
+def test_shared_state_write_under_lock_is_clean():
+    src = GUARDED_FIXTURE + (
+        "def f(x):\n"
+        "    with _lock:\n"
+        "        _items.append(x)\n")
+    assert "shared-state-unguarded" not in _rules(src)
+
+
+def test_shared_state_assignment_forms_fire():
+    base = GUARDED_FIXTURE.replace("'_items': '_lock'",
+                                   "'_n': '_lock'") + "_n = 0\n"
+    for stmt in ("_n = 1", "_n += 1", "del _n"):
+        src = base + f"def f():\n    global _n\n    {stmt}\n"
+        assert "shared-state-unguarded" in _rules(src), stmt
+
+
+def test_shared_state_exemptions():
+    # __init__ construction and *_locked helpers are exempt by contract
+    src = (
+        "import threading\n"
+        "GUARDED_STATE = {'_entries': '_lock'}\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._entries = {}\n"
+        "    def _evict_locked(self):\n"
+        "        self._entries.clear()\n")
+    assert "shared-state-unguarded" not in _rules(src)
+
+
+def test_uncatalogued_module_mutable_in_threaded_module_fires():
+    src = ("import threading\n"
+           "_cache: dict = {}\n"
+           "def go():\n"
+           "    threading.Thread(target=print).start()\n")
+    assert "shared-state-unguarded" in _rules(src)
+    # CONSTANT_CASE tables are immutable-by-convention: exempt
+    clean = src.replace("_cache", "_TABLE")
+    assert "shared-state-unguarded" not in _rules(clean)
+    # and a catalogued mapping satisfies the rule
+    fixed = "GUARDED_STATE = {'_cache': '_lock'}\n" + src + \
+            "_lock = threading.Lock()\n"
+    assert "shared-state-unguarded" not in _rules(fixed)
+
+
+def test_blocking_call_under_lock_fires():
+    src = ("import jax, threading, time\n"
+           "_lock = threading.Lock()\n"
+           "def f(x, fut, th):\n"
+           "    with _lock:\n"
+           "        jax.block_until_ready(x)\n"
+           "        fut.result(5)\n"
+           "        th.join(2.0)\n"
+           "        time.sleep(0.1)\n")
+    fnd = [f for f in graftlint.lint_source(src, "fixture.py")
+           if f.rule == "blocking-call-under-lock"]
+    assert len(fnd) == 4
+
+
+def test_blocking_call_exemptions():
+    src = ("import jax, threading, os\n"
+           "_lock = threading.Lock()\n"
+           "def f(x, strs, cv):\n"
+           "    with _lock:\n"
+           "        s = ', '.join(strs)\n"          # str.join: exempt
+           "        p = os.path.join('a', 'b')\n"   # path join: exempt
+           "        cv.wait(1.0)\n"                 # Condition: exempt
+           "    jax.block_until_ready(x)\n"         # after the with
+           "    def later():\n"
+           "        return jax.block_until_ready(x)\n")
+    assert "blocking-call-under-lock" not in _rules(src)
+    # a def INSIDE the with runs later, not under the lock
+    deferred = ("import jax, threading\n"
+                "_lock = threading.Lock()\n"
+                "def f(x):\n"
+                "    with _lock:\n"
+                "        def later():\n"
+                "            return jax.block_until_ready(x)\n"
+                "        return later\n")
+    assert "blocking-call-under-lock" not in _rules(deferred)
+
+
+def test_blocking_call_suppression():
+    src = ("import jax, threading\n"
+           "_lock = threading.Lock()\n"
+           "def f(x):\n"
+           "    with _lock:\n"
+           "        jax.block_until_ready(x)"
+           "  # graftlint: ok[blocking-call-under-lock]\n")
+    assert "blocking-call-under-lock" not in _rules(src)
+
+
+# ---------------------------------------------------------------------------
+# catalogue honesty: AST view == runtime view, everywhere
+# ---------------------------------------------------------------------------
+
+CATALOGUED_MODULES = (
+    "cylon_tpu.logging",
+    "cylon_tpu.observe.stats",
+    "cylon_tpu.observe.timeseries",
+    "cylon_tpu.serve.session",
+    "cylon_tpu.serve.admission",
+    "cylon_tpu.spill.pool",
+    "cylon_tpu.parallel.shuffle",
+    "cylon_tpu.parallel.broadcast",
+    "cylon_tpu.parallel.streaming",
+    "cylon_tpu.analysis.lockcheck",
+)
+
+
+@pytest.mark.parametrize("modname", CATALOGUED_MODULES)
+def test_guarded_state_parse_matches_runtime(modname):
+    """The AST-parsed catalogue (what lint checks against) must equal
+    the imported module's GUARDED_STATE (what the code actually does)
+    — the same two-view equality the metric and fault-point catalogues
+    get."""
+    import importlib
+    mod = importlib.import_module(modname)
+    assert lockcheck.guarded_state(mod.__file__) == mod.GUARDED_STATE
+
+
+def test_every_catalogued_lock_is_an_ordered_lock():
+    """The catalogue names a lock; the runtime object must be the
+    instrumented kind (or a Condition wrapping one) — a catalogued
+    plain Lock would be invisible to the order detector.  The two
+    deliberate plain locks (locks._graph_lock, graftlint's
+    stdlib-importable cache lock) are exactly the ones no catalogue
+    maps, or whose module cannot import the observe layer."""
+    import importlib
+    for modname in CATALOGUED_MODULES:
+        mod = importlib.import_module(modname)
+        for lock_name in set(mod.GUARDED_STATE.values()):
+            if not hasattr(mod, lock_name):
+                continue   # instance-attr locks are checked in __init__
+            lk = getattr(mod, lock_name)
+            assert isinstance(lk, OrderedLock), (modname, lock_name)
+
+
+def test_tree_is_clean_under_concurrency_rules():
+    """The burn-down gate: zero findings for the two concurrency rules
+    across the whole tree (the lockcheck CLI's exit-0 contract)."""
+    rc = lockcheck.main([os.path.join(REPO, "cylon_tpu"),
+                         os.path.join(REPO, "bench.py")])
+    assert rc == 0
+
+
+def test_lockcheck_cli_usage_contract():
+    assert lockcheck.main([]) == 2
+    assert lockcheck.main(["/no/such/path"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the check-then-act hammers (satellite: warn_once + the lint caches)
+# ---------------------------------------------------------------------------
+
+def test_warn_once_hammer_exactly_one_winner():
+    """N racing threads with one key: exactly one emits (returns True).
+    The check-then-add pair is atomic under the catalogued lock."""
+    from cylon_tpu import logging as glog
+    glog.reset_warn_once()
+    results = []
+    start = threading.Barrier(8)
+
+    def racer():
+        start.wait()
+        for i in range(50):
+            results.append(glog.warn_once(("t.hammer", i), "m"))
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert sum(results) == 50          # one winner per key
+    assert len(results) == 8 * 50      # nobody lost a call
+    glog.reset_warn_once()
+
+
+def test_catalogue_cache_hammer(tmp_path):
+    """Two threads hammering the mtime-cached parser over files being
+    rewritten: every read returns a CONSISTENT catalogue (one of the
+    file's two states, never a torn/stale-keyed mix) and never raises."""
+    p = tmp_path / "mod.py"
+    catalogs = [{"_a": "_la"}, {"_b": "_lb"}]
+    p.write_text("GUARDED_STATE = {'_a': '_la'}\n")
+    lockcheck.clear_cache()
+    stop = time.monotonic() + 1.0
+    errs = []
+
+    def reader():
+        while time.monotonic() < stop:
+            got = lockcheck.guarded_state(str(p))
+            if got is not None and got not in catalogs:
+                errs.append(got)
+
+    def writer():
+        i = 0
+        while time.monotonic() < stop:
+            i += 1
+            cat = catalogs[i % 2]
+            body = ", ".join(f"'{k}': '{v}'" for k, v in cat.items())
+            p.write_text("GUARDED_STATE = {%s}\n" % body)
+
+    threads = [threading.Thread(target=f)
+               for f in (reader, reader, writer)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert errs == []
+    lockcheck.clear_cache()
+
+
+def _plan_groupby(t):
+    from cylon_tpu.parallel import dist_groupby, shuffle_table
+    s = shuffle_table(t["fact"], ["k"])
+    return dist_groupby(s, ["k"], [("v", "sum")])
+
+
+def test_serve_window_under_enforcement(dctx):
+    """CYLON_LOCKCHECK=1 end-to-end: a concurrent serve window runs
+    green with every OrderedLock in the engine order-checked — queue
+    condition, breaker, session stats, warn_once — while real queries
+    flow (the suite-wide claim of conftest's CYLON_LOCKCHECK wiring,
+    in miniature)."""
+    import numpy as np
+    import pandas as pd
+
+    from cylon_tpu.parallel.dtable import DTable
+    from cylon_tpu.serve import ServeSession
+
+    config.set_lockcheck(True)
+    rng = np.random.default_rng(3)
+    n = 256
+    dts = {"fact": DTable.from_pandas(dctx, pd.DataFrame({
+        "k": rng.integers(0, 16, n).astype(np.int32),
+        "v": rng.random(n).astype(np.float64)}))}
+
+    with ServeSession(dctx, tables=dts, batch_window_ms=10.0) as s:
+        handles = []
+
+        def client(i):
+            handles.append(s.submit(_plan_groupby, label=f"h{i}"))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        outs = [h.result(timeout=600) for h in handles]
+        stats = s.stats()
+    assert len(outs) == 8
+    assert stats["failed"] == 0
+    assert stats["completed"] == 8
+    # the engine's own locks populated the DAG while enforcement held
+    assert known_locks()
