@@ -1452,6 +1452,40 @@ def main() -> None:
                 len(observe.STATS_STORE.fingerprints())
             em.emit("stats")
 
+        # per-fingerprint regression attribution (docs/observability.md
+        # "Live telemetry plane"): diff this round's run-stats store
+        # against the PREVIOUS bench round's snapshot (kept at
+        # <CYLON_STATS_PATH>.prev), so a gate failure upstream comes
+        # with the plan node that caused it; then roll the snapshot
+        # forward for the next round.
+        stats_path = os.environ.get("CYLON_STATS_PATH") or ""
+        if q_ms and stats_path:
+            import shutil
+
+            from cylon_tpu import observe
+            from cylon_tpu.analysis import queryprof
+            try:
+                observe.STATS_STORE.save()
+            except Exception as e:  # graftlint: ok[broad-except] — a failed flush must not kill the bench
+                print(f"stats store save FAILED: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+            prev_path = stats_path + ".prev"
+            if os.path.exists(stats_path):
+                if os.path.exists(prev_path):
+                    try:
+                        findings = queryprof.diff_snapshots(
+                            prev_path, stats_path)
+                        em.detail["queryprof_findings"] = len(findings)
+                        for line in queryprof.render_findings(
+                                findings)[:8]:
+                            print(f"queryprof: {line}")
+                    except Exception as e:  # graftlint: ok[broad-except] — attribution is advisory here
+                        print(f"queryprof pass FAILED: "
+                              f"{type(e).__name__}: {str(e)[:200]}",
+                              file=sys.stderr)
+                shutil.copyfile(stats_path, prev_path)
+                em.emit("queryprof")
+
         # sustained-load stage (docs/observability.md "the time-series
         # sampler"): CYLON_BENCH_SUSTAIN=<seconds> runs 8 closed-loop
         # client threads against a ServeSession for minutes, sampling
@@ -1539,6 +1573,22 @@ def main() -> None:
                     if lat_sorted else None
                 em.detail["serve_sustain_p99_ms"] = round(_pct(99), 2) \
                     if lat_sorted else None
+                # histogram-derived percentiles (docs/observability.md
+                # "Live telemetry plane"): the session's O(1)-memory
+                # latency histogram — p999 is gated UP by benchdiff,
+                # and the hist p50/p99 ride along so drift between the
+                # exact client-side numbers and the bucketed serving
+                # numbers is visible in the artifact
+                srv_stats = srv.stats()
+                em.detail["serve_sustain_p999_ms"] = \
+                    (round(srv_stats["p999_ms"], 2)
+                     if srv_stats["p999_ms"] is not None else None)
+                em.detail["serve_sustain_hist_p50_ms"] = \
+                    (round(srv_stats["p50_ms"], 2)
+                     if srv_stats["p50_ms"] is not None else None)
+                em.detail["serve_sustain_hist_p99_ms"] = \
+                    (round(srv_stats["p99_ms"], 2)
+                     if srv_stats["p99_ms"] is not None else None)
                 em.detail["serve_sustain_samples"] = summary["samples"]
                 em.detail["serve_sustain_dropped"] = summary["dropped"]
                 em.detail["serve_sustain_cache_hit_ratio"] = \

@@ -44,9 +44,10 @@ Gated metrics (relative threshold, default 15%):
   * ``serve_sustain_qps`` (whole-run completed/wall) and
     ``serve_sustain_steady_qps`` (the sampler's warm-up-excluded
     steady-state roll-up) — both lower = worse — plus
-    ``serve_sustain_p99_ms`` tail latency (higher = worse), from the
-    sustained-load stage (CYLON_BENCH_SUSTAIN;
-    docs/observability.md "the time-series sampler")
+    ``serve_sustain_p99_ms`` / ``serve_sustain_p999_ms`` tail latency
+    (higher = worse), from the sustained-load stage
+    (CYLON_BENCH_SUSTAIN; docs/observability.md "the time-series
+    sampler" and "Live telemetry plane")
   * ``tpch_<q>_recompiles``  jit builds inside the TIMED (warm) rep
     (higher = worse — a compile-cache-key regression re-tracing per
     call; the warm-up ``tpch_<q>_compile_ms`` column is reported but
@@ -160,6 +161,11 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     (r"serve_sustain_qps$", "down"),
     (r"serve_sustain_steady_qps$", "down"),
     (r"serve_sustain_p99_ms$", "up"),
+    # extreme-tail latency from the session's mergeable latency
+    # histogram (docs/observability.md "Live telemetry plane") — the
+    # p999 regresses before the p99 when a small fraction of queries
+    # fall off the fast path (breaker probes, recovery ladders)
+    (r"serve_sustain_p999_ms$", "up"),
     # compile tracking (docs/observability.md "compile tracking"):
     # steady-state recompiles per query gate UP — a timed rep is warm,
     # so any recompile there is a cache-key regression (a thrashing

@@ -80,10 +80,21 @@ Stages:
      ``CYLON_LOCKCHECK`` enforcement — BEFORE any thread blocks — and
      an 8-client serving window must run green with enforcement live
      suite-wide (``--no-lockcheck-smoke`` skips);
- 11. **benchdiff** (only when ``--baseline`` and a candidate artifact
+ 11. **export smoke** (docs/observability.md "Live telemetry plane"):
+     the OpenMetrics endpoint is started on an ephemeral loopback port
+     and scraped over real HTTP — every exposed family must map back
+     to a catalogued metric of the matching kind, the latency
+     histogram must carry cumulative buckets, and the
+     config-fingerprint info metric must be present; the JSON-lines
+     event log must capture a seeded SLO (deadline) miss as valid
+     JSON; and tail-based trace sampling must retain the always-keep
+     query's spans while dropping (and accounting for) the fast
+     peers' (``--no-export-smoke`` skips);
+ 12. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
      including the serving families (``serve_qps``/``serve_sustain_qps``
-     down, ``serve_p99_ms``/``serve_sustain_p99_ms`` up), the
+     down, ``serve_p99_ms``/``serve_sustain_p99_ms``/
+     ``serve_sustain_p999_ms`` up), the
      ``tpch_<q>_recompiles`` / ``serve_slo_violations`` up-gates, the
      chaos family (``serve_chaos_recovered_ratio`` down,
      ``serve_chaos_p99_ms`` up), and the mesh-chaos family
@@ -116,14 +127,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/11: graftlint ==")
+    print("== ci stage 1/12: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/11: plan_check pre-flight ==")
+    print("== ci stage 2/12: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -184,7 +195,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/11: serving smoke ==")
+    print("== ci stage 3/12: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -307,7 +318,7 @@ def _stage_telemetry_smoke(sf: float) -> int:
     CONTRACTS rather than the numbers: sampler non-empty, catalogue
     compliance, export validity (one track per query trace id), stats
     store populated with per-node observations."""
-    print("== ci stage 4/11: telemetry smoke ==")
+    print("== ci stage 4/12: telemetry smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -429,7 +440,7 @@ def _stage_doctor_smoke(sf: float) -> int:
     post-mortem machinery end to end: the victim fails onto its own
     handle, peers stay row-identical to serial execution, a
     flight-recorder bundle lands on disk, and doctor renders it."""
-    print("== ci stage 5/11: doctor smoke ==")
+    print("== ci stage 5/12: doctor smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -541,7 +552,7 @@ def _stage_chaos_smoke(sf: float) -> int:
     shows the ladder's stage retry with fewer stages replayed than the
     plan has), peers complete untouched, and the flight-recorder
     bundle doctor renders shows the ladder's events."""
-    print("== ci stage 6/11: chaos-recovery smoke ==")
+    print("== ci stage 6/12: chaos-recovery smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -696,7 +707,7 @@ def _stage_ooc_smoke(sf: float) -> int:
     run, and the exchange transient must stay within the pinned
     budget.  On failure a flight-recorder bundle is dumped and doctor
     renders it, so the evidence ships with the red CI run."""
-    print("== ci stage 7/11: out-of-core smoke ==")
+    print("== ci stage 7/12: out-of-core smoke ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -798,7 +809,7 @@ def _stage_mesh_smoke(sf: float) -> int:
     slices, the session must flip into degraded mode, and the
     flight-recorder bundle doctor renders must show the
     ``mesh_degraded`` event + evacuation timeline."""
-    print("== ci stage 8/11: mesh-loss chaos smoke ==")
+    print("== ci stage 8/12: mesh-loss chaos smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -971,7 +982,7 @@ def _stage_hierarchy_smoke() -> int:
     flat single-shot slow-share price.  A forced hierarchical leg and
     a forced hierarchical-combine fused-groupby leg prove both
     lowerings independently."""
-    print("== ci stage 9/11: hierarchy smoke ==")
+    print("== ci stage 9/12: hierarchy smoke ==")
     t0 = time.perf_counter()
     try:
         import dataclasses
@@ -1160,7 +1171,7 @@ def _stage_lockcheck_smoke() -> int:
     detector reports the deadlock instead of experiencing it; (c) an
     8-client serving window runs green with CYLON_LOCKCHECK
     enforcement live across every OrderedLock in the engine."""
-    print("== ci stage 10/11: concurrency smoke ==")
+    print("== ci stage 10/12: concurrency smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -1272,10 +1283,157 @@ def _stage_lockcheck_smoke() -> int:
     return 1 if bad else 0
 
 
+def _stage_export_smoke(sf: float) -> int:
+    """Live-telemetry-plane smoke (docs/observability.md): (a) the
+    OpenMetrics exporter binds an ephemeral loopback port and a real
+    HTTP scrape parses — every exposed family must map back to a
+    catalogued metric of the matching kind, histograms must carry
+    cumulative buckets, and the config-fingerprint info metric must be
+    present; (b) the JSON-lines event log captures a seeded SLO
+    (deadline) miss as one valid-JSON line; (c) tail-based trace
+    sampling retains the always-keep query's span waterfall and drops
+    the fast peers', with ``trace.sampled_out`` accounting for the
+    purge."""
+    print("== ci stage 11/12: export smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import json
+        import os
+        import re as _re
+        import tempfile
+        import urllib.request
+
+        import jax
+
+        from .. import trace
+        from ..context import CylonContext
+        from ..observe import exporter
+        from ..observe.metrics import COUNTER, HISTOGRAM, METRICS
+        from ..parallel.dtable import DTable
+        from ..serve import ServeSession
+        from ..tpch import generate
+        from ..tpch.queries import QUERIES
+
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(sf, seed=17)
+        dts = {name: DTable.from_pandas(ctx, df)
+               for name, df in data.items()}
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding
+        print(f"export smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    tmp = tempfile.mkdtemp(prefix="cylon-export-smoke-")
+    evt_path = os.path.join(tmp, "events.jsonl")
+    trace.enable()
+    trace.reset()
+    try:
+        port = exporter.start(0)
+        exporter.start_event_log(evt_path)
+
+        # (c)'s workload doubles as (a)+(b)'s event source: three q6
+        # runs SEQUENTIALLY — the first pays the compile and lands in
+        # the top-k heap; the cache-warm repeats are strictly faster,
+        # so with tail_keep_k=1 they are the droppable fast peers —
+        # plus one query carrying an impossible deadline, whose miss
+        # is the seeded SLO event AND the always-keep retention case
+        with ServeSession(ctx, tables=dts, batch_window_ms=20.0,
+                          tail_keep_k=1) as s:
+            fast = []
+            for i in range(3):
+                h = s.submit(lambda t, q=QUERIES["q6"]: q(ctx, t),
+                             label=f"fast{i}",
+                             export=lambda r: r.to_pandas())
+                h.result(timeout=600)
+                fast.append(h)
+            miss = s.submit(lambda t, q=QUERIES["q1"]: q(ctx, t),
+                            label="slo-miss", deadline_ms=0.001,
+                            export=lambda r: r.to_pandas())
+            miss.result(timeout=600)
+
+        # (a) a real scrape over HTTP, then forward catalogue
+        # compliance: every TYPE family must come from a catalogued
+        # metric and agree on the OpenMetrics kind
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+            body = resp.read().decode("utf-8")
+        if not body.endswith("# EOF\n"):
+            print("export smoke: scrape payload is not # EOF-terminated",
+                  file=sys.stderr)
+            bad += 1
+        allowed = {}
+        for name, spec in METRICS.items():
+            fam = exporter.family_name(name)
+            allowed[fam] = ("counter" if spec.kind == COUNTER else
+                            "histogram" if spec.kind == HISTOGRAM
+                            else "gauge")
+        for m in _re.finditer(r"^# TYPE (\S+) (\S+)$", body, _re.M):
+            fam, om_kind = m.group(1), m.group(2)
+            if allowed.get(fam) != om_kind:
+                print(f"export smoke: exposed family {fam} ({om_kind}) "
+                      f"does not match the catalogue "
+                      f"({allowed.get(fam)})", file=sys.stderr)
+                bad += 1
+        lat_fam = exporter.family_name("serve.latency_ms")
+        if f'{lat_fam}_bucket{{le="+Inf"}}' not in body:
+            print("export smoke: serve.latency_ms histogram has no "
+                  "+Inf cumulative bucket", file=sys.stderr)
+            bad += 1
+        if "cylon_observe_config_info{" not in body:
+            print("export smoke: config-fingerprint info metric "
+                  "missing from the scrape", file=sys.stderr)
+            bad += 1
+
+        # (b) the event log: one JSON object per line, and the seeded
+        # deadline miss must be among them
+        exporter.stop_event_log()
+        kinds = []
+        with open(evt_path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                kinds.append(json.loads(line)["kind"])
+        if "deadline_miss" not in kinds:
+            print(f"export smoke: seeded SLO miss not in the event log "
+                  f"(kinds={sorted(set(kinds))})", file=sys.stderr)
+            bad += 1
+
+        # (c) tail retention: the miss's waterfall survives, at least
+        # one fast peer's was purged and accounted for
+        kept_ids = {r[5] for r in trace.get_span_records(True) if r[5]}
+        if miss.trace_id not in kept_ids:
+            print("export smoke: the always-keep (deadline-missed) "
+                  "query's spans were dropped", file=sys.stderr)
+            bad += 1
+        dropped_ids = {h.trace_id for h in fast} - kept_ids
+        sampled_out = trace.snapshot()["counters"].get(
+            "trace.sampled_out", 0)
+        if not dropped_ids or not sampled_out:
+            print(f"export smoke: tail sampling dropped no fast peer "
+                  f"(dropped={len(dropped_ids)}, "
+                  f"sampled_out={sampled_out})", file=sys.stderr)
+            bad += 1
+        if not bad:
+            print(f"export smoke: scrape ok on :{port}, "
+                  f"{len(kinds)} event(s) logged, "
+                  f"{len(dropped_ids)} trace(s) sampled out "
+                  f"({time.perf_counter() - t0:.1f}s, sf={sf})")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract
+        print(f"export smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        exporter.stop_event_log()
+        exporter.stop()
+        trace.disable()
+        trace.reset()
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 11/11: benchdiff ==")
+    print("== ci stage 12/12: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -1313,6 +1471,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the hierarchical-collectives smoke stage")
     ap.add_argument("--no-lockcheck-smoke", action="store_true",
                     help="skip the concurrency (lockcheck) smoke stage")
+    ap.add_argument("--no-export-smoke", action="store_true",
+                    help="skip the telemetry-export (OpenMetrics + "
+                         "event log + tail sampling) smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -1322,44 +1483,48 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/11: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/12: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/11: serving smoke == (skipped)")
+        print("== ci stage 3/12: serving smoke == (skipped)")
     if not args.no_telemetry_smoke:
         rcs.append(_stage_telemetry_smoke(args.tpch_sf))
     else:
-        print("== ci stage 4/11: telemetry smoke == (skipped)")
+        print("== ci stage 4/12: telemetry smoke == (skipped)")
     if not args.no_doctor_smoke:
         rcs.append(_stage_doctor_smoke(args.tpch_sf))
     else:
-        print("== ci stage 5/11: doctor smoke == (skipped)")
+        print("== ci stage 5/12: doctor smoke == (skipped)")
     if not args.no_chaos_smoke:
         rcs.append(_stage_chaos_smoke(args.tpch_sf))
     else:
-        print("== ci stage 6/11: chaos-recovery smoke == (skipped)")
+        print("== ci stage 6/12: chaos-recovery smoke == (skipped)")
     if not args.no_ooc_smoke:
         rcs.append(_stage_ooc_smoke(args.tpch_sf))
     else:
-        print("== ci stage 7/11: out-of-core smoke == (skipped)")
+        print("== ci stage 7/12: out-of-core smoke == (skipped)")
     if not args.no_mesh_smoke:
         rcs.append(_stage_mesh_smoke(args.tpch_sf))
     else:
-        print("== ci stage 8/11: mesh-loss chaos smoke == (skipped)")
+        print("== ci stage 8/12: mesh-loss chaos smoke == (skipped)")
     if not args.no_hierarchy_smoke:
         rcs.append(_stage_hierarchy_smoke())
     else:
-        print("== ci stage 9/11: hierarchy smoke == (skipped)")
+        print("== ci stage 9/12: hierarchy smoke == (skipped)")
     if not args.no_lockcheck_smoke:
         rcs.append(_stage_lockcheck_smoke())
     else:
-        print("== ci stage 10/11: concurrency smoke == (skipped)")
+        print("== ci stage 10/12: concurrency smoke == (skipped)")
+    if not args.no_export_smoke:
+        rcs.append(_stage_export_smoke(args.tpch_sf))
+    else:
+        print("== ci stage 11/12: export smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 11/11: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 12/12: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

@@ -907,7 +907,7 @@ class _Linter(ast.NodeVisitor):
 _INSTRUMENT_DECOS = ("plan_check.instrument", "instrument")
 _DIST_OP_RE = re.compile(r"^(dist|shuffle)_[a-z0-9_]+$")
 
-_COUNTER_FNS = {"count", "count_max", "gauge"}
+_COUNTER_FNS = {"count", "count_max", "gauge", "hist"}
 
 # One shared mtime-cached "parse a catalogue literal out of a sibling
 # file" helper behind the three catalogue-backed rules.  Cache entries

@@ -29,6 +29,13 @@ subsystem underneath and above it (docs/observability.md):
   * **flightrec** / **doctor** — the flight recorder's bounded event
     ring + crash bundles, and the ``python -m cylon_tpu.observe.doctor``
     renderer for them.
+  * **histogram** — mergeable log2-bucket histograms: O(1)-memory
+    p50/p99/p999 with lossless cross-thread/cross-registry merge (the
+    percentile math behind ``ServeSession.stats()`` and the sampler).
+  * **exporter** — the live telemetry plane's export surface: a bounded
+    stdlib-HTTP OpenMetrics endpoint (``CYLON_METRICS_PORT`` /
+    ``config.set_metrics_port``) plus the rotating JSON-lines event log
+    (``CYLON_EVENT_LOG``) streaming flightrec events to collectors.
 
 Everything the old flat ``observe`` module exported is re-exported here
 unchanged — ``observe.METRICS``, ``observe.analyze``,
@@ -36,22 +43,25 @@ unchanged — ``observe.METRICS``, ``observe.analyze``,
 """
 from __future__ import annotations
 
-from . import compile, devmem, flightrec, locks, stats, timeseries
+from . import (compile, devmem, exporter, flightrec, histogram, locks,
+               stats, timeseries)
 from .analyze import analyze
 from .compile import kernel_factory
 from .export import export_chrome_trace
+from .histogram import Histogram
 from .locks import LockOrderViolation, OrderedLock
-from .metrics import (COUNTER, GAUGE, METRICS, REGISTRY, WATERMARK,
-                      MetricSpec, MetricsRegistry, counter_delta,
-                      exchange_count, row_bytes)
+from .metrics import (COUNTER, GAUGE, HISTOGRAM, METRICS, REGISTRY,
+                      WATERMARK, MetricSpec, MetricsRegistry,
+                      counter_delta, exchange_count, row_bytes)
 from .stats import STORE as STATS_STORE
 from .timeseries import TimeSeriesSampler
 
 __all__ = [
-    "COUNTER", "WATERMARK", "GAUGE", "MetricSpec", "METRICS",
-    "MetricsRegistry", "REGISTRY", "export_chrome_trace", "analyze",
-    "exchange_count", "counter_delta", "row_bytes", "TimeSeriesSampler",
-    "STATS_STORE", "stats", "timeseries", "compile", "devmem",
-    "flightrec", "kernel_factory", "locks", "OrderedLock",
-    "LockOrderViolation",
+    "COUNTER", "WATERMARK", "GAUGE", "HISTOGRAM", "MetricSpec",
+    "METRICS", "MetricsRegistry", "REGISTRY", "export_chrome_trace",
+    "analyze", "exchange_count", "counter_delta", "row_bytes",
+    "TimeSeriesSampler", "STATS_STORE", "stats", "timeseries",
+    "compile", "devmem", "flightrec", "kernel_factory", "locks",
+    "OrderedLock", "LockOrderViolation", "Histogram", "histogram",
+    "exporter",
 ]

@@ -176,6 +176,12 @@ def render(doc: Dict[str, Any]) -> str:
     lines.append(_section("ring"))
     lines.append(f"  {len(doc.get('events', []))} events retained, "
                  f"{doc.get('events_dropped', 0)} dropped")
+
+    suppressed = counters.get("flightrec.dumps_suppressed")
+    if suppressed:
+        lines.append(f"  NOTE: {suppressed} later auto-dump(s) were "
+                     f"suppressed after the per-process cap — this "
+                     f"bundle may not cover the most recent failure")
     return "\n".join(lines)
 
 

@@ -40,8 +40,8 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 __all__ = ["note", "events", "dropped", "clear", "bundle", "dump",
-           "maybe_dump_on_error", "CAPACITY", "MAX_AUTO_DUMPS",
-           "LAST_K_QUERIES"]
+           "maybe_dump_on_error", "set_tap", "config_fingerprint",
+           "CAPACITY", "MAX_AUTO_DUMPS", "LAST_K_QUERIES"]
 
 CAPACITY = int(os.environ.get("CYLON_FLIGHTREC_CAP", "256"))
 MAX_AUTO_DUMPS = 3          # per process; a crash loop stays bounded
@@ -54,6 +54,9 @@ _auto_dumps = 0
 _dump_seq = 0   # monotone per process: two back-to-back dumps (two
 #                 failures in one batch window) must never collide on
 #                 a wall-clock-derived filename and clobber each other
+_tap = None     # event tap (observe/exporter.py's JSON-lines event
+#                 log); invoked OUTSIDE _lock so a tap that itself
+#                 notes (or logs) cannot deadlock the ring
 
 
 # ---------------------------------------------------------------------------
@@ -71,6 +74,24 @@ def note(kind: str, **payload) -> None:
         if len(_ring) == _ring.maxlen:
             _dropped += 1
         _ring.append(ev)
+    tap = _tap
+    if tap is not None:
+        try:
+            tap(ev)
+        except Exception:  # graftlint: ok[broad-except] — a broken tap
+            pass            # must not take down the flight it records
+
+
+def set_tap(fn) -> Optional[Any]:
+    """Install (``fn``) or clear (``None``) the event tap: a callable
+    invoked with every noted event dict right after it enters the ring,
+    outside the ring lock.  The exporter's JSON-lines event log
+    (docs/observability.md "Live telemetry plane") is the intended
+    installer.  Returns the previous tap.  Tap exceptions are swallowed
+    by :func:`note` — the recorder never raises."""
+    global _tap
+    prev, _tap = _tap, fn
+    return prev
 
 
 def events() -> List[Dict[str, Any]]:
@@ -136,6 +157,12 @@ def _config_fingerprint() -> Dict[str, Any]:
         if v:
             out[env] = v
     return out
+
+
+def config_fingerprint() -> Dict[str, Any]:
+    """Public view of the bundle's config fingerprint — the label
+    source for the exporter's ``cylon_observe_config_info`` metric."""
+    return _config_fingerprint()
 
 
 def bundle(reason: str = "on-demand",
@@ -204,9 +231,19 @@ def maybe_dump_on_error(reason: str,
     if not base:
         return None
     with _lock:
-        if _auto_dumps >= MAX_AUTO_DUMPS:
-            return None
-        _auto_dumps += 1
+        suppressed = _auto_dumps >= MAX_AUTO_DUMPS
+        if not suppressed:
+            _auto_dumps += 1
+    if suppressed:
+        # the cap fired: no bundle will be written for this error.
+        # Book it loudly (direct registry bump — visible even with
+        # trace counters off) and note the ring so doctor + the event
+        # log can tell operators bundles are missing.
+        from .metrics import REGISTRY
+        REGISTRY.bump("flightrec.dumps_suppressed")
+        note("dump_suppressed", reason=reason,
+             error=type(error).__name__)
+        return None
     try:
         return dump(None, reason, error)
     except Exception:  # graftlint: ok[broad-except] — see docstring:

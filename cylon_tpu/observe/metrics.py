@@ -21,10 +21,12 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Tuple
 
+from .histogram import Histogram
+
 __all__ = [
-    "COUNTER", "WATERMARK", "GAUGE", "MetricSpec", "METRICS",
-    "MetricsRegistry", "REGISTRY", "exchange_count", "counter_delta",
-    "row_bytes",
+    "COUNTER", "WATERMARK", "GAUGE", "HISTOGRAM", "MetricSpec",
+    "METRICS", "MetricsRegistry", "REGISTRY", "exchange_count",
+    "counter_delta", "row_bytes",
 ]
 
 # ---------------------------------------------------------------------------
@@ -34,6 +36,7 @@ __all__ = [
 COUNTER = "counter"      # monotone sum (merge across threads: +)
 WATERMARK = "watermark"  # peak value (merge across threads: max)
 GAUGE = "gauge"          # last written value (process-level)
+HISTOGRAM = "histogram"  # log2-bucket distribution (merge: bucket sums)
 
 
 @dataclass(frozen=True)
@@ -519,6 +522,47 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "hold-time watchdog firings: an OrderedLock released after "
      "holding past config.lock_hold_watchdog_ms (flightrec carries "
      "the lock name and duration)"),
+    # live telemetry plane (docs/observability.md "Live telemetry
+    # plane"): mergeable latency/bytes histograms, tail-based trace
+    # sampling accounting, and the OpenMetrics/event-log export surface
+    ("serve.latency_ms", HISTOGRAM, "ms",
+     "submit->finish latency distribution of completed served queries "
+     "(log2 buckets; the source of ServeSession.stats() p50/p99/p999 "
+     "and the sampler's window percentiles)"),
+    ("serve.queue_wait_ms", HISTOGRAM, "ms",
+     "queue-wait distribution of admitted queries (submit->admission; "
+     "the admission-pressure histogram next to serve.latency_ms)"),
+    ("serve.query_bytes", HISTOGRAM, "bytes",
+     "priced exchange-transient bytes per served query (the admission "
+     "price distribution — heavy-tail drift here predicts deferrals)"),
+    ("trace.sampled_out", COUNTER, "spans",
+     "span records dropped by tail-based trace sampling: fast, "
+     "uneventful queries released at completion, plus retained traces "
+     "evicted past the trace.set_tail_budget ring bound — dropped "
+     "counts are visible, never silent"),
+    ("trace.tail_kept", COUNTER, "queries",
+     "query traces RETAINED by the tail sampler's completion-time "
+     "decision (slowest-k per window, errors, SLO misses, recovered "
+     "queries)"),
+    ("flightrec.dumps_suppressed", COUNTER, "bundles",
+     "auto-dumps suppressed by the MAX_AUTO_DUMPS per-process cap: a "
+     "CylonError escaped a served query but no bundle was written — "
+     "doctor notes this so operators know bundles are missing"),
+    ("observe.export_scrapes", COUNTER, "scrapes",
+     "OpenMetrics endpoint scrapes served (observe/exporter.py)"),
+    ("observe.export_skipped", COUNTER, "metrics",
+     "metric names present in the registry but NOT in this catalogue "
+     "at scrape time, skipped from the exposition (the exporter only "
+     "exports catalogued metrics — the same catalogue-as-contract "
+     "pinning as graftlint's counter rule)"),
+    ("observe.events_logged", COUNTER, "events",
+     "structured events appended to the JSON-lines event log "
+     "(CYLON_EVENT_LOG): flightrec events, SLO alerts, recovery and "
+     "remesh events, lock-order violations"),
+    ("observe.config_info", GAUGE, "info",
+     "constant-1 info metric whose labels carry the config "
+     "fingerprint (mesh/budget/knob state) on the OpenMetrics "
+     "endpoint"),
 )
 
 
@@ -529,12 +573,13 @@ METRICS: Dict[str, MetricSpec] = _specs(
 class _Cell:
     """One thread's lock-free metric buffers."""
 
-    __slots__ = ("thread", "counters", "watermarks", "events")
+    __slots__ = ("thread", "counters", "watermarks", "hists", "events")
 
     def __init__(self) -> None:
         self.thread = threading.current_thread()
         self.counters: Dict[str, int] = {}
         self.watermarks: Dict[str, int] = {}
+        self.hists: Dict[str, Histogram] = {}
         # (t_seconds, name, delta_or_value, thread_id) — recorded only
         # while span tracing is on; the Chrome exporter's C-event input.
         # Counter events carry the bump DELTA (not the thread-local
@@ -599,6 +644,21 @@ class MetricsRegistry:
             cell.events.append((time.perf_counter(), name, v,
                                 threading.get_ident()))
 
+    def observe(self, name: str, value: float) -> None:
+        """Record one observation into a per-thread histogram cell
+        (``trace.hist``'s store).  Same lock-free/read-once discipline
+        as ``bump``: a reset swaps ``cell.hists`` wholesale, and an
+        observation racing the swap lands in the discarded window.
+        Histograms record no Chrome events — a distribution has no
+        single monotone series to render."""
+        cell = self._cell()
+        d = cell.hists  # single snapshot — same race note as bump
+        h = d.get(name)
+        if h is None:
+            h = d[name] = Histogram()
+        h.observe(value)
+        self._kinds.setdefault(name, HISTOGRAM)
+
     def gauge(self, name: str, value: Any,
               record_event: bool = False) -> None:
         self._kinds.setdefault(name, GAUGE)
@@ -622,6 +682,11 @@ class MetricsRegistry:
             for k, v in cell.watermarks.items():
                 self._retired.watermarks[k] = \
                     max(self._retired.watermarks.get(k, 0), v)
+            for k, h in cell.hists.items():
+                r = self._retired.hists.get(k)
+                if r is None:
+                    r = self._retired.hists[k] = Histogram()
+                r.merge(h)
             self._retired.events.extend(cell.events)
         self._cells = live
 
@@ -641,22 +706,47 @@ class MetricsRegistry:
                     out[k] = max(out.get(k, 0), v)
             return out
 
+    def histograms(self) -> Dict[str, Histogram]:
+        """Merged process-level histograms (one lossless bucket-sum
+        fold per name across retired + live cells; returned copies are
+        the caller's to quantile/serialize)."""
+        with self._lock:
+            self._fold_dead_locked()
+            out: Dict[str, Histogram] = {}
+            for cell in [self._retired] + list(self._cells):
+                for k, h in cell.hists.items():
+                    tgt = out.get(k)
+                    if tgt is None:
+                        tgt = out[k] = Histogram()
+                    tgt.merge(h)
+            return out
+
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         """One-shot typed snapshot: ``{"counters": {...}, "watermarks":
-        {...}, "gauges": {...}}`` merged across threads under one lock
-        acquisition (a consistent cut, not three racing reads)."""
+        {...}, "gauges": {...}, "histograms": {...}}`` merged across
+        threads under one lock acquisition (a consistent cut, not four
+        racing reads).  Histograms are JSON-safe ``to_dict`` forms —
+        the flight-recorder bundle embeds this snapshot verbatim."""
         with self._lock:
             self._fold_dead_locked()
             cells = [self._retired] + list(self._cells)
             counters: Dict[str, int] = {}
             marks: Dict[str, int] = {}
+            hists: Dict[str, Histogram] = {}
             for cell in cells:
                 for k, v in cell.counters.items():
                     counters[k] = counters.get(k, 0) + v
                 for k, v in cell.watermarks.items():
                     marks[k] = max(marks.get(k, 0), v)
+                for k, h in cell.hists.items():
+                    tgt = hists.get(k)
+                    if tgt is None:
+                        tgt = hists[k] = Histogram()
+                    tgt.merge(h)
             return {"counters": counters, "watermarks": marks,
-                    "gauges": dict(self._gauges)}
+                    "gauges": dict(self._gauges),
+                    "histograms": {k: h.to_dict()
+                                   for k, h in hists.items()}}
 
     def counter_events(self) -> List[Tuple[float, str, Any, int]]:
         """Time-ordered PROCESS-LEVEL value series across threads
@@ -694,6 +784,7 @@ class MetricsRegistry:
             for cell in self._cells:
                 cell.counters = {}
                 cell.watermarks = {}
+                cell.hists = {}
                 cell.events = []
             self._gauges = {}
 
@@ -726,7 +817,10 @@ def counter_delta(before: Dict[str, int],
     (a watermark's difference is meaningless); unchanged keys are
     omitted.  The one definition behind both EXPLAIN ANALYZE's per-node
     stitching and ``resilience.counter_scope``'s per-query attribution
-    windows — a new metric kind handled here is handled in both."""
+    windows — a new metric kind handled here is handled in both.
+    Histograms never enter the flat merged view: their windows come
+    from ``Histogram.minus`` (bucket-wise difference), not from this
+    scalar delta."""
     out: Dict[str, int] = {}
     for k, v in after.items():
         v0 = before.get(k, 0)

@@ -15,9 +15,11 @@ of arXiv:2212.13732).  :class:`TimeSeriesSampler` is that instrument:
     dispatch, never touches a device array, and is safe to leave
     running next to a latency-sensitive serving loop;
   * per-sample derived fields: window QPS (completed-delta / dt),
-    window p50/p99 (nearest-rank over the latencies that completed in
-    the window), queue depth, plan-cache and subplan-share hit ratios,
-    and the ``shuffle.exchange_bytes_peak`` watermark.
+    window p50/p99 (histogram quantiles of the latency distribution
+    that completed in the window — ``Histogram.minus`` of two session
+    snapshots, fixed memory at any QPS), queue depth, plan-cache and
+    subplan-share hit ratios, and the
+    ``shuffle.exchange_bytes_peak`` watermark.
 
 The bench's sustained-load stage (``CYLON_BENCH_SUSTAIN``) drives one of
 these for minutes under 8 client threads and emits the series into the
@@ -43,6 +45,7 @@ import time
 import weakref
 from typing import Any, Dict, List, Optional
 
+from .histogram import Histogram
 from .locks import OrderedLock
 from .metrics import REGISTRY
 
@@ -105,6 +108,17 @@ class TimeSeriesSampler:
     ``min_history`` samples must exist before any rule can fire;
     ``p99_drift_factor`` / ``qps_collapse_frac`` / ``hit_collapse_frac``
     are the rule thresholds.  Fired alerts land in ``self.alerts``.
+
+    ``min_history`` interaction (docs/observability.md "SLO rules"):
+    every rule compares the CURRENT sample against the retained
+    history, so until ``min_history`` samples exist no rule can fire —
+    a cold start cannot alert on its own warm-up.  With the default
+    ``period_s=0.25`` and ``min_history=8`` that is a ~2 s blind
+    window; size them together (the blind window is ``min_history *
+    period_s``) when tuning either.  ``summary()`` applies the same
+    philosophy: fewer than 2 samples yield a typed EMPTY summary
+    (every key present, values ``None``) rather than one-window
+    numbers masquerading as steady state.
     """
 
     def __init__(self, period_s: float = 0.25, capacity: int = 512,
@@ -139,7 +153,10 @@ class TimeSeriesSampler:
         self._prev_completed = 0
         self._prev_cache = (0, 0)        # (hits, misses)
         self._prev_shared = 0
-        self._lat_idx = 0                # session latencies consumed
+        # cumulative-latency-histogram snapshot at the previous sample
+        # (None = nothing consumed yet); the next window is the
+        # session's cumulative histogram minus this cursor
+        self._lat_cursor: Optional[Histogram] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -197,13 +214,14 @@ class TimeSeriesSampler:
     # -- sampling -----------------------------------------------------------
 
     def _session_window(self):
-        """(stats, window latencies) from the attached session — reads
-        the session's self-accounting, never the device."""
+        """(stats, window latency histogram) from the attached session
+        — reads the session's self-accounting, never the device."""
         s = self._session
         if s is None:
-            return None, []
-        stats, lats, self._lat_idx = s.telemetry_window(self._lat_idx)
-        return stats, lats
+            return None, None
+        stats, window, self._lat_cursor = \
+            s.telemetry_window(self._lat_cursor)
+        return stats, window
 
     def sample_once(self) -> Dict[str, Any]:
         """Take one sample now; returns it (and appends to the ring)."""
@@ -212,7 +230,7 @@ class TimeSeriesSampler:
         snap = REGISTRY.snapshot()
         c, marks, gauges = (snap["counters"], snap["watermarks"],
                             snap["gauges"])
-        stats, lats = self._session_window()
+        stats, window_hist = self._session_window()
         if stats is not None:
             completed = stats.get("completed", 0)
             failed = stats.get("failed", 0)
@@ -234,7 +252,6 @@ class TimeSeriesSampler:
         dh = max(hits - self._prev_cache[0], 0)
         dm = max(misses - self._prev_cache[1], 0)
         dc = max(completed - self._prev_completed, 0)
-        lats_sorted = sorted(lats)
         sample = {
             "t": round(now - self._t0, 4),
             "completed": completed,
@@ -242,8 +259,10 @@ class TimeSeriesSampler:
             "deferred": deferred,
             "queue_depth": queue_depth,
             "qps": round(dc / dt, 3),
-            "p50_ms": _percentile(lats_sorted, 50),
-            "p99_ms": _percentile(lats_sorted, 99),
+            "p50_ms": (window_hist.quantile(50)
+                       if window_hist is not None else None),
+            "p99_ms": (window_hist.quantile(99)
+                       if window_hist is not None else None),
             "cache_hit_ratio": (round(dh / (dh + dm), 4)
                                 if dh + dm else None),
             "subplan_shared": shared,
@@ -367,11 +386,22 @@ class TimeSeriesSampler:
         """Steady-state roll-up of the retained series: median window
         QPS over the SECOND half (warm-up excluded), the worst window
         p99, and totals — the benchdiff-gated numbers of the sustained
-        bench stage."""
+        bench stage.
+
+        Fewer than 2 retained samples yield the TYPED EMPTY summary:
+        the full key set with ``None`` values (plus ``empty: True``),
+        never an exception and never one-window numbers pretending to
+        be steady state — consumers index the same keys either way."""
         samples = self.samples()
         out: Dict[str, Any] = {"samples": len(samples),
                                "dropped": self.dropped}
-        if not samples:
+        if len(samples) < 2:
+            out.update({"empty": True, "steady_qps": None,
+                        "worst_p99_ms": None, "steady_p50_ms": None,
+                        "final_completed": None,
+                        "max_queue_depth": None,
+                        "cache_hit_ratio": None,
+                        "exchange_bytes_peak": None})
             return out
         half = samples[len(samples) // 2:]
         qps = sorted(s["qps"] for s in half)

@@ -34,12 +34,14 @@ deltas, and the list of subplans served from the shared memo — the
 """
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import faults, resilience, topology, trace
+from ..observe.histogram import Histogram
 from ..observe.locks import OrderedLock
 from ..status import Code, CylonError, Status
 from . import admission
@@ -53,7 +55,8 @@ from . import admission
 # staleness — see its comment) and _SharedExecMemo (batch-scoped,
 # dispatcher-thread-only).
 GUARDED_STATE = {"_items": "_cv", "_entries": "_lock",
-                 "_stats": "_lock", "_latencies": "_lock",
+                 "_stats": "_lock", "_lat_hist": "_lock",
+                 "_tail_heap": "_lock", "_tail_seen": "_lock",
                  "_ewma_ms": "_lock", "_ids": "_lock",
                  "_drained": "_lock"}
 
@@ -514,6 +517,14 @@ class ServeSession:
         timeout (``submit(priority=1)`` and above ride out pressure
         until the queue is genuinely full).  Defaults to 3/4 of
         ``max_queue``; ``None`` keeps the default, 0 disables.
+      * ``tail_keep_k`` / ``tail_window`` — tail-based trace sampling
+        (docs/observability.md "Live telemetry plane"): with span
+        tracing on, each query's retention is decided at COMPLETION —
+        the slowest ``tail_keep_k`` per ``tail_window`` completions,
+        plus every error / deadline miss / recovered query, keep
+        their full span waterfalls; the rest are dropped from the
+        span ring with visible ``trace.sampled_out`` accounting.
+        ``tail_keep_k=None`` disables (every trace retained).
     """
 
     def __init__(self, ctx, tables=None, *, batch_window_ms: float = 4.0,
@@ -522,10 +533,22 @@ class ServeSession:
                  export_workers: int = 1, name: str = "serve",
                  breaker_threshold: Optional[int] = 3,
                  breaker_cooldown_s: float = 5.0,
-                 shed_depth: Optional[int] = None) -> None:
+                 shed_depth: Optional[int] = None,
+                 tail_keep_k: Optional[int] = 16,
+                 tail_window: int = 128) -> None:
         if batch_window_ms < 0:
             raise CylonError(Status(Code.Invalid,
                 f"batch_window_ms must be >= 0, got {batch_window_ms}"))
+        if tail_keep_k is not None and (isinstance(tail_keep_k, bool)
+                                        or not isinstance(tail_keep_k, int)
+                                        or tail_keep_k < 1):
+            raise CylonError(Status(Code.Invalid,
+                f"tail_keep_k must be an int >= 1 or None to disable "
+                f"tail sampling, got {tail_keep_k!r}"))
+        if (isinstance(tail_window, bool)
+                or not isinstance(tail_window, int) or tail_window < 1):
+            raise CylonError(Status(Code.Invalid,
+                f"tail_window must be an int >= 1, got {tail_window!r}"))
         self.ctx = ctx
         self.name = name
         self._tables = tables
@@ -573,12 +596,30 @@ class ServeSession:
         # query's builder anchors on the survivor mesh
         self._base_world = max(ctx.get_world_size(), 1)
         self._topology_epoch = topology.epoch()
-        self._latencies: List[float] = []
+        # completed-query latency distribution: a fixed-memory
+        # mergeable histogram (observe/histogram.py), NOT a raw sample
+        # list — stats() percentiles stay O(1)-memory at any QPS
+        self._lat_hist = Histogram()
+        # tail-based trace sampling (docs/observability.md "Live
+        # telemetry plane"): keep the slowest-k per tail_window
+        # completions (streaming top-k min-heap) plus every error /
+        # SLO miss / recovered query; drop the rest via
+        # trace.finish_trace.  tail_keep_k=None disables (every trace
+        # retained, the pre-sampling behavior).
+        self._tail_keep_k = tail_keep_k
+        self._tail_window = tail_window
+        self._tail_heap: List[float] = []
+        self._tail_seen = 0
         self._ids = 0
         self._closing = threading.Event()
         self._closed = False
         self._drained = False
         trace.gauge("serve.batch_window_ms", batch_window_ms)
+        # live telemetry plane bring-up: start the OpenMetrics endpoint
+        # / event log when config names them (best-effort — a bad knob
+        # warns once and never blocks serving)
+        from ..observe import exporter
+        exporter.ensure_started()
         self._dispatcher = threading.Thread(
             target=self._loop, name=f"{name}-dispatch", daemon=True)
         self._dispatcher.start()
@@ -739,28 +780,33 @@ class ServeSession:
 
     def stats(self) -> Dict[str, Any]:
         """Session-level tallies + latency percentiles (independent of
-        trace enablement — the serving loop always self-accounts)."""
+        trace enablement — the serving loop always self-accounts).
+        Percentiles are histogram quantiles (observe/histogram.py):
+        exact-to-one-log2-bucket, O(1) memory at any QPS."""
         with self._lock:
             out: Dict[str, Any] = dict(self._stats)
-            lat = sorted(self._latencies)
+            hist = self._lat_hist.copy()
         out["queue_depth"] = len(self._queue)
         out["batch_window_ms"] = self._window_s * 1e3
-        out["p50_ms"] = percentile(lat, 50)
-        out["p99_ms"] = percentile(lat, 99)
+        out["p50_ms"] = hist.quantile(50)
+        out["p99_ms"] = hist.quantile(99)
+        out["p999_ms"] = hist.quantile(99.9)
         return out
 
-    def telemetry_window(self, latency_idx: int = 0):
+    def telemetry_window(self, cursor: Optional[Histogram] = None):
         """One consistent cut for the time-series sampler
-        (observe.timeseries): ``(stats tallies, latencies completed
-        since ``latency_idx``, new index)``.  Host-side bookkeeping
+        (observe.timeseries): ``(stats tallies, window histogram of
+        latencies completed since the ``cursor`` snapshot, new
+        cursor)``.  Pass the returned cursor back on the next call;
+        ``None`` means "from the beginning".  Host-side bookkeeping
         only — reading it never touches a device or blocks the
         dispatcher beyond the stats lock."""
         with self._lock:
             stats = dict(self._stats)
-            lats = list(self._latencies[latency_idx:])
-            idx = len(self._latencies)
+            cum = self._lat_hist.copy()
+        window = cum.minus(cursor) if cursor is not None else cum
         stats["queue_depth"] = len(self._queue)
-        return stats, lats, idx
+        return stats, window, cum
 
     def close(self) -> None:
         """Stop accepting queries, drain everything queued, stop the
@@ -1047,7 +1093,7 @@ class ServeSession:
             trace.count("serve.completed")
             self._tally("completed")
             with self._lock:
-                self._latencies.append(h.latency_ms)
+                self._lat_hist.observe(h.latency_ms)
                 # SLO-pressure estimate: EWMA of SERVICE time (execute
                 # only).  Full submit→finish latency already contains
                 # queue wait, and the shed check multiplies by depth —
@@ -1063,6 +1109,14 @@ class ServeSession:
                 # resilience.collect_recoveries, so stats() keeps its
                 # counters-off self-accounting contract
                 self._tally("recovered")
+            # registry-side distributions (the OpenMetrics exporter's
+            # histogram series; no-ops with counters off — stats()
+            # self-accounts through _lat_hist regardless)
+            trace.hist("serve.latency_ms", h.latency_ms)
+            if h.queue_wait_ms is not None:
+                trace.hist("serve.queue_wait_ms", h.queue_wait_ms)
+            if h.priced_bytes:
+                trace.hist("serve.query_bytes", h.priced_bytes)
         # circuit-breaker bookkeeping: only queries that actually RAN
         # report an outcome (a straggler failed by session close must
         # not poison its fingerprint); a probe that never ran releases
@@ -1113,4 +1167,36 @@ class ServeSession:
             # (capped per process; never masks the original error)
             flightrec.maybe_dump_on_error(
                 f"serve[{self.name}] query {h.label!r} failed", error)
+        self._tail_retire(h, error)
         h._event.set()
+
+    def _tail_retire(self, h: QueryHandle,
+                     error: Optional[BaseException]) -> None:
+        """The tail sampler's completion-time retention decision
+        (docs/observability.md "Live telemetry plane"): always keep
+        errors, deadline misses and recovered queries; otherwise keep
+        iff this latency makes the window's slowest-k (streaming top-k
+        min-heap, reset every ``tail_window`` completions).  Everything
+        else is dropped from the span ring via ``trace.finish_trace``
+        with visible ``trace.sampled_out`` accounting."""
+        if (h.trace_id is None or self._tail_keep_k is None
+                or not trace.enabled()):
+            return
+        keep = bool(error is not None or h.deadline_missed
+                    or h.recovered)
+        if not keep:
+            lat = h.latency_ms if h.latency_ms is not None else 0.0
+            with self._lock:
+                self._tail_seen += 1
+                if self._tail_seen > self._tail_window:
+                    self._tail_seen = 1
+                    self._tail_heap = []
+                if len(self._tail_heap) < self._tail_keep_k:
+                    heapq.heappush(self._tail_heap, lat)
+                    keep = True
+                elif lat > self._tail_heap[0]:
+                    heapq.heapreplace(self._tail_heap, lat)
+                    keep = True
+        # the span-ring mutation happens OUTSIDE the session lock —
+        # finish_trace takes the trace module's span lock
+        trace.finish_trace(h.trace_id, keep)

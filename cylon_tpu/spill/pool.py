@@ -75,6 +75,7 @@ SANCTIONED_HOST_BOUNDARIES = (
     "cylon_tpu/io/",
     "cylon_tpu/trace.py",
     "cylon_tpu/observe/analyze.py",
+    "cylon_tpu/observe/exporter.py",
     "cylon_tpu/tpch/",
 )
 

@@ -36,16 +36,19 @@ import contextlib
 import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from . import observe
 
 __all__ = [
     "enable", "disable", "enabled", "span", "count", "count_max", "gauge",
-    "reset", "enable_counters", "disable_counters", "counters_enabled",
-    "get_spans", "get_span_records", "phase_totals", "counters",
-    "snapshot", "report", "bench_line", "export_chrome_trace", "profile",
-    "hard_sync", "trace_context", "current_trace_id", "record_span",
+    "hist", "reset", "enable_counters", "disable_counters",
+    "counters_enabled", "get_spans", "get_span_records", "phase_totals",
+    "counters", "snapshot", "report", "bench_line", "export_chrome_trace",
+    "profile", "hard_sync", "trace_context", "current_trace_id",
+    "record_span", "finish_trace", "set_tail_budget", "tail_budget",
+    "tail_stats",
 ]
 
 
@@ -319,6 +322,135 @@ def gauge(name: str, value) -> None:
     observe.REGISTRY.gauge(name, value, record_event=_enabled)
 
 
+def hist(name: str, value) -> None:
+    """Record one observation into a named mergeable histogram
+    (latencies, byte sizes, queue waits — anything whose DISTRIBUTION
+    matters, not just its sum).  Log2-bucket-merged across threads at
+    read time; the OpenMetrics exporter renders the buckets as
+    cumulative ``_bucket{le=...}`` series."""
+    if not (_enabled or _counters_enabled):
+        return
+    observe.REGISTRY.observe(name, float(value))
+
+
+# ---------------------------------------------------------------------------
+# tail-based trace sampling (docs/observability.md "Live telemetry
+# plane"): production tracing records EVERY span, then decides retention
+# at query COMPLETION — the serving tier calls finish_trace(trace_id,
+# keep=...) once the outcome (latency, error, SLO miss, recovery) is
+# known.  Kept traces enter a bounded FIFO of retained trace ids (env
+# CYLON_TRACE_RETAIN / set_tail_budget, default 256 queries); dropped
+# and evicted traces have their spans physically purged from the span
+# ring and tallied into the `trace.sampled_out` counter, so sustained
+# serving runs traced at a fixed span-memory ceiling with the drop rate
+# always visible, never silent.  Untagged spans (no trace id — engine
+# phases outside any query) keep the pre-existing manual reset()
+# lifecycle.
+#
+# One subtlety: a query's async-export span lands AFTER the serving
+# tier's finish bookkeeping (parallel/streaming.py wraps the export
+# callable in the span), so a freshly-dropped trace can still grow one
+# late span.  Dropped ids therefore linger in a bounded _condemned set:
+# get_span_records filters them and every subsequent finish_trace
+# physically sweeps late arrivals.
+# ---------------------------------------------------------------------------
+
+_finished_traces: "OrderedDict[str, None]" = OrderedDict()   # kept FIFO
+_condemned: "OrderedDict[str, None]" = OrderedDict()         # dropped ids
+_CONDEMNED_CAP = 1024
+
+
+def _parse_tail_budget() -> int:
+    raw = os.environ.get("CYLON_TRACE_RETAIN", "")
+    try:
+        n = int(raw)
+        return n if n >= 1 else 256
+    except ValueError:
+        return 256
+
+
+_tail_budget = _parse_tail_budget()
+
+
+def tail_budget() -> int:
+    """Retained-trace budget: how many kept traces' span waterfalls stay
+    in memory before the oldest is evicted (and tallied sampled-out)."""
+    return _tail_budget
+
+
+def set_tail_budget(n: int) -> int:
+    """Set the retained-trace budget (min 1); returns the previous one.
+    Overrides env ``CYLON_TRACE_RETAIN`` for the rest of the process."""
+    global _tail_budget
+    if isinstance(n, bool) or not isinstance(n, int) or n < 1:
+        raise ValueError(f"tail budget must be an int >= 1, got {n!r}")
+    prev, _tail_budget = _tail_budget, n
+    return prev
+
+
+def tail_stats() -> Dict[str, int]:
+    """Current retention-state sizes (kept trace ids / condemned ids
+    pending sweep) — for tests and the export smoke, not a hot path."""
+    with _span_lock:
+        return {"retained_traces": len(_finished_traces),
+                "condemned": len(_condemned)}
+
+
+def _condemn_locked(trace_id: str) -> None:
+    _condemned[trace_id] = None
+    _condemned.move_to_end(trace_id)
+    while len(_condemned) > _CONDEMNED_CAP:
+        _condemned.popitem(last=False)
+
+
+def _sweep_condemned_locked() -> int:
+    """Physically purge every condemned trace's spans from the ring;
+    returns how many span records were dropped."""
+    if not _condemned:
+        return 0
+    global _retired_spans
+    dropped = 0
+    kept = [r for r in _retired_spans if r[5] not in _condemned]
+    dropped += len(_retired_spans) - len(kept)
+    _retired_spans = kept
+    for st in _span_states:
+        live = [r for r in st.spans if r[5] not in _condemned]
+        dropped += len(st.spans) - len(live)
+        st.spans = live
+    return dropped
+
+
+def finish_trace(trace_id: Optional[str], keep: bool) -> int:
+    """Tail-sampling retention decision for one completed query trace.
+
+    ``keep=True`` retains the trace's span waterfall (evicting — and
+    purging — the OLDEST retained trace beyond the budget);
+    ``keep=False`` condemns it and purges its spans now.  Every call
+    also sweeps late-landing spans of previously condemned traces.
+    Purged span counts feed ``trace.sampled_out``; kept decisions feed
+    ``trace.tail_kept``.  Returns the number of span records purged.
+    No-op (0) when span tracing is off or ``trace_id`` is None."""
+    if trace_id is None or not _enabled:
+        return 0
+    with _span_lock:
+        _fold_dead_locked()
+        if keep:
+            _finished_traces[trace_id] = None
+            _finished_traces.move_to_end(trace_id)
+            while len(_finished_traces) > _tail_budget:
+                evicted, _ = _finished_traces.popitem(last=False)
+                _condemn_locked(evicted)
+        else:
+            _finished_traces.pop(trace_id, None)
+            _condemn_locked(trace_id)
+        dropped = _sweep_condemned_locked()
+    if keep:
+        count("trace.tail_kept")
+    if dropped:
+        count("trace.sampled_out", dropped)
+    return dropped
+
+
 def reset() -> None:
     """Clear spans + metrics of EVERY thread (the registry's process-level
     aggregate included) — one query's trace never bleeds into the next."""
@@ -326,6 +458,8 @@ def reset() -> None:
         _retired_spans.clear()
         for st in _span_states:
             st.spans = []
+        _finished_traces.clear()
+        _condemned.clear()
     _span_state().depth = 0
     observe.REGISTRY.reset()
 
@@ -341,14 +475,16 @@ def get_span_records(all_threads: bool = False
     """Full span records ``(name, depth, ms, t0, thread_id, trace_id,
     args)``; with ``all_threads`` the merged process-level list sorted
     by start time (dead threads' spans included) — the Chrome
-    exporter's input."""
+    exporter's input.  Spans of traces condemned by tail sampling
+    (:func:`finish_trace`) are filtered out even before the next
+    physical sweep catches them."""
     if not all_threads:
         return list(_span_state().spans)
     with _span_lock:
         _fold_dead_locked()
-        records = list(_retired_spans)
+        records = [r for r in _retired_spans if r[5] not in _condemned]
         for st in _span_states:
-            records.extend(st.spans)
+            records.extend(r for r in st.spans if r[5] not in _condemned)
     return sorted(records, key=lambda r: r[3])
 
 
@@ -359,8 +495,8 @@ def counters() -> Dict[str, int]:
 
 
 def snapshot() -> Dict[str, Dict[str, int]]:
-    """One-shot typed snapshot — ``{"counters", "watermarks", "gauges"}``
-    — taken under a single registry lock acquisition."""
+    """One-shot typed snapshot — ``{"counters", "watermarks", "gauges",
+    "histograms"}`` — taken under a single registry lock acquisition."""
     return observe.REGISTRY.snapshot()
 
 

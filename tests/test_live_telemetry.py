@@ -1,0 +1,457 @@
+"""Live telemetry plane (ISSUE 18): mergeable log2 histograms, the
+OpenMetrics exporter + JSON-lines event log, tail-based trace sampling,
+and per-fingerprint regression attribution (queryprof).
+
+Coverage contract:
+  * histogram quantiles agree with exact nearest-rank percentiles to
+    within one log2 bucket on a seeded latency set, merge losslessly,
+    and window via ``minus``;
+  * ``ServeSession.stats()`` derives p50/p99/p999 from the histogram
+    (the unbounded raw-sample path is gone) and the sampler's window
+    percentiles come from histogram cursor deltas;
+  * the exporter serves a catalogued, ``# EOF``-terminated OpenMetrics
+    payload over real HTTP with the config-fingerprint info metric;
+    the event log writes one valid JSON object per line and rotates;
+  * tail sampling keeps errors/deadline-misses and the slowest-k,
+    purges the rest with ``trace.sampled_out`` accounting, and sweeps
+    late-landing spans of condemned traces;
+  * the flight recorder's auto-dump cap books suppressed dumps on a
+    counter the doctor surfaces;
+  * queryprof diffs two stats snapshots and names the regressed
+    fingerprint AND plan node, with the 0/1/2 exit contract.
+"""
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import config, observe, trace
+from cylon_tpu.observe import Histogram, exporter, flightrec
+from cylon_tpu.observe.histogram import (E_MIN, bucket_exponent,
+                                         bucket_upper_bound)
+from cylon_tpu.parallel import DTable, dist_groupby, shuffle_table
+from cylon_tpu.serve import ServeSession, percentile
+from cylon_tpu.status import CylonError
+
+
+@pytest.fixture(autouse=True)
+def _plane_isolation(monkeypatch):
+    """Fresh telemetry state per test, and no ambient exporter: the
+    endpoint/event log are process-global, so a test leaking one would
+    couple every later test to its port and tap."""
+    monkeypatch.delenv("CYLON_METRICS_PORT", raising=False)
+    monkeypatch.delenv("CYLON_EVENT_LOG", raising=False)
+    monkeypatch.delenv("CYLON_TRACE_RETAIN", raising=False)
+    trace.reset()
+    yield
+    exporter.stop_event_log()
+    exporter.stop()
+    trace.disable()
+    trace.disable_counters()
+    trace.reset()
+
+
+@pytest.fixture(scope="module")
+def fact(dctx):
+    rng = np.random.default_rng(11)
+    n = 2000
+    return DTable.from_pandas(dctx, pd.DataFrame({
+        "k": rng.integers(0, 40, n).astype(np.int32),
+        "a": rng.random(n).astype(np.float32)}))
+
+
+def _plan(t):
+    s = shuffle_table(t["fact"], ["k"])
+    return dist_groupby(s, ["k"], [("a", "sum")])
+
+
+# ---------------------------------------------------------------------------
+# the histogram itself
+# ---------------------------------------------------------------------------
+
+def test_histogram_bucket_scheme():
+    # bucket e covers (2^(e-1), 2^e]
+    assert bucket_exponent(1.0) == 0
+    assert bucket_exponent(2.0) == 1
+    assert bucket_exponent(2.0001) == 2
+    assert bucket_exponent(0.5) == -1
+    # non-positive / non-finite land in the floor bucket, not a crash
+    assert bucket_exponent(0.0) == E_MIN
+    assert bucket_exponent(-3.0) == E_MIN
+    assert bucket_exponent(float("nan")) == E_MIN
+    assert bucket_upper_bound(3) == 8.0
+
+
+def test_histogram_quantile_nearest_rank_agreement():
+    rng = np.random.default_rng(3)
+    xs = sorted(float(v) for v in rng.lognormal(3.0, 1.2, size=257))
+    h = Histogram()
+    for v in xs:
+        h.observe(v)
+    assert h.count == len(xs)
+    assert h.max == pytest.approx(xs[-1])
+    for q in (50.0, 99.0, 99.9):
+        exact = percentile(xs, q)
+        got = h.quantile(q)
+        # same nearest rank, so the histogram answer is the exact
+        # value's bucket upper bound: within one power of two above
+        assert exact <= got <= 2 * exact, (q, exact, got)
+
+
+def test_histogram_merge_and_minus_are_lossless():
+    a, b = Histogram(), Histogram()
+    for v in (1.5, 3.0, 100.0):
+        a.observe(v)
+    for v in (0.7, 3.0):
+        b.observe(v)
+    m = a.copy()
+    m.merge(b)
+    assert m.count == 5
+    assert m.sum == pytest.approx(a.sum + b.sum)
+    assert m.max == pytest.approx(100.0)
+    # merged buckets are the bucket-wise sum: quantiles of the merge
+    # are the quantiles of the merged population
+    all_h = Histogram()
+    for v in (1.5, 3.0, 100.0, 0.7, 3.0):
+        all_h.observe(v)
+    assert m.buckets == all_h.buckets
+    # minus() yields the window between two cursor snapshots
+    cursor = a.copy()
+    a.observe(7.0)
+    a.observe(9.0)
+    win = a.minus(cursor)
+    assert win.count == 2
+    assert win.quantile(50.0) in (8.0, 16.0)  # 7.0 -> (4,8], 9.0 -> (8,16]
+    # round trip
+    assert Histogram.from_dict(a.to_dict()).buckets == a.buckets
+    # cumulative() is monotone and ends at count
+    cum = list(a.cumulative())
+    assert [c for _, c in cum] == sorted(c for _, c in cum)
+    assert cum[-1][1] == a.count
+
+
+def test_registry_histograms_cross_thread_merge():
+    trace.enable_counters()
+    trace.reset()
+    trace.hist("serve.latency_ms", 4.0)
+
+    def worker():
+        trace.hist("serve.latency_ms", 100.0)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    hists = observe.REGISTRY.histograms()
+    assert hists["serve.latency_ms"].count == 2
+    snap = trace.snapshot()
+    assert snap["histograms"]["serve.latency_ms"]["count"] == 2
+    # histogram metrics are catalogued like every other kind
+    assert observe.METRICS["serve.latency_ms"].kind == observe.HISTOGRAM
+
+
+# ---------------------------------------------------------------------------
+# session stats + sampler on histogram quantiles
+# ---------------------------------------------------------------------------
+
+def test_serve_stats_histogram_percentiles(dctx, fact):
+    with ServeSession(dctx, tables={"fact": fact},
+                      batch_window_ms=10.0) as s:
+        for _ in range(3):
+            s.submit(_plan, export=lambda r: r.to_table().to_pandas()
+                     ).result(timeout=300)
+        stats = s.stats()
+        _, win, cum = s.telemetry_window()
+    assert stats["completed"] == 3
+    assert stats["p50_ms"] > 0
+    assert stats["p50_ms"] <= stats["p99_ms"] <= stats["p999_ms"]
+    # no raw-sample retention anywhere on the session
+    assert not hasattr(s, "_latencies")
+    assert cum.count == 3 and win.count == 3
+    # a cursor makes the next window incremental
+    _, win2, _ = s.telemetry_window(cursor=cum)
+    assert win2.count == 0
+
+
+def test_session_tail_kwargs_validated(dctx, fact):
+    for bad in ({"tail_keep_k": 0}, {"tail_keep_k": True},
+                {"tail_window": 0}):
+        with pytest.raises(CylonError):
+            ServeSession(dctx, tables={"fact": fact}, **bad)
+
+
+def test_sampler_empty_summary_is_typed():
+    sm = observe.TimeSeriesSampler(period_s=60.0, capacity=8)
+    summary = sm.summary()
+    assert summary["empty"] is True
+    assert summary["samples"] == 0
+    for k in ("steady_qps", "worst_p99_ms", "steady_p50_ms",
+              "final_completed", "max_queue_depth", "cache_hit_ratio",
+              "exchange_bytes_peak"):
+        assert k in summary and summary[k] is None
+
+
+# ---------------------------------------------------------------------------
+# the exporter: OpenMetrics endpoint + event log
+# ---------------------------------------------------------------------------
+
+def test_openmetrics_scrape_catalogued_and_terminated():
+    trace.enable_counters()
+    trace.reset()
+    trace.count("serve.completed", 3)
+    trace.hist("serve.latency_ms", 12.5)
+    port = exporter.start(0)
+    assert exporter.running() and exporter.port() == port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+        assert "text/plain" in resp.headers["Content-Type"]
+        body = resp.read().decode("utf-8")
+    assert body.endswith("# EOF\n")
+    assert "cylon_serve_completed_total 3" in body
+    assert 'cylon_serve_latency_ms_bucket{le="+Inf"} 1' in body
+    assert "cylon_serve_latency_ms_count 1" in body
+    assert "cylon_observe_config_info{" in body
+    # forward catalogue compliance: every exposed family is catalogued
+    fams = {exporter.family_name(n) for n in observe.METRICS}
+    import re
+    for m in re.finditer(r"^# TYPE (\S+) (\S+)$", body, re.M):
+        assert m.group(1) in fams, m.group(1)
+    # scrapes are themselves accounted
+    assert observe.REGISTRY.snapshot()["counters"]["observe.export_scrapes"] >= 1
+    # idempotent start, 404 off-path
+    assert exporter.start(0) == port
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nope", timeout=30)
+    exporter.stop()
+    assert not exporter.running()
+
+
+def test_event_log_streams_flightrec_and_rotates(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    w = exporter.start_event_log(path, max_bytes=400)
+    assert exporter.event_log_writer() is w
+    for i in range(20):
+        flightrec.note("slo_alert", rule="p99-drift", i=i)
+    exporter.stop_event_log()
+    assert exporter.event_log_writer() is None
+    # rotation happened exactly once, to <path>.1
+    assert os.path.exists(path + ".1")
+    kinds = []
+    for p in (path + ".1", path):
+        with open(p, "r", encoding="utf-8") as fh:
+            for line in fh:
+                ev = json.loads(line)     # every line is one JSON object
+                kinds.append(ev["kind"])
+                assert "t" in ev
+    assert kinds and set(kinds) == {"slo_alert"}
+    # a broken tap never raises out of note()
+    prev = flightrec.set_tap(lambda ev: 1 / 0)
+    try:
+        flightrec.note("still_fine")
+    finally:
+        flightrec.set_tap(prev)
+
+
+def test_config_knobs_validate():
+    with pytest.raises(CylonError):
+        config.set_metrics_port(True)
+    with pytest.raises(CylonError):
+        config.set_metrics_port(-1)
+    with pytest.raises(CylonError):
+        config.set_metrics_port(70000)
+    prev = config.set_metrics_port(9184)
+    try:
+        assert config.metrics_port() == 9184
+    finally:
+        config.set_metrics_port(prev)
+    assert config.metrics_port() is None  # env unset -> disabled
+    os.environ["CYLON_METRICS_PORT"] = "not-a-port"
+    try:
+        with pytest.raises(CylonError):
+            config.metrics_port()
+    finally:
+        del os.environ["CYLON_METRICS_PORT"]
+    with pytest.raises(CylonError):
+        config.set_event_log_path(7)
+    prev = config.set_event_log_path("/tmp/x.jsonl")
+    try:
+        assert config.event_log_path() == "/tmp/x.jsonl"
+    finally:
+        config.set_event_log_path(prev)
+
+
+# ---------------------------------------------------------------------------
+# tail-based trace sampling
+# ---------------------------------------------------------------------------
+
+def _spanned(trace_id, ms_name="phase"):
+    with trace.trace_context(trace_id):
+        with trace.span(ms_name):
+            pass
+
+
+def test_finish_trace_keep_drop_and_sweep():
+    trace.enable()
+    trace.reset()
+    for tid in ("keep#1", "drop#2", "late#3"):
+        _spanned(tid)
+    assert trace.finish_trace("drop#2", keep=False) > 0
+    trace.finish_trace("keep#1", keep=True)
+    ids = {r[5] for r in trace.get_span_records(True) if r[5]}
+    assert "keep#1" in ids and "drop#2" not in ids
+    # a span landing AFTER the drop decision (the async-export shape)
+    # is swept on the next finish_trace call, not resurrected
+    _spanned("drop#2", "late-export")
+    trace.finish_trace("late#3", keep=False)
+    ids = {r[5] for r in trace.get_span_records(True) if r[5]}
+    assert "drop#2" not in ids and "late#3" not in ids
+    snap = trace.snapshot()["counters"]
+    assert snap["trace.sampled_out"] >= 3
+    assert snap["trace.tail_kept"] == 1
+    st = trace.tail_stats()
+    assert st["retained_traces"] == 1
+
+
+def test_tail_budget_evicts_oldest_kept():
+    trace.enable()
+    trace.reset()
+    prev = trace.set_tail_budget(2)
+    try:
+        for tid in ("a#1", "b#2", "c#3"):
+            _spanned(tid)
+            trace.finish_trace(tid, keep=True)
+        ids = {r[5] for r in trace.get_span_records(True) if r[5]}
+        assert ids == {"b#2", "c#3"}  # a#1 evicted past the budget
+    finally:
+        trace.set_tail_budget(prev)
+    for bad in (0, True, "8"):
+        with pytest.raises(ValueError):
+            trace.set_tail_budget(bad)
+
+
+def test_serve_tail_sampling_keeps_slow_drops_fast(dctx, fact):
+    trace.enable()
+    trace.reset()
+    handles = []
+    with ServeSession(dctx, tables={"fact": fact}, batch_window_ms=10.0,
+                      tail_keep_k=1) as s:
+        # sequential: the first pays the compile and tops the k=1 heap;
+        # the cache-warm repeats are strictly faster -> droppable
+        for i in range(3):
+            h = s.submit(_plan, label=f"q{i}",
+                         export=lambda r: r.to_table().to_pandas())
+            h.result(timeout=300)
+            handles.append(h)
+        miss = s.submit(_plan, label="slo", deadline_ms=0.001,
+                        export=lambda r: r.to_table().to_pandas())
+        miss.result(timeout=300)
+    ids = {r[5] for r in trace.get_span_records(True) if r[5]}
+    assert miss.trace_id in ids          # always-keep: deadline miss
+    assert handles[0].trace_id in ids    # slowest (compile) retained
+    dropped = {h.trace_id for h in handles[1:]} - ids
+    assert dropped                       # at least one fast peer purged
+    assert trace.snapshot()["counters"]["trace.sampled_out"] > 0
+
+
+def test_tail_sampling_disabled_keeps_everything(dctx, fact):
+    trace.enable()
+    trace.reset()
+    with ServeSession(dctx, tables={"fact": fact}, batch_window_ms=10.0,
+                      tail_keep_k=None) as s:
+        hs = [s.submit(_plan, export=lambda r: r.to_table().to_pandas())
+              for _ in range(3)]
+        for h in hs:
+            h.result(timeout=300)
+    ids = {r[5] for r in trace.get_span_records(True) if r[5]}
+    assert {h.trace_id for h in hs} <= ids
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: suppressed-dump accounting + doctor note
+# ---------------------------------------------------------------------------
+
+def test_dump_cap_books_suppressed_and_doctor_notes(tmp_path,
+                                                    monkeypatch):
+    from cylon_tpu.observe import doctor
+    monkeypatch.setenv("CYLON_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setattr(flightrec, "_auto_dumps",
+                        flightrec.MAX_AUTO_DUMPS)
+    before = observe.REGISTRY.snapshot()["counters"].get(
+        "flightrec.dumps_suppressed", 0)
+    assert flightrec.maybe_dump_on_error(
+        "boom", RuntimeError("x")) is None
+    after = observe.REGISTRY.snapshot()["counters"]["flightrec.dumps_suppressed"]
+    assert after == before + 1           # visible even with counters off
+    assert any(e["kind"] == "dump_suppressed"
+               for e in flightrec.events())
+    report = doctor.render({
+        "events": [], "counters": {
+            "counters": {"flightrec.dumps_suppressed": 2},
+            "watermarks": {}}})
+    assert "suppressed" in report
+    flightrec.clear()
+
+
+# ---------------------------------------------------------------------------
+# queryprof: per-fingerprint regression attribution
+# ---------------------------------------------------------------------------
+
+def _snap(tmp_path, name, latency, join_ms, exchange="ring",
+          drift_obs=1.05):
+    doc = {"deadbeef0123456789": {
+        "label": "q1", "runs": 2, "latency_ms": latency,
+        "nodes": [
+            {"op": "scan", "ms": 2.0, "bytes_moved": 100,
+             "decision": "local", "exchange": None,
+             "exchange_ms": None, "peak": None},
+            {"op": "join", "ms": join_ms, "bytes_moved": 1 << 21,
+             "decision": "shuffle", "exchange": exchange,
+             "exchange_ms":
+                 f"{exchange}: predicted 1.0 / observed {drift_obs} ms",
+             "peak": None}]}}
+    p = str(tmp_path / name)
+    with open(p, "w") as fh:
+        json.dump(doc, fh)
+    return p
+
+
+def test_queryprof_attributes_fingerprint_and_node(tmp_path):
+    from cylon_tpu.analysis import queryprof
+    old = _snap(tmp_path, "old.json", latency=10.0, join_ms=5.0)
+    new = _snap(tmp_path, "new.json", latency=40.0, join_ms=30.0,
+                exchange="all-to-all", drift_obs=2.5)
+    findings = queryprof.diff_snapshots(old, new)
+    kinds = {f["kind"] for f in findings}
+    assert {"latency_ms", "node_ms", "exchange_flip",
+            "drift_exchange_ms"} <= kinds
+    node_ms = next(f for f in findings if f["kind"] == "node_ms")
+    assert node_ms["op"] == "join" and node_ms["node"] == 1
+    assert all(f["digest"] == "deadbeef0123456789" for f in findings)
+    lines = queryprof.render_findings(findings)
+    assert any("deadbeef" in ln and "join" in ln for ln in lines)
+    # exit contract: 1 findings, 0 clean, 2 unreadable
+    assert queryprof.main([old, new]) == 1
+    assert queryprof.main([old, old]) == 0
+    assert queryprof.main([old, str(tmp_path / "missing.json")]) == 2
+    assert queryprof.main([]) == 2
+
+
+def test_queryprof_floors_and_shape_change(tmp_path):
+    from cylon_tpu.analysis import queryprof
+    old = _snap(tmp_path, "old.json", latency=10.0, join_ms=5.0)
+    # +2ms on 10ms is >20% relative but under the 5ms absolute floor
+    new = _snap(tmp_path, "new.json", latency=12.0, join_ms=5.0)
+    assert queryprof.diff_snapshots(old, new) == []
+    # a changed plan shape is its own finding and skips the node diff
+    doc = json.load(open(new))
+    doc["deadbeef0123456789"]["nodes"].append(
+        {"op": "sort", "ms": 1.0, "bytes_moved": 0, "decision": None,
+         "exchange": None, "exchange_ms": None, "peak": None})
+    with open(new, "w") as fh:
+        json.dump(doc, fh)
+    findings = queryprof.diff_snapshots(old, new)
+    assert [f["kind"] for f in findings] == ["plan_shape"]

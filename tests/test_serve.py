@@ -480,6 +480,7 @@ def test_serve_stats_latency_percentiles(dctx, fact, dim):
     assert stats["completed"] == 4
     assert stats["p50_ms"] is not None and stats["p50_ms"] > 0
     assert stats["p99_ms"] >= stats["p50_ms"]
+    assert stats["p999_ms"] >= stats["p99_ms"]
     assert stats["batch_window_ms"] == 10.0
 
 
