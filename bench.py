@@ -51,9 +51,11 @@ CYLON_BENCH_REPS (timed repetitions, default 3), CYLON_BENCH_TPCH_SF
 (0 disables), CYLON_BENCH_PIPELINE_K (default 4), CYLON_BENCH_OOC
 (default on: the pinned-budget out-of-core stage — spill-path row
 parity on a small query set; 0 skips), CYLON_BENCH_MESHCHAOS=<seed>
-(the mesh-loss chaos stage: a device is lost mid-run under sustained
-serving and the topology rung must re-mesh onto the survivors; emits
-serve_meshchaos_recovered_ratio/_remesh_ms/_p99, benchdiff-gated).
+(the mesh-chaos stage: a device is lost mid-run under sustained
+serving, the topology rung re-meshes onto the survivors, then the
+device REJOINS and the session must re-expand under traffic; emits
+serve_meshchaos_recovered_ratio/_remesh_ms/_p99 plus the scale-up leg's
+serve_meshchaos_scaleup_ms/_restored_qps_ratio, benchdiff-gated).
 """
 from __future__ import annotations
 
@@ -1747,10 +1749,18 @@ def main() -> None:
         # workload with a deterministic mid-run device loss injected —
         # the topology rung must evacuate + re-mesh onto the survivors
         # WHILE 8 clients drive traffic, and the session must keep
-        # serving on the shrunken mesh.  Emits the recovered ratio
-        # (benchdiff gates it DOWN), p99 across the degrade (gated
-        # UP), and the measured re-mesh wall-clock (ungated — it
-        # scales with data volume).  Rides CYLON_BENCH_SUSTAIN.
+        # serving on the shrunken mesh.  The profile is LOSE-THEN-
+        # REJOIN: after a degraded middle leg the lost device rejoins
+        # (topology.mark_joined) and the session must re-expand while
+        # traffic keeps flowing — the final leg's throughput is the
+        # restored steady state.  Emits the recovered ratio (benchdiff
+        # gates it DOWN), p99 across the degrade (gated UP), the
+        # measured re-mesh + scale-up wall-clocks (ungated — they
+        # scale with data volume), and the restored-QPS ratio
+        # (post-rejoin steady QPS / pre-loss steady QPS; gated DOWN
+        # with the ratio floor — elasticity that "recovers" into a
+        # permanently slower fleet is a regression).  Rides
+        # CYLON_BENCH_SUSTAIN.
         meshchaos_seed = os.environ.get("CYLON_BENCH_MESHCHAOS", "")
         if q_ms and meshchaos_seed not in ("", "0") and sustain_s > 0 \
                 and remaining() > sustain_s + 60 \
@@ -1765,14 +1775,20 @@ def main() -> None:
             world0 = ctx.get_world_size()
             _progress(f"mesh-chaos serving: {len(mix)} clients x "
                       f"{sustain_s:.0f}s, one device lost mid-run "
-                      f"(seed {meshchaos_seed})")
+                      f"then rejoined (seed {meshchaos_seed})")
             try:
                 _trace.enable_counters()
                 _trace.reset()
-                stop_at = time.monotonic() + sustain_s
+                t0m = time.monotonic()
+                stop_at = t0m + sustain_s
                 lat_ok = []
+                done_ts = []
                 failed = [0]
                 lat_lock = _threading.Lock()
+                t_loss = [None]
+                t_restored = [None]
+                survivor_world = [None]
+                scaleup_ms = [None]
                 # nth targets a stage-boundary consult a few queries
                 # in: the loss lands MID-run, so the emitted ratio
                 # covers before, across, and after the degrade
@@ -1803,16 +1819,53 @@ def main() -> None:
                                 continue
                             with lat_lock:
                                 lat_ok.append(h.latency_ms)
+                                done_ts.append(time.monotonic())
+
+                    def mesh_controller():
+                        # the leg boundaries: observe the session's
+                        # degrade (its dispatcher turn, not the raw
+                        # topology flip — a blip the dispatcher never
+                        # saw has no serving cost), hold the shrunken
+                        # mesh through the middle leg, then rejoin the
+                        # lost device(s) and time how long the session
+                        # takes to OBSERVE the expansion — that window
+                        # is the serving-visible scale-up cost
+                        while time.monotonic() < stop_at:
+                            if srv.stats().get("mesh_degraded", 0) >= 1:
+                                t_loss[0] = time.monotonic()
+                                survivor_world[0] = _topology.effective(
+                                    ctx).get_world_size()
+                                break
+                            time.sleep(0.05)
+                        if t_loss[0] is None:
+                            return
+                        rejoin_at = max(stop_at - sustain_s / 3.0,
+                                        t_loss[0])
+                        while time.monotonic() < rejoin_at:
+                            time.sleep(0.05)
+                        t_join = time.monotonic()
+                        _topology.mark_joined(
+                            ctx, world0 - survivor_world[0])
+                        while time.monotonic() < stop_at:
+                            if srv.stats().get("mesh_expanded", 0) >= 1:
+                                t_restored[0] = time.monotonic()
+                                scaleup_ms[0] = round(
+                                    (t_restored[0] - t_join) * 1e3, 2)
+                                break
+                            time.sleep(0.01)
 
                     t0 = time.perf_counter()
                     threads = [
                         _threading.Thread(target=mesh_client, args=(q,))
                         for q in mix]
+                    threads.append(_threading.Thread(
+                        target=mesh_controller))
                     for th in threads:
                         th.start()
                     for th in threads:
                         th.join()
                     wall = time.perf_counter() - t0
+                    end_m = time.monotonic()
                     stats = srv.drain()
                 from cylon_tpu.serve.session import percentile
                 c = _trace.counters()
@@ -1836,20 +1889,53 @@ def main() -> None:
                     c.get("recover.remesh_us", 0) / 1e3, 2)
                 em.detail["serve_meshchaos_evacuated_bytes"] = \
                     c.get("recover.evacuated_bytes", 0)
-                em.detail["serve_meshchaos_survivor_world"] = eff_world
+                em.detail["serve_meshchaos_survivor_world"] = \
+                    survivor_world[0] if survivor_world[0] else eff_world
+                em.detail["serve_meshchaos_restored_world"] = eff_world
                 em.detail["serve_meshchaos_shed"] = stats.get("shed", 0)
                 em.detail["serve_meshchaos_degraded_windows"] = \
                     stats.get("mesh_degraded", 0)
+                em.detail["serve_meshchaos_scaleups"] = \
+                    c.get("recover.scaleups", 0)
+                em.detail["serve_meshchaos_scaleup_ms"] = scaleup_ms[0]
+                # restored-QPS ratio: post-rejoin steady throughput
+                # over PRE-LOSS steady throughput — 1.0 means the
+                # rejoined fleet serves at its pre-loss rate.  The
+                # denominator is the sustain stage's warm steady-state
+                # QPS (same process, same client mix, same plan cache,
+                # full mesh — it runs right before this stage, which
+                # already requires CYLON_BENCH_SUSTAIN): the in-run
+                # pre-loss window cannot serve, because the nth-consult
+                # loss deterministically lands inside compile warm-up
+                # and a cold denominator would inflate the ratio by the
+                # warm-up factor.  The numerator uses the post-rejoin
+                # leg's TRAILING half only — its head absorbs the
+                # expansion migration, and a ratio polluted by that
+                # ramp would gate on migration cost (already reported
+                # as serve_meshchaos_scaleup_ms), not steady state.
+                ratio = None
+                pre_qps = (em.detail.get("serve_sustain_steady_qps")
+                           or em.detail.get("serve_sustain_qps"))
+                if t_restored[0] is not None and pre_qps:
+                    post_lo = (t_restored[0]
+                               + (end_m - t_restored[0]) / 2.0)
+                    post_n = sum(1 for t in done_ts if t >= post_lo)
+                    post_qps = post_n / max(end_m - post_lo, 1e-9)
+                    ratio = round(post_qps / pre_qps, 4)
+                em.detail["serve_meshchaos_restored_qps_ratio"] = ratio
                 _progress(
                     f"mesh-chaos: "
                     f"{em.detail['serve_meshchaos_recovered_ratio']} "
-                    f"recovered ratio over {attempted} queries on "
-                    f"{eff_world}/{world0} devices "
+                    f"recovered ratio over {attempted} queries, "
+                    f"{em.detail['serve_meshchaos_survivor_world']}"
+                    f"/{world0} survivors -> {eff_world} restored "
                     f"({em.detail['serve_meshchaos_remeshes']} remesh, "
                     f"{em.detail['serve_meshchaos_remesh_ms']} ms "
                     f"evacuating "
-                    f"{em.detail['serve_meshchaos_evacuated_bytes']} B)"
-                    f", p99 {em.detail['serve_meshchaos_p99_ms']} ms")
+                    f"{em.detail['serve_meshchaos_evacuated_bytes']} B; "
+                    f"scale-up {scaleup_ms[0]} ms, restored-QPS ratio "
+                    f"{ratio}), p99 "
+                    f"{em.detail['serve_meshchaos_p99_ms']} ms")
             except Exception as e:  # graftlint: ok[broad-except] — the mesh-chaos stage must not kill the bench
                 print(f"mesh-chaos stage FAILED: {type(e).__name__}: "
                       f"{str(e)[:200]}", file=sys.stderr)

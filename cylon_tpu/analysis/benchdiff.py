@@ -197,6 +197,14 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     # scales with data volume, not code quality).
     (r"serve_meshchaos_recovered_ratio$", "down"),
     (r"serve_meshchaos_p99_ms$", "up"),
+    # the scale-UP half of the same profile: after the mid-run rejoin
+    # the restored steady QPS over the pre-loss steady QPS gates DOWN
+    # (with the ratio floor — a couple of queries' jitter on a ~1.0
+    # baseline is noise): a fleet that "recovers" into a permanently
+    # slower steady state regressed its elasticity even when every
+    # query completed.  The scale-up wall-clock itself is reported
+    # ungated (it scales with resident data volume, not code quality).
+    (r"serve_meshchaos_restored_qps_ratio$", "down"),
     # out-of-core family (docs/out_of_core.md): the main TPC-H stage
     # runs at AMPLE budget, so per-query spill bytes must stay 0 —
     # spilling when memory is ample means the morsel pricing or the

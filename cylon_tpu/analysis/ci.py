@@ -63,7 +63,17 @@ Stages:
      session flips into degraded mode, and doctor renders the
      ``mesh_degraded`` bundle with the evacuation timeline
      (``--no-mesh-smoke`` skips; auto-skips below 2 devices);
-  9. **hierarchy smoke** (docs/tpu_perf_notes.md "Hierarchical
+  9. **mesh-grow chaos smoke** (docs/robustness.md "Elasticity", the
+     scale-UP half): a deterministic ``mesh.device_lost`` THEN
+     ``mesh.device_joined`` sequence is injected into served
+     multi-stage queries — the victim recovers on the survivor mesh
+     and the session flips degraded; the NEXT query's executor takes
+     the rejoin mid-plan (``recover.scaleups`` in its counter slice)
+     and completes row-identical; the session un-degrades
+     (``mesh_expanded``); a follow-up query runs on the restored full
+     world; and doctor renders the scale-up timeline from the bundle
+     (``--no-scaleup-smoke`` skips; auto-skips below 2 devices);
+ 10. **hierarchy smoke** (docs/tpu_perf_notes.md "Hierarchical
      collectives"): on an 8-device 2x4 mesh with a synthetic per-edge
      profile the cost chooser must SELECT the hierarchical lowering
      for a skewed cross-slow-axis shuffle — row-identical to
@@ -72,7 +82,7 @@ Stages:
      must hold parity, with the pre-combine moving exactly one partial
      per group across the slow axis
      (``--no-hierarchy-smoke`` skips; auto-skips below 8 devices);
- 10. **concurrency smoke** (docs/static_analysis.md "Concurrency
+ 11. **concurrency smoke** (docs/static_analysis.md "Concurrency
      discipline"): the two concurrency rules
      (``shared-state-unguarded`` / ``blocking-call-under-lock``) must
      hold the tree at ZERO findings, a deterministic AB/BA lock-order
@@ -80,7 +90,7 @@ Stages:
      ``CYLON_LOCKCHECK`` enforcement — BEFORE any thread blocks — and
      an 8-client serving window must run green with enforcement live
      suite-wide (``--no-lockcheck-smoke`` skips);
- 11. **export smoke** (docs/observability.md "Live telemetry plane"):
+ 12. **export smoke** (docs/observability.md "Live telemetry plane"):
      the OpenMetrics endpoint is started on an ephemeral loopback port
      and scraped over real HTTP — every exposed family must map back
      to a catalogued metric of the matching kind, the latency
@@ -90,7 +100,7 @@ Stages:
      JSON; and tail-based trace sampling must retain the always-keep
      query's spans while dropping (and accounting for) the fast
      peers' (``--no-export-smoke`` skips);
- 12. **benchdiff** (only when ``--baseline`` and a candidate artifact
+ 13. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
      including the serving families (``serve_qps``/``serve_sustain_qps``
      down, ``serve_p99_ms``/``serve_sustain_p99_ms``/
@@ -98,7 +108,8 @@ Stages:
      ``tpch_<q>_recompiles`` / ``serve_slo_violations`` up-gates, the
      chaos family (``serve_chaos_recovered_ratio`` down,
      ``serve_chaos_p99_ms`` up), and the mesh-chaos family
-     (``serve_meshchaos_recovered_ratio`` down,
+     (``serve_meshchaos_recovered_ratio`` /
+     ``serve_meshchaos_restored_qps_ratio`` down,
      ``serve_meshchaos_p99_ms`` up).
 
 Exit code is the worst across stages under the shared contract: 0 clean,
@@ -127,14 +138,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/12: graftlint ==")
+    print("== ci stage 1/13: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/12: plan_check pre-flight ==")
+    print("== ci stage 2/13: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -195,7 +206,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/12: serving smoke ==")
+    print("== ci stage 3/13: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -318,7 +329,7 @@ def _stage_telemetry_smoke(sf: float) -> int:
     CONTRACTS rather than the numbers: sampler non-empty, catalogue
     compliance, export validity (one track per query trace id), stats
     store populated with per-node observations."""
-    print("== ci stage 4/12: telemetry smoke ==")
+    print("== ci stage 4/13: telemetry smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -440,7 +451,7 @@ def _stage_doctor_smoke(sf: float) -> int:
     post-mortem machinery end to end: the victim fails onto its own
     handle, peers stay row-identical to serial execution, a
     flight-recorder bundle lands on disk, and doctor renders it."""
-    print("== ci stage 5/12: doctor smoke ==")
+    print("== ci stage 5/13: doctor smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -552,7 +563,7 @@ def _stage_chaos_smoke(sf: float) -> int:
     shows the ladder's stage retry with fewer stages replayed than the
     plan has), peers complete untouched, and the flight-recorder
     bundle doctor renders shows the ladder's events."""
-    print("== ci stage 6/12: chaos-recovery smoke ==")
+    print("== ci stage 6/13: chaos-recovery smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -707,7 +718,7 @@ def _stage_ooc_smoke(sf: float) -> int:
     run, and the exchange transient must stay within the pinned
     budget.  On failure a flight-recorder bundle is dumped and doctor
     renders it, so the evidence ships with the red CI run."""
-    print("== ci stage 7/12: out-of-core smoke ==")
+    print("== ci stage 7/13: out-of-core smoke ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -809,7 +820,7 @@ def _stage_mesh_smoke(sf: float) -> int:
     slices, the session must flip into degraded mode, and the
     flight-recorder bundle doctor renders must show the
     ``mesh_degraded`` event + evacuation timeline."""
-    print("== ci stage 8/12: mesh-loss chaos smoke ==")
+    print("== ci stage 8/13: mesh-loss chaos smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -972,6 +983,194 @@ def _stage_mesh_smoke(sf: float) -> int:
     return 1 if bad else 0
 
 
+def _stage_scaleup_smoke(sf: float) -> int:
+    """Mesh-grow chaos smoke (docs/robustness.md "Elasticity", the
+    scale-UP half): deterministic ``mesh.device_lost`` THEN
+    ``mesh.device_joined`` rules are injected into served multi-stage
+    queries — the victim must recover on the survivor mesh and the
+    session flip degraded; the next served query's executor must take
+    the rejoin mid-plan (``recover.scaleups`` in ITS counter slice)
+    and complete row-identical; the session must UN-degrade
+    (``mesh_expanded`` tallied, degraded gauge cleared); a follow-up
+    query must run on the restored full world; and the doctor must
+    render the ``mesh_expanded`` scale-up timeline from the bundle."""
+    print("== ci stage 9/13: mesh-grow chaos smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import tempfile
+
+        import jax
+
+        from .. import faults, plan as planner, topology
+        from ..context import CylonContext
+        from ..observe import doctor, flightrec
+        from ..parallel.dtable import DTable
+        from ..serve import ServeSession
+        from ..tpch import generate
+
+        if len(jax.devices()) < 2:
+            print("mesh-grow smoke: skipped — needs >= 2 devices (set "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+            return 0
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        data = generate(sf, seed=11)
+        dts = {name: DTable.from_pandas(ctx, df)
+               for name, df in data.items()}
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding —
+        # the same contract as the stages above
+        print(f"mesh-grow smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    world0 = ctx.get_world_size()
+    prev_dir = os.environ.get("CYLON_FLIGHTREC_DIR")
+    tmpdir = tempfile.mkdtemp(prefix="cylon-grow-")
+    os.environ["CYLON_FLIGHTREC_DIR"] = tmpdir
+    try:
+        import json
+
+        from ..config import JoinConfig
+        from ..parallel import dist_groupby, dist_join
+
+        li = dts["lineitem"].column_names.index("l_orderkey")
+        oi = dts["orders"].column_names.index("o_orderkey")
+
+        def two_stage_op(t):
+            # two exchange stages: the victim loses a device at its
+            # SECOND boundary (mid-query); the scale-up leg rejoins at
+            # a boundary of the NEXT query the same way
+            j = dist_join(t["lineitem"], t["orders"],
+                          JoinConfig.InnerJoin(li, oi))
+            return dist_groupby(j, ["lt-l_orderkey"],
+                                [("lt-l_quantity", "sum")])
+
+        def norm(df):
+            return (df.sort_values(list(df.columns))
+                    .reset_index(drop=True))
+
+        serial = norm(planner.run(ctx, two_stage_op, dts)
+                      .to_table().to_pandas())
+        flightrec.clear()
+        from .. import trace as _trace
+        _trace.enable_counters()
+        _trace.reset()
+
+        def wait_stat(s, key, timeout=30.0):
+            deadline = time.perf_counter() + timeout
+            while time.perf_counter() < deadline:
+                if s.stats().get(key, 0) >= 1:
+                    return True
+                time.sleep(0.05)
+            return s.stats().get(key, 0) >= 1
+
+        with ServeSession(ctx, tables=dts, batch_window_ms=10.0) as s:
+            # leg 1 — deterministic loss mid-query: the victim's ladder
+            # shrinks the mesh, the session flips degraded
+            lose = faults.FaultPlan(seed=0, rules=[
+                faults.FaultRule("mesh.device_lost", kind="topology",
+                                 nth=2, lost=1)])
+            with faults.active(lose):
+                victim = s.submit(two_stage_op, label="victim")
+                got_v = norm(victim.result(timeout=600)
+                             .to_table().to_pandas())
+            if not got_v.equals(serial):
+                print("mesh-grow smoke: the victim DIVERGED on the "
+                      "survivor mesh", file=sys.stderr)
+                bad += 1
+            if not victim.counters.get("recover.remesh", 0):
+                print("mesh-grow smoke: the victim's slice shows no "
+                      "re-mesh — the loss never engaged",
+                      file=sys.stderr)
+                bad += 1
+            if not wait_stat(s, "mesh_degraded"):
+                print("mesh-grow smoke: the session never flipped "
+                      "into degraded mode", file=sys.stderr)
+                bad += 1
+            # leg 2 — deterministic rejoin at the next query's first
+            # boundary: the executor takes the expansion mid-plan
+            grow = faults.FaultPlan(seed=0, rules=[
+                faults.FaultRule("mesh.device_joined", kind="topology",
+                                 nth=1, lost=1)])
+            with faults.active(grow):
+                riser = s.submit(two_stage_op, label="riser")
+                got_r = norm(riser.result(timeout=600)
+                             .to_table().to_pandas())
+            if not got_r.equals(serial):
+                print("mesh-grow smoke: the scale-up query DIVERGED",
+                      file=sys.stderr)
+                bad += 1
+            if not riser.counters.get("recover.scaleups", 0):
+                print("mesh-grow smoke: the scale-up query's slice "
+                      "shows no recover.scaleups — the rejoin never "
+                      "expanded the plan", file=sys.stderr)
+                bad += 1
+            if not wait_stat(s, "mesh_expanded"):
+                print("mesh-grow smoke: the session never recorded "
+                      "the expansion (mesh_expanded)", file=sys.stderr)
+                bad += 1
+            if "degraded_world" in s.stats():
+                print("mesh-grow smoke: degraded_world survived the "
+                      "full restore — the session did not un-degrade",
+                      file=sys.stderr)
+                bad += 1
+            # leg 3 — the follow-up query runs on the restored world
+            tail = s.submit(two_stage_op, label="tail")
+            got_t = norm(tail.result(timeout=600)
+                         .to_table().to_pandas())
+            if not got_t.equals(serial):
+                print("mesh-grow smoke: the post-expansion query "
+                      "diverged", file=sys.stderr)
+                bad += 1
+        eff = topology.effective(ctx)
+        if eff.get_world_size() != world0:
+            print(f"mesh-grow smoke: world is {eff.get_world_size()} "
+                  f"after the rejoin, expected {world0}",
+                  file=sys.stderr)
+            bad += 1
+        if not any(e.get("kind") == "mesh_expanded"
+                   for e in flightrec.events()):
+            print("mesh-grow smoke: no mesh_expanded event reached "
+                  "the flight recorder", file=sys.stderr)
+            bad += 1
+        bundle_path = flightrec.dump(reason="ci mesh-grow chaos smoke")
+        rc = doctor.main([bundle_path])
+        if rc != 0:
+            print(f"mesh-grow smoke: doctor exited {rc} on the bundle",
+                  file=sys.stderr)
+            bad += 1
+        with open(bundle_path) as f:
+            rendered = doctor.render(json.load(f))
+        if "MESH EXPANDED" not in rendered:
+            print("mesh-grow smoke: doctor did not render the "
+                  "scale-up timeline", file=sys.stderr)
+            bad += 1
+        print(f"mesh-grow smoke: victim recovered, rejoin expanded "
+              f"back to {eff.get_world_size()}/{world0} devices "
+              f"(scaleups={riser.counters.get('recover.scaleups', 0)}),"
+              f" follow-up clean "
+              f"({time.perf_counter() - t0:.1f}s, sf={sf})")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract and
+        # let the remaining stages run instead of dying with a traceback
+        print(f"mesh-grow smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        try:
+            from .. import topology as _topology, trace as _trace
+            _trace.disable_counters()
+            _trace.reset()
+            _topology.reset()
+        except Exception:  # graftlint: ok[broad-except] — best-effort
+            pass           # teardown must not mask the stage verdict
+        if prev_dir is None:
+            os.environ.pop("CYLON_FLIGHTREC_DIR", None)
+        else:
+            os.environ["CYLON_FLIGHTREC_DIR"] = prev_dir
+    return 1 if bad else 0
+
+
 def _stage_hierarchy_smoke() -> int:
     """Hierarchical-collectives smoke (docs/tpu_perf_notes.md
     "Hierarchical collectives"): on an 8-device 2x4 mesh with a
@@ -982,7 +1181,7 @@ def _stage_hierarchy_smoke() -> int:
     flat single-shot slow-share price.  A forced hierarchical leg and
     a forced hierarchical-combine fused-groupby leg prove both
     lowerings independently."""
-    print("== ci stage 9/12: hierarchy smoke ==")
+    print("== ci stage 10/13: hierarchy smoke ==")
     t0 = time.perf_counter()
     try:
         import dataclasses
@@ -1171,7 +1370,7 @@ def _stage_lockcheck_smoke() -> int:
     detector reports the deadlock instead of experiencing it; (c) an
     8-client serving window runs green with CYLON_LOCKCHECK
     enforcement live across every OrderedLock in the engine."""
-    print("== ci stage 10/12: concurrency smoke ==")
+    print("== ci stage 11/13: concurrency smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -1294,7 +1493,7 @@ def _stage_export_smoke(sf: float) -> int:
     sampling retains the always-keep query's span waterfall and drops
     the fast peers', with ``trace.sampled_out`` accounting for the
     purge."""
-    print("== ci stage 11/12: export smoke ==")
+    print("== ci stage 12/13: export smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -1433,7 +1632,7 @@ def _stage_export_smoke(sf: float) -> int:
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 12/12: benchdiff ==")
+    print("== ci stage 13/13: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -1467,6 +1666,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the out-of-core (spill) smoke stage")
     ap.add_argument("--no-mesh-smoke", action="store_true",
                     help="skip the mesh-loss chaos smoke stage")
+    ap.add_argument("--no-scaleup-smoke", action="store_true",
+                    help="skip the mesh-grow chaos smoke stage")
     ap.add_argument("--no-hierarchy-smoke", action="store_true",
                     help="skip the hierarchical-collectives smoke stage")
     ap.add_argument("--no-lockcheck-smoke", action="store_true",
@@ -1483,48 +1684,52 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/12: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/13: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/12: serving smoke == (skipped)")
+        print("== ci stage 3/13: serving smoke == (skipped)")
     if not args.no_telemetry_smoke:
         rcs.append(_stage_telemetry_smoke(args.tpch_sf))
     else:
-        print("== ci stage 4/12: telemetry smoke == (skipped)")
+        print("== ci stage 4/13: telemetry smoke == (skipped)")
     if not args.no_doctor_smoke:
         rcs.append(_stage_doctor_smoke(args.tpch_sf))
     else:
-        print("== ci stage 5/12: doctor smoke == (skipped)")
+        print("== ci stage 5/13: doctor smoke == (skipped)")
     if not args.no_chaos_smoke:
         rcs.append(_stage_chaos_smoke(args.tpch_sf))
     else:
-        print("== ci stage 6/12: chaos-recovery smoke == (skipped)")
+        print("== ci stage 6/13: chaos-recovery smoke == (skipped)")
     if not args.no_ooc_smoke:
         rcs.append(_stage_ooc_smoke(args.tpch_sf))
     else:
-        print("== ci stage 7/12: out-of-core smoke == (skipped)")
+        print("== ci stage 7/13: out-of-core smoke == (skipped)")
     if not args.no_mesh_smoke:
         rcs.append(_stage_mesh_smoke(args.tpch_sf))
     else:
-        print("== ci stage 8/12: mesh-loss chaos smoke == (skipped)")
+        print("== ci stage 8/13: mesh-loss chaos smoke == (skipped)")
+    if not args.no_scaleup_smoke:
+        rcs.append(_stage_scaleup_smoke(args.tpch_sf))
+    else:
+        print("== ci stage 9/13: mesh-grow chaos smoke == (skipped)")
     if not args.no_hierarchy_smoke:
         rcs.append(_stage_hierarchy_smoke())
     else:
-        print("== ci stage 9/12: hierarchy smoke == (skipped)")
+        print("== ci stage 10/13: hierarchy smoke == (skipped)")
     if not args.no_lockcheck_smoke:
         rcs.append(_stage_lockcheck_smoke())
     else:
-        print("== ci stage 10/12: concurrency smoke == (skipped)")
+        print("== ci stage 11/13: concurrency smoke == (skipped)")
     if not args.no_export_smoke:
         rcs.append(_stage_export_smoke(args.tpch_sf))
     else:
-        print("== ci stage 11/12: export smoke == (skipped)")
+        print("== ci stage 12/13: export smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 12/12: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 13/13: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

@@ -745,6 +745,40 @@ def set_lock_hold_watchdog_ms(ms: "Optional[int]") -> "Optional[int]":
     return prev
 
 
+_remesh_cooldown_ms: Optional[int] = None       # None -> env-resolved
+
+
+def remesh_cooldown_ms() -> int:
+    """Flap-damping hysteresis window in ms for elastic topology
+    transitions (docs/robustness.md "Elasticity"): a device rejoin
+    arriving within this window of the LAST topology change is held
+    pending rather than applied, so a flapping device cannot thrash
+    evacuation/expansion back to back.  0 disables (joins apply
+    immediately).  Explicit knob, else ``CYLON_REMESH_COOLDOWN_MS``
+    (default 0 — damping is opt-in because the tests and CI smokes
+    drive deterministic transitions)."""
+    if _remesh_cooldown_ms is not None:
+        return _remesh_cooldown_ms
+    try:
+        return int(os.environ.get("CYLON_REMESH_COOLDOWN_MS", "0"))
+    except ValueError:
+        return 0
+
+
+def set_remesh_cooldown_ms(ms: "Optional[int]") -> "Optional[int]":
+    """Set the remesh flap-damping window (``None`` restores env
+    resolution, 0 disables); returns the previous explicit setting."""
+    global _remesh_cooldown_ms
+    if ms is not None and (not isinstance(ms, int)
+                           or isinstance(ms, bool) or ms < 0):
+        raise CylonError(Status(Code.Invalid,
+            "remesh cooldown must be a non-negative int of ms or "
+            f"None (env-resolved), got {type(ms).__name__} {ms!r}"))
+    prev = _remesh_cooldown_ms
+    _remesh_cooldown_ms = ms
+    return prev
+
+
 # ---------------------------------------------------------------------------
 # sanitizer mode (docs/static_analysis.md): the RUNTIME backstop for the
 # invariants graftlint proves statically.  When on:

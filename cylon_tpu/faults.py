@@ -61,7 +61,7 @@ __all__ = [
     "POINTS", "FaultError", "TransientFault", "ResourceFault",
     "PermanentFault", "TopologyFault", "FaultRule", "FaultPlan",
     "install", "uninstall", "active", "plan", "check", "perturb",
-    "undersize_hint",
+    "poll", "undersize_hint",
 ]
 
 # ---------------------------------------------------------------------------
@@ -141,6 +141,14 @@ POINTS: Dict[str, str] = {
         "(plan/executor._execute) — topology rules carry lost=k; the "
         "escalation ladder's TOPOLOGY rung evacuates and re-meshes "
         "onto the P-k survivors",
+    "mesh.device_joined":
+        "return of a repaired device (or mesh slice), surfacing at the "
+        "same exchange-boundary dispatch (plan/executor._execute) — an "
+        "EVENT point consulted via poll(), not check(): a rejoin is an "
+        "opportunity, not a failure.  Topology rules carry lost=k as "
+        "the rejoin count; the executor answers by growing the mesh "
+        "back along the roster (topology.mark_joined) and expanding or "
+        "deferring per the amortization bound",
 }
 
 
@@ -234,7 +242,20 @@ class FaultRule:
     once: bool = False              # at most one fire PER POINT
     limit: Optional[int] = None     # max fires PER POINT
     mutate: Optional[Callable] = None  # kind="value": old -> new
-    lost: int = 1                   # kind="topology": devices that died
+    lost: int = 1     # kind="topology": devices that died (or, at join
+    #                   points, returned)
+    after: Optional[str] = None     # eligible only once this point fired
+    window: Optional[int] = None    # ...within this many consultations
+    # after/window sequence a PATTERN across points (lose→rejoin→lose at
+    # bounded intervals): the rule is eligible only after some rule last
+    # fired at the `after` point, and — when `window` is set — only
+    # within that many subsequent consultations (of ANY point) of that
+    # fire.  The sequencing reads a plan-global consultation counter, so
+    # a pattern rule's eligibility does depend on how concurrent
+    # consultations interleave — inherent to cross-point ordering, and
+    # harmless in practice: the chaos flap rules fire at the executor's
+    # single-threaded exchange-boundary dispatch, where the consult
+    # order is the stage order and replays are exact.
     # once/limit caps are scoped per (rule, point): for an exact-name
     # rule that is the historical "once ever", while a PATTERN rule
     # ("io.*") caps each matching point independently — a shared
@@ -256,6 +277,16 @@ class FaultRule:
             raise CylonError(Status(Code.Invalid,
                 f"topology fault 'lost' must be a positive int device "
                 f"count, got {self.lost!r}"))
+        if self.window is not None and (
+                isinstance(self.window, bool)
+                or not isinstance(self.window, int) or self.window < 1):
+            raise CylonError(Status(Code.Invalid,
+                f"fault rule 'window' must be a positive int consultation "
+                f"count, got {self.window!r}"))
+        if self.window is not None and self.after is None:
+            raise CylonError(Status(Code.Invalid,
+                f"fault rule 'window' at {self.point!r} needs 'after' — "
+                f"a window is measured from the prerequisite's fire"))
 
 
 class FaultPlan:
@@ -272,6 +303,11 @@ class FaultPlan:
         # once/limit deterministic under pattern rules (see FaultRule)
         self._fires: Dict[Tuple[int, str], int] = {}
         self.fired: List[Tuple[str, str]] = []  # (point, kind) log
+        # cross-point pattern sequencing (FaultRule.after/window): a
+        # plan-global consultation sequence and, per point, the seq of
+        # its last fire
+        self._seq = 0
+        self._last_fire_seq: Dict[str, int] = {}
 
     def _draw(self, point: str, n: int, rule_idx: int) -> float:
         """The deterministic probability draw for the ``n``-th
@@ -333,6 +369,23 @@ class FaultPlan:
             # models "a chip died", not "the fleet is melting"
             FaultRule("mesh.device_lost", kind="topology",
                       probability=0.003, limit=1),
+            # the flap pattern (docs/robustness.md "Elasticity",
+            # scale-up half): a lost device RETURNS within a bounded
+            # interval of the loss, then may die again shortly after
+            # rejoining — lose → rejoin → lose, each leg eligible only
+            # within `window` consultations of the previous one.  Both
+            # legs are capped (limit=1, modest probabilities), so a
+            # chaos run exercises at most one flap cycle on top of the
+            # base loss rule above — the hysteresis window
+            # (CYLON_REMESH_COOLDOWN_MS) is what keeps this from
+            # thrashing the evacuation machinery, and the flap-damping
+            # test pins that down
+            FaultRule("mesh.device_joined", kind="topology",
+                      probability=0.25, limit=1,
+                      after="mesh.device_lost", window=400),
+            FaultRule("mesh.device_lost", kind="topology",
+                      probability=0.10, limit=1,
+                      after="mesh.device_joined", window=400),
         ])
 
     def _decide(self, point: str, want_value: bool) -> Optional[FaultRule]:
@@ -341,6 +394,8 @@ class FaultPlan:
         with self._lock:
             n = self._calls.get(point, 0) + 1
             self._calls[point] = n
+            self._seq += 1
+            seq = self._seq
             for i, rule in enumerate(self.rules):
                 is_value = rule.kind == "value"
                 if is_value != want_value:
@@ -352,6 +407,12 @@ class FaultPlan:
                     continue
                 if rule.limit is not None and fires >= rule.limit:
                     continue
+                if rule.after is not None:
+                    last = self._last_fire_seq.get(rule.after)
+                    if last is None:
+                        continue
+                    if rule.window is not None and seq - last > rule.window:
+                        continue
                 if rule.nth is not None:
                     if n != rule.nth:
                         continue
@@ -360,6 +421,7 @@ class FaultPlan:
                 self._fires[(i, point)] = fires + 1
                 self.injected += 1
                 self.fired.append((point, rule.kind))
+                self._last_fire_seq[point] = seq
                 return rule
         return None
 
@@ -427,6 +489,23 @@ def check(point: str) -> None:
     if rule.kind == "topology":
         raise TopologyFault(point, lost=rule.lost)
     raise TransientFault(point)
+
+
+def poll(point: str) -> Optional[FaultRule]:
+    """Event hook: consult ``point`` like :func:`check` but RETURN the
+    firing rule instead of raising — for event-class points
+    (``mesh.device_joined``) where an injected occurrence is an
+    opportunity the caller acts on, not a failure to recover from.
+    None without an active plan or firing rule.  Fires count into
+    ``fault.injected`` and the plan's tally like any other."""
+    p = _active_plan
+    if p is None:
+        return None
+    rule = p._decide(point, want_value=False)
+    if rule is None:
+        return None
+    _count_injection()
+    return rule
 
 
 def perturb(point: str, value):

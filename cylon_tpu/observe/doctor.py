@@ -88,25 +88,49 @@ def render(doc: Dict[str, Any]) -> str:
                      f"{state:<9} {q.get('latency_ms', '?'):>9} ms"
                      f"{tail}")
 
-    # elastic degraded-mesh timeline (docs/robustness.md
-    # "Elasticity"): device losses and the evacuations that answered
-    # them, in ring order — the "what happened to the fleet" view of a
-    # post-mortem
+    # elasticity timeline (docs/robustness.md "Elasticity"): device
+    # losses, the evacuations that answered them, and the scale-UP
+    # half — damped/applied rejoins, SLO-driven capacity requests and
+    # the expansions that fulfilled them — in ring order: the "what
+    # happened to the fleet" view of a post-mortem
     mesh = [e for e in doc.get("events", [])
-            if e.get("kind") == "mesh_degraded"
+            if e.get("kind") in ("mesh_degraded", "mesh_expanded",
+                                 "mesh_join_damped", "capacity_request")
             or (e.get("kind") == "recover"
-                and e.get("action") == "remesh")]
+                and e.get("action") in ("remesh", "scaleup"))]
     if mesh:
-        lines.append(_section(f"mesh topology / evacuation timeline "
-                              f"({len(mesh)})"))
-        for e in mesh[-8:]:
-            if e.get("kind") == "mesh_degraded":
+        lines.append(_section(f"elasticity timeline ({len(mesh)})"))
+        for e in mesh[-12:]:
+            kind = e.get("kind")
+            sess = (f" (session {e.get('session')})"
+                    if e.get("session") else "")
+            if kind == "mesh_degraded":
                 lines.append(
                     f"  [{_fmt_ts(e.get('t'))}] MESH DEGRADED: lost "
                     f"{e.get('lost', '?')} device(s) -> "
-                    f"{e.get('survivor_world', '?')} survivors"
-                    + (f" (session {e.get('session')})"
-                       if e.get("session") else ""))
+                    f"{e.get('survivor_world', '?')} survivors{sess}")
+            elif kind == "mesh_expanded":
+                world = e.get("new_world", e.get("world", "?"))
+                lines.append(
+                    f"  [{_fmt_ts(e.get('t'))}] MESH EXPANDED: "
+                    f"+{e.get('joined', '?')} device(s) -> "
+                    f"{world} world{sess}")
+            elif kind == "mesh_join_damped":
+                lines.append(
+                    f"  [{_fmt_ts(e.get('t'))}] JOIN DAMPED: "
+                    f"{e.get('pending', '?')} rejoin(s) held "
+                    f"(flap window {e.get('cooldown_ms', '?')} ms)")
+            elif kind == "capacity_request":
+                lines.append(
+                    f"  [{_fmt_ts(e.get('t'))}] CAPACITY REQUEST "
+                    f"[{e.get('rule', '?')}]{sess}: "
+                    f"{e.get('detail', '')}")
+            elif e.get("action") == "scaleup":
+                lines.append(
+                    f"  [{_fmt_ts(e.get('t'))}] SCALE-UP: evacuated "
+                    f"{e.get('evacuated_bytes', '?')} B, resumed on "
+                    f"{e.get('new_world', '?')} devices "
+                    f"({e.get('note', '')})")
             else:
                 lines.append(
                     f"  [{_fmt_ts(e.get('t'))}] REMESH: evacuated "

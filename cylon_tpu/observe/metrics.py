@@ -424,7 +424,39 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "devices the serving session has lost vs its construction-time "
      "mesh — nonzero means degraded mode: admission budgets re-price "
      "to the survivor fraction and new builders anchor on the "
-     "survivor mesh"),
+     "survivor mesh; cleared back to 0 by a full scale-up"),
+    # elastic scale-UP (docs/robustness.md "Elasticity", scale-up
+    # half): the inverse arm — repaired devices rejoining, expansion
+    # vs deferral, and the SLO loop that asks for capacity
+    ("recover.scaleups", COUNTER, "scaleups",
+     "applied mesh expansions: a device rejoin (mesh.device_joined / "
+     "topology.mark_joined) grew the live mesh back along the roster "
+     "and bumped the topology epoch"),
+    ("recover.scaleup_deferred", COUNTER, "deferrals",
+     "mid-plan expansions the executor deferred because the amortized "
+     "win (observed per-stage priced bytes x stages left) did not "
+     "beat the migration cost — annotated remesh=deferred(P->P') and "
+     "re-evaluated at each later stage boundary"),
+    ("recover.join_damped", COUNTER, "joins",
+     "device rejoins held pending by the flap-damping hysteresis "
+     "window (CYLON_REMESH_COOLDOWN_MS) instead of applied — a "
+     "flapping device pays one damped interval, not two evacuations"),
+    ("serve.capacity_requests", COUNTER, "requests",
+     "typed capacity requests booked on a serving session by "
+     "sustained p99-drift / qps-collapse SLO alerts "
+     "(observe.timeseries) — fulfilled by the next mesh_expanded "
+     "event, rendered by doctor in the scale-up timeline"),
+    ("serve.router_routed", COUNTER, "queries",
+     "queries placed onto a fleet replica by serve.router — by "
+     "plan-cache affinity when the fingerprint's compiling replica is "
+     "known and healthy, else by least priced-bytes load"),
+    ("serve.router_affinity_hits", COUNTER, "queries",
+     "fleet routings that hit plan-cache affinity: the query's "
+     "fingerprint routed to the replica recorded as having compiled "
+     "it (observe.stats set_replica)"),
+    ("serve.router_failovers", COUNTER, "queries",
+     "fleet routings diverted off their preferred replica because it "
+     "was draining, quarantined (breaker OPEN), degraded, or closed"),
     ("shuffle.watchdog_timeouts", COUNTER, "timeouts",
      "collective dispatches aborted by the exchange hang watchdog "
      "(CYLON_EXCHANGE_TIMEOUT_MS): the wedged exchange raised a "

@@ -318,6 +318,20 @@ class StatsStore:
                 self._dirty = True
                 self._flush_maybe_locked()
 
+    def set_replica(self, digest: str, replica: str) -> None:
+        """Record which serving replica compiled/served ``digest`` —
+        the plan-cache affinity hint the fleet router reads
+        (serve/router.py, docs/serving.md "Fleet mode").  Unlike
+        ``set_label`` this CREATES the record when absent: affinity
+        must stick from a fingerprint's very first routing, before any
+        run has been recorded under it."""
+        with self._lock:
+            self._ensure_loaded_locked()
+            rec = self._records.setdefault(digest, {"runs": 0})
+            rec["replica"] = replica
+            self._dirty = True
+            self._flush_maybe_locked()
+
     # -- reads (the future planner pass's API) ------------------------------
 
     def get(self, digest: str) -> Optional[Dict[str, Any]]:

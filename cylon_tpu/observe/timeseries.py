@@ -354,6 +354,18 @@ class TimeSeriesSampler:
         self.alerts.append({"t": sample.get("t"), "rule": rule,
                             "detail": detail})
         del self.alerts[:-64]   # bounded like everything else here
+        if (self._session is not None
+                and rule in ("p99-drift", "qps-collapse")):
+            # the SLO loop's demand half (docs/robustness.md
+            # "Elasticity"): sustained tail-latency drift or throughput
+            # collapse under queued demand are the pressure signatures
+            # a bigger mesh actually fixes — open a typed capacity
+            # request on the session (cache-hit collapse is a plan
+            # cache problem; more devices do not help it)
+            try:
+                self._session.request_capacity(rule, detail)
+            except Exception:  # graftlint: ok[broad-except] — a
+                pass            # session mid-close must not kill alerts
         msg = f"SLO alert [{rule}]: {detail} (logged once per rule " \
               f"per process — sampler.alerts and the serve tally " \
               f"record every firing; docs/observability.md 'SLO rules')"

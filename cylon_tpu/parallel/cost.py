@@ -332,6 +332,29 @@ def price_remesh(p_old: int, p_new: int, counts: np.ndarray,
         host_bytes=2 * payload)
 
 
+def amortized_remesh_win(per_stage_bytes: float, stages_left: int,
+                         p_old: int, p_new: int) -> float:
+    """The scale-up deferral bound (docs/robustness.md "Elasticity",
+    scale-up half): priced bytes a mid-plan expansion P → P' would save
+    over the REMAINING stages.  Each stage's exchange payload is fixed
+    by the data, but the per-device share — the resident blocks and the
+    serialized host legs the single-core simulation actually pays —
+    shrinks by ``1 − P/P'`` when the same rows spread over more
+    devices.  ``per_stage_bytes`` comes from the run-stats store's
+    observed per-fingerprint bytes (bytes_moved summed over the
+    recorded plan, divided by its stage count).  The executor expands
+    only when this win beats the migration cost (the summed
+    ``price_remesh`` wire + host bytes of the plan's live tables);
+    otherwise it defers, annotates ``remesh=deferred(P->P')``, and
+    re-evaluates at the next stage boundary — where ``stages_left`` has
+    shrunk but so has the remaining win."""
+    p_old_eff = max(int(p_old), 1)
+    p_new_eff = max(int(p_new), p_old_eff)
+    frac = 1.0 - p_old_eff / p_new_eff
+    return max(float(per_stage_bytes), 0.0) * max(int(stages_left), 0) \
+        * frac
+
+
 def chunk_plan(nparts: int, counts: np.ndarray, rbytes: int,
                budget: int) -> Tuple[int, int, int, int]:
     """The chunk math (docs/robustness.md): pick the smallest per-round

@@ -1,5 +1,11 @@
-"""Elastic re-partition: move a DTable from a P-shard mesh onto the
-P′-shard survivor mesh (docs/robustness.md "Elasticity").
+"""Elastic re-partition: move a DTable from a P-shard mesh onto a
+P′-shard mesh (docs/robustness.md "Elasticity").
+
+The pipeline is DIRECTION-AGNOSTIC: P′ < P is the shrink the ladder's
+TOPOLOGY rung takes after a ``mesh.device_lost`` fault, and P′ > P is
+the scale-UP the executor takes when ``mesh.device_joined`` re-grows
+the mesh mid-plan — same evacuate/re-block/restage path, same pricing,
+either way.
 
 The escalation ladder's TOPOLOGY rung (plan/executor.py) calls
 :func:`remesh_table` for every live piece of state a resumed attempt

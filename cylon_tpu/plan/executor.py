@@ -655,7 +655,121 @@ def _remesh_scan_tables(pre_nodes: List[Node], new_ctx) -> int:
     return evac
 
 
-def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node]):
+class _MeshExpansion(Exception):
+    """Control flow, not failure: a stage boundary decided to take a
+    pending mesh EXPANSION (device rejoin, docs/robustness.md
+    "Elasticity" scale-up half).  Raised by :func:`_maybe_expand` and
+    caught by ``_execute_recovering`` BEFORE the escalation ladder —
+    an expansion is an opportunity, never a ladder rung, and must not
+    consume the loss budget (``RecoveryPolicy.max_remeshes``) a later
+    real failure needs."""
+
+    def __init__(self, new_ctx, note: str):
+        super().__init__(note)
+        self.new_ctx = new_ctx
+        self.note = note
+
+
+def _expansion_decision(pre_nodes: List[Node], plan_key, p_old: int,
+                        p_new: int, stages_left: int
+                        ) -> Tuple[bool, str]:
+    """The amortization bound on a mid-plan expansion P → P': expand
+    only when the priced bytes the remaining stages save on the grown
+    mesh beat the migration cost of moving the plan's live tables
+    (cost.amortized_remesh_win).  The per-stage savings come from the
+    run-stats store's OBSERVED bytes for this plan's fingerprint — a
+    fingerprint never observed (or observed moving nothing) expands
+    eagerly: the win is unknown and the grown mesh is strictly more
+    fleet.  Returns ``(expand, note)`` where ``note`` carries the math
+    for the EXPLAIN ANALYZE annotation either way."""
+    import numpy as np
+
+    from .. import observe
+    from ..observe import stats as _obstats
+    from ..parallel import cost
+    move = 0
+    seen: Set[int] = set()
+    for n in pre_nodes:
+        if n.op != "scan":
+            continue
+        dt = n.runtime.get("dtable")
+        if dt is None or id(dt) in seen:
+            continue
+        seen.add(id(dt))
+        counts = np.asarray(dt.counts_host()).astype(np.int64)
+        leaves = []
+        for c in dt._columns:
+            leaves.append(c.data)
+            if c.validity is not None:
+                leaves.append(c.validity)
+        rbytes = max(observe.row_bytes(leaves), 1)
+        price = cost.price_remesh(p_old, p_new, counts, rbytes)
+        move += price.wire_bytes + price.host_bytes
+    rec = None
+    if plan_key is not None:
+        rec = _obstats.STORE.get(_obstats.plan_digest(plan_key))
+    observed = 0
+    nstages = 0
+    if rec:
+        observed = sum(int(node.get("bytes_moved") or 0)
+                       for node in rec.get("nodes", []))
+        nstages = sum(1 for node in rec.get("nodes", [])
+                      if node.get("exchange"))
+        if not observed:
+            observed = sum(int(v) for k, v in
+                           (rec.get("counters") or {}).items()
+                           if k in ("shuffle.bytes_sent",
+                                    "broadcast.bytes_sent"))
+    if observed <= 0:
+        return True, (f"no observed bytes for fingerprint — expanding "
+                      f"eagerly (migration {move} B)")
+    per_stage = observed / max(nstages, stages_left, 1)
+    win = cost.amortized_remesh_win(per_stage, stages_left, p_old, p_new)
+    note = (f"win {int(win)} B ({int(per_stage)} B/stage x "
+            f"{stages_left} left) vs migration {move} B")
+    return win >= move, note
+
+
+def _maybe_expand(builder, pre_nodes: List[Node], stages_left: int,
+                  expand: Optional[Dict[str, int]], plan_key) -> None:
+    """The stage-boundary scale-up consult (the inverse of the
+    ``mesh.device_lost`` consult next to it in ``_execute``): poll the
+    ``mesh.device_joined`` event point, flush any hysteresis-pending
+    joins, and — when the effective mesh has GROWN past the builder's —
+    either take the expansion (raise :class:`_MeshExpansion`, handled
+    by the recovering driver as an evacuation onto the grown mesh) or
+    defer it per the amortization bound, annotating
+    ``remesh=deferred(P->P')`` and leaving the decision to re-run at
+    the next boundary.  With recovery disabled (``expand`` is None)
+    joins still register in the topology registry, so the NEXT query
+    anchors on the grown mesh — only the mid-plan migration is a
+    recovery-driver feature."""
+    from .. import topology
+    rule = faults.poll("mesh.device_joined")
+    if rule is not None:
+        topology.mark_joined(builder.ctx, rule.lost)
+    elif topology.pending_joins(builder.ctx):
+        topology.mark_joined(builder.ctx, 0)
+    new_ctx = topology.effective(builder.ctx)
+    if new_ctx is builder.ctx:
+        return
+    p_old = builder.ctx.get_world_size()
+    p_new = new_ctx.get_world_size()
+    if p_new <= p_old:
+        return      # a shrink routes through the ladder's topology rung
+    if expand is None or expand.get("left", 0) <= 0:
+        return
+    do_expand, note = _expansion_decision(pre_nodes, plan_key, p_old,
+                                          p_new, stages_left)
+    if do_expand:
+        raise _MeshExpansion(new_ctx, note)
+    trace.count("recover.scaleup_deferred")
+    plan_check.annotate_append("remesh",
+                               f"deferred({p_old}->{p_new}): {note}")
+
+
+def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node],
+                        plan_key=None):
     """The classified escalation ladder around ``_execute``
     (docs/robustness.md): transient → bounded stage retry resuming
     from the INTACT execution memo (completed results are immutable —
@@ -682,13 +796,17 @@ def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node]):
     from ..logging import warning as _warn
     from ..observe import flightrec
     if not recovery_enabled():
-        return _execute(builder, opt_root, pre_nodes)
+        return _execute(builder, opt_root, pre_nodes, plan_key=plan_key)
     ladder = resilience.Ladder()
     ckpt = _CheckpointStore(int(ladder.policy.checkpoint_fraction
                                 * resilience.exchange_budget()))
     prior: Set[Any] = set()
     inserted: Set[Any] = set()
     failed_strategies: Set[str] = set()
+    # the mid-plan scale-up budget (RecoveryPolicy.max_scaleups):
+    # consulted and decremented by the _MeshExpansion arm below, so a
+    # flapping device cannot re-raise expansions forever
+    expand = {"left": ladder.policy.max_scaleups}
     while True:
         try:
             with resilience.demoted_exchanges(
@@ -696,7 +814,8 @@ def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node]):
                     failed=tuple(sorted(failed_strategies))), \
                     resilience.collect_strategy_choices() as chosen:
                 out = _execute(builder, opt_root, pre_nodes, ckpt=ckpt,
-                               prior=prior, inserted=inserted)
+                               prior=prior, inserted=inserted,
+                               expand=expand, plan_key=plan_key)
             if ladder.attempts:
                 trace.count("recover.recovered")
                 resilience.note_recovery("recovered")
@@ -714,6 +833,54 @@ def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node]):
                 # an earlier attempt), and interpreter shutdown must
                 # never be booked as a recovery outcome
                 raise
+            if isinstance(e, _MeshExpansion):
+                # the scale-up arm (docs/robustness.md "Elasticity",
+                # scale-up half): an opportunity taken, not a rung —
+                # the ladder never sees it.  Same evacuation dance as
+                # the topology rung, pointed UP: drop every memo
+                # result (old-mesh arrays cannot feed new-mesh
+                # collectives), migrate the scan tables and retained
+                # checkpoints onto the grown mesh, re-anchor, resume
+                # from the re-meshed checkpoints.
+                expand["left"] -= 1
+                import time as _time
+                t0 = _time.perf_counter()
+                try:
+                    for esig in list(builder.exec_memo.keys()):
+                        builder.exec_memo.pop(esig, None)
+                    inserted.clear()
+                    evac = _remesh_scan_tables(pre_nodes, e.new_ctx)
+                    evac += ckpt.remesh(e.new_ctx)
+                    from ..parallel import broadcast as _bcast
+                    _bcast.clear_replica_cache()  # old-mesh replicas
+                except BaseException as re_err:  # graftlint: ok[broad-except]
+                    # the expansion evacuation failed mid-flight: the
+                    # plan may be mixed-mesh — the one state nothing
+                    # can resume — so fail annotated, exactly like a
+                    # failed loss-side evacuation
+                    trace.count("recover.failures")
+                    ladder.attempts.append(resilience.LadderAttempt(
+                        resilience.TOPOLOGY, "fail",
+                        f"scale-up evacuation failed: "
+                        f"{type(re_err).__name__}: {str(re_err)[:120]}"))
+                    re_err.ladder = ladder.as_dicts()
+                    flightrec.note("recover_failed",
+                                   attempts=ladder.as_dicts(),
+                                   error=f"scale-up evacuation failed: "
+                                         f"{re_err}")
+                    raise
+                new_world = e.new_ctx.get_world_size()
+                builder.ctx = e.new_ctx
+                trace.count("recover.remesh_us",
+                            int((_time.perf_counter() - t0) * 1e6))
+                _warn("recovery: mesh expansion — evacuated %d B and "
+                      "re-meshed onto %d devices mid-plan (%s), "
+                      "resuming from checkpoint", evac, new_world,
+                      e.note)
+                flightrec.note("recover", action="scaleup",
+                               new_world=new_world,
+                               evacuated_bytes=evac, note=e.note)
+                continue
             action = ladder.decide(e)
             if action == "fail":
                 if len(ladder.attempts) == 1 \
@@ -909,7 +1076,8 @@ def materialize(builder, root: Node):
     builder.stats["fires"] += entry.fires
     builder.stats["pre_exchange_row_bytes"] += entry.pre_bytes
     builder.stats["post_exchange_row_bytes"] += entry.post_bytes
-    out = _execute_recovering(builder, entry.root, pre_nodes)
+    out = _execute_recovering(builder, entry.root, pre_nodes,
+                              plan_key=key)
     builder.memo_put(root, out)
     return out
 
@@ -927,7 +1095,9 @@ def _bound_runtime(node: Node, pre_nodes: List[Node]) -> Dict[str, Any]:
 def _execute(builder, opt_root: Node, pre_nodes: List[Node],
              ckpt: Optional[_CheckpointStore] = None,
              prior: Optional[Set[Any]] = None,
-             inserted: Optional[Set[Any]] = None):
+             inserted: Optional[Set[Any]] = None,
+             expand: Optional[Dict[str, int]] = None,
+             plan_key=None):
     """Children-first walk of the optimized DAG; each node lowers through
     LOWERING under suspended capture, memoized per run by content
     signature so shared subplans (within and across materialization
@@ -989,6 +1159,12 @@ def _execute(builder, opt_root: Node, pre_nodes: List[Node],
                     inserted.add(esig)
                 continue
         stack.extend(n.inputs)
+    # stages this attempt will actually lower (memo/checkpoint-covered
+    # boundaries excluded): the scale-up consult below prices its
+    # amortization bound against how many are LEFT at each boundary
+    stages_left = sum(1 for n in order
+                      if id(n) in needed and ir.is_stage_boundary(n)
+                      and esigs[id(n)] not in builder.exec_memo)
     results: Dict[int, Any] = {}
     for node in order:
         if id(node) not in needed:
@@ -1007,6 +1183,12 @@ def _execute(builder, opt_root: Node, pre_nodes: List[Node],
             # chaos injects it, and the recovering driver's TOPOLOGY
             # rung answers by evacuating + re-meshing onto survivors
             faults.check("mesh.device_lost")
+            # ...and the inverse event: a repaired device REJOINING
+            # surfaces at the same dispatch — expand onto it now, or
+            # defer per the amortization bound (annotated, re-decided
+            # at the next boundary)
+            _maybe_expand(builder, pre_nodes, stages_left, expand,
+                          plan_key)
             if prior is not None and esig in prior:
                 trace.count("recover.stages_replayed")
         lower = LOWERING.get(node.op)
@@ -1025,6 +1207,7 @@ def _execute(builder, opt_root: Node, pre_nodes: List[Node],
         if inserted is not None:
             inserted.add(esig)
         if boundary:
+            stages_left -= 1
             if prior is not None:
                 prior.add(esig)
             if ckpt is not None:

@@ -391,6 +391,16 @@ class RecoveryPolicy:
                               shrinks the mesh onto the survivors —
                               bounded because every re-mesh halves-ish
                               the fleet a query may consume.
+    ``max_scaleups``          mid-plan mesh EXPANSIONS (device rejoin,
+                              the scale-up half of "Elasticity"); each
+                              one re-migrates the plan's live state
+                              onto the grown mesh — bounded separately
+                              from ``max_remeshes`` because an
+                              expansion is an opportunity taken, not a
+                              failure survived, and must never consume
+                              the loss budget a later real failure
+                              needs (a flapping device could otherwise
+                              starve the topology rung).
     ``checkpoint_fraction``   the share of ``exchange_budget()`` the
                               stage-checkpoint store may pin across
                               attempts — checkpointing is a COSTED
@@ -402,13 +412,15 @@ class RecoveryPolicy:
     max_stage_retries: int = 2
     max_replans: int = 2
     max_remeshes: int = 1
+    max_scaleups: int = 1
     checkpoint_fraction: float = 0.25
 
     def __post_init__(self):
         if self.max_stage_retries < 0 or self.max_replans < 0 \
-                or self.max_remeshes < 0:
+                or self.max_remeshes < 0 or self.max_scaleups < 0:
             raise CylonError(Status(Code.Invalid,
-                "RecoveryPolicy retry/replan/remesh caps must be >= 0"))
+                "RecoveryPolicy retry/replan/remesh/scaleup caps must "
+                "be >= 0"))
         if not 0.0 <= self.checkpoint_fraction <= 1.0:
             raise CylonError(Status(Code.Invalid,
                 f"checkpoint_fraction must be in [0, 1], got "

@@ -26,6 +26,11 @@ tables, executed through the PR-5 logical planner with
     :class:`Overloaded` instead of letting it time out, and graceful
     ``drain()`` finishes in-flight work, flushes the async export lane
     and the run-stats store, then returns the final stats snapshot.
+  * **fleet mode** (serve/router.py): N sessions over disjoint device
+    groups behind one :class:`FleetRouter` — placement by plan-cache
+    affinity (the shared run-stats store) then priced-bytes load,
+    failover past quarantined/degraded/draining replicas, per-replica
+    drain (docs/serving.md "Fleet mode").
 
 Quick start::
 
@@ -40,10 +45,13 @@ Quick start::
 """
 from __future__ import annotations
 
-from .admission import admit, price_query, price_table
-from .session import (CircuitBreaker, Overloaded, QueryHandle,
-                      QueryQueue, Quarantined, ServeSession, percentile)
+from .admission import admit, price_query, price_table, scaled_budget
+from .router import FleetRouter
+from .session import (CapacityRequest, CircuitBreaker, Overloaded,
+                      QueryHandle, QueryQueue, Quarantined, ServeSession,
+                      percentile)
 
 __all__ = ["ServeSession", "QueryHandle", "QueryQueue", "percentile",
-           "price_query", "price_table", "admit", "CircuitBreaker",
-           "Overloaded", "Quarantined"]
+           "price_query", "price_table", "admit", "scaled_budget",
+           "CircuitBreaker", "Overloaded", "Quarantined",
+           "CapacityRequest", "FleetRouter"]

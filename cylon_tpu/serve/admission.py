@@ -48,7 +48,21 @@ import numpy as np
 # contract explicit if one is ever added).
 GUARDED_STATE: Dict[str, str] = {}
 
-__all__ = ["price_table", "price_query", "admit"]
+__all__ = ["price_table", "price_query", "admit", "scaled_budget"]
+
+
+def scaled_budget(base: int, world: int, base_world: int) -> int:
+    """Re-price the window admission budget to the CURRENT mesh size
+    (docs/robustness.md "Elasticity").  ``P'`` survivors of a ``P``
+    -device session hold ``P'/P`` of the fleet's aggregate transient
+    headroom, so a degraded window may co-admit proportionally less;
+    a scale-up is the EXACT INVERSE — as the mesh re-expands the
+    budget re-prices back up along the same line, and a full restore
+    (``world >= base_world``) returns ``base`` verbatim, so degraded
+    mode's admission squeeze relaxes the moment the world grows."""
+    if base_world <= 0 or world >= base_world:
+        return base
+    return max(int(base * world / base_world), 1)
 
 
 def price_table(dt) -> int:

@@ -38,6 +38,7 @@ import heapq
 import threading
 import time
 from collections import deque
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import faults, resilience, topology, trace
@@ -51,17 +52,18 @@ from . import admission
 # QueryQueue._items under the condition's OrderedLock; the breaker's
 # entry table and the session's tallies/latency history under their
 # respective _lock.  NOT catalogued on purpose: ServeSession's
-# _pending_count (dispatcher-thread-only, readers tolerate one-window
-# staleness — see its comment) and _SharedExecMemo (batch-scoped,
-# dispatcher-thread-only).
+# _pending_count / _pending_bytes / _last_world (dispatcher-thread-only,
+# readers tolerate one-window staleness — see their comments) and
+# _SharedExecMemo (batch-scoped, dispatcher-thread-only).
 GUARDED_STATE = {"_items": "_cv", "_entries": "_lock",
                  "_stats": "_lock", "_lat_hist": "_lock",
                  "_tail_heap": "_lock", "_tail_seen": "_lock",
                  "_ewma_ms": "_lock", "_ids": "_lock",
-                 "_drained": "_lock"}
+                 "_drained": "_lock", "_capacity_requests": "_lock"}
 
 __all__ = ["QueryHandle", "QueryQueue", "ServeSession", "percentile",
-           "Overloaded", "Quarantined", "CircuitBreaker"]
+           "Overloaded", "Quarantined", "CircuitBreaker",
+           "CapacityRequest"]
 
 _UNSET = object()
 
@@ -86,6 +88,25 @@ class Quarantined(CylonError):
 
     def __init__(self, msg: str):
         super().__init__(Status(Code.CapacityError, msg))
+
+
+@dataclass
+class CapacityRequest:
+    """One typed scale-up request (docs/robustness.md "Elasticity",
+    the capacity-request lifecycle): a sustained SLO-pressure alert —
+    the time-series sampler's ``p99-drift`` or ``qps-collapse`` rule
+    firing against this session — becomes a durable, inspectable
+    record that the session WANTS more devices, instead of a log line
+    an operator has to grep for.  Requests open here; the topology
+    grow branch (``_check_topology``) marks every open request
+    ``fulfilled`` when the mesh actually expands, closing the loop:
+    alert → request → ``mesh.device_joined`` → re-priced admission.
+    The session keeps a bounded ring (newest 64)."""
+
+    rule: str        # the alert rule that fired ("p99-drift", ...)
+    detail: str      # the alert's human-readable evidence line
+    t: float         # time.time() at request creation
+    status: str = "open"   # "open" -> "fulfilled"
 
 
 def percentile(sorted_xs: List[float], q: float) -> Optional[float]:
@@ -220,6 +241,12 @@ class QueryQueue:
     def wait_nonempty(self, timeout: Optional[float] = None) -> bool:
         with self._cv:
             return self._cv.wait_for(lambda: len(self._items) > 0, timeout)
+
+    def priced_bytes(self) -> int:
+        """Sum of the queued handles' admission prices — the fleet
+        router's queued-load component (serve/router.py)."""
+        with self._cv:
+            return sum(h.priced_bytes or 0 for h in self._items)
 
     def kick(self) -> None:
         """Wake any waiter (session close)."""
@@ -574,6 +601,10 @@ class ServeSession:
         # never engages overload protection).  Plain int, written by
         # the dispatcher only; readers tolerate one-window staleness.
         self._pending_count = 0
+        # ... and its priced-bytes twin: the deferred backlog's
+        # admission price, read (with the same staleness tolerance) by
+        # load_bytes() for the fleet router's placement score
+        self._pending_bytes = 0
         self._queue = QueryQueue(max_queue)
         self._pipeline = None
         if export_workers > 0:
@@ -587,6 +618,7 @@ class ServeSession:
             "subplan_shared": 0, "exports_async": 0,
             "slo_violations": 0, "shed": 0, "breaker_rejected": 0,
             "breaker_probes": 0, "recovered": 0, "mesh_degraded": 0,
+            "mesh_expanded": 0, "capacity_requests": 0,
         }
         # elastic degraded-mesh state (docs/robustness.md
         # "Elasticity"): the session polls the topology epoch each
@@ -596,6 +628,15 @@ class ServeSession:
         # query's builder anchors on the survivor mesh
         self._base_world = max(ctx.get_world_size(), 1)
         self._topology_epoch = topology.epoch()
+        # the last world size _check_topology observed (dispatcher-
+        # thread-only): the grow-vs-shrink discriminator — a rejoin
+        # that still leaves the mesh short of base must count as a
+        # scale-UP (mesh_expanded, budget relaxes), never as another
+        # degrade event
+        self._last_world = self._base_world
+        # open/fulfilled scale-up requests (bounded ring, newest 64):
+        # the SLO loop's paper trail — see CapacityRequest
+        self._capacity_requests: deque = deque(maxlen=64)
         # completed-query latency distribution: a fixed-memory
         # mergeable histogram (observe/histogram.py), NOT a raw sample
         # list — stats() percentiles stay O(1)-memory at any QPS
@@ -808,6 +849,43 @@ class ServeSession:
         stats["queue_depth"] = len(self._queue)
         return stats, window, cum
 
+    def request_capacity(self, rule: str, detail: str = "") -> CapacityRequest:
+        """Open a typed :class:`CapacityRequest` against this session —
+        the SLO loop's demand half (docs/robustness.md "Elasticity").
+        Called by the time-series sampler when a sustained ``p99-drift``
+        or ``qps-collapse`` alert fires; callable directly by operators
+        too.  Books ``serve.capacity_requests``, tallies on the
+        session, and records a ``capacity_request`` flight-recorder
+        event the doctor renders on the scale-up timeline.  The request
+        stays ``open`` until a mesh expansion marks it ``fulfilled``
+        (``_check_topology``'s grow branch)."""
+        from ..observe import flightrec
+        req = CapacityRequest(rule=rule, detail=detail, t=time.time())
+        with self._lock:
+            self._capacity_requests.append(req)
+        trace.count("serve.capacity_requests")
+        self._tally("capacity_requests")
+        flightrec.note("capacity_request", session=self.name, rule=rule,
+                       detail=detail)
+        return req
+
+    def capacity_requests(self) -> List[CapacityRequest]:
+        """Snapshot of the bounded capacity-request ring, oldest
+        first (the live objects — ``status`` flips in place when a
+        scale-up fulfils them)."""
+        with self._lock:
+            return list(self._capacity_requests)
+
+    def load_bytes(self) -> int:
+        """This session's waiting load in PRICED bytes: everything
+        queued plus the dispatcher's budget-deferred backlog, valued by
+        the same admission cost model that gates windows.  The fleet
+        router's placement score (serve/router.py) — comparable across
+        replicas because every session prices with the one shared
+        model.  Host bookkeeping only; one-window staleness on the
+        deferred half is tolerated by design."""
+        return self._queue.priced_bytes() + self._pending_bytes
+
     def close(self) -> None:
         """Stop accepting queries, drain everything queued, stop the
         dispatcher and export lane.  Idempotent."""
@@ -874,29 +952,37 @@ class ServeSession:
                 else resilience.exchange_budget())
         # degraded mesh: P' survivors hold P'/P of the fleet's
         # aggregate transient headroom, so a window may co-admit
-        # proportionally less — the re-priced admission budget of
-        # docs/robustness.md "Elasticity" (per-QUERY prices already
-        # re-derive from the re-meshed tables' counts)
+        # proportionally less; a scale-up relaxes the squeeze along
+        # the same line — admission.scaled_budget is the one re-pricing
+        # rule for both directions (docs/robustness.md "Elasticity";
+        # per-QUERY prices already re-derive from the re-meshed
+        # tables' counts)
         eff = topology.effective(self.ctx)
-        world = eff.get_world_size()
-        if world < self._base_world:
-            base = max(int(base * world / self._base_world), 1)
-        return base
+        return admission.scaled_budget(base, eff.get_world_size(),
+                                       self._base_world)
 
     def _check_topology(self) -> None:
         """One epoch poll (an int compare in the common case): on a new
         degrade, record the event once — the gauge, the session tally,
         and the flight-recorder ``mesh_degraded`` event the doctor
+        renders; on a GROW (``mesh.device_joined`` applied), run the
+        exact inverse — re-price the admission budget to the expanded
+        world (``_budget`` re-reads the effective world every window,
+        so relaxation is automatic once the gauge/tallies record the
+        transition), mark open capacity requests fulfilled, and emit
+        the ``mesh_expanded`` event the doctor's scale-up timeline
         renders.  In-flight work needs no action here: the victim's
         ladder already re-meshed the shared tables in place, and every
-        later query's builder resolves the survivor context."""
+        later query's builder resolves the effective context."""
         ep = topology.epoch()
         if ep == self._topology_epoch:
             return
         self._topology_epoch = ep
         eff = topology.effective(self.ctx)
         world = eff.get_world_size()
-        if world < self._base_world:
+        prev = self._last_world
+        self._last_world = world
+        if world < prev:
             from ..observe import flightrec
             lost = self._base_world - world
             trace.gauge("serve.degraded", lost)
@@ -911,22 +997,56 @@ class ServeSession:
             # Migrate them now, on the dispatcher thread (queries
             # execute here too, so nothing races the in-place move);
             # a failed migration degrades to the per-query lazy path
-            try:
-                from ..parallel.remesh import ensure_current
-                ensure_current(self._tables)
-            except Exception as mig_err:  # graftlint: ok[broad-except]
-                # — the lazy ensure_current in _execute_one retries
-                # per query; a migration failure must not kill the
-                # dispatcher
-                from ..logging import warning as _warn
-                _warn("degraded-mode table migration failed (per-query"
-                      " migration will retry): %s: %s",
-                      type(mig_err).__name__, str(mig_err)[:160])
+            self._migrate_tables("degraded-mode")
+        elif world > prev and prev < self._base_world:
+            from ..observe import flightrec
+            # still-missing devices after the grow: 0 on a full
+            # restore (gauge cleared — the degraded signal's inverse),
+            # positive on a partial rejoin (still degraded, less so)
+            lost = max(self._base_world - world, 0)
+            trace.gauge("serve.degraded", lost)
+            self._tally("mesh_expanded")
+            with self._lock:
+                if lost:
+                    self._stats["degraded_world"] = world
+                else:
+                    self._stats.pop("degraded_world", None)
+                for req in self._capacity_requests:
+                    if req.status == "open":
+                        req.status = "fulfilled"
+            flightrec.note("mesh_expanded", session=self.name,
+                           world=world, joined=world - prev,
+                           still_lost=lost)
+            # the inverse of the degrade migration: session tables the
+            # scale-up's plan never scanned are still pinned to the
+            # shrunken mesh — re-expand them now so the next window's
+            # collectives span the full world
+            self._migrate_tables("scale-up")
+
+    def _migrate_tables(self, why: str) -> None:
+        try:
+            from ..parallel.remesh import ensure_current
+            ensure_current(self._tables)
+        except Exception as mig_err:  # graftlint: ok[broad-except]
+            # — the lazy ensure_current in _execute_one retries
+            # per query; a migration failure must not kill the
+            # dispatcher
+            from ..logging import warning as _warn
+            _warn("%s table migration failed (per-query"
+                  " migration will retry): %s: %s", why,
+                  type(mig_err).__name__, str(mig_err)[:160])
 
     def _loop(self) -> None:
         pending: List[QueryHandle] = []
         while True:
             got = self._queue.wait_nonempty(timeout=0.05)
+            if topology.pending_joins(self.ctx):
+                # flush hysteresis-held rejoins (flap damping,
+                # CYLON_REMESH_COOLDOWN_MS): mark_joined(..., 0)
+                # applies the pending joins iff the cooldown window
+                # has elapsed, else it stays a cheap no-op — the
+                # dispatcher turn is the session's stage boundary
+                topology.mark_joined(self.ctx, 0)
             self._check_topology()
             if not got and not pending:
                 if self._closing.is_set() and len(self._queue) == 0:
@@ -942,6 +1062,7 @@ class ServeSession:
                 continue
             pending = []
             self._pending_count = 0
+            self._pending_bytes = 0
             try:
                 admitted, deferred = admission.admit(batch,
                                                      self._budget())
@@ -955,6 +1076,8 @@ class ServeSession:
                 continue
             pending = deferred
             self._pending_count = len(pending)
+            self._pending_bytes = sum(h.priced_bytes or 0
+                                      for h in pending)
             for h in pending:
                 h.status = "deferred"
                 h.deferrals += 1

@@ -22,23 +22,45 @@ and stage checkpoints rather than the dead chip.
 
 The registry chains: a second loss shrinks the CURRENT survivor mesh,
 and ``effective`` follows the chain from any context it has ever seen.
+The inverse event — a repaired device RETURNING — goes through
+:func:`mark_joined`, which grows the live mesh back along the same
+roster.  Every transition, down or up, is a prefix of the **roster**:
+the device order of the original full mesh, recorded at the first loss
+and append-only thereafter.  That makes device identity stable across
+any lose/rejoin interleaving (lose 2 → rejoin 1 → lose 1 always yields
+prefixes of one fixed order — a rejoin can never reorder the registry).
+Rejoins are flap-damped: within ``CYLON_REMESH_COOLDOWN_MS`` of the
+last transition a join is held *pending* and applied by the next
+:func:`mark_joined` call outside the window (the executor's stage
+boundaries and the serve dispatcher both poll with ``joined=0``).
 ``reset()`` restores the full mesh (test isolation; operationally, the
 repaired-fleet restart).
 """
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from . import trace
 
-__all__ = ["effective", "mark_lost", "epoch", "degraded", "reset",
-           "axis_split"]
+__all__ = ["effective", "mark_lost", "mark_joined", "pending_joins",
+           "epoch", "degraded", "reset", "axis_split"]
 
 # id(ctx) -> (ctx, survivor_ctx): the value pins BOTH contexts so an
 # id() key can never be reused by the garbage collector while mapped.
 _lock = threading.Lock()
 _survivors: Dict[int, Tuple[object, object]] = {}
+# id(ctx) -> (ctx, roster): the append-only device order of the ORIGINAL
+# full mesh, recorded at the first loss; every later transition is a
+# prefix of it.  The value pins the context for the same GC reason.
+_rosters: Dict[int, Tuple[object, Tuple]] = {}
+# roster -> the family's ORIGINAL full-mesh context (the full-restore
+# collapse target), joins held back by the flap-damping window, and the
+# monotonic time of the family's last applied transition
+_origins: Dict[Tuple, object] = {}
+_pending: Dict[Tuple, int] = {}
+_last_change: Dict[Tuple, float] = {}
 _epoch = 0
 
 
@@ -61,23 +83,52 @@ def degraded(ctx) -> bool:
 
 
 def epoch() -> int:
-    """Monotone counter bumped by every :func:`mark_lost` — pollers
-    (the serve dispatcher) compare it instead of chasing contexts."""
+    """Monotone counter bumped by every :func:`mark_lost` /
+    :func:`mark_joined` — pollers (the serve dispatcher) compare it
+    instead of chasing contexts."""
     return _epoch
+
+
+def pending_joins(ctx) -> int:
+    """Rejoined devices held back by the flap-damping window for
+    ``ctx``'s mesh family (0 while none).  Lock-free read — the serve
+    dispatcher polls this every turn to decide whether a ``joined=0``
+    flush is worth taking the lock for."""
+    hit = _rosters.get(id(ctx)) or _rosters.get(id(effective(ctx)))
+    if hit is None:
+        return 0
+    return _pending.get(hit[1], 0)
+
+
+def _roster_locked(ctx, cur) -> Tuple:
+    """The append-only device roster for ``cur``'s mesh family,
+    recording ``cur.devices`` as the family's fixed order on first
+    sight.  Caller holds ``_lock``."""
+    hit = _rosters.get(id(cur)) or _rosters.get(id(ctx))
+    if hit is not None:
+        return hit[1]
+    roster = tuple(cur.devices)
+    _rosters[id(ctx)] = (ctx, roster)
+    _rosters[id(cur)] = (cur, roster)
+    _origins.setdefault(roster, cur)
+    return roster
 
 
 def mark_lost(ctx, lost: int = 1):
     """Record the loss of ``lost`` devices from ``ctx``'s (effective)
     mesh and return the survivor context.
 
-    The survivors are the first ``P − lost`` devices of the current
-    effective mesh (deterministic — chaos runs replay).  ``lost`` is
-    clamped so at least one device survives; a single-device mesh has
-    no survivors to shrink onto and is returned UNCHANGED (the caller's
-    topology rung degrades to a stage retry there).  Registers the
-    mapping for every context that resolves through ``ctx``, bumps the
-    epoch, and records the event (``recover.survivor_world`` gauge +
-    a ``mesh_degraded`` flight-recorder event)."""
+    The survivors are the first ``P − lost`` devices of the mesh
+    family's append-only ROSTER (deterministic — chaos runs replay, and
+    a later rejoin can never reorder identity: every epoch's mesh is a
+    prefix of the same fixed order).  ``lost`` is clamped so at least
+    one device survives; a single-device mesh has no survivors to
+    shrink onto and is returned UNCHANGED (the caller's topology rung
+    degrades to a stage retry there).  Registers the mapping for every
+    context that resolves through ``ctx``, bumps the epoch, starts the
+    flap-damping window, and records the event
+    (``recover.survivor_world`` gauge + a ``mesh_degraded``
+    flight-recorder event)."""
     from .context import CylonContext
     from .logging import warning as _warn
     from .observe import flightrec
@@ -88,18 +139,108 @@ def mark_lost(ctx, lost: int = 1):
         lost_eff = min(max(int(lost), 1), world - 1)
         if world <= 1 or lost_eff < 1:
             return cur
-        survivors = cur.devices[:world - lost_eff]
-        new_ctx = CylonContext({"backend": "dist", "devices": survivors})
+        roster = _roster_locked(ctx, cur)
+        live = world - lost_eff
+        new_ctx = CylonContext({"backend": "dist",
+                                "devices": list(roster[:live])})
         _survivors[id(ctx)] = (ctx, new_ctx)
         _survivors[id(cur)] = (cur, new_ctx)
         _survivors[id(new_ctx)] = (new_ctx, new_ctx)
+        _rosters[id(new_ctx)] = (new_ctx, roster)
+        # a loss consumes any pending rejoin of the same family — the
+        # flapper died again before its join was applied
+        _pending.pop(roster, None)
+        _last_change[roster] = time.monotonic()
         _epoch += 1
-    trace.gauge("recover.survivor_world", len(survivors))
+    trace.gauge("recover.survivor_world", live)
     _warn("mesh degraded: %d device(s) lost, re-meshing %d -> %d "
-          "survivors (epoch %d)", lost_eff, world, len(survivors),
-          _epoch)
+          "survivors (epoch %d)", lost_eff, world, live, _epoch)
     flightrec.note("mesh_degraded", lost=lost_eff, world=world,
-                   survivor_world=len(survivors), epoch=_epoch)
+                   survivor_world=live, epoch=_epoch)
+    return new_ctx
+
+
+def mark_joined(ctx, joined: int = 1):
+    """Record the RETURN of ``joined`` devices to ``ctx``'s mesh family
+    and return the grown context — the exact inverse of
+    :func:`mark_lost` (docs/robustness.md "Elasticity", scale-up half).
+
+    Rejoined devices are re-attached in roster order (the next devices
+    after the current live prefix), clamped so the mesh never grows past
+    the family's full roster; a family that was never degraded has
+    nothing to rejoin and the effective context is returned unchanged.
+    ``joined=0`` is the hysteresis flush: apply any joins a previous
+    call held back, without registering new ones — the executor's stage
+    boundaries and the serve dispatcher poll with it.
+
+    Flap damping: when ``config.remesh_cooldown_ms()`` > 0 and the last
+    topology transition of this family is within the window, the join is
+    accumulated as *pending* (``recover.join_damped``) and the current
+    context is returned — a flapping device pays one damped interval
+    before the fleet re-expands, instead of thrashing two evacuations.
+
+    On apply: if the grown mesh is the family's FULL roster and ``ctx``
+    itself is that mesh, the registry collapses back onto the ORIGINAL
+    context — ``degraded(ctx)`` turns False and plans compiled before
+    the loss hit their caches again.  Bumps the epoch, books
+    ``recover.scaleups``, and notes a ``mesh_expanded`` flight-recorder
+    event (doctor's scale-up timeline)."""
+    from .context import CylonContext
+    from .logging import warning as _warn
+    from .observe import flightrec
+    from . import config
+    global _epoch
+    joined_eff = max(int(joined), 0)
+    with _lock:
+        cur = effective(ctx)
+        hit = _rosters.get(id(cur)) or _rosters.get(id(ctx))
+        if hit is None:
+            return cur          # never degraded: nothing to rejoin
+        roster = hit[1]
+        world = cur.get_world_size()
+        pend = min(_pending.get(roster, 0) + joined_eff,
+                   len(roster) - world)
+        if pend <= 0:
+            _pending.pop(roster, None)
+            return cur
+        cooldown = config.remesh_cooldown_ms()
+        now = time.monotonic()
+        if cooldown > 0 and \
+                (now - _last_change.get(roster, 0.0)) * 1e3 < cooldown:
+            _pending[roster] = pend
+            damped_new = joined_eff > 0
+            applied = False
+        else:
+            live = world + pend
+            anchor = _origins.get(roster)
+            if live == len(roster) and anchor is not None \
+                    and tuple(getattr(anchor, "devices", ())) == roster:
+                new_ctx = anchor    # full restore: collapse the chain
+            else:
+                new_ctx = CylonContext({"backend": "dist",
+                                        "devices": list(roster[:live])})
+            _survivors[id(ctx)] = (ctx, new_ctx)
+            _survivors[id(cur)] = (cur, new_ctx)
+            _survivors[id(new_ctx)] = (new_ctx, new_ctx)
+            _rosters[id(new_ctx)] = (new_ctx, roster)
+            _pending.pop(roster, None)
+            _last_change[roster] = now
+            _epoch += 1
+            applied = True
+    if not applied:
+        if damped_new:
+            trace.count("recover.join_damped")
+            _warn("mesh join damped: %d device(s) pending rejoin "
+                  "(flap window %d ms)", pend, cooldown)
+            flightrec.note("mesh_join_damped", pending=pend,
+                           cooldown_ms=cooldown, world=world)
+        return cur
+    trace.gauge("recover.survivor_world", live)
+    trace.count("recover.scaleups")
+    _warn("mesh expanded: %d device(s) rejoined, re-meshing %d -> %d "
+          "(epoch %d)", pend, world, live, _epoch)
+    flightrec.note("mesh_expanded", joined=pend, world=world,
+                   new_world=live, epoch=_epoch)
     return new_ctx
 
 
@@ -146,4 +287,8 @@ def reset() -> None:
     global _epoch
     with _lock:
         _survivors.clear()
+        _rosters.clear()
+        _origins.clear()
+        _pending.clear()
+        _last_change.clear()
         _epoch += 1

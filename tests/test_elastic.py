@@ -28,7 +28,7 @@ from cylon_tpu.parallel import remesh as remesh_mod
 from cylon_tpu.parallel import shuffle as shmod
 from cylon_tpu.plan import executor
 from cylon_tpu.resilience import Ladder, RecoveryPolicy, RetryPolicy
-from cylon_tpu.serve import ServeSession
+from cylon_tpu.serve import FleetRouter, ServeSession, scaled_budget
 
 
 @pytest.fixture(autouse=True)
@@ -49,6 +49,7 @@ def _clean_state():
     config.set_exchange_timeout_ms(None)
     config.set_device_memory_budget(None)
     config.set_recovery_enabled(None)
+    config.set_remesh_cooldown_ms(None)
     if session_plan is not None:
         faults.install(session_plan)
     else:
@@ -117,13 +118,26 @@ def test_check_raises_topology_with_lost():
 
 def test_default_chaos_plan_has_capped_topology_rule():
     # the chaos gate's contract: FaultPlan.default exercises the
-    # topology rung, but capped — one device loss per run models "a
-    # chip died", not "the fleet is melting"
-    rules = [r for r in faults.FaultPlan.default(0).rules
-             if r.point == "mesh.device_lost"]
-    assert len(rules) == 1
-    assert rules[0].kind == "topology"
-    assert rules[0].limit == 1
+    # topology rung, but capped — one UNCONDITIONAL device loss per run
+    # models "a chip died", not "the fleet is melting".  The flap
+    # pattern (lose -> rejoin -> lose again, each leg gated on the
+    # previous by after/window) rides on top, every leg capped too.
+    rules = faults.FaultPlan.default(0).rules
+    losses = [r for r in rules if r.point == "mesh.device_lost"]
+    base = [r for r in losses if r.after is None]
+    assert len(base) == 1
+    assert base[0].kind == "topology"
+    assert base[0].limit == 1
+    # the flap's second loss only ever fires shortly after a rejoin
+    flap_back = [r for r in losses if r.after is not None]
+    assert len(flap_back) == 1
+    assert flap_back[0].after == "mesh.device_joined"
+    assert flap_back[0].limit == 1
+    assert flap_back[0].window is not None
+    joins = [r for r in rules if r.point == "mesh.device_joined"]
+    assert len(joins) == 1
+    assert joins[0].after == "mesh.device_lost"
+    assert joins[0].limit == 1
 
 
 def test_classify_topology():
@@ -587,3 +601,487 @@ def test_serve_deadline_estimate_sees_retry_cap(dctx):
         h.result(timeout=60)
     finally:
         s.close()
+
+
+# ---------------------------------------------------------------------------
+# scale-UP: device rejoin, hysteresis, deferral, served fleet
+# ---------------------------------------------------------------------------
+
+def _wait_until(pred, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _sorted_out(out):
+    return (out.to_table().to_pandas()
+            .sort_values("lt-k").reset_index(drop=True))
+
+
+def test_remesh_cooldown_knob_validation():
+    assert config.remesh_cooldown_ms() == 0  # disabled by default
+    prev = config.set_remesh_cooldown_ms(250)
+    try:
+        assert config.remesh_cooldown_ms() == 250
+    finally:
+        config.set_remesh_cooldown_ms(prev)
+    for bad in (-1, 1.5, True, "100"):
+        with pytest.raises(CylonError):
+            config.set_remesh_cooldown_ms(bad)
+
+
+def test_amortized_remesh_win_math():
+    # 4 -> 8 halves the per-stage exchange bytes: win = bytes x stages / 2
+    assert cost.amortized_remesh_win(1000, 4, 4, 8) == pytest.approx(2000.0)
+    assert cost.amortized_remesh_win(1000, 0, 4, 8) == 0.0
+    assert cost.amortized_remesh_win(-5.0, 3, 4, 8) == 0.0
+    # no growth -> no win
+    assert cost.amortized_remesh_win(1000, 3, 8, 8) == 0.0
+
+
+def test_scaled_budget_math():
+    assert scaled_budget(8_000_000, 8, 8) == 8_000_000
+    assert scaled_budget(8_000_000, 4, 8) == 4_000_000
+    assert scaled_budget(8_000_000, 6, 8) == 6_000_000
+    assert scaled_budget(8_000_000, 12, 8) == 8_000_000  # never over base
+    assert scaled_budget(100, 0, 8) == 1
+
+
+def test_fault_rule_after_window_gating():
+    with pytest.raises(CylonError):
+        faults.FaultRule("exec.stage", window=3)  # window requires after
+    with pytest.raises(CylonError):
+        faults.FaultRule("exec.stage", after="exec.stage", window=0)
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology", nth=1,
+                         lost=1, limit=1),
+        faults.FaultRule("mesh.device_joined", kind="topology",
+                         probability=1.0, limit=1, lost=1,
+                         after="mesh.device_lost", window=10)])
+    with faults.active(plan):
+        # gated: device_lost has not fired yet
+        assert faults.poll("mesh.device_joined") is None
+        with pytest.raises(faults.TopologyFault):
+            faults.check("mesh.device_lost")
+        rule = faults.poll("mesh.device_joined")  # within the window
+        assert rule is not None and rule.lost == 1
+        assert faults.poll("mesh.device_joined") is None  # limit spent
+    # the window bound: consultations past it keep the rule cold
+    plan2 = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology", nth=1,
+                         lost=1, limit=1),
+        faults.FaultRule("mesh.device_joined", kind="topology",
+                         probability=1.0, limit=1, lost=1,
+                         after="mesh.device_lost", window=2)])
+    with faults.active(plan2):
+        with pytest.raises(faults.TopologyFault):
+            faults.check("mesh.device_lost")
+        for _ in range(3):   # burn the window on unrelated consults
+            faults.check("exec.stage")
+        assert faults.poll("mesh.device_joined") is None
+
+
+def test_poll_without_plan_is_none():
+    assert faults.poll("mesh.device_joined") is None
+    assert "mesh.device_joined" in faults.POINTS
+
+
+# -- topology: append-only rosters, rejoin, hysteresis ----------------------
+
+def test_topology_rejoin_restores_original(dctx):
+    c4 = topology.mark_lost(dctx, 4)
+    assert c4.get_world_size() == 4
+    restored = topology.mark_joined(dctx, 4)
+    # full restore collapses onto the ORIGINAL context object, so plan
+    # caches keyed on it hit again and degraded() turns False
+    assert restored is dctx
+    assert topology.effective(dctx) is dctx
+    assert topology.effective(c4) is dctx
+    assert not topology.degraded(dctx)
+    assert trace.counters().get("recover.scaleups", 0) == 1
+
+
+def test_topology_epoch_append_only_identity(dctx):
+    """Satellite regression: epoch transitions are prefixes of ONE
+    append-only roster — lose 2, rejoin 1, lose 1 must walk the same
+    device list every time, never invent a different survivor set."""
+    roster = list(dctx.devices)
+    c6 = topology.mark_lost(dctx, 2)
+    assert c6.devices == roster[:6]
+    c7 = topology.mark_joined(dctx, 1)
+    assert c7.devices == roster[:7]       # rejoin EXTENDS the prefix
+    c6b = topology.mark_lost(dctx, 1)
+    assert c6b.devices == roster[:6]      # identity stable across epochs
+    assert topology.effective(dctx) is c6b
+    assert topology.effective(c6) is c6b
+    assert topology.effective(c7) is c6b
+    restored = topology.mark_joined(dctx, 2)
+    assert restored is dctx
+    assert restored.devices == roster
+
+
+def test_topology_join_on_healthy_mesh_noop(dctx):
+    ep0 = topology.epoch()
+    assert topology.mark_joined(dctx, 1) is dctx
+    assert topology.epoch() == ep0
+    assert topology.pending_joins(dctx) == 0
+
+
+def test_topology_join_hysteresis_damps_flap(dctx):
+    prev = config.set_remesh_cooldown_ms(600_000)
+    try:
+        c6 = topology.mark_lost(dctx, 2)
+        held = topology.mark_joined(dctx, 2)
+        assert held is c6                     # damped: inside the window
+        assert topology.pending_joins(dctx) == 2
+        assert topology.effective(dctx) is c6
+        assert trace.counters().get("recover.join_damped", 0) == 1
+        # a flush attempt inside the window stays held, and does NOT
+        # re-count the damping (nothing new arrived)
+        assert topology.mark_joined(dctx, 0) is c6
+        assert trace.counters().get("recover.join_damped", 0) == 1
+    finally:
+        config.set_remesh_cooldown_ms(prev)
+    # cooldown disabled: the next flush applies the held rejoins
+    restored = topology.mark_joined(dctx, 0)
+    assert restored is dctx
+    assert topology.pending_joins(dctx) == 0
+    assert trace.counters().get("recover.scaleups", 0) == 1
+
+
+# -- the executor's scale-up arm, end to end --------------------------------
+
+def test_scaleup_mid_plan_row_parity(dctx):
+    """Acceptance shape: a plan running degraded on 4 of 8 devices,
+    upon ``mesh.device_joined``, re-expands mid-plan and completes
+    row-identical to the healthy 8-device run (recover.scaleups == 1),
+    and the follow-up query runs on the full mesh."""
+    op, mk, expect = _two_stage(dctx)
+    tables = mk()
+    topology.mark_lost(dctx, 4)
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_joined", kind="topology", nth=2,
+                         lost=4)])
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with faults.active(plan):
+            got = _sorted_out(planner.run(dctx, op, tables))
+        again = _sorted_out(planner.run(dctx, op, tables))
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    pd.testing.assert_frame_equal(got, expect)
+    pd.testing.assert_frame_equal(again, expect)
+    c = trace.counters()
+    assert c.get("recover.scaleups", 0) == 1
+    assert c.get("recover.scaleup_deferred", 0) == 0
+    assert c.get("recover.evacuated_bytes", 0) > 0
+    assert topology.effective(dctx) is dctx
+    assert not topology.degraded(dctx)
+    # the scan tables re-expanded onto the grown mesh mid-plan
+    assert tables["fact"].ctx is dctx
+    assert tables["dim"].ctx is dctx
+
+
+def test_scaleup_deferred_honors_amortization(dctx):
+    """With observed per-fingerprint bytes on record and a tiny
+    amortized win, the executor must DEFER the expansion (counted +
+    annotated) and finish the plan on the shrunken mesh; the next plan
+    picks up the full world."""
+    from cylon_tpu import observe
+    op, mk, expect = _two_stage(dctx, seed=17)
+    tables = mk()
+    observe.STATS_STORE.clear()
+    from cylon_tpu.observe import stats as obstats
+    topology.mark_lost(dctx, 4)
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with obstats.collect_digests() as ds:
+            planner.run(dctx, op, tables)   # degraded run learns digests
+        digests = list(ds)
+        assert digests
+        # seed tiny observed exchange bytes: win << migration cost
+        for d in digests:
+            observe.STATS_STORE.record_run(
+                d, counters={"shuffle.bytes_sent": 64})
+        plan = faults.FaultPlan(seed=0, rules=[
+            faults.FaultRule("mesh.device_joined", kind="topology",
+                             nth=2, lost=4)])
+        with faults.active(plan):
+            got = _sorted_out(planner.run(dctx, op, tables))
+        pd.testing.assert_frame_equal(got, expect)
+        c = trace.counters()
+        assert c.get("recover.scaleup_deferred", 0) >= 1
+        # the topology event APPLIED (world grew) — only the in-flight
+        # plan's migration was deferred
+        assert c.get("recover.scaleups", 0) == 1
+        assert topology.effective(dctx) is dctx
+        assert tables["fact"].ctx.get_world_size() == 4
+        # the next plan starts on the full mesh via lazy migration
+        again = _sorted_out(planner.run(dctx, op, tables))
+        pd.testing.assert_frame_equal(again, expect)
+        assert tables["fact"].ctx is dctx
+    finally:
+        config.set_broadcast_join_threshold(prev)
+        observe.STATS_STORE.clear()
+
+
+def test_scaleup_flap_damping_bounds_thrash(dctx):
+    """The chaos flap pattern (lose -> immediate rejoin) under an
+    active hysteresis window: the rejoin is HELD pending, the plan
+    completes on the survivor mesh with exactly one re-mesh — no
+    migrate-back-and-forth thrash."""
+    op, mk, expect = _two_stage(dctx, seed=23)
+    tables = mk()
+    prev_cd = config.set_remesh_cooldown_ms(600_000)
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("mesh.device_lost", kind="topology", nth=2,
+                         lost=2),
+        faults.FaultRule("mesh.device_joined", kind="topology",
+                         probability=1.0, limit=1, lost=2,
+                         after="mesh.device_lost", window=400)])
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with faults.active(plan):
+            got = _sorted_out(planner.run(dctx, op, tables))
+    finally:
+        config.set_broadcast_join_threshold(prev)
+        config.set_remesh_cooldown_ms(prev_cd)
+    pd.testing.assert_frame_equal(got, expect)
+    c = trace.counters()
+    assert c.get("recover.remesh", 0) == 1          # bounded: one shrink
+    assert c.get("recover.scaleups", 0) == 0        # rejoin held
+    assert c.get("recover.join_damped", 0) >= 1
+    assert topology.pending_joins(dctx) == 2
+    assert topology.effective(dctx).get_world_size() == 6
+
+
+# -- serving: the SLO loop + fleet mode -------------------------------------
+
+def test_admission_budget_relaxes_on_scaleup(dctx):
+    s = ServeSession(dctx, tables=None, admission_budget=8_000_000)
+    try:
+        assert s._budget() == 8_000_000
+        topology.mark_lost(dctx, 4)
+        assert s._budget() == 4_000_000
+        # partial rejoin re-prices UP proportionally; full restore
+        # returns the base budget verbatim — PR 15's degraded mode,
+        # exactly inverted
+        topology.mark_joined(dctx, 2)
+        assert s._budget() == 6_000_000
+        topology.mark_joined(dctx, 2)
+        assert s._budget() == 8_000_000
+    finally:
+        s.close()
+
+
+def test_served_scaleup_undegrades_and_serves_full_mesh(dctx):
+    op, mk, expect = _two_stage(dctx, seed=31)
+    tables = mk()
+    prev = config.set_broadcast_join_threshold(1)
+    try:
+        with ServeSession(dctx, tables=tables, batch_window_ms=0.0,
+                          admission_budget=8_000_000,
+                          name="scaleup-test") as s:
+            topology.mark_lost(dctx, 4)
+            assert _wait_until(
+                lambda: s.stats().get("mesh_degraded", 0) >= 1)
+            assert s._budget() == 4_000_000
+            h = s.submit(op, label="degraded")
+            pd.testing.assert_frame_equal(
+                _sorted_out(h.result(timeout=600)), expect)
+            topology.mark_joined(dctx, 4)
+            assert _wait_until(
+                lambda: s.stats().get("mesh_expanded", 0) >= 1)
+            st = s.stats()
+            assert st["mesh_expanded"] == 1
+            assert "degraded_world" not in st    # gauge cleared
+            assert s._budget() == 8_000_000      # admission relaxed
+            h2 = s.submit(op, label="restored")
+            pd.testing.assert_frame_equal(
+                _sorted_out(h2.result(timeout=600)), expect)
+            # the post-expansion query ran on the FULL mesh
+            assert topology.effective(dctx) is dctx
+            assert tables["fact"].ctx is dctx
+            assert s.stats()["failed"] == 0
+    finally:
+        config.set_broadcast_join_threshold(prev)
+    assert trace.counters().get("recover.scaleups", 0) == 1
+
+
+def test_capacity_request_lifecycle(dctx):
+    from cylon_tpu.observe.timeseries import TimeSeriesSampler
+    s = ServeSession(dctx, tables=None, batch_window_ms=0.0)
+    try:
+        sampler = TimeSeriesSampler(session=s)
+        # capacity-class alerts open typed requests on the session;
+        # cache-hit collapse is NOT a capacity problem and must not
+        sampler._alert("p99-drift", {"t": 1.0}, "p99 drifted 4x")
+        sampler._alert("cache-hit-collapse", {"t": 2.0}, "churn")
+        reqs = s.capacity_requests()
+        assert len(reqs) == 1
+        assert reqs[0].rule == "p99-drift"
+        assert reqs[0].status == "open"
+        assert s.stats()["capacity_requests"] == 1
+        assert trace.counters().get("serve.capacity_requests", 0) == 1
+        # the grow event fulfils every open request
+        topology.mark_lost(dctx, 4)
+        assert _wait_until(
+            lambda: s.stats().get("mesh_degraded", 0) >= 1)
+        topology.mark_joined(dctx, 4)
+        assert _wait_until(
+            lambda: s.stats().get("mesh_expanded", 0) >= 1)
+        assert all(r.status == "fulfilled"
+                   for r in s.capacity_requests())
+    finally:
+        s.close()
+
+
+def test_fleet_router_validation(dctx):
+    with pytest.raises(CylonError, match="at least one"):
+        FleetRouter([])
+    s1 = ServeSession(dctx, tables=None, name="dup")
+    s2 = ServeSession(dctx, tables=None, name="dup")
+    try:
+        with pytest.raises(CylonError, match="unique"):
+            FleetRouter([s1, s2])
+    finally:
+        s1.close()
+        s2.close()
+    s3 = ServeSession(dctx, tables=None, name="left")
+    s4 = ServeSession(dctx, tables=None, name="right")
+    try:
+        with pytest.raises(CylonError, match="disjoint"):
+            FleetRouter([s3, s4])   # same ctx = same devices
+    finally:
+        s3.close()
+        s4.close()
+
+
+def _fleet(df):
+    """Two replicas over disjoint halves of the 8-device world, each
+    holding its own copy of ``df`` as session tables."""
+    import jax
+
+    from cylon_tpu.context import CylonContext
+    devs = jax.devices()
+    ctx_a = CylonContext({"backend": "tpu", "devices": devs[:4]})
+    ctx_b = CylonContext({"backend": "tpu", "devices": devs[4:]})
+    sa = ServeSession(
+        ctx_a, tables={"t": DTable.from_table(
+            ctx_a, Table.from_pandas(ctx_a, df))},
+        name="rep-a", batch_window_ms=0.0)
+    sb = ServeSession(
+        ctx_b, tables={"t": DTable.from_table(
+            ctx_b, Table.from_pandas(ctx_b, df))},
+        name="rep-b", batch_window_ms=0.0)
+    return sa, sb
+
+
+def test_fleet_router_affinity_and_failover_parity():
+    rng = np.random.default_rng(41)
+    df = pd.DataFrame({
+        "g": rng.integers(0, 20, 2000).astype(np.int32),
+        "x": rng.random(2000).astype(np.float32)})
+    exp = (df.groupby("g", as_index=False)["x"].sum()
+           .sort_values("g").reset_index(drop=True))
+
+    def op(t):
+        return dops.dist_groupby(t["t"], ["g"], [("x", "sum")])
+
+    def check(h):
+        got = (h.result(timeout=600).to_table().to_pandas()
+               .sort_values("g").reset_index(drop=True))
+        assert np.allclose(got["sum_x"].to_numpy(),
+                           exp["x"].to_numpy(), atol=1e-4)
+
+    sa, sb = _fleet(df)
+    try:
+        r = FleetRouter([sa, sb])
+        check(r.submit(op, label="first"))
+        first = r.replica_of(op)
+        assert first in ("rep-a", "rep-b")
+        # hot fingerprint routes back to the replica that compiled it
+        check(r.submit(op, label="second"))
+        assert r.replica_of(op) == first
+        assert trace.counters().get("serve.router_affinity_hits", 0) >= 1
+        # degrade the affinity replica: the router fails over and the
+        # failover replica answers row-identically
+        victim = {"rep-a": sa, "rep-b": sb}[first]
+        topology.mark_lost(victim.ctx, 2)
+        check(r.submit(op, label="failover"))
+        moved = r.replica_of(op)
+        assert moved != first
+        assert trace.counters().get("serve.router_failovers", 0) == 1
+        assert trace.counters().get("serve.router_routed", 0) == 3
+        # rejoin heals the victim: it becomes routable again
+        topology.mark_joined(victim.ctx, 2)
+        assert not topology.degraded(victim.ctx)
+    finally:
+        sa.close()
+        sb.close()
+
+
+def test_fleet_router_drain_keeps_serving():
+    df = pd.DataFrame({"g": np.arange(8, dtype=np.int32),
+                       "x": np.ones(8, np.float32)})
+
+    def op(t):
+        return dops.dist_groupby(t["t"], ["g"], [("x", "sum")])
+
+    sa, sb = _fleet(df)
+    try:
+        r = FleetRouter([sa, sb])
+        final = r.drain("rep-a")
+        assert final["failed"] == 0
+        h = r.submit(op, label="after-drain")
+        assert h.result(timeout=600).to_table().num_rows == 8
+        assert r.replica_of(op) == "rep-b"
+        assert "rep-a" in r.stats()["draining"]
+        with pytest.raises(CylonError, match="no replica"):
+            r.drain("rep-z")
+    finally:
+        sa.close()
+        sb.close()
+
+
+def test_doctor_renders_elasticity_timeline():
+    from cylon_tpu.observe import doctor
+    doc = {"schema": 1, "reason": "test", "events": [
+        {"kind": "mesh_degraded", "t": 1.0, "lost": 2,
+         "survivor_world": 6, "session": "s"},
+        {"kind": "mesh_join_damped", "t": 2.0, "pending": 1,
+         "cooldown_ms": 500, "world": 6},
+        {"kind": "capacity_request", "t": 3.0, "rule": "p99-drift",
+         "session": "s", "detail": "p99 drifted"},
+        {"kind": "mesh_expanded", "t": 4.0, "joined": 2, "world": 6,
+         "new_world": 8},
+        {"kind": "recover", "action": "scaleup", "t": 5.0,
+         "new_world": 8, "evacuated_bytes": 123, "note": "win"},
+    ], "queries": [], "counters": {}}
+    text = doctor.render(doc)
+    assert "elasticity timeline" in text
+    assert "MESH DEGRADED: lost 2 device(s) -> 6 survivors" in text
+    assert "JOIN DAMPED: 1 rejoin(s) held (flap window 500 ms)" in text
+    assert "CAPACITY REQUEST [p99-drift] (session s): p99 drifted" in text
+    assert "MESH EXPANDED: +2 device(s) -> 8 world" in text
+    assert "SCALE-UP: evacuated 123 B, resumed on 8 devices (win)" in text
+
+
+def test_benchdiff_gates_restored_qps_ratio_down():
+    """The scale-up bench family gates: a restored-QPS ratio DROP past
+    the threshold regresses; sub-floor jitter (the 0.02 ratio floor)
+    never fails CI; the scale-up wall-clock stays ungated."""
+    from cylon_tpu.analysis import benchdiff
+    key = "serve_meshchaos_restored_qps_ratio"
+    assert benchdiff._gate_direction(key) == "down"
+    assert benchdiff._gate_direction(
+        "serve_meshchaos_scaleup_ms") is None
+    _, regs = benchdiff.diff({key: 1.0}, {key: 0.7})
+    assert [r["key"] for r in regs] == [key]
+    _, regs = benchdiff.diff({key: 1.0}, {key: 0.99})
+    assert regs == []
+    _, regs = benchdiff.diff({key: 0.98}, {key: 1.1})
+    assert regs == []          # an improvement is never a regression
