@@ -1619,6 +1619,142 @@ def main() -> None:
                 _trace.reset()
             em.emit("sustain")
 
+        # mixed read/write stage (docs/serving.md "Materialized
+        # subplans"): CYLON_BENCH_MIXED=<seconds> runs ONE writer
+        # thread appending delta batches through session.ingest while
+        # 8 reader threads repeat a foldable aggregation — the
+        # materialized-view steady state under churn.  Emits the gated
+        # roll-up: serve_mixed_qps (DOWN) and serve_mixed_view_hit_ratio
+        # (DOWN — hits + folds over reads; a regression here means the
+        # ingest path started invalidating instead of folding),
+        # serve_mixed_p99_ms (UP), plus serve_mixed_staleness_ms — the
+        # measured visibility lag of the snapshot-at-window-admission
+        # staleness model (p95 ingest submit→applied latency: a query
+        # admitted after that lag sees the rows).
+        mixed_s = float(os.environ.get("CYLON_BENCH_MIXED", "0"))
+        if mixed_s > 0 and remaining() > mixed_s + 60:
+            import threading as _threading
+
+            import pandas as _pd
+
+            from cylon_tpu.parallel.dist_ops import (dist_groupby,
+                                                     shuffle_table)
+            from cylon_tpu.parallel.dtable import DTable
+            from cylon_tpu.serve import ServeSession
+            _progress(f"mixed read/write serving: 1 writer + 8 readers "
+                      f"x {mixed_s:.0f}s")
+            try:
+                _trace.enable_counters()
+                _trace.reset()
+                mrng = np.random.default_rng(11)
+                base_df = _pd.DataFrame({
+                    "k": mrng.integers(0, 64, 8192).astype(np.int64),
+                    "v": mrng.normal(size=8192)})
+                fact = DTable.from_pandas(ctx, base_df)
+
+                def _mixed_q(t):
+                    s = shuffle_table(t["fact"], ["k"])
+                    return dist_groupby(s, ["k"],
+                                        [("v", "sum"), ("v", "count")])
+
+                stop_at = time.monotonic() + mixed_s
+                lat_all, views_all, stale_all, errors = [], [], [], []
+                mlock = _threading.Lock()
+                with ServeSession(ctx, tables={"fact": fact},
+                                  batch_window_ms=4.0) as srv:
+
+                    def reader(i):
+                        while time.monotonic() < stop_at:
+                            try:
+                                h = srv.submit(_mixed_q,
+                                               label=f"mixed-r{i}")
+                                h.result(timeout=600)
+                            except Exception as e:  # graftlint: ok[broad-except] — recorded in the artifact below
+                                with mlock:
+                                    errors.append(
+                                        f"reader{i}: {type(e).__name__}:"
+                                        f" {str(e)[:120]}")
+                                return
+                            with mlock:
+                                lat_all.append(h.latency_ms)
+                                views_all.append(h.view)
+
+                    def writer():
+                        n = 0
+                        while time.monotonic() < stop_at:
+                            ddf = _pd.DataFrame({
+                                "k": mrng.integers(0, 64, 128)
+                                    .astype(np.int64),
+                                "v": mrng.normal(size=128)})
+                            try:
+                                delta = DTable.from_pandas(ctx, ddf)
+                                h = srv.ingest("fact", delta)
+                                h.result(timeout=600)
+                            except Exception as e:  # graftlint: ok[broad-except] — recorded in the artifact below
+                                with mlock:
+                                    errors.append(
+                                        f"writer: {type(e).__name__}: "
+                                        f"{str(e)[:120]}")
+                                return
+                            with mlock:
+                                stale_all.append(h.latency_ms)
+                            n += 1
+                            time.sleep(0.05)
+
+                    t0 = time.perf_counter()
+                    threads = ([_threading.Thread(target=reader,
+                                                  args=(i,))
+                                for i in range(8)]
+                               + [_threading.Thread(target=writer)])
+                    for th in threads:
+                        th.start()
+                    for th in threads:
+                        th.join()
+                    wall = time.perf_counter() - t0
+                    mst = srv.stats()
+                from cylon_tpu.serve.session import percentile
+                lat_sorted = sorted(lat_all)
+                stale_sorted = sorted(stale_all)
+                served = sum(1 for v in views_all
+                             if v in ("hit", "fold"))
+                em.detail["serve_mixed_s"] = round(wall, 1)
+                em.detail["serve_mixed_reads"] = len(lat_all)
+                em.detail["serve_mixed_appends"] = len(stale_all)
+                em.detail["serve_mixed_qps"] = round(
+                    len(lat_all) / wall, 3) if wall else None
+                em.detail["serve_mixed_view_hit_ratio"] = round(
+                    served / len(views_all), 3) if views_all else None
+                em.detail["serve_mixed_p99_ms"] = round(
+                    percentile(lat_sorted, 99), 2) if lat_sorted \
+                    else None
+                em.detail["serve_mixed_staleness_ms"] = round(
+                    percentile(stale_sorted, 95), 2) if stale_sorted \
+                    else None
+                em.detail["serve_mixed_view_hits"] = mst["view_hits"]
+                em.detail["serve_mixed_view_folds"] = mst["view_folds"]
+                em.detail["serve_mixed_view_invalidations"] = \
+                    mst["view_invalidations"]
+                if errors:
+                    em.detail["serve_mixed_client_errors"] = len(errors)
+                    em.detail["serve_mixed_error"] = errors[0]
+                    print("mixed stage client errors: "
+                          + "; ".join(errors[:3]), file=sys.stderr)
+                _progress(
+                    f"mixed: {em.detail['serve_mixed_qps']} qps, "
+                    f"view ratio "
+                    f"{em.detail['serve_mixed_view_hit_ratio']}, "
+                    f"p99 {em.detail['serve_mixed_p99_ms']} ms, "
+                    f"staleness p95 "
+                    f"{em.detail['serve_mixed_staleness_ms']} ms")
+            except Exception as e:  # graftlint: ok[broad-except] — the mixed stage must not kill the bench
+                print(f"mixed stage FAILED: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+                em.detail["serve_mixed_error"] = str(e)[:200]
+            finally:
+                _trace.disable_counters()
+                _trace.reset()
+            em.emit("mixed")
+
         # chaos-under-sustained-load stage (docs/robustness.md
         # "self-healing execution"): CYLON_BENCH_CHAOS=<seed> reruns the
         # sustained serving workload with a seeded default fault plan

@@ -48,6 +48,14 @@ Gated metrics (relative threshold, default 15%):
     (higher = worse), from the sustained-load stage
     (CYLON_BENCH_SUSTAIN; docs/observability.md "the time-series
     sampler" and "Live telemetry plane")
+  * ``serve_mixed_qps`` read throughput of the mixed read/write stage
+    (CYLON_BENCH_MIXED; lower = worse),
+    ``serve_mixed_view_hit_ratio`` — queries answered by a
+    materialized-view hit or delta fold over all reads (lower = worse:
+    the ingest path started invalidating views it used to fold) — and
+    ``serve_mixed_p99_ms`` read tail latency (higher = worse); the
+    measured ``serve_mixed_staleness_ms`` visibility lag is reported
+    ungated (docs/serving.md "Materialized subplans")
   * ``tpch_<q>_recompiles``  jit builds inside the TIMED (warm) rep
     (higher = worse — a compile-cache-key regression re-tracing per
     call; the warm-up ``tpch_<q>_compile_ms`` column is reported but
@@ -166,6 +174,19 @@ _GATES: Tuple[Tuple[str, str], ...] = (
     # p999 regresses before the p99 when a small fraction of queries
     # fall off the fast path (breaker probes, recovery ladders)
     (r"serve_sustain_p999_ms$", "up"),
+    # mixed read/write family (docs/serving.md "Materialized
+    # subplans", CYLON_BENCH_MIXED): one writer appending deltas while
+    # 8 readers repeat a foldable aggregation.  Read throughput gates
+    # DOWN and the view-served ratio (hits + folds over reads) gates
+    # DOWN with the ratio floor — a drop means the ingest path started
+    # invalidating views it used to fold, paying full recomputes under
+    # churn — while read tail latency gates UP (ms floor).  The
+    # measured staleness (p95 ingest submit→applied) is reported
+    # UNGATED: it tracks batch-window sizing, not code quality, and
+    # the staleness MODEL is what tests pin down.
+    (r"serve_mixed_qps$", "down"),
+    (r"serve_mixed_view_hit_ratio$", "down"),
+    (r"serve_mixed_p99_ms$", "up"),
     # compile tracking (docs/observability.md "compile tracking"):
     # steady-state recompiles per query gate UP — a timed rep is warm,
     # so any recompile there is a cache-key regression (a thrashing

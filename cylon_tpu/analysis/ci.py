@@ -100,11 +100,21 @@ Stages:
      JSON; and tail-based trace sampling must retain the always-keep
      query's spans while dropping (and accounting for) the fast
      peers' (``--no-export-smoke`` skips);
- 13. **benchdiff** (only when ``--baseline`` and a candidate artifact
+ 13. **matview smoke** (docs/serving.md "Materialized subplans"): the
+     same aggregation across two batch windows must be served from the
+     materialized view on window 2 — strictly fewer exchanges than
+     window 1 and row-identical — an ``ingest`` append must FOLD
+     through the view's captured aggregation state with row parity
+     against a cold recompute, and with the ``matview.fold`` fault
+     armed the fold must degrade to invalidate + full recompute, still
+     row-identical (``--no-matview-smoke`` skips);
+ 14. **benchdiff** (only when ``--baseline`` and a candidate artifact
      are given): the bench regression gate, unchanged semantics —
      including the serving families (``serve_qps``/``serve_sustain_qps``
      down, ``serve_p99_ms``/``serve_sustain_p99_ms``/
-     ``serve_sustain_p999_ms`` up), the
+     ``serve_sustain_p999_ms`` up), the mixed read/write family
+     (``serve_mixed_qps`` / ``serve_mixed_view_hit_ratio`` down,
+     ``serve_mixed_p99_ms`` up), the
      ``tpch_<q>_recompiles`` / ``serve_slo_violations`` up-gates, the
      chaos family (``serve_chaos_recovered_ratio`` down,
      ``serve_chaos_p99_ms`` up), and the mesh-chaos family
@@ -138,14 +148,14 @@ def _repo_paths() -> List[str]:
 
 def _stage_lint() -> int:
     from . import graftlint
-    print("== ci stage 1/13: graftlint ==")
+    print("== ci stage 1/14: graftlint ==")
     rc = graftlint.main(_repo_paths())
     print(f"graftlint: exit {rc}")
     return rc
 
 
 def _stage_plan_check(sf: float) -> int:
-    print("== ci stage 2/13: plan_check pre-flight ==")
+    print("== ci stage 2/14: plan_check pre-flight ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -206,7 +216,7 @@ def _stage_serve_smoke(sf: float) -> int:
     queries (q1 twice, q6 once) through one batch window — results must
     match serial execution row-for-row and at least ONE cross-query
     subplan must have been served from the shared memo."""
-    print("== ci stage 3/13: serving smoke ==")
+    print("== ci stage 3/14: serving smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -329,7 +339,7 @@ def _stage_telemetry_smoke(sf: float) -> int:
     CONTRACTS rather than the numbers: sampler non-empty, catalogue
     compliance, export validity (one track per query trace id), stats
     store populated with per-node observations."""
-    print("== ci stage 4/13: telemetry smoke ==")
+    print("== ci stage 4/14: telemetry smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -451,7 +461,7 @@ def _stage_doctor_smoke(sf: float) -> int:
     post-mortem machinery end to end: the victim fails onto its own
     handle, peers stay row-identical to serial execution, a
     flight-recorder bundle lands on disk, and doctor renders it."""
-    print("== ci stage 5/13: doctor smoke ==")
+    print("== ci stage 5/14: doctor smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -563,7 +573,7 @@ def _stage_chaos_smoke(sf: float) -> int:
     shows the ladder's stage retry with fewer stages replayed than the
     plan has), peers complete untouched, and the flight-recorder
     bundle doctor renders shows the ladder's events."""
-    print("== ci stage 6/13: chaos-recovery smoke ==")
+    print("== ci stage 6/14: chaos-recovery smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -718,7 +728,7 @@ def _stage_ooc_smoke(sf: float) -> int:
     run, and the exchange transient must stay within the pinned
     budget.  On failure a flight-recorder bundle is dumped and doctor
     renders it, so the evidence ships with the red CI run."""
-    print("== ci stage 7/13: out-of-core smoke ==")
+    print("== ci stage 7/14: out-of-core smoke ==")
     t0 = time.perf_counter()
     try:
         import jax
@@ -820,7 +830,7 @@ def _stage_mesh_smoke(sf: float) -> int:
     slices, the session must flip into degraded mode, and the
     flight-recorder bundle doctor renders must show the
     ``mesh_degraded`` event + evacuation timeline."""
-    print("== ci stage 8/13: mesh-loss chaos smoke ==")
+    print("== ci stage 8/14: mesh-loss chaos smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -994,7 +1004,7 @@ def _stage_scaleup_smoke(sf: float) -> int:
     (``mesh_expanded`` tallied, degraded gauge cleared); a follow-up
     query must run on the restored full world; and the doctor must
     render the ``mesh_expanded`` scale-up timeline from the bundle."""
-    print("== ci stage 9/13: mesh-grow chaos smoke ==")
+    print("== ci stage 9/14: mesh-grow chaos smoke ==")
     t0 = time.perf_counter()
     try:
         import tempfile
@@ -1181,7 +1191,7 @@ def _stage_hierarchy_smoke() -> int:
     flat single-shot slow-share price.  A forced hierarchical leg and
     a forced hierarchical-combine fused-groupby leg prove both
     lowerings independently."""
-    print("== ci stage 10/13: hierarchy smoke ==")
+    print("== ci stage 10/14: hierarchy smoke ==")
     t0 = time.perf_counter()
     try:
         import dataclasses
@@ -1370,7 +1380,7 @@ def _stage_lockcheck_smoke() -> int:
     detector reports the deadlock instead of experiencing it; (c) an
     8-client serving window runs green with CYLON_LOCKCHECK
     enforcement live across every OrderedLock in the engine."""
-    print("== ci stage 11/13: concurrency smoke ==")
+    print("== ci stage 11/14: concurrency smoke ==")
     t0 = time.perf_counter()
     try:
         import threading
@@ -1493,7 +1503,7 @@ def _stage_export_smoke(sf: float) -> int:
     sampling retains the always-keep query's span waterfall and drops
     the fast peers', with ``trace.sampled_out`` accounting for the
     purge."""
-    print("== ci stage 12/13: export smoke ==")
+    print("== ci stage 12/14: export smoke ==")
     t0 = time.perf_counter()
     try:
         import json
@@ -1629,10 +1639,155 @@ def _stage_export_smoke(sf: float) -> int:
     return 1 if bad else 0
 
 
+def _stage_matview_smoke() -> int:
+    """Materialized-subplan smoke (docs/serving.md "Materialized
+    subplans"): the same aggregation across two batch windows must be
+    served from the view on window 2 — strictly fewer exchanges than
+    window 1 and row-identical — an ``ingest`` append must FOLD through
+    the view's captured aggregation state with row parity against a
+    cold recompute, and with the ``matview.fold`` fault armed the fold
+    must DEGRADE to invalidate + full recompute, still row-identical —
+    never a stale or half-folded answer."""
+    print("== ci stage 13/14: matview smoke ==")
+    t0 = time.perf_counter()
+    try:
+        import jax
+        import numpy as np
+        import pandas as pd
+
+        from .. import faults, trace
+        from ..context import CylonContext
+        from ..observe import metrics as obmetrics
+        from ..parallel.dist_ops import dist_groupby, shuffle_table
+        from ..parallel.dtable import DTable
+        from ..serve import ServeSession
+
+        ctx = CylonContext({"backend": "dist", "devices": jax.devices()})
+        rng = np.random.default_rng(3)
+        base = pd.DataFrame({
+            "k": rng.integers(0, 16, 512).astype(np.int64),
+            "v": rng.normal(size=512)})
+        dt = DTable.from_pandas(ctx, base)
+    except Exception as e:  # graftlint: ok[broad-except] — environment
+        # setup failing is a TOOLING error (exit 2), not a finding —
+        # the same contract as the plan_check stage
+        print(f"matview smoke: setup failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+
+    def _q(t):
+        s = shuffle_table(t["fact"], ["k"])
+        return dist_groupby(s, ["k"], [("v", "sum"), ("v", "count")])
+
+    def _frame(dt_out):
+        df = dt_out.to_table().to_pandas()
+        return df.sort_values("k").reset_index(drop=True)
+
+    def _cold(df):
+        out = df.groupby("k", as_index=False).agg(
+            sum_v=("v", "sum"), count_v=("v", "count"))
+        return out.sort_values("k").reset_index(drop=True)
+
+    def _parity(got, want, what):
+        nonlocal bad
+        if (len(got) != len(want)
+                or not np.allclose(got["sum_v"].to_numpy(np.float64),
+                                   want["sum_v"].to_numpy(np.float64),
+                                   rtol=1e-4, atol=1e-4)
+                or not np.array_equal(
+                    got["count_v"].to_numpy(np.int64),
+                    want["count_v"].to_numpy(np.int64))):
+            print(f"matview smoke: {what} DIVERGED from the cold "
+                  "recompute", file=sys.stderr)
+            bad += 1
+
+    try:
+        trace.enable_counters()
+        trace.reset()
+        with ServeSession(ctx, tables={"fact": dt},
+                          batch_window_ms=0.0) as s:
+            h1 = s.submit(_q, label="w1")
+            r1 = _frame(h1.result(timeout=600))
+            h2 = s.submit(_q, label="w2")
+            r2 = _frame(h2.result(timeout=600))
+            ex1 = obmetrics.exchange_count(h1.counters)
+            ex2 = obmetrics.exchange_count(h2.counters)
+            if h2.view != "hit" or ex2 >= ex1:
+                print(f"matview smoke: window-2 repeat was not served "
+                      f"from the view (view={h2.view!r}, exchanges "
+                      f"{ex1} -> {ex2}; the repeat must dispatch "
+                      "strictly fewer)", file=sys.stderr)
+                bad += 1
+            _parity(r2, _cold(base), "window-2 view hit")
+            # the append must FOLD — O(delta) through the captured
+            # aggregation state — and answer row-identical to a cold
+            # recompute over base + delta
+            ddf = pd.DataFrame({
+                "k": rng.integers(0, 16, 64).astype(np.int64),
+                "v": rng.normal(size=64)})
+            s.ingest("fact", DTable.from_pandas(ctx, ddf)) \
+                .result(timeout=600)
+            h3 = s.submit(_q, label="w3")
+            r3 = _frame(h3.result(timeout=600))
+            if h3.view != "fold":
+                print(f"matview smoke: post-append query did not fold "
+                      f"(view={h3.view!r}) — the ingest path stopped "
+                      "maintaining the view incrementally",
+                      file=sys.stderr)
+                bad += 1
+            both = pd.concat([base, ddf], ignore_index=True)
+            _parity(r3, _cold(both), "post-append fold")
+            # chaos: a failure INSIDE the fold must degrade to
+            # invalidate + full recompute — row-identical, never a
+            # stale or half-folded answer
+            ddf2 = pd.DataFrame({
+                "k": rng.integers(0, 16, 64).astype(np.int64),
+                "v": rng.normal(size=64)})
+            s.ingest("fact", DTable.from_pandas(ctx, ddf2)) \
+                .result(timeout=600)
+            plan = faults.FaultPlan(seed=0, rules=[
+                faults.FaultRule("matview.fold", kind="transient",
+                                 once=True)])
+            with faults.active(plan):
+                h4 = s.submit(_q, label="w4-chaos")
+                r4 = _frame(h4.result(timeout=600))
+            if h4.view is not None:
+                print(f"matview smoke: faulted fold was served from "
+                      f"the view (view={h4.view!r}) — a failed fold "
+                      "must degrade to a full recompute",
+                      file=sys.stderr)
+                bad += 1
+            all3 = pd.concat([base, ddf, ddf2], ignore_index=True)
+            _parity(r4, _cold(all3), "chaos-degraded recompute")
+            failures = trace.counters().get("matview.fold_failures", 0)
+            if not failures:
+                print("matview smoke: the armed matview.fold fault "
+                      "never fired (matview.fold_failures == 0)",
+                      file=sys.stderr)
+                bad += 1
+            st = s.stats()
+        if not bad:
+            print(f"matview smoke: hit + fold + chaos degrade ok "
+                  f"(view_hits={st['view_hits']}, "
+                  f"view_folds={st['view_folds']}, exchanges "
+                  f"{ex1} -> {ex2}; "
+                  f"{time.perf_counter() - t0:.1f}s)")
+    except Exception as e:  # graftlint: ok[broad-except] — a crash in
+        # the workload is a finding: keep the 0/1/2 exit contract
+        print(f"matview smoke: RAISED: {type(e).__name__}: "
+              f"{str(e)[:300]}", file=sys.stderr)
+        bad += 1
+    finally:
+        trace.disable_counters()
+        trace.reset()
+    return 1 if bad else 0
+
+
 def _stage_benchdiff(baseline: str, candidate: str,
                      threshold: float) -> int:
     from . import benchdiff
-    print("== ci stage 13/13: benchdiff ==")
+    print("== ci stage 14/14: benchdiff ==")
     rc = benchdiff.main([baseline, candidate,
                          "--threshold", str(threshold)])
     print(f"benchdiff: exit {rc}")
@@ -1675,6 +1830,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--no-export-smoke", action="store_true",
                     help="skip the telemetry-export (OpenMetrics + "
                          "event log + tail sampling) smoke stage")
+    ap.add_argument("--no-matview-smoke", action="store_true",
+                    help="skip the materialized-subplan (view cache + "
+                         "delta fold + chaos degrade) smoke stage")
     args = ap.parse_args(argv)
     if bool(args.baseline) != bool(args.candidate):
         print("ci: benchdiff needs BOTH --baseline OLD.json and a "
@@ -1684,52 +1842,56 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_plan_check:
         rcs.append(_stage_plan_check(args.tpch_sf))
     else:
-        print("== ci stage 2/13: plan_check pre-flight == (skipped)")
+        print("== ci stage 2/14: plan_check pre-flight == (skipped)")
     if not args.no_serve_smoke:
         rcs.append(_stage_serve_smoke(args.tpch_sf))
     else:
-        print("== ci stage 3/13: serving smoke == (skipped)")
+        print("== ci stage 3/14: serving smoke == (skipped)")
     if not args.no_telemetry_smoke:
         rcs.append(_stage_telemetry_smoke(args.tpch_sf))
     else:
-        print("== ci stage 4/13: telemetry smoke == (skipped)")
+        print("== ci stage 4/14: telemetry smoke == (skipped)")
     if not args.no_doctor_smoke:
         rcs.append(_stage_doctor_smoke(args.tpch_sf))
     else:
-        print("== ci stage 5/13: doctor smoke == (skipped)")
+        print("== ci stage 5/14: doctor smoke == (skipped)")
     if not args.no_chaos_smoke:
         rcs.append(_stage_chaos_smoke(args.tpch_sf))
     else:
-        print("== ci stage 6/13: chaos-recovery smoke == (skipped)")
+        print("== ci stage 6/14: chaos-recovery smoke == (skipped)")
     if not args.no_ooc_smoke:
         rcs.append(_stage_ooc_smoke(args.tpch_sf))
     else:
-        print("== ci stage 7/13: out-of-core smoke == (skipped)")
+        print("== ci stage 7/14: out-of-core smoke == (skipped)")
     if not args.no_mesh_smoke:
         rcs.append(_stage_mesh_smoke(args.tpch_sf))
     else:
-        print("== ci stage 8/13: mesh-loss chaos smoke == (skipped)")
+        print("== ci stage 8/14: mesh-loss chaos smoke == (skipped)")
     if not args.no_scaleup_smoke:
         rcs.append(_stage_scaleup_smoke(args.tpch_sf))
     else:
-        print("== ci stage 9/13: mesh-grow chaos smoke == (skipped)")
+        print("== ci stage 9/14: mesh-grow chaos smoke == (skipped)")
     if not args.no_hierarchy_smoke:
         rcs.append(_stage_hierarchy_smoke())
     else:
-        print("== ci stage 10/13: hierarchy smoke == (skipped)")
+        print("== ci stage 10/14: hierarchy smoke == (skipped)")
     if not args.no_lockcheck_smoke:
         rcs.append(_stage_lockcheck_smoke())
     else:
-        print("== ci stage 11/13: concurrency smoke == (skipped)")
+        print("== ci stage 11/14: concurrency smoke == (skipped)")
     if not args.no_export_smoke:
         rcs.append(_stage_export_smoke(args.tpch_sf))
     else:
-        print("== ci stage 12/13: export smoke == (skipped)")
+        print("== ci stage 12/14: export smoke == (skipped)")
+    if not args.no_matview_smoke:
+        rcs.append(_stage_matview_smoke())
+    else:
+        print("== ci stage 13/14: matview smoke == (skipped)")
     if args.baseline:
         rcs.append(_stage_benchdiff(args.baseline, args.candidate,
                                     args.threshold))
     else:
-        print("== ci stage 13/13: benchdiff == (no --baseline; skipped)")
+        print("== ci stage 14/14: benchdiff == (no --baseline; skipped)")
     worst = max(rcs)
     print(f"ci: {'CLEAN' if worst == 0 else 'FAILED'} "
           f"(stage exits {rcs} -> {worst})")

@@ -26,7 +26,7 @@ __all__ = ["render", "main"]
 _INTERESTING_PREFIXES = ("serve.", "compile.", "fault.", "retry.",
                          "recover.", "spill.", "flightrec.",
                          "shuffle.strategy.", "devmem.", "plan.cache",
-                         "lock.")
+                         "lock.", "matview.")
 
 
 def _fmt_ts(t: Optional[float]) -> str:
@@ -172,6 +172,33 @@ def render(doc: Dict[str, Any]) -> str:
                          f"held {e.get('held_ms', '?')} ms "
                          f"(watchdog {e.get('watchdog_ms', '?')} ms) on "
                          f"thread {e.get('thread', '?')!r}")
+
+    # materialized-view lifecycle (docs/serving.md "Materialized
+    # subplans"): retains, hits, delta folds and invalidations in ring
+    # order — a serving post-mortem's "was the cache helping or
+    # thrashing" view
+    views = [e for e in doc.get("events", [])
+             if e.get("kind") == "matview"]
+    if views:
+        lines.append(_section(f"materialized views ({len(views)})"))
+        for e in views[-12:]:
+            act = e.get("action", "?")
+            if act == "retain":
+                lines.append(
+                    f"  [{_fmt_ts(e.get('t'))}] RETAIN {e.get('label', '?')}: "
+                    f"{e.get('bytes', '?')} B pooled, foldable="
+                    f"{e.get('foldable', '?')}")
+            elif act == "fold":
+                lines.append(
+                    f"  [{_fmt_ts(e.get('t'))}] FOLD {e.get('label', '?')}: "
+                    f"{e.get('rows', '?')} delta row(s) merged")
+            elif act == "invalidate":
+                lines.append(
+                    f"  [{_fmt_ts(e.get('t'))}] INVALIDATE "
+                    f"{e.get('label', '?')}: {e.get('why', '?')}")
+            else:
+                lines.append(f"  [{_fmt_ts(e.get('t'))}] "
+                             f"{act.upper()} {e.get('label', '?')}")
 
     choices = [e for e in doc.get("events", [])
                if e.get("kind") == "exchange_choice"]

@@ -290,6 +290,49 @@ METRICS: Dict[str, MetricSpec] = _specs(
     ("serve.queue_depth", GAUGE, "queries",
      "queries waiting in the serve queue (submitted, not yet admitted "
      "to a window — deferred queries count until re-admitted)"),
+    # cross-window materialized subplans (serve/matview.py;
+    # docs/serving.md "Materialized subplans"): the serve.view_* family
+    # is the query's-eye view (hit/miss/fold outcomes), the matview.*
+    # family the store's own lifecycle (retention, invalidation, loss)
+    ("serve.view_hits", COUNTER, "queries",
+     "queries served whole from a cross-window materialized view — "
+     "result rebuilt from pooled host blocks, zero exchanges dispatched"),
+    ("serve.view_misses", COUNTER, "queries",
+     "view probes that fell through to full execution (no entry, or "
+     "the pool's LRU reclaimed the blocks)"),
+    ("serve.view_folds", COUNTER, "queries",
+     "queries served by folding pending ingest deltas through the "
+     "view's captured mergeable aggregation state — O(delta), the "
+     "base table untouched"),
+    ("serve.view_subplan_hits", COUNTER, "subplans",
+     "carried SUBPLAN entries re-seeded into a later window's shared "
+     "execution memo — cross-window cousins of serve.subplan_shared"),
+    ("matview.retained", COUNTER, "views",
+     "query results retained as materialized views (admission-by-cost "
+     "passed, the spill pool admitted the blocks)"),
+    ("matview.declined", COUNTER, "views",
+     "retention offers declined — benefit per retained MiB under the "
+     "CYLON_MATVIEW_MIN_BENEFIT floor, or the pool refused the bytes "
+     "(host budget)"),
+    ("matview.invalidations", COUNTER, "views",
+     "views dropped because a base table's content epoch advanced past "
+     "a non-foldable plan (or a fold failed) — the never-stale "
+     "guarantee made visible"),
+    ("matview.folds", COUNTER, "folds",
+     "successful delta folds (serve.view_folds' store-side twin; one "
+     "fold may merge several pending epochs)"),
+    ("matview.fold_rows", COUNTER, "rows",
+     "delta rows folded through captured aggregation state — the "
+     "O(delta) in incremental maintenance, measured"),
+    ("matview.fold_failures", COUNTER, "folds",
+     "folds that failed (matview.fold fault point included) and "
+     "degraded to invalidate + full recompute"),
+    ("matview.lost", COUNTER, "views",
+     "views whose pooled blocks the host-budget LRU evicted before the "
+     "next probe — served as misses, never errors"),
+    ("matview.subplans_retained", COUNTER, "subplans",
+     "hot shared subplans harvested from a window's execution memo "
+     "into the pool for cross-window reuse"),
     ("serve.batch_window_ms", GAUGE, "ms",
      "the serve session's configured batch-window length: how long the "
      "dispatcher collects concurrent arrivals before admitting a batch"),
@@ -454,6 +497,12 @@ METRICS: Dict[str, MetricSpec] = _specs(
      "fleet routings that hit plan-cache affinity: the query's "
      "fingerprint routed to the replica recorded as having compiled "
      "it (observe.stats set_replica)"),
+    ("serve.router_view_affinity_hits", COUNTER, "queries",
+     "fleet routings that hit LIVE-VIEW affinity: the query's "
+     "fingerprint routed to the replica whose materialized-view store "
+     "holds a live view for it (serve/matview.py) — that replica "
+     "answers from pooled host blocks with zero exchanges, so view "
+     "affinity outranks plan-cache affinity in serve.router placement"),
     ("serve.router_failovers", COUNTER, "queries",
      "fleet routings diverted off their preferred replica because it "
      "was draining, quarantined (breaker OPEN), degraded, or closed"),

@@ -21,6 +21,8 @@ splitters.
 """
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
 from typing import List, Sequence, Tuple, Union
 
 import jax
@@ -1537,6 +1539,182 @@ def _recompose_partials(dt: DTable, aggregations, plan, comb: DTable,
     return DTable(dt.ctx, cols, comb.cap, comb.counts)
 
 
+# ---------------------------------------------------------------------------
+# aggregation-state capture + merge (serve/matview.py "incremental
+# maintenance"): every mergeable aggregation tail holds a combined
+# partial-group table (plain combine specs) or a merged sketch-state
+# table right before recomposition.  Under collect_agg_state() that
+# state is handed to a thread-local sink at zero extra device cost —
+# it already exists — so a materialized view can later fold an
+# appended delta's state into it (arXiv:2010.14596's mergeable-
+# summary contract) and re-finalize WITHOUT touching the base table.
+# ---------------------------------------------------------------------------
+
+_matview_tls = threading.local()
+
+
+class AggState:
+    """One captured mergeable aggregation state.
+
+    ``kind``   — ``"plain"`` (combine-spec partials: sum/count/min/max
+                 slots, mean = Σsum/Σcount) or ``"sketch"`` (HLL /
+                 bottom-k lanes).
+    ``state``  — the partial DTable: ``K`` key columns then partial /
+                 sketch-lane columns, positional (the
+                 ``_recompose_partials`` contract).
+    ``base_meta`` — per aggregation ``(base column name, base
+                 DataType, op)``: everything finalize needs from the
+                 base table, captured as metadata so re-finalizing
+                 never faults a spilled base back in.
+    """
+
+    __slots__ = ("kind", "state", "K", "partial", "plan", "base_meta",
+                 "dense_key_range", "kinds", "qs")
+
+    def __init__(self, kind: str, state: DTable, K: int, *,
+                 partial=None, plan=None, base_meta=None,
+                 dense_key_range=None, kinds=None, qs=None) -> None:
+        self.kind = kind
+        self.state = state
+        self.K = K
+        self.partial = partial
+        self.plan = plan
+        self.base_meta = base_meta
+        self.dense_key_range = dense_key_range
+        self.kinds = kinds
+        self.qs = qs
+
+
+@contextmanager
+def collect_agg_state():
+    """Collect every mergeable aggregation state produced on THIS
+    thread while the context is open (yields the sink list).  Nestable;
+    the inner collector wins, restoring the outer one on exit."""
+    prev = getattr(_matview_tls, "sink", None)
+    sink: List[AggState] = []
+    _matview_tls.sink = sink
+    try:
+        yield sink
+    finally:
+        _matview_tls.sink = prev
+
+
+def _collecting() -> bool:
+    return getattr(_matview_tls, "sink", None) is not None
+
+
+def _note_plain_state(dt: DTable, aggregations, partial, plan,
+                      comb: DTable, K: int, dense_key_range) -> None:
+    sink = getattr(_matview_tls, "sink", None)
+    if sink is None:
+        return
+    base_meta = []
+    for cref, op in aggregations:
+        c = dt._columns[dt.column_index(cref)]
+        base_meta.append((c.name, c.dtype, op))
+    sink.append(AggState("plain", comb, K, partial=list(partial),
+                         plan=list(plan), base_meta=base_meta,
+                         dense_key_range=dense_key_range))
+
+
+def _note_sketch_state(dt: DTable, aggregations, sh: DTable, K: int,
+                       kinds, qs) -> None:
+    sink = getattr(_matview_tls, "sink", None)
+    if sink is None:
+        return
+    base_meta = []
+    for cref, op in aggregations:
+        c = dt._columns[dt.column_index(cref)]
+        base_meta.append((c.name, c.dtype, op))
+    # the shuffled partial table co-locates same-group rows; one local
+    # merge collapses it to the global one-row-per-group state
+    state = _sketch_merge_local(sh, K, kinds, qs)
+    sink.append(AggState("sketch", state, K, base_meta=base_meta,
+                         kinds=tuple(kinds), qs=tuple(qs)))
+
+
+def merge_agg_state(a: AggState, b: AggState) -> AggState:
+    """Merge two captured states of the SAME aggregation tail (base ∪
+    delta) into one — the O(delta) fold.  Key dictionaries are unified
+    first (an append can grow a dictionary, which re-encodes codes);
+    plain partials re-combine through the standard combining groupby,
+    sketch lanes through the sketch merge kernel."""
+    from .streaming import _concat_compact
+    K = a.K
+    sa, sb = _unify_dtable_dicts(a.state, b.state, list(range(K)),
+                                 list(range(K)))
+    cc = _concat_compact([sa, sb])
+    if a.kind == "sketch":
+        sh = _shuffle_by_pids(cc, _hash_pids(cc, list(range(K))),
+                              owner="groupby")
+        merged = _sketch_merge_local(sh, K, a.kinds, a.qs)
+        return AggState("sketch", merged, K, base_meta=a.base_meta,
+                        kinds=a.kinds, qs=a.qs)
+    comb_aggs = [(K + j, _COMBINE_OP[op])
+                 for j, (_, op) in enumerate(a.partial)]
+    merged = dist_groupby(cc, list(range(K)), comb_aggs,
+                          dense_key_range=a.dense_key_range,
+                          pre_aggregate=False)
+    return AggState("plain", merged, K, partial=a.partial, plan=a.plan,
+                    base_meta=a.base_meta,
+                    dense_key_range=a.dense_key_range)
+
+
+def finalize_agg_state(st: AggState) -> DTable:
+    """The final result table from a (merged) captured state — local
+    arithmetic only for plain partials, shuffle + sketch collapse for
+    sketches; never reads a base table (``base_meta`` carries the
+    output naming/typing)."""
+    from ..compute import _agg_output_type
+    from ..dtypes import Type
+    comb, K = st.state, st.K
+    if st.kind == "plain":
+        fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        cols = list(comb.columns[:K])
+        for (name, dtype, _), spec in zip(st.base_meta, st.plan):
+            op = spec[0]
+            t_out = _agg_output_type(dtype.type, op)
+            if op == "mean":
+                s, c = comb.columns[K + spec[1]], comb.columns[K + spec[2]]
+                data = (s.data.astype(fdt)
+                        / jnp.maximum(c.data, 1).astype(fdt))
+                cols.append(DColumn(f"{op}_{name}", DataType(t_out),
+                                    data, c.data > 0))
+            else:
+                src = comb.columns[K + spec[1]]
+                cols.append(DColumn(f"{op}_{name}", DataType(t_out),
+                                    src.data, src.validity))
+        return DTable(comb.ctx, cols, comb.cap, comb.counts)
+    # sketch: co-locate groups, then the finalizing combine
+    sh = _shuffle_by_pids(comb, _hash_pids(comb, list(range(K))),
+                          owner="groupby")
+    key_leaves = tuple((sh.columns[i].data, sh.columns[i].validity)
+                       for i in range(K))
+    fn = _sketch_combine_fn(
+        sh.ctx.mesh, sh.ctx.axis, sh.cap,
+        tuple(sh.columns[i].validity is not None for i in range(K)),
+        st.kinds, st.qs, sh.cap, True)
+    keys_out, outs, counts = fn(sh.counts, key_leaves,
+                                _sketch_state_groups(sh, K, st.kinds))
+    cols = []
+    for meta, (kd, kv) in zip(sh.columns[:K], keys_out):
+        cols.append(DColumn(meta.name, meta.dtype, kd, kv,
+                            meta.dictionary, meta.arrow_type))
+    idt = Type.INT64 if jax.config.jax_enable_x64 else Type.INT32
+    for (name, _, op), (est, valid), kind in zip(st.base_meta, outs,
+                                                 st.kinds):
+        out_name = sketch_output_name(name, op)
+        if kind == "distinct":
+            cols.append(DColumn(out_name, DataType(idt),
+                                est.astype(jnp.int64
+                                           if jax.config.jax_enable_x64
+                                           else jnp.int32), None))
+        else:
+            cols.append(DColumn(out_name, DataType(Type.FLOAT), est,
+                                valid))
+    return DTable(comb.ctx, cols, sh.cap, counts)
+
+
 def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
                          where, dense_key_range,
                          emit_empty: bool = False) -> DTable:
@@ -1577,6 +1755,8 @@ def _dist_groupby_preagg(dt: DTable, key_ids: List[int], aggregations,
         comb = dist_groupby(part, list(range(K)), comb_aggs,
                             dense_key_range=dense_key_range,
                             pre_aggregate=False)
+    _note_plain_state(dt, aggregations, partial, plan, comb, K,
+                      dense_key_range)
     return _recompose_partials(dt, aggregations, plan, comb, K)
 
 
@@ -1795,6 +1975,8 @@ def _fused_psum_groupby(dt: DTable, key_ids: List[int], aggregations,
                                                        op)),
                              lane, None))
     comb = DTable(dt.ctx, pcols, out_cap, counts_out)
+    _note_plain_state(dt, aggregations, partial, plan, comb,
+                      len(key_ids), None)
     return _recompose_partials(dt, aggregations, plan, comb,
                                len(key_ids))
 
@@ -1900,6 +2082,8 @@ def dist_groupby_fused(dt: DTable, key_columns: Sequence[Union[int, str]],
     comb = dist_groupby(sh, list(range(K)), comb_aggs,
                         dense_key_range=dense_key_range,
                         pre_aggregate=False, _local_only=True)
+    _note_plain_state(dt, aggregations, partial, plan, comb, K,
+                      dense_key_range)
     return _recompose_partials(dt, aggregations, plan, comb, K)
 
 
@@ -2250,6 +2434,7 @@ def dist_groupby_sketch(dt: DTable,
         keys_out, outs, counts = fn(
             sh.counts, key_leaves, _sketch_state_groups(sh, K, kinds))
         sp.sync(outs)
+    _note_sketch_state(dt, aggregations, sh, K, kinds, qs)
     cols = []
     for i, (kd, kv) in zip(key_ids, keys_out):
         c = dt._columns[i]

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, replace
-from typing import Any, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -114,6 +114,16 @@ class DTable:
         self.pending_mask = pending_mask   # [P*cap] bool or None
         self.pending_cnts = pending_cnts   # replicated [P] survivor counts
         self._counts_host: Optional[np.ndarray] = None
+        # content-signature epoch (docs/serving.md "Materialized
+        # subplans"): bumped on every logical-content change made
+        # through the ingest path (append).  Materialized views record
+        # the epoch of every base handle at capture time; a mismatch at
+        # probe time invalidates.  _deltas holds the last few appended
+        # batches keyed by the epoch they created, so a view whose tail
+        # is a mergeable aggregation can fold forward in O(delta)
+        # instead of recomputing.
+        self._epoch: int = 0
+        self._deltas: Dict[int, "DTable"] = {}
 
     # -- the host tier (docs/out_of_core.md) ---------------------------------
 
@@ -614,6 +624,71 @@ class DTable:
                      self.pending_cnts)
         out._counts_host = self._counts_host  # same rows, same counts
         return out
+
+    # -- the ingest-delta path (docs/serving.md) -----------------------------
+
+    @property
+    def content_epoch(self) -> int:
+        """Monotone logical-content version of this handle.  Layout
+        changes (compaction, spill round trips) do NOT bump it; only
+        the ingest path (:meth:`append`) does."""
+        return self._epoch
+
+    def delta_for(self, epoch: int) -> Optional["DTable"]:
+        """The appended batch that moved this table TO ``epoch``, if
+        still retained — the input to a materialized view's O(delta)
+        fold (serve/matview.py)."""
+        return self._deltas.get(epoch)
+
+    # how many appended batches stay reachable for folding.  A view
+    # more than this many epochs behind recomputes instead — bounded so
+    # a table ingesting forever does not retain its whole history.
+    _DELTA_KEEP = 8
+
+    def append(self, other: "DTable") -> "DTable":
+        """UNION ALL ``other``'s rows into THIS handle, in place.
+
+        This is the serving ingest path: identity-preserving — every
+        holder of this handle (session tables, plans captured by
+        value) observes the grown table — so it composes with the
+        serving tier's id()-keyed runtime signatures.  The merge
+        round-trips through Arrow (decode → concat → re-distribute),
+        which re-buckets capacity and rebuilds dictionary columns as
+        the sorted-unique superset; O(n+delta) host work, same as
+        ingest.  The *view* maintenance this enables is O(delta): the
+        appended batch is registered under the new content epoch and
+        :class:`~cylon_tpu.serve.matview.ViewStore` folds it through
+        the mergeable combine kernels instead of recomputing.
+
+        Returns ``self`` (for chaining).
+        """
+        import pyarrow as pa
+
+        self.verify_same_schema(other)
+        merged = pa.concat_tables(
+            [self.to_table().to_arrow(), other.to_table().to_arrow()]
+        ).combine_chunks()
+        grown = DTable.from_arrow(self.ctx, merged)
+        if self._spill_entry is not None:
+            # the pooled host copy describes the PRE-append contents;
+            # drop it rather than fault stale bytes back in later
+            from ..spill.pool import get_pool
+            get_pool().drop_entry(self._spill_entry.sig)
+        self._spill_entry = None
+        self._spill_sig = None
+        self._columns = grown._columns
+        self.cap = grown.cap
+        self._counts = grown._counts
+        self.pending_mask = None
+        self.pending_cnts = None
+        self._counts_host = grown._counts_host
+        self._epoch += 1
+        self._deltas[self._epoch] = other
+        for e in sorted(self._deltas):
+            if len(self._deltas) <= self._DELTA_KEEP:
+                break
+            del self._deltas[e]
+        return self
 
     def explain(self, plan=None, *, tables=None, validate: bool = False,
                 concrete=(), analyze: bool = False,

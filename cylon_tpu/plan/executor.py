@@ -56,6 +56,7 @@ plan/ir.py) or the tree fails lint.
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .. import faults, trace
@@ -1031,6 +1032,33 @@ def _execute_recovering(builder, opt_root: Node, pre_nodes: List[Node],
 # materialize
 # ---------------------------------------------------------------------------
 
+# materialization-root capture (serve/matview.py): while a collector
+# is open on this thread, every materialized PRE-rewrite root — full
+# runtime attached, so scan nodes still reference their DTables — is
+# handed to the sink.  The cached/frozen copy would be useless for
+# foldability analysis (``_frozen_copy`` strips runtime); this hook
+# exists precisely because the pre-rewrite root is only reachable
+# here.  One thread-local read when no collector is open.
+_roots_tls = threading.local()
+
+
+@contextmanager
+def collect_roots():
+    prev = getattr(_roots_tls, "sink", None)
+    sink: List[Node] = []
+    _roots_tls.sink = sink
+    try:
+        yield sink
+    finally:
+        _roots_tls.sink = prev
+
+
+def _note_root(root: Node) -> None:
+    sink = getattr(_roots_tls, "sink", None)
+    if sink is not None:
+        sink.append(root)
+
+
 def materialize(builder, root: Node):
     """Optimize + execute the captured DAG under ``root``; returns the
     concrete DTable (or local Table for dist_aggregate / dist_head
@@ -1038,6 +1066,7 @@ def materialize(builder, root: Node):
     hit = builder.memo_get(root)
     if hit is not None:
         return hit
+    _note_root(root)
     pre_nodes, _ = _preorder(root)
     for i, n in enumerate(pre_nodes):
         n.origin_idx = i

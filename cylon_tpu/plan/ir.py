@@ -161,6 +161,74 @@ def topo(root: Node) -> List[Node]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# foldable-tail detection (serve/matview.py "incremental maintenance"):
+# a materialized view folds an appended delta in O(delta) only when its
+# plan is ROW-LINEAR — the result over base ∪ delta equals the merge of
+# the result over base and the result over delta.  That holds when the
+# tail is a mergeable aggregation (partial sums/counts/min/max, sketch
+# lanes — arXiv:2010.14596's merge contract) and every op beneath it
+# distributes over row-set union: per-row ops trivially, inner join
+# because (A ∪ dA) ⋈ B = (A ⋈ B) ∪ (dA ⋈ B) when only ONE side grew.
+# Semi/anti joins, set ops and outer joins are NOT per-side linear (a
+# delta on the right can change which EXISTING left rows survive), so
+# they force invalidate-on-append.
+# ---------------------------------------------------------------------------
+
+FOLDABLE_AGG_TAILS = frozenset({
+    "dist_groupby", "dist_groupby_fused", "dist_groupby_sketch",
+})
+
+FOLD_LINEAR_OPS = frozenset({
+    "scan", "rename", "dist_select", "dist_project", "dist_with_column",
+    "shuffle_table", "morsel_scan",
+})
+
+
+def fold_analysis(root: Node):
+    """Walk the PRE-rewrite DAG under ``root`` (full runtime attached —
+    the executor's ``collect_roots`` hook hands exactly that) and
+    return ``(bases, foldable, scan_counts)``:
+
+    * ``bases`` — ``id(dtable) -> dtable`` for every DTable the plan
+      reads: scan payloads plus any DTable riding another op's runtime
+      (a table-valued predicate parameter).  This is the view's
+      invalidation frontier — a content-epoch mismatch on ANY of these
+      at probe time means the cached result no longer reflects its
+      inputs.
+    * ``foldable`` — the tail is a mergeable aggregation over a
+      row-linear DAG (see above).  Runtime-payload tables void
+      linearity: they are invisible to the row-set algebra.
+    * ``scan_counts`` — ``id(dtable) -> scan-node count``.  Folding an
+      append to a base scanned TWICE is unsound even in a linear plan
+      (the self-join cross terms ``dA ⋈ dA`` never appear in a
+      single-delta rerun), so the view store only folds bases with
+      exactly one scan."""
+    bases: Dict[int, Any] = {}
+    scan_counts: Dict[int, int] = {}
+    foldable = root.op in FOLDABLE_AGG_TAILS
+    for node in topo(root):
+        if node.op == "scan":
+            dt = node.runtime.get("dtable")
+            if dt is not None:
+                bases[id(dt)] = dt
+                scan_counts[id(dt)] = scan_counts.get(id(dt), 0) + 1
+            continue
+        for v in node.runtime.values():
+            if _is_dtable(v):
+                bases[id(v)] = v
+                foldable = False
+        if node is root:
+            continue
+        if node.op == "dist_join":
+            if node.static.get("how") != "inner":
+                foldable = False
+            continue
+        if node.op not in FOLD_LINEAR_OPS:
+            foldable = False
+    return bases, foldable, scan_counts
+
+
 def is_stage_boundary(node: Node) -> bool:
     """Is ``node`` a recovery STAGE boundary?  The exchange-shaped ops
     are the sanctioned failure points (docs/robustness.md: every

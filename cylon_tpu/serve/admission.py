@@ -48,7 +48,19 @@ import numpy as np
 # contract explicit if one is ever added).
 GUARDED_STATE: Dict[str, str] = {}
 
-__all__ = ["price_table", "price_query", "admit", "scaled_budget"]
+__all__ = ["price_table", "price_query", "admit", "scaled_budget",
+           "PROBE_PRICE"]
+
+# What a probable materialized-view hit prices: ~0.  A view-served
+# query dispatches NO exchange — it rebuilds its result from pooled
+# host blocks (serve/matview.py) — so charging it the worst-exchange
+# price would defer real work behind queries that will never use the
+# budget.  The session stamps this at submit time when the store's
+# would_hit() says a live view covers the fingerprint; the signal is
+# advisory (the view can evict or invalidate before dispatch), which
+# is exactly the over-admission tolerance admission already grants the
+# head-of-line query.
+PROBE_PRICE = 0
 
 
 def scaled_budget(base: int, world: int, base_world: int) -> int:

@@ -8,7 +8,12 @@ single session shrinks and re-grows one mesh, a fleet trades whole
 replicas in and out.  Placement is decided per query, in O(replicas),
 from host-side evidence only:
 
-  * **plan-cache affinity first** — a fingerprint that already ran
+  * **live-view affinity first** — a replica whose materialized-view
+    store holds a live view for this fingerprint
+    (:meth:`ServeSession.holds_view`) answers from pooled host blocks
+    with zero exchanges, so it outranks every other signal
+    (``serve.router_view_affinity_hits``).
+  * **plan-cache affinity next** — a fingerprint that already ran
     routes back to the replica that compiled it, read from the SHARED
     run-stats store (``observe.stats.STORE``, the ``replica`` field
     ``set_replica`` stamps after each successful placement).  A hot
@@ -123,26 +128,37 @@ class FleetRouter:
             return set(self._draining)
 
     def _place(self, op: Callable):
-        """Return ``(session, affinity_hit, failed_over)`` — the
-        placement decision and its evidence."""
+        """Return ``(session, affinity_hit, view_hit, failed_over)`` —
+        the placement decision and its evidence.  A replica holding a
+        LIVE materialized view for this fingerprint outranks plan-cache
+        affinity: the view replica answers from pooled host blocks with
+        zero exchanges (docs/serving.md "Materialized subplans"), where
+        the compiled-plan replica still executes — so the view is the
+        cheaper home whenever both exist and the former is healthy."""
         affinity = self.replica_of(op)
+        view = next((s.name for s in self._sessions.values()
+                     if s.holds_view(op)), None)
+        preferred = [n for n in (view, affinity) if n is not None]
         order: List[ServeSession] = []
-        if affinity is not None:
-            order.append(self._sessions[affinity])
+        for n in preferred:
+            if n not in (s.name for s in order):
+                order.append(self._sessions[n])
         # least priced-bytes load first among the rest — ties break on
         # name for determinism
         rest = sorted((s for s in self._sessions.values()
-                       if s.name != affinity),
+                       if s.name not in preferred),
                       key=lambda s: (s.load_bytes(), s.name))
         order.extend(rest)
         for i, s in enumerate(order):
             if self._healthy(s, op):
-                hit = affinity is not None and i == 0
-                failed_over = affinity is not None and i > 0
-                return s, hit, failed_over
+                view_hit = view is not None and s.name == view
+                hit = (affinity is not None and s.name == affinity)
+                failed_over = bool(preferred) and i > 0 and not (
+                    view_hit or hit)
+                return s, hit, view_hit, failed_over
         # every replica is out: surface the preferred replica's state
         # as a typed error instead of silently queueing on a corpse
-        return order[0], False, False
+        return order[0], False, False, False
 
     def submit(self, op: Callable, tables=_UNSET, **kw) -> QueryHandle:
         """Place ``op`` on a replica and ``submit`` it there; returns
@@ -153,10 +169,12 @@ class FleetRouter:
         session tables and ops closing over none."""
         from ..observe import flightrec
         from ..observe import stats as obstats
-        s, hit, failed_over = self._place(op)
+        s, hit, view_hit, failed_over = self._place(op)
         trace.count("serve.router_routed")
         if hit:
             trace.count("serve.router_affinity_hits")
+        if view_hit:
+            trace.count("serve.router_view_affinity_hits")
         if failed_over:
             trace.count("serve.router_failovers")
             flightrec.note("router_failover", to=s.name,

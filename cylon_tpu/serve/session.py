@@ -35,6 +35,7 @@ deltas, and the list of subplans served from the shared memo — the
 from __future__ import annotations
 
 import heapq
+import os
 import threading
 import time
 from collections import deque
@@ -133,7 +134,7 @@ class QueryHandle:
                  "trace_id", "admitted_at", "queue_wait_ms",
                  "plan_digests", "deadline_ms", "deadline_missed",
                  "compile_ms", "priority", "breaker_key", "probe",
-                 "recovered")
+                 "recovered", "view")
 
     def __init__(self, qid: int, label: str, op: Callable, tables,
                  export: Optional[Callable],
@@ -159,6 +160,10 @@ class QueryHandle:
         # mid-flight (attributed directly, NOT via the counter
         # registry — stats() self-accounts with counters off)
         self.recovered = False
+        # how the materialized-view store served this query: None
+        # (full execution), "hit" (rebuilt from pooled blocks, zero
+        # exchanges) or "fold" (delta-folded aggregation state)
+        self.view: Optional[str] = None
         # per-query SLO deadline (submit(deadline_ms=...)): checked at
         # finish time against the submit→finish latency; a miss stamps
         # deadline_missed and bumps serve.slo_violations on the session
@@ -273,6 +278,10 @@ class _SharedExecMemo(dict):
         self._session = session
         self._owner: Dict[Any, QueryHandle] = {}
         self._current: Optional[QueryHandle] = None
+        # content signatures that earned a cross-query hit THIS window
+        # — the hot set the view store harvests at window end
+        # (docs/serving.md "Materialized subplans")
+        self._shared_keys: set = set()
 
     def begin_query(self, handle: QueryHandle) -> None:
         self._current = handle
@@ -285,13 +294,36 @@ class _SharedExecMemo(dict):
         self._owner.pop(key, None)
         return dict.pop(self, key, *default)
 
+    def __contains__(self, key) -> bool:
+        # cross-window carry: a miss consults the session's view store
+        # for a subplan a PREVIOUS window harvested; a valid carried
+        # entry faults in here (epoch-checked, pool-rebuilt) so the
+        # executor's root-down coverage pass sees it exactly like an
+        # in-window memo entry.  Inserted via dict.__setitem__ — no
+        # owner — so in-window share accounting never double-counts it.
+        if dict.__contains__(self, key):
+            return True
+        vs = self._session._views
+        if vs is None:
+            return False
+        fetched = vs.fetch_subplan(key)
+        if fetched is None:
+            return False
+        dict.__setitem__(self, key, fetched)
+        if self._current is not None:
+            self._current.shared_subplans.append(fetched[0].op)
+        return True
+
     def get(self, key, default=None):
+        if not dict.__contains__(self, key):
+            self.__contains__(key)   # may fault a carried subplan in
         hit = dict.get(self, key, default)
         if hit is not None:
             owner = self._owner.get(key)
             if owner is not None and owner is not self._current:
                 trace.count("serve.subplan_shared")
                 self._session._tally("subplan_shared")
+                self._shared_keys.add(key)
                 if self._current is not None:
                     self._current.shared_subplans.append(hit[0].op)
         return hit
@@ -562,7 +594,9 @@ class ServeSession:
                  breaker_cooldown_s: float = 5.0,
                  shed_depth: Optional[int] = None,
                  tail_keep_k: Optional[int] = 16,
-                 tail_window: int = 128) -> None:
+                 tail_window: int = 128,
+                 views: Optional[bool] = None,
+                 pipelined: Optional[bool] = None) -> None:
         if batch_window_ms < 0:
             raise CylonError(Status(Code.Invalid,
                 f"batch_window_ms must be >= 0, got {batch_window_ms}"))
@@ -619,7 +653,25 @@ class ServeSession:
             "slo_violations": 0, "shed": 0, "breaker_rejected": 0,
             "breaker_probes": 0, "recovered": 0, "mesh_degraded": 0,
             "mesh_expanded": 0, "capacity_requests": 0,
+            "view_hits": 0, "view_folds": 0, "view_invalidations": 0,
+            "view_subplan_hits": 0,
         }
+        # the cross-window materialized-view store (serve/matview.py;
+        # docs/serving.md "Materialized subplans"): ctor arg > env
+        # CYLON_MATVIEW (default on).  Pipelined dispatch (ctor arg >
+        # CYLON_SERVE_PIPELINE, default on) additionally needs the
+        # export pipeline — clean view hits are host-phase-only, so
+        # the window dispatches them onto its workers while compute
+        # queries run on the dispatcher, overlapping the two.
+        from . import matview
+        if views is None:
+            views = matview.matview_enabled()
+        self._views = matview.ViewStore(self) if views else None
+        if pipelined is None:
+            pipelined = os.environ.get(
+                "CYLON_SERVE_PIPELINE", "1") not in ("", "0")
+        self._pipe_dispatch = bool(pipelined and self._views is not None
+                                   and self._pipeline is not None)
         # elastic degraded-mesh state (docs/robustness.md
         # "Elasticity"): the session polls the topology epoch each
         # dispatcher turn — a mid-query device loss flips it into
@@ -772,6 +824,14 @@ class ServeSession:
             h.breaker_key = bkey
             h.probe = bool(probe)
             h.priced_bytes = admission.price_query(tabs)
+            if (self._views is not None and h.priced_bytes
+                    and self._views.would_hit(op, tabs)):
+                # a probable view hit never dispatches an exchange —
+                # it rebuilds from pooled host blocks — so it must not
+                # consume the window's exchange budget and defer real
+                # work behind it.  Advisory: the view can evict before
+                # dispatch, and the probe re-validates (matview.py).
+                h.priced_bytes = admission.PROBE_PRICE
             self._tally("submitted")
             if not self._queue.put(h, block=block, timeout=timeout):
                 trace.count("serve.rejected")
@@ -818,6 +878,28 @@ class ServeSession:
         """``submit`` + ``result`` — the synchronous convenience form."""
         return self.submit(op, tables, export=export,
                            label=label).result(timeout)
+
+    def ingest(self, name: str, delta, *, block: bool = True,
+               timeout: Optional[float] = None) -> QueryHandle:
+        """Append ``delta`` to the session base table ``name`` THROUGH
+        the dispatcher (docs/serving.md "Materialized subplans" —
+        staleness model).  Routing writes through the queue serializes
+        them against query execution on the one dispatcher thread:
+        no query ever observes a half-applied append, every query
+        admitted after the ingest completes observes it (the bench's
+        measured visibility lag), and the table's content epoch bumps
+        exactly once per batch — which is what the view store folds
+        on.  Writes ride ``priority=1`` so load shedding never drops
+        data."""
+        if not isinstance(self._tables, dict) or name not in self._tables:
+            raise CylonError(Status(Code.Invalid,
+                f"serve: no session base table named {name!r} to "
+                "ingest into"))
+        base = self._tables[name]
+        return self.submit(
+            lambda base=base, delta=delta: base.append(delta),
+            None, label=f"ingest:{name}", block=block, timeout=timeout,
+            priority=1)
 
     def stats(self) -> Dict[str, Any]:
         """Session-level tallies + latency percentiles (independent of
@@ -886,6 +968,15 @@ class ServeSession:
         deferred half is tolerated by design."""
         return self._queue.priced_bytes() + self._pending_bytes
 
+    def holds_view(self, op: Callable) -> bool:
+        """Whether this session's materialized-view store holds a live
+        view for ``op``'s fingerprint — the fleet router's view-
+        residency affinity signal (serve/router.py): routing a repeat
+        query to the replica that can serve it from pooled blocks
+        beats routing by load alone.  O(entries) over host bookkeeping."""
+        return (self._views is not None
+                and self._views.holds_view_for(op))
+
     def close(self) -> None:
         """Stop accepting queries, drain everything queued, stop the
         dispatcher and export lane.  Idempotent."""
@@ -902,6 +993,10 @@ class ServeSession:
         self._fail_stragglers()
         if self._pipeline is not None:
             self._pipeline.close()
+        if self._views is not None:
+            # release the retained views' host-budget bytes — the pool
+            # is process-level, the store was per-session
+            self._views.clear()
 
     def drain(self) -> Dict[str, Any]:
         """Graceful shutdown (docs/serving.md "drain"): stop admitting
@@ -978,6 +1073,11 @@ class ServeSession:
         if ep == self._topology_epoch:
             return
         self._topology_epoch = ep
+        if self._views is not None:
+            # pooled view blocks are laid out for the mesh that staged
+            # them ([P*cap] shard-major); any topology change makes
+            # them unloadable — purge rather than serve a wrong shape
+            self._views.clear()
         eff = topology.effective(self.ctx)
         world = eff.get_world_size()
         prev = self._last_world
@@ -1102,9 +1202,36 @@ class ServeSession:
             trace.gauge("serve.queue_depth",
                         len(pending) + len(self._queue))
             memo = _SharedExecMemo(self)
+            if self._views is not None:
+                self._views.begin_window()
             with trace.span("serve.window"):
-                for h in admitted:
+                run_now = admitted
+                if self._pipe_dispatch:
+                    # pipelined dispatch (docs/serving.md "Materialized
+                    # subplans"): clean view hits are host-phase-only
+                    # (pool lookup + H2D stage-in + export) — dispatch
+                    # them onto the export pipeline's workers NOW, so
+                    # they overlap the device phases of the window's
+                    # compute queries instead of serializing behind
+                    # them.  pin() validates epochs on this thread (the
+                    # staleness model's snapshot instant) and holds the
+                    # pool entry so eviction cannot race the worker.
+                    run_now = []
+                    for h in admitted:
+                        if self._views.pin(h):
+                            h.status = "running"
+                            self._pipeline.submit(
+                                lambda h=h: self._serve_overlapped(h),
+                                trace_id=h.trace_id)
+                        else:
+                            run_now.append(h)
+                for h in run_now:
                     self._execute_one(h, memo)
+            if self._views is not None:
+                # window-end harvest: subplans that earned a cross-
+                # query hit this window persist into the pool for the
+                # NEXT window's memo to fault in
+                self._views.harvest(memo)
             # the memo dies with the window: its pinned results stay
             # live only while still referenced by handles/exports
 
@@ -1118,6 +1245,34 @@ class ServeSession:
         deltas: Dict[str, int] = {}
         cevents: list = []
         recoveries: list = []
+        if self._views is not None:
+            # probe-before-execute (docs/serving.md "Materialized
+            # subplans"): a live view serves this query from pooled
+            # host blocks (zero exchanges) or folds pending deltas
+            # through its captured aggregation state; any probe
+            # failure falls through to a full execution — the cache
+            # must never fail a query it cannot serve
+            probe_deltas: Dict[str, int] = {}
+            served = None
+            try:
+                with trace.trace_context(h.trace_id), \
+                        resilience.counter_scope(probe_deltas):
+                    with trace.span("serve.query"):
+                        served = self._views.probe(h)
+            except Exception:  # graftlint: ok[broad-except] — the
+                # probe is pure cache; its errors degrade to recompute
+                served = None
+            if served is not None:
+                out, mode = served
+                h.view = mode
+                h.counters = probe_deltas
+                h.compile_ms = 0.0
+                h.execute_ms = (time.perf_counter()
+                                - h.started_at) * 1e3
+                self._deliver(h, out)
+                return
+        roots: list = []
+        vstates: list = []
         try:
             # the query's trace id wraps the WHOLE execution: the
             # serve.query span and every nested operator phase land on
@@ -1151,7 +1306,17 @@ class ServeSession:
                         ensure_current(h.tables)
                     wrapped = (b.wrap_tables(h.tables)
                                if h.tables is not None else None)
-                    with ir.capture(b):
+                    # view capture rides the execution: the executor's
+                    # root hook hands every pre-rewrite root (the
+                    # foldability walk needs runtime-attached scans),
+                    # the dist-ops hook hands each mergeable
+                    # aggregation state it was computing anyway —
+                    # both one thread-local read when idle
+                    from ..parallel import dist_ops as _dops
+                    from ..plan import executor as _pexec
+                    with ir.capture(b), \
+                            _pexec.collect_roots() as roots, \
+                            _dops.collect_agg_state() as vstates:
                         out = (h.op(wrapped) if h.tables is not None
                                else h.op())
                         out = b.finish(out)
@@ -1178,6 +1343,16 @@ class ServeSession:
             obstats.STORE.record_run(d, counters=deltas,
                                      latency_ms=h.execute_ms,
                                      label=h.label)
+        if self._views is not None:
+            try:
+                self._views.offer(h, out, roots, vstates)
+            except Exception:  # graftlint: ok[broad-except] —
+                # retention is pure cache; a failed offer must never
+                # fail a query that just executed successfully
+                pass
+        self._deliver(h, out)
+
+    def _deliver(self, h: QueryHandle, out) -> None:
         if h.export is not None and self._pipeline is not None:
             trace.count("serve.exports_async")
             self._tally("exports_async")
@@ -1186,6 +1361,37 @@ class ServeSession:
                 lambda h=h, out=out: self._run_export(h, out),
                 trace_id=h.trace_id)
         elif h.export is not None:
+            self._run_export(h, out)
+        else:
+            self._finish(h, value=out)
+
+    def _serve_overlapped(self, h: QueryHandle) -> None:
+        """Serve one pinned view hit on an export-pipeline worker —
+        the host half of pipelined dispatch.  The pin (taken on the
+        dispatcher at window admission) holds the pooled blocks, so
+        the only failure mode here is an injected stage-in fault; that
+        degrades by requeueing the query for the next window's serial
+        recompute path — never a failed or stale answer."""
+        h.started_at = time.perf_counter()
+        try:
+            with trace.trace_context(h.trace_id):
+                with trace.span("serve.query"):
+                    out = self._views.serve_pinned(h)
+        except Exception:  # graftlint: ok[broad-except] — pure-cache
+            # degrade: recompute via requeue instead of failing
+            self._views.unpin(h)
+            h.status = "queued"
+            if not self._queue.put(h, block=False):
+                self._finish(h, error=CylonError(Status(
+                    Code.CapacityError,
+                    "serve: pipelined view serve failed and the queue "
+                    "is full — cannot requeue for recompute")))
+            return
+        h.view = "hit"
+        h.counters = {}
+        h.compile_ms = 0.0
+        h.execute_ms = (time.perf_counter() - h.started_at) * 1e3
+        if h.export is not None:
             self._run_export(h, out)
         else:
             self._finish(h, value=out)

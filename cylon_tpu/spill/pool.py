@@ -340,6 +340,65 @@ class SpillPool:
             dt._spill_entry = None
             trace.count("spill.faultins")
 
+    # -- retained materialized views (serve/matview.py) ----------------------
+
+    def retain_view(self, dt) -> Optional[int]:
+        """Stage a materialized view's leaves into an UNPINNED entry —
+        LRU-evictable cache sharing the one host budget with every
+        spilled table — and return its signature.  A view is PURE
+        cache (its loss costs a recompute, never data), so over-budget
+        retention DECLINES (returns None) instead of raising the
+        pinned-set OOM, and an injected ``spill.stage_out`` fault
+        declines the same way.  Already-spilled tables reuse their
+        existing pooled entry."""
+        with self._lock:
+            if dt._spill_entry is not None:
+                return dt._spill_entry.sig
+            dt._collapse_pending()
+            counts = np.asarray(dt.counts_host()).copy()
+            cols = dt._columns
+            flat = []
+            for c in cols:
+                flat.append(c.data)
+                if c.validity is not None:
+                    flat.append(c.validity)
+            need = sum(int(lf.nbytes) for lf in flat)
+            if self._pinned_bytes_locked() + need > host_memory_budget():
+                return None
+            try:
+                self._admit_locked(need)
+                hosts = stage_out_arrays(flat)
+            except CylonError:
+                return None
+            leaves = []
+            hi = 0
+            for c in cols:
+                d = hosts[hi]
+                hi += 1
+                v = None
+                if c.validity is not None:
+                    v = hosts[hi]
+                    hi += 1
+                leaves.append((d, v))
+            entry = _Entry(next(_sig_counter), tuple(leaves), counts,
+                           dt.cap)
+            entry.pinned = False
+            self._entries[entry.sig] = entry
+            trace.count_max("spill.host_bytes_peak",
+                            self._total_bytes_locked())
+            return entry.sig
+
+    def view_entry(self, sig: int) -> Optional[_Entry]:
+        """LRU-touch lookup of a retained view entry — None once the
+        budget's eviction loop reclaimed it (the view store treats
+        that as a miss and recomputes)."""
+        with self._lock:
+            e = self._entries.get(sig)
+            if e is not None:
+                self._entries.pop(sig)
+                self._entries[sig] = e
+            return e
+
     def drop_entry(self, sig: int) -> None:
         """Forget one pooled entry by signature — the elastic re-mesh
         (parallel/remesh.py) rebuilds a spilled table's layout from the

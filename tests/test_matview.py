@@ -1,0 +1,558 @@
+"""Cross-window materialized subplans + incremental view maintenance
+(cylon_tpu/serve/matview.py; docs/serving.md "Materialized subplans").
+
+The acceptance contract (ISSUE 20):
+
+  * a repeated query is served from its materialized view on the next
+    batch window — row-identical and with strictly fewer exchanges;
+  * ``ServeSession.ingest`` appends FOLD through the view's captured
+    aggregation state (sum/count/mean/min/max partials and HLL /
+    bottom-k sketches) in O(delta), row-identical (or within the
+    sketch's advertised bound) to a cold recompute over base + delta;
+  * a base-table change under a NON-foldable view invalidates — the
+    next query recomputes and never returns stale rows;
+  * retained views share the spill pool's host budget: over-budget
+    retention declines, and the LRU evicts cold views first;
+  * an injected ``matview.fold`` fault degrades to invalidate + full
+    recompute — row-identical, never a half-folded answer;
+  * pipelined dispatch (view hits overlapped onto the export pipeline)
+    answers identically to serial dispatch.
+"""
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import config as cfg
+from cylon_tpu import faults
+from cylon_tpu import plan as planner
+from cylon_tpu import trace
+from cylon_tpu.observe import metrics as obmetrics
+from cylon_tpu.ops import sketch as ops_sketch
+from cylon_tpu.parallel import DTable, dist_groupby, shuffle_table
+from cylon_tpu.parallel.dist_ops import dist_groupby_sketch
+from cylon_tpu.serve import ServeSession
+
+
+@pytest.fixture(autouse=True)
+def _matview_isolation():
+    """Counter-only tracing + fresh plan cache around every test (the
+    serving-suite contract): assertions below read counters from
+    exactly this test's runs."""
+    planner.clear_plan_cache()
+    trace.enable_counters()
+    trace.reset()
+    yield
+    trace.disable_counters()
+    trace.reset()
+    planner.clear_plan_cache()
+
+
+def _frame(res) -> pd.DataFrame:
+    if not hasattr(res, "to_pandas"):
+        res = res.to_table()
+    df = res.to_pandas()
+    for c in df.columns:
+        if isinstance(df[c].dtype, pd.CategoricalDtype):
+            df[c] = df[c].astype(str)
+    return df
+
+
+def _assert_rowset_equal(got: pd.DataFrame, want: pd.DataFrame):
+    assert list(got.columns) == list(want.columns)
+    assert len(got) == len(want)
+    g = got.sort_values(list(got.columns)).reset_index(drop=True)
+    w = want.sort_values(list(want.columns)).reset_index(drop=True)
+    for c in g.columns:
+        if pd.api.types.is_float_dtype(w[c]):
+            gv = g[c].to_numpy(np.float64)
+            wv = w[c].to_numpy(np.float64)
+            both_nan = np.isnan(gv) & np.isnan(wv)
+            assert np.all(both_nan | np.isclose(gv, wv, rtol=1e-4,
+                                                atol=1e-4)), c
+        else:
+            assert g[c].astype(str).tolist() \
+                == w[c].astype(str).tolist(), c
+
+
+def _base_df(n=1200, groups=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, groups, n).astype(np.int64),
+        "v": rng.normal(size=n),
+        "w": rng.integers(0, 100, n).astype(np.int64)})
+
+
+# module-level plan callables: stable code identity across submissions
+# is what keys both the breaker fingerprint and the view store
+
+def _q_agg(t):
+    s = shuffle_table(t["fact"], ["k"])
+    return dist_groupby(s, ["k"], [("v", "sum"), ("v", "count"),
+                                   ("v", "mean"), ("w", "min"),
+                                   ("w", "max")])
+
+
+def _q_sum(t):
+    s = shuffle_table(t["fact"], ["k"])
+    return dist_groupby(s, ["k"], [("v", "sum"), ("v", "count")])
+
+
+def _q_mean(t):
+    s = shuffle_table(t["fact"], ["k"])
+    return dist_groupby(s, ["k"], [("v", "mean"), ("w", "max")])
+
+
+def _q_sort(t):
+    from cylon_tpu.parallel import dist_sort
+    return dist_sort(t["fact"], ["k", "v"])
+
+
+def _cold_agg(dctx, df, qfn=_q_agg):
+    """The engine's own cold answer over a FRESH table — fold parity is
+    against this (engine null/overflow semantics, not pandas')."""
+    return _frame(qfn({"fact": DTable.from_pandas(dctx, df)}))
+
+
+# ---------------------------------------------------------------------------
+# cross-window hits
+# ---------------------------------------------------------------------------
+
+def test_cross_window_hit_parity_and_fewer_exchanges(dctx):
+    base = _base_df()
+    dt = DTable.from_pandas(dctx, base)
+    with ServeSession(dctx, tables={"fact": dt},
+                      batch_window_ms=0.0) as s:
+        h1 = s.submit(_q_agg, label="w1")
+        r1 = _frame(h1.result(timeout=600))
+        h2 = s.submit(_q_agg, label="w2")
+        r2 = _frame(h2.result(timeout=600))
+        st = s.stats()
+    assert h1.view is None
+    assert h2.view == "hit"
+    ex1 = obmetrics.exchange_count(h1.counters)
+    ex2 = obmetrics.exchange_count(h2.counters)
+    assert ex1 >= 1 and ex2 < ex1, (ex1, ex2)
+    _assert_rowset_equal(r2, r1)
+    assert st["view_hits"] >= 1
+    assert trace.counters().get("serve.view_hits", 0) >= 1
+    assert trace.counters().get("matview.retained", 0) >= 1
+
+
+def test_view_disabled_never_serves_from_cache(dctx):
+    dt = DTable.from_pandas(dctx, _base_df())
+    with ServeSession(dctx, tables={"fact": dt}, batch_window_ms=0.0,
+                      views=False) as s:
+        h1 = s.submit(_q_sum, label="w1")
+        r1 = _frame(h1.result(timeout=600))
+        h2 = s.submit(_q_sum, label="w2")
+        r2 = _frame(h2.result(timeout=600))
+        st = s.stats()
+    assert h1.view is None and h2.view is None
+    assert st["view_hits"] == 0
+    _assert_rowset_equal(r2, r1)
+
+
+# ---------------------------------------------------------------------------
+# incremental maintenance: delta folds
+# ---------------------------------------------------------------------------
+
+def _fold_roundtrip(dctx, base, delta, qfn, label):
+    """window 1 executes, ingest appends, window 2 must FOLD; returns
+    (folded frame, view tag)."""
+    dt = DTable.from_pandas(dctx, base)
+    with ServeSession(dctx, tables={"fact": dt},
+                      batch_window_ms=0.0) as s:
+        s.submit(qfn, label=f"{label}-w1").result(timeout=600)
+        s.ingest("fact", DTable.from_pandas(dctx, delta)) \
+            .result(timeout=600)
+        h = s.submit(qfn, label=f"{label}-w2")
+        out = _frame(h.result(timeout=600))
+    return out, h.view
+
+
+def test_fold_parity_sum_count_mean_min_max_int_keys(dctx):
+    base = _base_df(seed=1)
+    delta = _base_df(n=150, seed=2)
+    out, view = _fold_roundtrip(dctx, base, delta, _q_agg, "plain")
+    assert view == "fold"
+    both = pd.concat([base, delta], ignore_index=True)
+    _assert_rowset_equal(out, _cold_agg(dctx, both))
+    assert trace.counters().get("matview.folds", 0) >= 1
+    assert trace.counters().get("matview.fold_rows", 0) >= len(delta)
+
+
+def test_fold_parity_dict_string_keys(dctx):
+    rng = np.random.default_rng(3)
+    cities = np.array(["auckland", "bern", "cairo", "dakar", "erbil"])
+
+    def mk(n, seed):
+        r = np.random.default_rng(seed)
+        return pd.DataFrame({"k": cities[r.integers(0, 5, n)],
+                             "v": r.normal(size=n),
+                             "w": r.integers(0, 100, n)
+                             .astype(np.int64)})
+    base, delta = mk(800, 4), mk(120, 5)
+    out, view = _fold_roundtrip(dctx, base, delta, _q_agg, "dictkey")
+    assert view == "fold"
+    both = pd.concat([base, delta], ignore_index=True)
+    _assert_rowset_equal(out, _cold_agg(dctx, both))
+
+
+def test_fold_parity_null_values(dctx):
+    def mk(n, seed):
+        r = np.random.default_rng(seed)
+        v = r.normal(size=n)
+        return pd.DataFrame({
+            "k": r.integers(0, 8, n).astype(np.int64),
+            "v": pd.array(np.where(r.random(n) < 0.25, None, v),
+                          dtype="Float64"),
+            "w": r.integers(0, 100, n).astype(np.int64)})
+    base, delta = mk(600, 6), mk(90, 7)
+    out, view = _fold_roundtrip(dctx, base, delta, _q_agg, "nulls")
+    assert view == "fold"
+    both = pd.concat([base, delta], ignore_index=True)
+    _assert_rowset_equal(out, _cold_agg(dctx, both))
+
+
+def test_fold_parity_composite_keys(dctx):
+    def mk(n, seed):
+        r = np.random.default_rng(seed)
+        return pd.DataFrame({
+            "k": r.integers(0, 6, n).astype(np.int64),
+            "k2": r.integers(0, 3, n).astype(np.int64),
+            "v": r.normal(size=n),
+            "w": r.integers(0, 100, n).astype(np.int64)})
+
+    def q(t):
+        s = shuffle_table(t["fact"], ["k", "k2"])
+        return dist_groupby(s, ["k", "k2"],
+                            [("v", "sum"), ("v", "mean"),
+                             ("w", "min"), ("w", "max")])
+    base, delta = mk(900, 8), mk(140, 9)
+    out, view = _fold_roundtrip(dctx, base, delta, q, "composite")
+    assert view == "fold"
+    both = pd.concat([base, delta], ignore_index=True)
+    _assert_rowset_equal(out, _cold_agg(dctx, both, qfn=q))
+
+
+def test_fold_sketch_within_advertised_bounds(dctx):
+    """HLL / bottom-k states are mergeable — folding a delta must land
+    inside the same advertised error bounds as a cold sketch run over
+    base + delta (exact equality is NOT promised: the sample a fold
+    keeps can differ from the one a recompute would draw)."""
+    def mk(n, seed):
+        r = np.random.default_rng(seed)
+        return pd.DataFrame({"g": r.integers(0, 4, n).astype(np.int64),
+                             "ids": r.integers(0, 2500, n)
+                             .astype(np.int64),
+                             "x": (r.standard_normal(n) * 40.0)
+                             .astype(np.float64)})
+
+    def q(t):
+        return dist_groupby_sketch(t["fact"], ["g"],
+                                   [("ids", "approx_distinct"),
+                                    ("x", "approx_quantile:0.5")])
+    base, delta = mk(6000, 10), mk(1200, 11)
+    out, view = _fold_roundtrip(dctx, base, delta, q, "sketch")
+    assert view == "fold"
+    both = pd.concat([base, delta], ignore_index=True)
+    exact_distinct = both.groupby("g")["ids"].nunique()
+    for _, r in out.iterrows():
+        e = exact_distinct[int(r["g"])]
+        rel = abs(int(r["approx_distinct_ids"]) - e) / e
+        assert rel <= ops_sketch.HLL_ERROR_BOUND, (r["g"], rel)
+        vals = np.sort(both[both["g"] == int(r["g"])]["x"].to_numpy())
+        rank = np.searchsorted(vals, float(r["p50_x"])) / len(vals)
+        assert abs(rank - 0.5) \
+            <= ops_sketch.QUANTILE_RANK_ERROR_BOUND, (r["g"], rank)
+
+
+# ---------------------------------------------------------------------------
+# invalidation + fallback: never stale
+# ---------------------------------------------------------------------------
+
+def test_invalidation_on_base_change_no_stale_rows(dctx):
+    """A NON-foldable view (sort tail) over a changed base must
+    invalidate: the next query recomputes and includes the appended
+    rows — a stale cached answer here is the one unforgivable bug."""
+    base = _base_df(n=400, seed=12)
+    dt = DTable.from_pandas(dctx, base)
+    delta = _base_df(n=60, seed=13)
+    with ServeSession(dctx, tables={"fact": dt},
+                      batch_window_ms=0.0) as s:
+        s.submit(_q_sort, label="w1").result(timeout=600)
+        h2 = s.submit(_q_sort, label="w2")
+        h2.result(timeout=600)
+        assert h2.view == "hit"   # unchanged base: sort views DO hit
+        s.ingest("fact", DTable.from_pandas(dctx, delta)) \
+            .result(timeout=600)
+        h3 = s.submit(_q_sort, label="w3")
+        r3 = _frame(h3.result(timeout=600))
+        st = s.stats()
+    assert h3.view is None        # invalidated, recomputed
+    assert len(r3) == len(base) + len(delta)
+    assert st["view_invalidations"] >= 1
+    assert trace.counters().get("matview.invalidations", 0) >= 1
+    # the recompute re-retained: a FOURTH query would hit again — and
+    # the folded world never shows a half-applied append
+    want = pd.concat([base, delta], ignore_index=True)
+    assert np.isclose(r3["v"].astype(np.float64).sum(),
+                      want["v"].sum(), rtol=1e-4)
+
+
+def test_non_foldable_join_tail_falls_back(dctx):
+    """An aggregation tail fed by anything outside the fold-linear set
+    must NOT fold — it degrades to invalidate + recompute with parity
+    (here: the aggregation is not the plan root)."""
+    def q(t):
+        from cylon_tpu.parallel import dist_select
+        s = shuffle_table(t["fact"], ["k"])
+        g = dist_groupby(s, ["k"], [("v", "sum"), ("v", "count")])
+        return dist_select(g, lambda c: c["sum_v"] > -1e18)
+    base = _base_df(n=500, seed=14)
+    delta = _base_df(n=80, seed=15)
+    out, view = _fold_roundtrip(dctx, base, delta, q, "nonfold")
+    assert view is None           # recomputed, not folded
+    both = pd.concat([base, delta], ignore_index=True)
+    _assert_rowset_equal(out, _cold_agg(dctx, both, qfn=q))
+    assert trace.counters().get("matview.folds", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# retention economics: budget + LRU
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction_under_pinned_host_budget(dctx):
+    """Two views that cannot coexist under a pinned
+    CYLON_HOST_MEMORY_BUDGET: retaining the second evicts the first
+    (LRU), the evicted view's next query recomputes (matview.lost) —
+    and every answer stays row-identical throughout."""
+    from cylon_tpu.spill.pool import get_pool
+    base = _base_df(n=2000, groups=512, seed=16)
+    cold_a = _cold_agg(dctx, base, qfn=_q_agg)
+    cold_b = _cold_agg(dctx, base, qfn=_q_mean)
+    # probe pass at ample budget: learn what the two retained views
+    # actually cost in the pool (session close purges them)
+    pool = get_pool()
+    dt = DTable.from_pandas(dctx, base)
+    with ServeSession(dctx, tables={"fact": dt},
+                      batch_window_ms=0.0) as s:
+        s.submit(_q_agg, label="probe-a").result(timeout=600)
+        s.submit(_q_mean, label="probe-b").result(timeout=600)
+        both_bytes = pool.host_bytes()
+    assert both_bytes > 0
+    # one byte short of BOTH: retaining the second view must evict the
+    # first (LRU) instead of declining or raising
+    prev = cfg.set_host_memory_budget(both_bytes - 1)
+    try:
+        dt = DTable.from_pandas(dctx, base)
+        with ServeSession(dctx, tables={"fact": dt},
+                          batch_window_ms=0.0) as s:
+            s.submit(_q_agg, label="a1").result(timeout=600)
+            s.submit(_q_mean, label="b1").result(timeout=600)
+            # B's retention evicted A from the pool (budget holds one)
+            h_a2 = s.submit(_q_agg, label="a2")
+            r_a2 = _frame(h_a2.result(timeout=600))
+            h_b2 = s.submit(_q_mean, label="b2")
+            r_b2 = _frame(h_b2.result(timeout=600))
+    finally:
+        cfg.set_host_memory_budget(prev)
+    assert h_a2.view is None      # evicted -> full recompute
+    assert trace.counters().get("matview.lost", 0) >= 1
+    _assert_rowset_equal(r_a2, cold_a)
+    _assert_rowset_equal(r_b2, cold_b)
+
+
+def test_zero_budget_declines_retention(dctx):
+    """Pure-cache contract: with no host headroom the store declines
+    retention instead of raising — every query still answers."""
+    base = _base_df(n=300, seed=17)
+    prev = cfg.set_host_memory_budget(1)
+    try:
+        dt = DTable.from_pandas(dctx, base)
+        with ServeSession(dctx, tables={"fact": dt},
+                          batch_window_ms=0.0) as s:
+            r1 = _frame(s.submit(_q_sum, label="w1").result(timeout=600))
+            h2 = s.submit(_q_sum, label="w2")
+            r2 = _frame(h2.result(timeout=600))
+    finally:
+        cfg.set_host_memory_budget(prev)
+    assert h2.view is None
+    _assert_rowset_equal(r2, r1)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the fold fault degrades, never lies
+# ---------------------------------------------------------------------------
+
+def test_chaos_fold_fault_degrades_to_recompute(dctx):
+    base = _base_df(n=600, seed=18)
+    delta = _base_df(n=90, seed=19)
+    dt = DTable.from_pandas(dctx, base)
+    plan = faults.FaultPlan(seed=0, rules=[
+        faults.FaultRule("matview.fold", kind="transient", once=True)])
+    with ServeSession(dctx, tables={"fact": dt},
+                      batch_window_ms=0.0) as s:
+        s.submit(_q_agg, label="w1").result(timeout=600)
+        s.ingest("fact", DTable.from_pandas(dctx, delta)) \
+            .result(timeout=600)
+        with faults.active(plan):
+            h2 = s.submit(_q_agg, label="w2-chaos")
+            r2 = _frame(h2.result(timeout=600))
+        # the degrade re-retained a fresh view: the NEXT append folds
+        delta2 = _base_df(n=70, seed=20)
+        s.ingest("fact", DTable.from_pandas(dctx, delta2)) \
+            .result(timeout=600)
+        h3 = s.submit(_q_agg, label="w3")
+        r3 = _frame(h3.result(timeout=600))
+    assert h2.view is None        # degraded to full recompute
+    assert trace.counters().get("matview.fold_failures", 0) == 1
+    both = pd.concat([base, delta], ignore_index=True)
+    _assert_rowset_equal(r2, _cold_agg(dctx, both))
+    assert h3.view == "fold"      # the machinery recovered
+    all3 = pd.concat([base, delta, delta2], ignore_index=True)
+    _assert_rowset_equal(r3, _cold_agg(dctx, all3))
+
+
+# ---------------------------------------------------------------------------
+# pipelined dispatch
+# ---------------------------------------------------------------------------
+
+def _burst(s, qfn, n, label):
+    handles = []
+    hlock = threading.Lock()
+
+    def client(i):
+        h = s.submit(qfn, label=f"{label}-{i}")
+        with hlock:
+            handles.append(h)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return [(h, _frame(h.result(timeout=600))) for h in handles]
+
+
+def test_pipelined_dispatch_parity_with_serial(dctx):
+    """Overlapped view serving (hits pinned on the dispatcher, served
+    on the export pipeline while compute queries run) answers
+    row-identically to the serial dispatch path."""
+    base = _base_df(n=900, seed=21)
+    want = _cold_agg(dctx, base, qfn=_q_agg)
+    for pipelined in (False, True):
+        dt = DTable.from_pandas(dctx, base)
+        with ServeSession(dctx, tables={"fact": dt},
+                          batch_window_ms=25.0,
+                          pipelined=pipelined) as s:
+            s.submit(_q_agg, label="warm").result(timeout=600)
+            results = _burst(s, _q_agg, 6, "p" if pipelined else "s")
+            st = s.stats()
+        for h, got in results:
+            _assert_rowset_equal(got, want)
+        assert st["view_hits"] >= 1, pipelined
+
+
+# ---------------------------------------------------------------------------
+# cross-window subplan carry
+# ---------------------------------------------------------------------------
+
+def test_cross_window_subplan_carry(dctx):
+    """A subplan SHARED inside one window (the exchange both queries
+    reuse) survives the window through the pool: a THIRD query with
+    the same prefix in a LATER window rebuilds it from pooled blocks
+    instead of re-executing the exchange
+    (``serve.view_subplan_hits``)."""
+    base = _base_df(n=1000, seed=22)
+    dt = DTable.from_pandas(dctx, base)
+
+    def qa(t):
+        s = shuffle_table(t["fact"], ["k"])
+        return dist_groupby(s, ["k"], [("v", "sum")])
+
+    def qb(t):
+        s = shuffle_table(t["fact"], ["k"])
+        return dist_groupby(s, ["k"], [("w", "max")])
+
+    def qc(t):
+        s = shuffle_table(t["fact"], ["k"])
+        return dist_groupby(s, ["k"], [("v", "count"), ("w", "min")])
+
+    with ServeSession(dctx, tables={"fact": dt},
+                      batch_window_ms=80.0) as s:
+        # window 1: qa + qb co-admitted -> the shuffle subplan shares
+        first = _burst_pair(s, qa, qb)
+        # window 2: a DIFFERENT fingerprint with the same prefix
+        h3 = s.submit(qc, label="carry")
+        r3 = _frame(h3.result(timeout=600))
+        st = s.stats()
+    for h, _ in first:
+        assert h.status == "done"
+    if st["subplan_shared"] >= 1:
+        # the carry contract only binds when window 1 actually shared
+        assert st["view_subplan_hits"] >= 1
+        assert trace.counters().get("serve.view_subplan_hits", 0) >= 1
+    _assert_rowset_equal(r3, _cold_agg(dctx, base, qfn=qc))
+
+
+def _burst_pair(s, qa, qb):
+    handles = []
+    hlock = threading.Lock()
+
+    def client(qfn, label):
+        h = s.submit(qfn, label=label)
+        with hlock:
+            handles.append(h)
+
+    threads = [threading.Thread(target=client, args=(q, n))
+               for q, n in ((qa, "qa"), (qb, "qb"))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return [(h, h.result(timeout=600)) for h in handles]
+
+
+# ---------------------------------------------------------------------------
+# fleet routing: live-view affinity
+# ---------------------------------------------------------------------------
+
+def test_router_prefers_replica_holding_live_view(dctx):
+    """FleetRouter placement: the replica whose view store holds a
+    live view for the fingerprint wins placement even when another
+    replica has plan-cache affinity."""
+    import jax
+
+    from cylon_tpu.serve.router import FleetRouter
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices for two replicas")
+    from cylon_tpu.context import CylonContext
+    half = len(devs) // 2
+    ctx_a = CylonContext({"backend": "dist", "devices": devs[:half]})
+    ctx_b = CylonContext({"backend": "dist", "devices": devs[half:]})
+    base = _base_df(n=400, seed=23)
+    sa = ServeSession(ctx_a,
+                      tables={"fact": DTable.from_pandas(ctx_a, base)},
+                      batch_window_ms=0.0, name="replica-a")
+    sb = ServeSession(ctx_b,
+                      tables={"fact": DTable.from_pandas(ctx_b, base)},
+                      batch_window_ms=0.0, name="replica-b")
+    try:
+        with FleetRouter([sa, sb]) as router:
+            # seed a live view on replica-b directly (not through the
+            # router, so no plan-cache affinity record points at b)
+            sb.submit(_q_sum, label="seed").result(timeout=600)
+            assert sb.holds_view(_q_sum) and not sa.holds_view(_q_sum)
+            h = router.submit(_q_sum, label="routed")
+            h.result(timeout=600)
+            assert h.view == "hit"   # placed on b, served from its view
+            assert trace.counters().get(
+                "serve.router_view_affinity_hits", 0) >= 1
+    finally:
+        sa.close()
+        sb.close()

@@ -652,6 +652,66 @@ def test_benchdiff_gates_sustain_family(tmp_path, capsys):
     assert benchdiff.main([t_old, t_new]) == 0
 
 
+def test_benchdiff_gates_mixed_family(tmp_path, capsys):
+    """The mixed read/write family (docs/serving.md "Materialized
+    subplans", CYLON_BENCH_MIXED): serve_mixed_qps and
+    serve_mixed_view_hit_ratio gate DOWN, serve_mixed_p99_ms gates UP;
+    the measured staleness is reported but never gates."""
+    old = _artifact(tmp_path, "mx_old.json",
+                    {"serve_mixed_qps": 50.0,
+                     "serve_mixed_view_hit_ratio": 0.9,
+                     "serve_mixed_p99_ms": 40.0,
+                     "serve_mixed_staleness_ms": 10.0})
+    new = _artifact(tmp_path, "mx_new.json",
+                    {"serve_mixed_qps": 20.0,              # collapsed
+                     "serve_mixed_view_hit_ratio": 0.2,    # invalidating
+                     "serve_mixed_p99_ms": 160.0,          # 4x tail
+                     "serve_mixed_staleness_ms": 10.0})
+    assert benchdiff.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "serve_mixed_qps" in out and "REGRESSED" in out
+    assert "serve_mixed_view_hit_ratio" in out
+    assert "serve_mixed_p99_ms" in out
+    better = _artifact(tmp_path, "mx_better.json",
+                       {"serve_mixed_qps": 80.0,
+                        "serve_mixed_view_hit_ratio": 0.95,
+                        "serve_mixed_p99_ms": 25.0,
+                        "serve_mixed_staleness_ms": 5.0})
+    assert benchdiff.main([old, better]) == 0
+    # staleness is UNGATED: batch-window sizing, not code quality —
+    # a big swing alone must stay clean
+    s_old = _artifact(tmp_path, "mxs_old.json",
+                      {"serve_mixed_staleness_ms": 5.0})
+    s_new = _artifact(tmp_path, "mxs_new.json",
+                      {"serve_mixed_staleness_ms": 500.0})
+    assert benchdiff.main([s_old, s_new]) == 0
+    # the ratio floor: a 0.02-scale wobble on the hit ratio is noise
+    r_old = _artifact(tmp_path, "mxr_old.json",
+                      {"serve_mixed_view_hit_ratio": 0.99})
+    r_new = _artifact(tmp_path, "mxr_new.json",
+                      {"serve_mixed_view_hit_ratio": 0.98})
+    assert benchdiff.main([r_old, r_new]) == 0
+
+
+def test_matview_metrics_catalogued():
+    """The materialized-view counters are documented catalogue entries
+    (the compliance sweeps reject uncatalogued bumps), and the fold
+    fault point is registered so chaos tests can arm it."""
+    for name in ("serve.view_hits", "serve.view_misses",
+                 "serve.view_folds", "serve.view_subplan_hits",
+                 "serve.router_view_affinity_hits",
+                 "matview.retained", "matview.declined",
+                 "matview.invalidations", "matview.folds",
+                 "matview.fold_rows", "matview.fold_failures",
+                 "matview.lost", "matview.subplans_retained"):
+        spec = observe.METRICS.get(name)
+        assert spec is not None, name
+        assert spec.kind == observe.COUNTER, name
+        assert spec.doc
+    from cylon_tpu import faults
+    assert "matview.fold" in faults.POINTS
+
+
 def test_telemetry_metrics_catalogued():
     """The telemetry-2.0 counters/gauges are documented catalogue
     entries (the compliance sweeps reject uncatalogued bumps)."""
